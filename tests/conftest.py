@@ -1,0 +1,13 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's single-process multi-node testing strategy
+(DistributedQueryRunner boots N servers in one JVM — SURVEY.md §4): we boot an
+8-device CPU topology in one process via XLA host-platform device count, so
+all sharding/collective paths compile and execute without TPU hardware.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

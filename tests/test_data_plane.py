@@ -1,0 +1,102 @@
+"""Types + Page/Column + serde golden tests (SURVEY.md §7.2 step 1)."""
+import datetime
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.data import Column, Dictionary, Page
+from trino_tpu.data.serde import CODEC_NONE, deserialize_page, serialize_page
+
+
+def test_parse_types():
+    assert T.parse_type("bigint") is T.BIGINT
+    assert T.parse_type("decimal(15,2)").scale == 2
+    assert T.parse_type("varchar(25)").length == 25
+    assert T.parse_type("double") is T.DOUBLE
+    with pytest.raises(ValueError):
+        T.parse_type("frobnicate")
+
+
+def test_common_super_type():
+    assert T.common_super_type(T.INTEGER, T.BIGINT) == T.BIGINT
+    assert T.common_super_type(T.BIGINT, T.DOUBLE) == T.DOUBLE
+    d = T.common_super_type(T.decimal(15, 2), T.decimal(10, 4))
+    assert (d.precision, d.scale) == (17, 4)
+    assert T.common_super_type(T.UNKNOWN, T.DATE) == T.DATE
+    assert T.common_super_type(T.BOOLEAN, T.BIGINT) is None
+
+
+def test_column_roundtrip_fixed_width():
+    col = Column.from_python(T.BIGINT, [1, 2, None, 4])
+    assert col.to_python() == [1, 2, None, 4]
+    col = Column.from_python(T.DOUBLE, [1.5, -2.25])
+    assert col.to_python() == [1.5, -2.25]
+    col = Column.from_python(T.BOOLEAN, [True, None, False])
+    assert col.to_python() == [True, None, False]
+
+
+def test_column_roundtrip_date_decimal():
+    col = Column.from_python(T.DATE, ["1994-01-01", datetime.date(1998, 12, 1), None])
+    assert col.to_python() == [datetime.date(1994, 1, 1), datetime.date(1998, 12, 1), None]
+    dec = T.decimal(15, 2)
+    col = Column.from_python(dec, ["1.50", "-7.25", None])
+    assert col.to_python() == [Decimal("1.50"), Decimal("-7.25"), None]
+    assert np.asarray(col.values)[:2].tolist() == [150, -725]
+
+
+def test_varchar_dictionary_order():
+    col = Column.from_python(T.VARCHAR, ["beta", "alpha", None, "beta", "gamma"])
+    assert col.to_python() == ["beta", "alpha", None, "beta", "gamma"]
+    # dictionary codes preserve string order (dictionary-first design)
+    d = col.dictionary
+    assert d.values == sorted(d.values)
+    assert d.code_of("alpha") < d.code_of("beta") < d.code_of("gamma")
+
+
+def test_page_sel_mask():
+    import jax.numpy as jnp
+
+    page = Page.from_pydict(
+        {"a": T.BIGINT, "b": T.VARCHAR},
+        {"a": [1, 2, 3], "b": ["x", "y", "z"]},
+    )
+    assert page.num_rows == 3 and page.channel_count == 2
+    page.sel = jnp.asarray(np.array([True, False, True]))
+    assert page.live_count() == 2
+    assert page.to_pylist() == [(1, "x"), (3, "z")]
+
+
+@pytest.mark.parametrize("codec", [CODEC_NONE, 1])
+def test_serde_roundtrip(codec):
+    page = Page.from_pydict(
+        {
+            "k": T.BIGINT,
+            "s": T.VARCHAR,
+            "d": T.DATE,
+            "m": T.decimal(15, 2),
+            "f": T.DOUBLE,
+        },
+        {
+            "k": [10, None, 30],
+            "s": ["foo", "bar", None],
+            "d": ["1995-03-15", None, "1992-01-02"],
+            "m": ["1.10", "2.20", None],
+            "f": [0.5, None, -1.0],
+        },
+    )
+    blob = serialize_page(page, codec=codec)
+    back = deserialize_page(blob)
+    assert back.num_rows == 3
+    for orig, rt in zip(page.columns, back.columns):
+        assert str(orig.type) == str(rt.type)
+        assert orig.to_python() == rt.to_python()
+
+
+def test_dictionary_recode():
+    a = Dictionary.build(["apple", "pear"])
+    b = Dictionary.build(["pear", "apple", "fig"])
+    table = a.recode_table(b)
+    assert b.decode_one(table[a.code_of("apple")]) == "apple"
+    assert b.decode_one(table[a.code_of("pear")]) == "pear"

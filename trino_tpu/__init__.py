@@ -1,0 +1,30 @@
+"""trino_tpu: a TPU-native distributed SQL query engine.
+
+A ground-up re-design of the capabilities of Trino (reference:
+losipiuk/trino, studied in SURVEY.md) for TPU hardware:
+
+- The columnar data plane (reference ``core/trino-spi/.../spi/Page.java``,
+  ``spi/block/*``) becomes device-resident struct-of-arrays batches of
+  ``jax.Array`` columns with validity masks (``trino_tpu.data``).
+- Query-time bytecode generation (reference ``sql/gen/ExpressionCompiler.java``)
+  becomes tracing + ``jax.jit``: expression IR lowers to jax ops and XLA fuses
+  the filter/project pipeline (``trino_tpu.ops.expr_lower``).
+- Hash aggregation / hash join (reference ``operator/HashAggregationOperator``,
+  ``operator/join/``) become vectorized sort/segment and lookup kernels that
+  map onto the MXU/VPU (``trino_tpu.ops``).
+- The repartition shuffle (reference ``operator/output/PartitionedOutputOperator``)
+  becomes XLA ``all_to_all`` over ICI inside ``shard_map`` programs
+  (``trino_tpu.parallel``).
+- Everything sits behind a connector SPI (reference ``core/trino-spi``):
+  ``trino_tpu.connector``.
+"""
+
+import jax
+
+# SQL semantics require 64-bit integers (BIGINT) and doubles. JAX defaults to
+# 32-bit; enable x64 before any arrays are created.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from trino_tpu.client.session import Session, execute  # noqa: E402,F401

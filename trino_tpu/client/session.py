@@ -1,0 +1,37 @@
+"""User-facing entry point: Session.execute(sql) -> rows.
+
+Reference: the client protocol stack (client/trino-client
+``StatementClientV1.java:70``) collapsed to an in-process call for the local
+engine; the HTTP coordinator/worker protocol is the distributed tier
+(trino_tpu.server, later rounds).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Session:
+    """A query session: catalogs, session properties, and an executor."""
+
+    def __init__(self, properties: Optional[Dict[str, Any]] = None, num_partitions: int = 1):
+        from trino_tpu.connector.registry import default_catalogs
+
+        self.catalogs = default_catalogs()
+        self.properties: Dict[str, Any] = dict(properties or {})
+        self.num_partitions = num_partitions
+
+    def execute(self, sql: str):
+        """Run a query; returns a QueryResult (column names + Python rows)."""
+        from trino_tpu.exec.query import run_query
+
+        return run_query(self, sql)
+
+    def explain(self, sql: str, mode: str = "logical") -> str:
+        from trino_tpu.exec.query import explain_query
+
+        return explain_query(self, sql, mode)
+
+
+def execute(sql: str, **kwargs) -> List[Tuple]:
+    """One-shot convenience: execute sql in a fresh session, return rows."""
+    return Session(**kwargs).execute(sql).rows

@@ -1,0 +1,11 @@
+"""Columnar data plane: device-resident struct-of-arrays batches.
+
+Reference: ``core/trino-spi/.../spi/Page.java`` (Page = Block[] + positionCount)
+and the Block hierarchy (``spi/block/``). Here a Page is a list of Columns;
+each Column is one ``jax.Array`` of values plus an optional null mask array;
+varchar columns carry a host-side Dictionary.
+"""
+from trino_tpu.data.dictionary import Dictionary
+from trino_tpu.data.page import Column, Page
+
+__all__ = ["Dictionary", "Column", "Page"]

@@ -1,0 +1,157 @@
+"""Page/Column: the device-resident columnar batch.
+
+Reference: ``core/trino-spi/.../spi/Page.java:31`` (Page = Block[] +
+positionCount) and the Block hierarchy ``spi/block/`` (LongArrayBlock,
+IntArrayBlock, VariableWidthBlock, DictionaryBlock, null masks per block).
+
+TPU-first differences (SURVEY.md §7.1):
+- A Column is a struct-of-arrays: ``values: jax.Array`` (+ optional
+  ``nulls: jax.Array`` of bool, True = NULL) instead of a class hierarchy.
+- Varchar values are int32 dictionary codes; the Dictionary lives host-side.
+- A Page may carry a *selection mask* (``sel``) instead of being compacted:
+  filters AND into ``sel`` so shapes stay static for XLA (no data-dependent
+  compaction inside jit).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.data.dictionary import NULL_CODE, Dictionary
+
+
+@dataclasses.dataclass
+class Column:
+    type: T.Type
+    values: jnp.ndarray  # device array; int32 codes when type.is_varchar
+    nulls: Optional[jnp.ndarray] = None  # bool[n], True where NULL; None = no nulls
+    dictionary: Optional[Dictionary] = None  # required when type.is_varchar
+
+    def __post_init__(self):
+        if self.type.is_varchar and self.dictionary is None:
+            raise ValueError("varchar column requires a dictionary")
+
+    def __len__(self) -> int:
+        return int(self.values.shape[0])
+
+    @classmethod
+    def from_python(cls, typ: T.Type, data: Sequence) -> "Column":
+        """Build a column from Python values (None = NULL). Host -> device."""
+        n = len(data)
+        has_null = any(v is None for v in data)
+        nulls = (
+            jnp.asarray(np.array([v is None for v in data], dtype=np.bool_))
+            if has_null
+            else None
+        )
+        if typ.is_varchar:
+            d = Dictionary.build(data)
+            codes = d.encode(list(data))
+            return cls(typ, jnp.asarray(codes), nulls, d)
+        np_dtype = typ.np_dtype
+        assert np_dtype is not None, f"unsupported type {typ}"
+        fill = 0
+        arr = np.array([fill if v is None else _to_repr(typ, v) for v in data], dtype=np_dtype)
+        if n == 0:
+            arr = np.empty(0, dtype=np_dtype)
+        return cls(typ, jnp.asarray(arr), nulls, None)
+
+    def to_python(self) -> List:
+        """Device -> host, decoding reprs back to Python values."""
+        vals = np.asarray(self.values)
+        nulls = np.asarray(self.nulls) if self.nulls is not None else None
+        if self.type.is_varchar:
+            assert self.dictionary is not None
+            out = self.dictionary.decode(vals)
+            if nulls is not None:
+                out = [None if isnull else v for v, isnull in zip(out, nulls)]
+            return out
+        out = [_from_repr(self.type, v) for v in vals.tolist()]
+        if nulls is not None:
+            out = [None if isnull else v for v, isnull in zip(out, nulls)]
+        return out
+
+
+def _to_repr(typ: T.Type, v):
+    """Python value -> device representation (int days, scaled int, ...)."""
+    if typ == T.DATE:
+        if isinstance(v, str):
+            import datetime
+
+            d = datetime.date.fromisoformat(v)
+            return (d - datetime.date(1970, 1, 1)).days
+        import datetime
+
+        if isinstance(v, datetime.date):
+            return (v - datetime.date(1970, 1, 1)).days
+        return int(v)
+    if typ.is_decimal:
+        assert isinstance(typ, T.DecimalType)
+        from decimal import Decimal
+
+        return int(Decimal(str(v)).scaleb(typ.scale).to_integral_value())
+    if typ == T.BOOLEAN:
+        return bool(v)
+    if typ.is_floating:
+        return float(v)
+    return int(v)
+
+
+def _from_repr(typ: T.Type, r):
+    if typ == T.DATE:
+        import datetime
+
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=int(r))
+    if typ.is_decimal:
+        assert isinstance(typ, T.DecimalType)
+        from decimal import Decimal
+
+        return Decimal(r).scaleb(-typ.scale)
+    if typ == T.BOOLEAN:
+        return bool(r)
+    if typ.is_floating:
+        return float(r)
+    return int(r)
+
+
+@dataclasses.dataclass
+class Page:
+    """A batch of rows: one Column per channel + optional selection mask.
+
+    ``sel`` (bool[n], True = row is live) realizes filtering without
+    compaction — XLA-friendly static shapes (SURVEY.md §7.3 item 1). ``None``
+    means all rows live.
+    """
+
+    columns: List[Column]
+    sel: Optional[jnp.ndarray] = None
+
+    @property
+    def num_rows(self) -> int:
+        return 0 if not self.columns else len(self.columns[0])
+
+    @property
+    def channel_count(self) -> int:
+        return len(self.columns)
+
+    @classmethod
+    def from_pydict(cls, schema: Dict[str, T.Type], data: Dict[str, Sequence]) -> "Page":
+        return cls([Column.from_python(t, data[name]) for name, t in schema.items()])
+
+    def live_count(self) -> int:
+        if self.sel is None:
+            return self.num_rows
+        return int(jnp.sum(self.sel))
+
+    def to_pylist(self) -> List[tuple]:
+        """Materialize live rows as Python tuples (host side, test/CLI path)."""
+        cols = [c.to_python() for c in self.columns]
+        n = self.num_rows
+        if self.sel is not None:
+            live = np.asarray(self.sel)
+            return [tuple(col[i] for col in cols) for i in range(n) if live[i]]
+        return [tuple(col[i] for col in cols) for i in range(n)]

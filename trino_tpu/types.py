@@ -1,0 +1,197 @@
+"""SQL type system.
+
+Reference: Trino's SPI types (``core/trino-spi/src/main/java/io/trino/spi/type/``,
+~90 files: BigintType, IntegerType, DoubleType, BooleanType, VarcharType,
+DateType, DecimalType via Int128, TimestampType, ...). Here each SQL type maps
+to a fixed-width device representation (TPUs want fixed-width):
+
+- BOOLEAN            -> bool_
+- TINYINT/SMALLINT/INTEGER/BIGINT -> int8/int16/int32/int64
+- REAL/DOUBLE        -> float32/float64
+- DATE               -> int32 (days since 1970-01-01)
+- TIMESTAMP(6)       -> int64 (microseconds since epoch)
+- DECIMAL(p<=18, s)  -> int64 scaled by 10**s  (reference: short decimal;
+                        long decimal Int128 is emulated with 2x int64 limbs
+                        in ops/int128.py when p > 18)
+- VARCHAR/CHAR       -> int32 dictionary codes; the dictionary (the actual
+                        UTF-8 strings) lives host-side (data/dictionary.py).
+                        TPUs excel at fixed width; strings are dictionary-first
+                        (SURVEY.md §7.1).
+
+Nulls are carried out-of-band as boolean masks on columns, three-valued logic
+is implemented in the expression lowering (ops/expr_lower.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """A SQL type. Instances are interned/compared by value."""
+
+    name: str  # canonical SQL name, e.g. "bigint", "varchar", "decimal(15,2)"
+    np_dtype: Optional[np.dtype]  # device representation; None => not yet supported
+    comparable: bool = True
+    orderable: bool = True
+
+    def __str__(self) -> str:
+        return self.name
+
+    @property
+    def is_varchar(self) -> bool:
+        return self.name.startswith("varchar") or self.name.startswith("char")
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.name.startswith("decimal")
+
+    @property
+    def is_integer_kind(self) -> bool:
+        return self.name in ("tinyint", "smallint", "integer", "bigint")
+
+    @property
+    def is_floating(self) -> bool:
+        return self.name in ("real", "double")
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer_kind or self.is_floating or self.is_decimal
+
+
+BOOLEAN = Type("boolean", np.dtype(np.bool_))
+TINYINT = Type("tinyint", np.dtype(np.int8))
+SMALLINT = Type("smallint", np.dtype(np.int16))
+INTEGER = Type("integer", np.dtype(np.int32))
+BIGINT = Type("bigint", np.dtype(np.int64))
+REAL = Type("real", np.dtype(np.float32))
+DOUBLE = Type("double", np.dtype(np.float64))
+DATE = Type("date", np.dtype(np.int32))
+# TIMESTAMP(6) — microsecond precision, the engine default (reference supports
+# p in 0..12; picosecond tails are a later round).
+TIMESTAMP = Type("timestamp(6)", np.dtype(np.int64))
+UNKNOWN = Type("unknown", None)  # type of NULL literal before coercion
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(Type):
+    precision: int = 38
+    scale: int = 0
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def decimal(precision: int, scale: int) -> DecimalType:
+    if not 1 <= precision <= 38:
+        raise ValueError(f"decimal precision out of range: {precision}")
+    # p <= 18: scaled int64 ("short decimal"). p > 18: still int64 limbs here;
+    # full Int128 limb arithmetic (reference Int128Math.java) lives in
+    # ops/int128.py and is engaged by the expression lowering when needed.
+    return DecimalType(
+        name=f"decimal({precision},{scale})",
+        np_dtype=np.dtype(np.int64),
+        precision=precision,
+        scale=scale,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class VarcharType(Type):
+    length: Optional[int] = None  # None = unbounded
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def varchar(length: Optional[int] = None) -> VarcharType:
+    name = "varchar" if length is None else f"varchar({length})"
+    return VarcharType(name=name, np_dtype=np.dtype(np.int32), length=length)
+
+
+def char(length: int) -> VarcharType:
+    # CHAR semantics (pad/compare) are normalized to varchar at load time.
+    return VarcharType(name=f"char({length})", np_dtype=np.dtype(np.int32), length=length)
+
+
+VARCHAR = varchar()
+
+
+def parse_type(s: str) -> Type:
+    """Parse a SQL type string, e.g. ``decimal(15,2)``, ``varchar(25)``."""
+    s = s.strip().lower()
+    simple = {
+        "boolean": BOOLEAN,
+        "tinyint": TINYINT,
+        "smallint": SMALLINT,
+        "int": INTEGER,
+        "integer": INTEGER,
+        "bigint": BIGINT,
+        "real": REAL,
+        "double": DOUBLE,
+        "double precision": DOUBLE,
+        "date": DATE,
+        "timestamp": TIMESTAMP,
+        "timestamp(6)": TIMESTAMP,
+        "varchar": VARCHAR,
+        "unknown": UNKNOWN,
+    }
+    if s in simple:
+        return simple[s]
+    if s.startswith("decimal(") and s.endswith(")"):
+        p, sc = s[len("decimal(") : -1].split(",")
+        return decimal(int(p), int(sc))
+    if s.startswith("varchar(") and s.endswith(")"):
+        return varchar(int(s[len("varchar(") : -1]))
+    if s.startswith("char(") and s.endswith(")"):
+        return char(int(s[len("char(") : -1]))
+    raise ValueError(f"unknown type: {s}")
+
+
+# ---------------------------------------------------------------------------
+# Type coercion (reference: io.trino.type.TypeCoercion / function resolution in
+# core/trino-main/.../metadata — simplified numeric promotion lattice).
+# ---------------------------------------------------------------------------
+
+_INT_ORDER = ["tinyint", "smallint", "integer", "bigint"]
+
+
+def common_super_type(a: Type, b: Type) -> Optional[Type]:
+    """Least common type two operands coerce to, or None if incompatible."""
+    if a == b:
+        return a
+    if a == UNKNOWN:
+        return b
+    if b == UNKNOWN:
+        return a
+    if a.is_integer_kind and b.is_integer_kind:
+        ia, ib = _INT_ORDER.index(a.name), _INT_ORDER.index(b.name)
+        return parse_type(_INT_ORDER[max(ia, ib)])
+    if a.is_floating and b.is_floating:
+        return DOUBLE
+    if (a.is_floating and b.is_numeric) or (b.is_floating and a.is_numeric):
+        return DOUBLE if DOUBLE in (a, b) or a.is_decimal or b.is_decimal else REAL
+    if a.is_decimal and b.is_integer_kind:
+        return _decimal_int_super(a, b)
+    if b.is_decimal and a.is_integer_kind:
+        return _decimal_int_super(b, a)
+    if a.is_decimal and b.is_decimal:
+        assert isinstance(a, DecimalType) and isinstance(b, DecimalType)
+        scale = max(a.scale, b.scale)
+        ip = max(a.precision - a.scale, b.precision - b.scale)
+        return decimal(min(38, ip + scale), scale)
+    if a.is_varchar and b.is_varchar:
+        return VARCHAR
+    if {a.name, b.name} == {"date", "timestamp(6)"}:
+        return TIMESTAMP
+    return None
+
+
+def _decimal_int_super(d: Type, i: Type) -> Type:
+    assert isinstance(d, DecimalType)
+    int_digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}[i.name]
+    ip = max(d.precision - d.scale, int_digits)
+    return decimal(min(38, ip + d.scale), d.scale)
