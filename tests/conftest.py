@@ -4,10 +4,17 @@ Mirrors the reference's single-process multi-node testing strategy
 (DistributedQueryRunner boots N servers in one JVM — SURVEY.md §4): we boot an
 8-device CPU topology in one process via XLA host-platform device count, so
 all sharding/collective paths compile and execute without TPU hardware.
+
+Note: this image's axon sitecustomize force-registers the TPU-tunnel backend
+by setting the jax_platforms *config* (env vars don't win) — we override the
+config back to cpu before any backend initializes.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,144 @@
+"""End-to-end TPC-H query tests against the independent Python oracle.
+
+Reference test-strategy analog: the DistributedQueryRunner + TPCH connector +
+H2 oracle combination (SURVEY.md §4) — here local engine + TPCH generator +
+pure-Python oracle, exact comparison (bit-identical decimals).
+"""
+import pytest
+
+from tests import tpch_oracle as oracle
+from trino_tpu import Session
+
+Q1 = """
+select
+    l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+Q3 = """
+select l_orderkey,
+    sum(l_extendedprice * (1 - l_discount)) as revenue,
+    o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+    and c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and o_orderdate < date '1995-03-15'
+    and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+"""
+
+Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+    and l_shipdate < date '1994-01-01' + interval '1' year
+    and l_discount between 0.06 - 0.01 and 0.06 + 0.01
+    and l_quantity < 24
+"""
+
+Q5 = """
+select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem, supplier, nation, region
+where c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and l_suppkey = s_suppkey
+    and c_nationkey = s_nationkey
+    and s_nationkey = n_nationkey
+    and n_regionkey = r_regionkey
+    and r_name = 'ASIA'
+    and o_orderdate >= date '1994-01-01'
+    and o_orderdate < date '1994-01-01' + interval '1' year
+group by n_name
+order by revenue desc
+"""
+
+Q18 = """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice, sum(l_quantity)
+from customer, orders, lineitem
+where o_orderkey in (
+        select l_orderkey from lineitem
+        group by l_orderkey
+        having sum(l_quantity) > 300)
+    and c_custkey = o_custkey
+    and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate
+limit 100
+"""
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session()
+
+
+def test_q1(session):
+    got = session.execute(Q1).rows
+    assert got == oracle.q1()
+
+
+def test_q3(session):
+    got = session.execute(Q3).rows
+    expected = oracle.q3()
+    assert got == expected
+
+
+def test_q6(session):
+    got = session.execute(Q6).rows
+    assert got == oracle.q6()
+
+
+def test_q5(session):
+    got = session.execute(Q5).rows
+    expected = [(n, v) for n, v in oracle.q5()]
+    assert got == expected
+
+
+def test_q18(session):
+    got = session.execute(Q18).rows
+    assert got == oracle.q18()
+
+
+def test_simple_select_where(session):
+    r = session.execute(
+        "select n_name, n_nationkey from nation where n_regionkey = 1 order by n_name"
+    )
+    assert r.rows == [
+        ("ARGENTINA", 1), ("BRAZIL", 2), ("CANADA", 3), ("PERU", 17), ("UNITED STATES", 24),
+    ]
+
+
+def test_explicit_join(session):
+    r = session.execute(
+        "select n_name, r_name from nation join region on n_regionkey = r_regionkey "
+        "where n_name like 'A%' order by n_name"
+    )
+    assert r.rows == [("ALGERIA", "AFRICA"), ("ARGENTINA", "AMERICA")]
+
+
+def test_limit_distinct(session):
+    r = session.execute("select distinct l_linestatus from lineitem order by 1")
+    assert r.rows == [("F",), ("O",)]
+    r = session.execute("select l_orderkey from lineitem limit 7")
+    assert len(r.rows) == 7
+
+
+def test_show_and_describe(session):
+    r = session.execute("show tables from tpch.tiny")
+    assert ("lineitem",) in r.rows
+    r = session.execute("describe tpch.tiny.nation")
+    assert ("n_nationkey", "bigint") in r.rows

@@ -1,0 +1,181 @@
+"""Independent reference implementation for TPC-H query results.
+
+Role of the H2 oracle in the reference test strategy (SURVEY.md §4:
+QueryAssertions.java:151-176 runs the same SQL against embedded H2 and
+diffs). Here: plain-Python row-at-a-time evaluation with exact Decimal
+arithmetic over the same generated data the engine scans — a fully
+independent code path from the vectorized device kernels.
+"""
+from __future__ import annotations
+
+import datetime
+from collections import defaultdict
+from decimal import Decimal
+
+from trino_tpu.connector.tpch import TpchConnector
+from trino_tpu.connector.tpch.generator import SCHEMAS
+
+
+def load_table(schema: str, table: str, columns=None):
+    """Table as list of dicts of Python values."""
+    conn = TpchConnector()
+    cols = columns or [n for n, _ in SCHEMAS[table]]
+    split = conn.get_splits(schema, table, 1)
+    from trino_tpu.data.page import Column
+
+    out = []
+    datas = [conn.scan(s, cols) for s in split]
+    col_lists = {}
+    for c in cols:
+        vals = []
+        for d in datas:
+            cd = d[c]
+            col = Column(cd.type, cd.values, None, cd.dictionary)
+            vals.extend(col.to_python())
+        col_lists[c] = vals
+    n = len(next(iter(col_lists.values())))
+    for i in range(n):
+        out.append({c: col_lists[c][i] for c in cols})
+    return out
+
+
+def d(s: str) -> datetime.date:
+    return datetime.date.fromisoformat(s)
+
+
+def q1(schema="tiny"):
+    rows = load_table(
+        schema,
+        "lineitem",
+        [
+            "l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+            "l_discount", "l_tax", "l_shipdate",
+        ],
+    )
+    cutoff = d("1998-12-01") - datetime.timedelta(days=90)
+    groups = defaultdict(lambda: {
+        "sum_qty": Decimal(0), "sum_base": Decimal(0), "sum_disc": Decimal(0),
+        "sum_charge": Decimal(0), "sum_disc_only": Decimal(0), "count": 0,
+    })
+    for r in rows:
+        if r["l_shipdate"] > cutoff:
+            continue
+        g = groups[(r["l_returnflag"], r["l_linestatus"])]
+        g["sum_qty"] += r["l_quantity"]
+        g["sum_base"] += r["l_extendedprice"]
+        disc_price = r["l_extendedprice"] * (1 - r["l_discount"])
+        g["sum_disc"] += disc_price
+        g["sum_charge"] += disc_price * (1 + r["l_tax"])
+        g["sum_disc_only"] += r["l_discount"]
+        g["count"] += 1
+
+    def avg_dec(total, cnt, scale):
+        # decimal avg rounds half-up at the input scale
+        q = (total / cnt).quantize(Decimal(1).scaleb(-scale), rounding="ROUND_HALF_UP")
+        return q
+
+    out = []
+    for (rf, ls), g in sorted(groups.items()):
+        out.append(
+            (
+                rf, ls, g["sum_qty"], g["sum_base"], g["sum_disc"], g["sum_charge"],
+                avg_dec(g["sum_qty"], g["count"], 2),
+                avg_dec(g["sum_base"], g["count"], 2),
+                avg_dec(g["sum_disc_only"], g["count"], 2),
+                g["count"],
+            )
+        )
+    return out
+
+
+def q3(schema="tiny", limit=10):
+    cust = load_table(schema, "customer", ["c_custkey", "c_mktsegment"])
+    orders = load_table(schema, "orders", ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"])
+    li = load_table(schema, "lineitem", ["l_orderkey", "l_extendedprice", "l_discount", "l_shipdate"])
+    building = {c["c_custkey"] for c in cust if c["c_mktsegment"] == "BUILDING"}
+    cut = d("1995-03-15")
+    omap = {
+        o["o_orderkey"]: o
+        for o in orders
+        if o["o_custkey"] in building and o["o_orderdate"] < cut
+    }
+    groups = defaultdict(Decimal)
+    meta = {}
+    for r in li:
+        if r["l_shipdate"] <= cut:
+            continue
+        o = omap.get(r["l_orderkey"])
+        if o is None:
+            continue
+        groups[r["l_orderkey"]] += r["l_extendedprice"] * (1 - r["l_discount"])
+        meta[r["l_orderkey"]] = (o["o_orderdate"], o["o_shippriority"])
+    rows = [
+        (k, rev, meta[k][0], meta[k][1]) for k, rev in groups.items()
+    ]
+    rows.sort(key=lambda t: (-t[1], t[2]))
+    return rows[:limit]
+
+
+def q6(schema="tiny"):
+    li = load_table(schema, "lineitem", ["l_extendedprice", "l_discount", "l_quantity", "l_shipdate"])
+    lo, hi = d("1994-01-01"), d("1995-01-01")
+    total = Decimal(0)
+    for r in li:
+        if (
+            lo <= r["l_shipdate"] < hi
+            and Decimal("0.05") <= r["l_discount"] <= Decimal("0.07")
+            and r["l_quantity"] < 24
+        ):
+            total += r["l_extendedprice"] * r["l_discount"]
+    return [(total,)]
+
+
+def q18(schema="tiny", limit=100):
+    cust = load_table(schema, "customer", ["c_custkey", "c_name"])
+    orders = load_table(schema, "orders", ["o_orderkey", "o_custkey", "o_orderdate", "o_totalprice"])
+    li = load_table(schema, "lineitem", ["l_orderkey", "l_quantity"])
+    qty = defaultdict(Decimal)
+    for r in li:
+        qty[r["l_orderkey"]] += r["l_quantity"]
+    big = {k for k, v in qty.items() if v > 300}
+    cmap = {c["c_custkey"]: c["c_name"] for c in cust}
+    rows = []
+    for o in orders:
+        if o["o_orderkey"] not in big:
+            continue
+        rows.append(
+            (
+                cmap[o["o_custkey"]], o["o_custkey"], o["o_orderkey"],
+                o["o_orderdate"], o["o_totalprice"], qty[o["o_orderkey"]],
+            )
+        )
+    rows.sort(key=lambda t: (-t[4], t[3]))
+    return rows[:limit]
+
+
+def q5(schema="tiny"):
+    region = load_table(schema, "region", ["r_regionkey", "r_name"])
+    nation = load_table(schema, "nation", ["n_nationkey", "n_name", "n_regionkey"])
+    cust = load_table(schema, "customer", ["c_custkey", "c_nationkey"])
+    orders = load_table(schema, "orders", ["o_orderkey", "o_custkey", "o_orderdate"])
+    supp = load_table(schema, "supplier", ["s_suppkey", "s_nationkey"])
+    li = load_table(schema, "lineitem", ["l_orderkey", "l_suppkey", "l_extendedprice", "l_discount"])
+    asia = {r["r_regionkey"] for r in region if r["r_name"] == "ASIA"}
+    nmap = {n["n_nationkey"]: n["n_name"] for n in nation if n["n_regionkey"] in asia}
+    cnat = {c["c_custkey"]: c["c_nationkey"] for c in cust if c["c_nationkey"] in nmap}
+    lo, hi = d("1994-01-01"), d("1995-01-01")
+    omap = {}
+    for o in orders:
+        if lo <= o["o_orderdate"] < hi and o["o_custkey"] in cnat:
+            omap[o["o_orderkey"]] = cnat[o["o_custkey"]]
+    snat = {s["s_suppkey"]: s["s_nationkey"] for s in supp}
+    groups = defaultdict(Decimal)
+    for r in li:
+        cn = omap.get(r["l_orderkey"])
+        if cn is None:
+            continue
+        sn = snat.get(r["l_suppkey"])
+        if sn != cn:
+            continue
+        groups[nmap[cn]] += r["l_extendedprice"] * (1 - r["l_discount"])
+    return sorted(groups.items(), key=lambda t: -t[1])

@@ -1,0 +1,82 @@
+"""In-memory tables connector.
+
+Reference: ``plugin/trino-memory`` (3.7k LoC in-memory tables used heavily by
+tests). Tables are registered programmatically (round 1; CREATE TABLE AS in a
+later round) and served as single- or multi-split scans.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector import spi
+from trino_tpu.data.dictionary import Dictionary
+
+
+class MemoryConnector(spi.Connector):
+    name = "memory"
+
+    def __init__(self):
+        self._tables: Dict[Tuple[str, str], Tuple[spi.TableMetadata, Dict[str, spi.ColumnData]]] = {}
+
+    def create_table(self, schema: str, name: str, schema_def: Sequence[Tuple[str, T.Type]], rows: List[tuple]):
+        """Register a table from Python rows (None = NULL)."""
+        from trino_tpu.data.page import Column
+
+        cols: Dict[str, spi.ColumnData] = {}
+        for i, (cname, ctype) in enumerate(schema_def):
+            pycol = [r[i] for r in rows]
+            col = Column.from_python(ctype, pycol)
+            cols[cname] = spi.ColumnData(
+                ctype,
+                np.asarray(col.values),
+                np.asarray(col.nulls) if col.nulls is not None else None,
+                col.dictionary,
+            )
+        meta = spi.TableMetadata(
+            schema, name, [spi.ColumnMetadata(n, t) for n, t in schema_def]
+        )
+        self._tables[(schema, name)] = (meta, cols)
+
+    def list_schemas(self) -> List[str]:
+        return sorted({s for s, _ in self._tables} | {"default"})
+
+    def list_tables(self, schema: str) -> List[str]:
+        return sorted(n for s, n in self._tables if s == schema)
+
+    def get_table(self, schema: str, table: str) -> Optional[spi.TableMetadata]:
+        entry = self._tables.get((schema, table))
+        return entry[0] if entry else None
+
+    def table_row_count(self, schema: str, table: str) -> Optional[int]:
+        entry = self._tables.get((schema, table))
+        if not entry:
+            return None
+        _, cols = entry
+        first = next(iter(cols.values()), None)
+        return 0 if first is None else len(first.values)
+
+    def get_splits(self, schema: str, table: str, target_splits: int) -> List[spi.Split]:
+        n = self.table_row_count(schema, table) or 0
+        target_splits = max(1, min(target_splits, max(n, 1)))
+        bounds = [n * i // target_splits for i in range(target_splits + 1)]
+        return [
+            spi.Split(table, schema, bounds[i], bounds[i + 1])
+            for i in range(target_splits)
+            if bounds[i] < bounds[i + 1] or n == 0
+        ] or [spi.Split(table, schema, 0, 0)]
+
+    def scan(self, split: spi.Split, columns: List[str]) -> Dict[str, spi.ColumnData]:
+        _, cols = self._tables[(split.schema, split.table)]
+        out = {}
+        for c in columns:
+            cd = cols[c]
+            out[c] = spi.ColumnData(
+                cd.type,
+                cd.values[split.lo : split.hi],
+                cd.nulls[split.lo : split.hi] if cd.nulls is not None else None,
+                cd.dictionary,
+            )
+        return out

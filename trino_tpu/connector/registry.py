@@ -1,0 +1,18 @@
+"""Catalog registry.
+
+Reference: ``core/trino-main/.../metadata/CatalogManager`` + connector
+creation from ``etc/catalog/*.properties``. Round 1: built-in catalogs
+(tpch, memory); plugin-style registration hook for more.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from trino_tpu.connector.spi import Connector
+
+
+def default_catalogs() -> Dict[str, Connector]:
+    from trino_tpu.connector.memory.connector import MemoryConnector
+    from trino_tpu.connector.tpch import TpchConnector
+
+    return {"tpch": TpchConnector(), "memory": MemoryConnector()}

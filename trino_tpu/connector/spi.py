@@ -1,0 +1,96 @@
+"""Connector SPI.
+
+Reference: ``core/trino-spi/src/main/java/io/trino/spi/connector/`` —
+``ConnectorMetadata.java:80``, ``ConnectorSplitManager.java:19``,
+``ConnectorPageSource.java:24``. Round-1 surface: metadata (schemas, tables,
+columns, row-count stats), split enumeration (for distributed scans), and a
+page source that materializes a projected column subset of a split as numpy
+arrays (the engine moves them to device). Pushdown negotiation
+(applyFilter/TupleDomain) is a later round; the planner prunes projections
+already (``columns`` argument).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.data.dictionary import Dictionary
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnMetadata:
+    name: str
+    type: T.Type
+
+
+@dataclasses.dataclass(frozen=True)
+class TableMetadata:
+    schema: str
+    name: str
+    columns: Sequence[ColumnMetadata]
+
+    def column_index(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """A unit of scan parallelism (reference: spi/connector/ConnectorSplit).
+    ``lo``/``hi`` are connector-interpreted bounds (e.g. row or key range)."""
+
+    table: str
+    schema: str
+    lo: int
+    hi: int
+    info: object = None
+
+
+@dataclasses.dataclass
+class ColumnData:
+    """One scanned column: numpy values (+nulls) host-side; the executor
+    transfers to device. Varchar carries the dictionary."""
+
+    type: T.Type
+    values: np.ndarray
+    nulls: Optional[np.ndarray] = None
+    dictionary: Optional[Dictionary] = None
+
+
+class Connector:
+    """Reference: spi/Plugin.java -> ConnectorFactory -> Connector."""
+
+    name: str = "connector"
+
+    # --- metadata (ConnectorMetadata) ---
+    def list_schemas(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_tables(self, schema: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_table(self, schema: str, table: str) -> Optional[TableMetadata]:
+        raise NotImplementedError
+
+    def table_row_count(self, schema: str, table: str) -> Optional[int]:
+        """Stats for the cost-based optimizer (reference: spi/statistics/)."""
+        return None
+
+    def primary_key(self, schema: str, table: str) -> Optional[List[str]]:
+        """Unique key columns, if any — drives join build-side selection
+        (reference: uniqueness constraints via
+        spi/connector/ConnectorMetadata getTableProperties)."""
+        return None
+
+    # --- splits (ConnectorSplitManager) ---
+    def get_splits(self, schema: str, table: str, target_splits: int) -> List[Split]:
+        raise NotImplementedError
+
+    # --- data (ConnectorPageSource) ---
+    def scan(self, split: Split, columns: List[str]) -> Dict[str, ColumnData]:
+        raise NotImplementedError

@@ -1,0 +1,3 @@
+from trino_tpu.connector.tpch.connector import TpchConnector
+
+__all__ = ["TpchConnector"]

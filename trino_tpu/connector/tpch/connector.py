@@ -1,0 +1,88 @@
+"""TPC-H connector: schemas tiny/sf1/sf10/... over the stateless generator.
+
+Reference: ``plugin/trino-tpch`` (TpchMetadata.java:99 exposes schemas
+tiny/sf1/sf100/... whose scale factor is parsed from the schema name;
+TpchSplitManager splits by part ranges). Splits here are row ranges (order
+ranges for orders/lineitem), each generated independently.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from trino_tpu import types as T
+from trino_tpu.connector import spi
+from trino_tpu.connector.tpch import generator as gen
+
+_SCHEMA_SF = {
+    "tiny": 0.01,
+    "sf1": 1.0,
+    "sf10": 10.0,
+    "sf100": 100.0,
+    "sf300": 300.0,
+    "sf1000": 1000.0,
+}
+
+
+def schema_scale_factor(schema: str) -> float:
+    if schema in _SCHEMA_SF:
+        return _SCHEMA_SF[schema]
+    if schema.startswith("sf"):
+        return float(schema[2:].replace("_", "."))
+    raise KeyError(f"unknown tpch schema: {schema}")
+
+
+class TpchConnector(spi.Connector):
+    name = "tpch"
+
+    def list_schemas(self) -> List[str]:
+        return list(_SCHEMA_SF)
+
+    def list_tables(self, schema: str) -> List[str]:
+        schema_scale_factor(schema)
+        return list(gen.SCHEMAS)
+
+    def get_table(self, schema: str, table: str) -> Optional[spi.TableMetadata]:
+        try:
+            schema_scale_factor(schema)
+        except KeyError:
+            return None
+        if table not in gen.SCHEMAS:
+            return None
+        cols = [spi.ColumnMetadata(n, T.parse_type(t)) for n, t in gen.SCHEMAS[table]]
+        return spi.TableMetadata(schema, table, cols)
+
+    def table_row_count(self, schema: str, table: str) -> Optional[int]:
+        return gen.table_row_count(table, schema_scale_factor(schema))
+
+    _PRIMARY_KEYS = {
+        "region": ["r_regionkey"],
+        "nation": ["n_nationkey"],
+        "supplier": ["s_suppkey"],
+        "customer": ["c_custkey"],
+        "part": ["p_partkey"],
+        "partsupp": ["ps_partkey", "ps_suppkey"],
+        "orders": ["o_orderkey"],
+        "lineitem": ["l_orderkey", "l_linenumber"],
+    }
+
+    def primary_key(self, schema: str, table: str):
+        return self._PRIMARY_KEYS.get(table)
+
+    def get_splits(self, schema: str, table: str, target_splits: int) -> List[spi.Split]:
+        sf = schema_scale_factor(schema)
+        if table == "lineitem":
+            n = gen.table_row_count("orders", sf)  # order-range splits
+        else:
+            n = gen.table_row_count(table, sf)
+        target_splits = max(1, min(target_splits, n))
+        bounds = [n * i // target_splits for i in range(target_splits + 1)]
+        return [
+            spi.Split(table, schema, bounds[i], bounds[i + 1])
+            for i in range(target_splits)
+            if bounds[i] < bounds[i + 1]
+        ]
+
+    def scan(self, split: spi.Split, columns: List[str]) -> Dict[str, spi.ColumnData]:
+        sf = schema_scale_factor(split.schema)
+        data = gen.generate(split.table, sf, split.lo, split.hi, columns)
+        return {c: data[c] for c in columns}

@@ -1,0 +1,316 @@
+"""Local (single-device) plan executor.
+
+Reference: the worker execution engine — ``LocalExecutionPlanner.java:532``
+turning plan nodes into operator pipelines + ``Driver.java:372``'s page loop.
+TPU-first difference (SURVEY.md §7.1): no page-at-a-time pull loop — each
+plan node is a whole-column array transformation; XLA traces/fuses the
+per-node work, and data-dependent result sizes (group counts, sort/limit
+compaction) surface as one host-read scalar per materialization point.
+
+This eager executor is the correctness path; ``exec.compiled`` (bench path)
+jits whole fragments.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.data.page import Column, Page
+from trino_tpu.ops import aggregate as agg_ops
+from trino_tpu.ops import expr_lower as L
+from trino_tpu.ops import groupby as gb
+from trino_tpu.ops import join as join_ops
+from trino_tpu.ops import sort as sort_ops
+from trino_tpu.sql import ir
+from trino_tpu.sql.planner import plan as P
+
+
+class QueryError(RuntimeError):
+    pass
+
+
+def _check_errors(ctx: L.LowerCtx):
+    for code, flag in ctx.errors:
+        if bool(flag):
+            raise QueryError(code.replace("_", " ").capitalize())
+
+
+def _lower_expr(e: ir.Expr, page: Page) -> Tuple[L.LoweredVal, L.LowerCtx]:
+    ctx = L.LowerCtx(page.columns, page.num_rows)
+    out = L.lower(e, ctx)
+    # errors only matter on live rows
+    if ctx.errors and page.sel is not None:
+        ctx.errors = [(c, f) for c, f in ctx.errors]
+    _check_errors(ctx)
+    return out, ctx
+
+
+def _col_from_lowered(t: T.Type, lv: L.LoweredVal) -> Column:
+    nulls = None if lv.valid is None else ~lv.valid
+    return Column(t, lv.vals, nulls, lv.dictionary)
+
+
+def _col_to_lowered(c: Column) -> join_ops.Lowered:
+    return (c.values, None if c.nulls is None else ~c.nulls)
+
+
+class Executor:
+    def __init__(self, session):
+        self.session = session
+
+    def execute(self, node: P.PlanNode) -> Page:
+        method = getattr(self, f"_exec_{type(node).__name__}", None)
+        if method is None:
+            raise NotImplementedError(f"executor: {type(node).__name__}")
+        return method(node)
+
+    # ----------------------------------------------------------------- scan
+    def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
+        conn = self.session.catalogs[node.catalog]
+        splits = conn.get_splits(node.schema, node.table, 1)
+        datas = [conn.scan(s, node.column_names) for s in splits]
+        cols: List[Column] = []
+        for name, typ in zip(node.column_names, node.column_types):
+            parts = [d[name] for d in datas]
+            vals = np.concatenate([p.values for p in parts]) if len(parts) > 1 else parts[0].values
+            nulls = None
+            if any(p.nulls is not None for p in parts):
+                nulls = np.concatenate(
+                    [
+                        p.nulls if p.nulls is not None else np.zeros(len(p.values), bool)
+                        for p in parts
+                    ]
+                )
+            dictionary = parts[0].dictionary
+            cols.append(
+                Column(
+                    typ,
+                    jnp.asarray(vals),
+                    jnp.asarray(nulls) if nulls is not None else None,
+                    dictionary,
+                )
+            )
+        return Page(cols)
+
+    def _exec_ValuesNode(self, node: P.ValuesNode) -> Page:
+        cols = [
+            Column.from_python(t, [r[i] for r in node.rows])
+            for i, t in enumerate(node.types)
+        ]
+        if not cols:
+            # zero-column single row (SELECT without FROM)
+            return Page([Column(T.BIGINT, jnp.zeros(len(node.rows), dtype=jnp.int64))])
+        return Page(cols)
+
+    # --------------------------------------------------------------- filter
+    def _exec_FilterNode(self, node: P.FilterNode) -> Page:
+        page = self.execute(node.source)
+        lv, _ = _lower_expr(node.predicate, page)
+        passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
+        sel = passed if page.sel is None else (page.sel & passed)
+        return Page(page.columns, sel)
+
+    def _exec_ProjectNode(self, node: P.ProjectNode) -> Page:
+        page = self.execute(node.source)
+        cols = []
+        for e in node.expressions:
+            lv, _ = _lower_expr(e, page)
+            cols.append(_col_from_lowered(e.type, lv))
+        return Page(cols, page.sel)
+
+    # ---------------------------------------------------------- aggregation
+    def _exec_AggregationNode(self, node: P.AggregationNode) -> Page:
+        page = self.execute(node.source)
+        n = page.num_rows
+        keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
+        if node.group_channels:
+            gids, rep, num_groups_dev = gb.group_ids(keys, page.sel)
+            num_groups = int(num_groups_dev)
+            key_cols = gb.gather_group_keys(keys, rep)
+        else:
+            gids = jnp.zeros((max(n, 1),), dtype=jnp.int32)
+            num_groups = 1
+            key_cols = []
+        cap = max(n, 1)
+        out_cols: List[Column] = []
+        for i, c in enumerate(node.group_channels):
+            src = page.columns[c]
+            v, valid = key_cols[i]
+            nulls = None if valid is None else ~valid
+            out_cols.append(
+                Column(
+                    src.type,
+                    v[:num_groups],
+                    nulls[:num_groups] if nulls is not None else None,
+                    src.dictionary,
+                )
+            )
+        sel_for_agg = page.sel
+        if n == 0:
+            # pad a zero-row page so segment ops have shape (1,)
+            sel_for_agg = jnp.zeros((1,), dtype=bool)
+        for call in node.aggregates:
+            col = self._exec_aggregate(call, page, sel_for_agg, gids, cap, n)
+            out_cols.append(
+                Column(
+                    call.output_type,
+                    col[0][:num_groups],
+                    (~col[1][:num_groups]) if col[1] is not None else None,
+                    None,
+                )
+            )
+        return Page(out_cols)
+
+    def _exec_aggregate(self, call: P.AggregateCall, page, sel, gids, cap, n):
+        if call.distinct:
+            raise NotImplementedError("DISTINCT aggregates: round 2")
+        if call.function == "count" and call.arg_channel is None:
+            return agg_ops.agg_count_star(sel, gids, cap, max(n, 1))
+        arg_col = page.columns[call.arg_channel]
+        arg = _col_to_lowered(arg_col)
+        if n == 0:
+            arg = (jnp.zeros((1,), dtype=arg_col.values.dtype), jnp.zeros((1,), bool))
+        if call.function == "count":
+            return agg_ops.agg_count(arg, sel, gids, cap)
+        if call.function == "sum":
+            dt = call.output_type.np_dtype
+            return agg_ops.agg_sum(arg, sel, gids, cap, dt)
+        if call.function == "avg":
+            base = (
+                call.output_type.np_dtype
+                if call.output_type.is_decimal
+                else np.dtype(np.float64)
+            )
+            s, s_valid = agg_ops.agg_sum(arg, sel, gids, cap, base)
+            cnt, _ = agg_ops.agg_count(arg, sel, gids, cap)
+            return agg_ops.finish_avg(s, cnt, call.output_type)
+        if call.function == "min":
+            return agg_ops.agg_min(arg, sel, gids, cap)
+        if call.function == "max":
+            return agg_ops.agg_max(arg, sel, gids, cap)
+        raise NotImplementedError(call.function)
+
+    # -------------------------------------------------------------- joins
+    def _exec_JoinNode(self, node: P.JoinNode) -> Page:
+        left = self.execute(node.left)
+        right = self.execute(node.right)
+        if node.join_type in ("semi", "anti"):
+            return self._exec_semi(node, left, right)
+        if not node.left_keys:
+            return self._exec_singleton_cross(node, left, right)
+        build_key = join_ops.pack_keys(
+            [_col_to_lowered(right.columns[c]) for c in node.right_keys]
+        )
+        probe_key = join_ops.pack_keys(
+            [_col_to_lowered(left.columns[c]) for c in node.left_keys]
+        )
+        bk_sorted, b_rows, b_live = join_ops.build_side(build_key, right.sel)
+        rows, matched = join_ops.probe_unique(bk_sorted, b_rows, b_live, probe_key)
+        out_cols = list(left.columns)
+        for rc in right.columns:
+            v, valid = join_ops.gather_column(_col_to_lowered(rc), rows, matched)
+            out_cols.append(Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary))
+        if node.join_type == "inner":
+            sel = matched if left.sel is None else (left.sel & matched)
+        else:  # left outer: probe rows always survive; build cols null when unmatched
+            sel = left.sel
+        page = Page(out_cols, sel)
+        if node.filter is not None:
+            lv, _ = _lower_expr(node.filter, page)
+            passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
+            if node.join_type == "left":
+                raise NotImplementedError("filtered left join: round 2")
+            page = Page(out_cols, passed if page.sel is None else page.sel & passed)
+        return page
+
+    def _exec_semi(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        build = join_ops.pack_keys(
+            [_col_to_lowered(right.columns[c]) for c in node.right_keys]
+        )
+        probe = join_ops.pack_keys(
+            [_col_to_lowered(left.columns[c]) for c in node.left_keys]
+        )
+        hit = join_ops.membership(build, right.sel, probe)
+        keep = hit if node.join_type == "semi" else ~hit
+        sel = keep if left.sel is None else left.sel & keep
+        return Page(left.columns, sel)
+
+    def _exec_singleton_cross(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        """Cross join against a single-row relation (scalar subquery)."""
+        r_live = right.live_count()
+        if r_live != 1:
+            raise QueryError(
+                "Scalar sub-query has returned multiple rows"
+                if r_live > 1
+                else "Scalar sub-query returned no rows"  # SQL says NULL; round 2
+            )
+        n = left.num_rows
+        # find live row index host-side
+        if right.sel is None:
+            idx = 0
+        else:
+            idx = int(np.argmax(np.asarray(right.sel)))
+        out_cols = list(left.columns)
+        for rc in right.columns:
+            v = jnp.broadcast_to(rc.values[idx], (n,))
+            nulls = (
+                jnp.broadcast_to(rc.nulls[idx], (n,)) if rc.nulls is not None else None
+            )
+            out_cols.append(Column(rc.type, v, nulls, rc.dictionary))
+        page = Page(out_cols, left.sel)
+        if node.filter is not None:
+            lv, _ = _lower_expr(node.filter, page)
+            passed = lv.vals if lv.valid is None else lv.vals & lv.valid
+            page = Page(out_cols, passed if page.sel is None else page.sel & passed)
+        return page
+
+    # ------------------------------------------------------------- ordering
+    def _exec_SortNode(self, node: P.SortNode) -> Page:
+        page = self.execute(node.source)
+        return self._sorted_page(page, node.sort_channels)
+
+    def _sorted_page(self, page: Page, sort_channels, limit: Optional[int] = None) -> Page:
+        n = page.num_rows
+        keys = [
+            (_col_to_lowered(page.columns[c]), asc, nf) for c, asc, nf in sort_channels
+        ]
+        order = sort_ops.sort_order(keys, page.sel, n)
+        live = page.live_count()
+        if limit is not None:
+            live = min(live, limit)
+        order = order[:live]
+        cols = [
+            Column(
+                c.type,
+                c.values[order],
+                c.nulls[order] if c.nulls is not None else None,
+                c.dictionary,
+            )
+            for c in page.columns
+        ]
+        return Page(cols)
+
+    def _exec_TopNNode(self, node: P.TopNNode) -> Page:
+        page = self.execute(node.source)
+        return self._sorted_page(page, node.sort_channels, limit=node.count)
+
+    def _exec_LimitNode(self, node: P.LimitNode) -> Page:
+        page = self.execute(node.source)
+        return self._sorted_page(page, [], limit=node.count)
+
+    def _exec_OutputNode(self, node: P.OutputNode) -> Page:
+        return self.execute(node.source)
+
+
+@dataclasses.dataclass
+class QueryResult:
+    column_names: List[str]
+    columns: List[Column]
+    rows: List[tuple]
+
+    def __repr__(self):
+        return f"QueryResult({self.column_names}, {len(self.rows)} rows)"

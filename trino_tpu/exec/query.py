@@ -1,0 +1,95 @@
+"""Query lifecycle: parse -> analyze/plan -> optimize -> execute.
+
+Reference: ``execution/SqlQueryExecution.java:393`` (start -> analyze ->
+planQuery -> planDistribution -> schedule); collapsed here to the local path.
+EXPLAIN mirrors sql/planner/planprinter/PlanPrinter.
+"""
+from __future__ import annotations
+
+from trino_tpu.exec.executor import Executor, QueryResult
+from trino_tpu.sql.parser import ast
+from trino_tpu.sql.parser.parser import parse_statement
+from trino_tpu.sql.planner.optimizer import optimize
+from trino_tpu.sql.planner.plan import format_plan
+from trino_tpu.sql.planner.planner import Planner
+
+
+def plan_sql(session, sql: str):
+    stmt = parse_statement(sql)
+    if isinstance(stmt, ast.Explain):
+        raise ValueError("use explain_query")
+    if not isinstance(stmt, ast.Query):
+        return stmt  # SHOW et al, handled by run_query
+    root = Planner(session).plan(stmt)
+    return optimize(root, session)
+
+
+def run_query(session, sql: str) -> QueryResult:
+    stmt = parse_statement(sql)
+    if isinstance(stmt, ast.Explain):
+        text = explain_query(session, None, stmt.mode, stmt=stmt.statement)
+        return QueryResult(["Query Plan"], [], [(line,) for line in text.split("\n")])
+    if isinstance(stmt, ast.ShowTables):
+        return _show_tables(session, stmt)
+    if isinstance(stmt, ast.ShowSchemas):
+        return _show_schemas(session, stmt)
+    if isinstance(stmt, ast.ShowColumns):
+        return _show_columns(session, stmt)
+    if not isinstance(stmt, ast.Query):
+        raise ValueError(f"unsupported statement {type(stmt).__name__}")
+    root = Planner(session).plan(stmt)
+    root = optimize(root, session)
+    page = Executor(session).execute(root)
+    return QueryResult(root.column_names, page.columns, page.to_pylist())
+
+
+def explain_query(session, sql, mode: str = "logical", stmt=None) -> str:
+    if stmt is None:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, ast.Explain):
+            mode = stmt.mode
+            stmt = stmt.statement
+    root = Planner(session).plan(stmt)
+    root = optimize(root, session)
+    if mode == "distributed":
+        from trino_tpu.sql.planner.fragmenter import fragment_plan, format_fragments
+
+        return format_fragments(fragment_plan(root, session))
+    return format_plan(root)
+
+
+def _show_tables(session, stmt):
+    if stmt.schema:
+        parts = stmt.schema
+        catalog = parts[0] if len(parts) == 2 else session.properties.get("catalog", "tpch")
+        schema = parts[-1]
+    else:
+        catalog = session.properties.get("catalog", "tpch")
+        schema = session.properties.get("schema", "tiny")
+    conn = session.catalogs[catalog]
+    rows = [(t,) for t in conn.list_tables(schema)]
+    return QueryResult(["Table"], [], rows)
+
+
+def _show_schemas(session, stmt):
+    catalog = stmt.catalog or session.properties.get("catalog", "tpch")
+    conn = session.catalogs[catalog]
+    return QueryResult(["Schema"], [], [(s,) for s in conn.list_schemas()])
+
+
+def _show_columns(session, stmt):
+    parts = [p.lower() for p in stmt.table]
+    catalog = session.properties.get("catalog", "tpch")
+    schema = session.properties.get("schema", "tiny")
+    if len(parts) == 3:
+        catalog, schema, table = parts
+    elif len(parts) == 2:
+        schema, table = parts
+    else:
+        (table,) = parts
+    meta = session.catalogs[catalog].get_table(schema, table)
+    if meta is None:
+        raise ValueError(f"table not found: {table}")
+    return QueryResult(
+        ["Column", "Type"], [], [(c.name, str(c.type)) for c in meta.columns]
+    )

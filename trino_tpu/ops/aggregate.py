@@ -1,0 +1,88 @@
+"""Aggregate accumulation over group ids: segment reductions.
+
+Reference: ``operator/aggregation/`` Accumulators (AccumulatorCompiler
+bytecode); here each aggregate is a masked ``jax.ops.segment_*`` over the
+dense group ids from ops/groupby.py. NULL inputs are excluded per SQL
+semantics; count(*) counts live rows; avg carries (sum, count) state
+(the same intermediate state Trino's partial aggregation ships).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+
+Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+
+
+def _live(sel: Optional[jnp.ndarray], valid: Optional[jnp.ndarray], n: int) -> jnp.ndarray:
+    m = jnp.ones((n,), dtype=bool)
+    if sel is not None:
+        m = m & sel
+    if valid is not None:
+        m = m & valid
+    return m
+
+
+def agg_count_star(sel: Optional[jnp.ndarray], gids, num_segments: int, n: int):
+    w = jnp.ones((n,), dtype=jnp.int64) if sel is None else sel.astype(jnp.int64)
+    return jax.ops.segment_sum(w, gids, num_segments=num_segments), None
+
+
+def agg_count(arg: Lowered, sel, gids, num_segments: int):
+    vals, valid = arg
+    m = _live(sel, valid, vals.shape[0])
+    return jax.ops.segment_sum(m.astype(jnp.int64), gids, num_segments=num_segments), None
+
+
+def agg_sum(arg: Lowered, sel, gids, num_segments: int, out_dtype):
+    vals, valid = arg
+    m = _live(sel, valid, vals.shape[0])
+    v = jnp.where(m, vals, 0).astype(out_dtype)
+    total = jax.ops.segment_sum(v, gids, num_segments=num_segments)
+    cnt = jax.ops.segment_sum(m.astype(jnp.int64), gids, num_segments=num_segments)
+    # SQL: sum of empty/all-null group is NULL
+    return total, cnt > 0
+
+
+def agg_min(arg: Lowered, sel, gids, num_segments: int):
+    return _agg_minmax(arg, sel, gids, num_segments, is_min=True)
+
+
+def agg_max(arg: Lowered, sel, gids, num_segments: int):
+    return _agg_minmax(arg, sel, gids, num_segments, is_min=False)
+
+
+def _agg_minmax(arg: Lowered, sel, gids, num_segments: int, is_min: bool):
+    vals, valid = arg
+    m = _live(sel, valid, vals.shape[0])
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        sentinel = jnp.inf if is_min else -jnp.inf
+    elif vals.dtype == jnp.bool_:
+        vals = vals.astype(jnp.int32)
+        sentinel = 1 if is_min else 0
+    else:
+        info = jnp.iinfo(vals.dtype)
+        sentinel = info.max if is_min else info.min
+    v = jnp.where(m, vals, sentinel)
+    fn = jax.ops.segment_min if is_min else jax.ops.segment_max
+    out = fn(v, gids, num_segments=num_segments)
+    cnt = jax.ops.segment_sum(m.astype(jnp.int64), gids, num_segments=num_segments)
+    return out, cnt > 0
+
+
+def finish_avg(sum_vals, cnt, out_type: T.Type):
+    """avg final step from (sum, count) state.
+
+    decimal avg: rounds half-up at the input scale (reference:
+    DecimalAverageAggregation); numeric: double division."""
+    valid = cnt > 0
+    safe = jnp.where(valid, cnt, 1)
+    if out_type.is_decimal:
+        s = jnp.abs(sum_vals)
+        q = (s + safe // 2) // safe
+        return jnp.sign(sum_vals) * q, valid
+    return sum_vals.astype(jnp.float64) / safe, valid

@@ -1,0 +1,487 @@
+"""Expression IR -> jax lowering: the query-time "compiler".
+
+Reference role: ``sql/gen/ExpressionCompiler.java`` + ``PageFunctionCompiler
+.java`` (bytecode-generates fused PageFilter/PageProjection over blocks) and
+the ~40 per-op generators in ``sql/gen/*CodeGenerator.java``. Here the same
+job is done by *tracing*: each expression lowers to jax ops over whole column
+arrays; ``jax.jit`` + XLA fusion produce the fused filter/project kernel
+(SURVEY.md §7.1 "kernels replace codegen").
+
+Conventions:
+- A lowered value is ``LoweredVal(vals, valid, dictionary)``:
+  ``vals`` is a jax array (codes for varchar), ``valid`` is a bool array or
+  None (= all valid), ``dictionary`` only for varchar.
+- Three-valued logic: comparisons/arithmetic are null-strict; AND/OR are
+  Kleene; see each op. (Reference: three-valued logic is threaded through the
+  bytecode generators via "wasNull" slots; here it's an explicit mask.)
+- Data-dependent runtime errors (division by zero, numeric overflow) cannot
+  throw inside a compiled program; they are collected as error flags on the
+  context and checked host-side after kernel execution (reference throws
+  TrinoException synchronously — same user-visible outcome, deferred).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.data.dictionary import NULL_CODE, Dictionary
+from trino_tpu.data.page import Column
+from trino_tpu.ops import datetime_ops as dt
+from trino_tpu.sql import ir
+
+DIVISION_BY_ZERO = "DIVISION_BY_ZERO"
+NUMERIC_OVERFLOW = "NUMERIC_VALUE_OUT_OF_RANGE"
+
+
+@dataclasses.dataclass
+class LoweredVal:
+    vals: jnp.ndarray
+    valid: Optional[jnp.ndarray]  # bool array; None = all valid
+    dictionary: Optional[Dictionary] = None
+
+
+class LowerCtx:
+    """Lowering context: the input columns and collected error conditions."""
+
+    def __init__(self, columns: List[Column], num_rows: int):
+        self.columns = columns
+        self.num_rows = num_rows
+        self.errors: List[Tuple[str, jnp.ndarray]] = []
+
+    def add_error(self, code: str, cond: jnp.ndarray, live: Optional[jnp.ndarray]):
+        if live is not None:
+            cond = cond & live
+        self.errors.append((code, jnp.any(cond)))
+
+
+def and_valid(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def lower(expr: ir.Expr, ctx: LowerCtx) -> LoweredVal:
+    if isinstance(expr, ir.ColumnRef):
+        col = ctx.columns[expr.index]
+        valid = None if col.nulls is None else ~col.nulls
+        return LoweredVal(col.values, valid, col.dictionary)
+    if isinstance(expr, ir.Constant):
+        return _lower_constant(expr, ctx)
+    if isinstance(expr, ir.Cast):
+        return _lower_cast(expr, ctx)
+    if isinstance(expr, ir.Case):
+        return _lower_case(expr, ctx)
+    if isinstance(expr, ir.Call):
+        fn = FUNCTIONS.get(expr.name)
+        if fn is None:
+            raise NotImplementedError(f"scalar function not implemented: {expr.name}")
+        return fn(ctx, expr)
+    raise TypeError(f"unexpected IR node: {expr!r}")
+
+
+def _const_array(ctx: LowerCtx, dtype, value) -> jnp.ndarray:
+    return jnp.full((ctx.num_rows,), value, dtype=dtype)
+
+
+def _lower_constant(expr: ir.Constant, ctx: LowerCtx) -> LoweredVal:
+    t = expr.type
+    if expr.value is None:
+        dtype = t.np_dtype if t.np_dtype is not None else np.dtype(np.int32)
+        return LoweredVal(
+            _const_array(ctx, dtype, 0), jnp.zeros((ctx.num_rows,), dtype=bool), None
+        )
+    if t.is_varchar:
+        d = Dictionary([expr.value])
+        return LoweredVal(_const_array(ctx, np.int32, 0), None, d)
+    return LoweredVal(_const_array(ctx, t.np_dtype, expr.value), None, None)
+
+
+# ---------------------------------------------------------------------------
+# varchar comparison support: align two lowered varchar values onto comparable
+# integer code spaces (dictionaries are order-preserving, data/dictionary.py).
+# ---------------------------------------------------------------------------
+
+
+def _align_varchar(a: LoweredVal, b: LoweredVal) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    assert a.dictionary is not None and b.dictionary is not None
+    if a.dictionary is b.dictionary or a.dictionary.values == b.dictionary.values:
+        return a.vals, b.vals
+    merged = a.dictionary.merge(b.dictionary)
+    ra = jnp.asarray(a.dictionary.recode_table(merged))
+    rb = jnp.asarray(b.dictionary.recode_table(merged))
+    av = jnp.where(a.vals >= 0, ra[jnp.clip(a.vals, 0)], NULL_CODE)
+    bv = jnp.where(b.vals >= 0, rb[jnp.clip(b.vals, 0)], NULL_CODE)
+    return av, bv
+
+
+def _comparison(op: Callable) -> Callable:
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = lower(expr.args[0], ctx)
+        b = lower(expr.args[1], ctx)
+        at, bt = expr.args[0].type, expr.args[1].type
+        if at.is_varchar and bt.is_varchar:
+            av, bv = _align_varchar(a, b)
+        else:
+            av, bv = _numeric_align(a.vals, at, b.vals, bt)
+        return LoweredVal(op(av, bv), and_valid(a.valid, b.valid), None)
+
+    return fn
+
+
+def _numeric_align(av, at: T.Type, bv, bt: T.Type):
+    """Bring two numeric/date arrays to a common comparable representation."""
+    if at.is_decimal or bt.is_decimal:
+        sa = at.scale if isinstance(at, T.DecimalType) else 0
+        sb = bt.scale if isinstance(bt, T.DecimalType) else 0
+        if at.is_floating or bt.is_floating:
+            fa = av / (10.0**sa) if at.is_decimal else av
+            fb = bv / (10.0**sb) if bt.is_decimal else bv
+            return fa.astype(jnp.float64), fb.astype(jnp.float64)
+        s = max(sa, sb)
+        return (
+            av.astype(jnp.int64) * (10 ** (s - sa)),
+            bv.astype(jnp.int64) * (10 ** (s - sb)),
+        )
+    if at.is_floating != bt.is_floating:
+        return av.astype(jnp.float64), bv.astype(jnp.float64)
+    return av, bv
+
+
+def _rescale_decimal(v: jnp.ndarray, from_scale: int, to_scale: int) -> jnp.ndarray:
+    if to_scale == from_scale:
+        return v
+    if to_scale > from_scale:
+        return v * (10 ** (to_scale - from_scale))
+    # round half-up toward +/- infinity (Trino decimal rescale semantics)
+    div = 10 ** (from_scale - to_scale)
+    q = jnp.floor_divide(jnp.abs(v) + div // 2, div)
+    return jnp.sign(v) * q
+
+
+def _scale_of(t: T.Type) -> int:
+    return t.scale if isinstance(t, T.DecimalType) else 0
+
+
+def _arith(name: str):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = lower(expr.args[0], ctx)
+        b = lower(expr.args[1], ctx)
+        at, bt, rt = expr.args[0].type, expr.args[1].type, expr.type
+        valid = and_valid(a.valid, b.valid)
+        av, bv = a.vals, b.vals
+        if rt.is_decimal and not (at.is_floating or bt.is_floating):
+            rs = _scale_of(rt)
+            sa, sb = _scale_of(at), _scale_of(bt)
+            if name in ("add", "sub"):
+                av = _rescale_decimal(av.astype(jnp.int64), sa, rs)
+                bv = _rescale_decimal(bv.astype(jnp.int64), sb, rs)
+                out = av + bv if name == "add" else av - bv
+            elif name == "mul":
+                out = _rescale_decimal(av.astype(jnp.int64) * bv.astype(jnp.int64), sa + sb, rs)
+            elif name == "div":
+                ctx.add_error(DIVISION_BY_ZERO, bv == 0, valid)
+                num = av.astype(jnp.int64) * (10 ** (rs - sa + sb))
+                den = jnp.where(bv == 0, 1, bv.astype(jnp.int64))
+                q = jnp.floor_divide(jnp.abs(num) + jnp.abs(den) // 2, jnp.abs(den))
+                out = jnp.sign(num) * jnp.sign(den) * q
+            elif name == "mod":
+                s = max(sa, sb)
+                av = _rescale_decimal(av.astype(jnp.int64), sa, s)
+                bv = _rescale_decimal(bv.astype(jnp.int64), sb, s)
+                ctx.add_error(DIVISION_BY_ZERO, bv == 0, valid)
+                bv = jnp.where(bv == 0, 1, bv)
+                out = jnp.sign(av) * jnp.mod(jnp.abs(av), jnp.abs(bv))
+                out = _rescale_decimal(out, s, rs)
+            else:
+                raise AssertionError(name)
+            return LoweredVal(out, valid, None)
+        if rt.is_floating:
+            fa = av.astype(jnp.float64) / (10.0 ** _scale_of(at)) if at.is_decimal else av
+            fb = bv.astype(jnp.float64) / (10.0 ** _scale_of(bt)) if bt.is_decimal else bv
+            fa = fa.astype(jnp.float64 if rt == T.DOUBLE else jnp.float32)
+            fb = fb.astype(jnp.float64 if rt == T.DOUBLE else jnp.float32)
+            if name == "add":
+                out = fa + fb
+            elif name == "sub":
+                out = fa - fb
+            elif name == "mul":
+                out = fa * fb
+            elif name == "div":
+                out = fa / fb
+            elif name == "mod":
+                out = jnp.where(fb != 0, fa - fb * jnp.trunc(fa / fb), jnp.nan)
+            else:
+                raise AssertionError(name)
+            return LoweredVal(out, valid, None)
+        # integer kinds (and date +/- integer days)
+        av = av.astype(rt.np_dtype)
+        bv = bv.astype(rt.np_dtype)
+        if name == "add":
+            out = av + bv
+        elif name == "sub":
+            out = av - bv
+        elif name == "mul":
+            out = av * bv
+        elif name == "div":
+            ctx.add_error(DIVISION_BY_ZERO, bv == 0, valid)
+            den = jnp.where(bv == 0, 1, bv)
+            out = jnp.sign(av) * jnp.sign(den) * jnp.floor_divide(jnp.abs(av), jnp.abs(den))
+        elif name == "mod":
+            ctx.add_error(DIVISION_BY_ZERO, bv == 0, valid)
+            den = jnp.where(bv == 0, 1, bv)
+            out = jnp.sign(av) * jnp.mod(jnp.abs(av), jnp.abs(den))
+        else:
+            raise AssertionError(name)
+        return LoweredVal(out, valid, None)
+
+    return fn
+
+
+def _lower_and(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """Kleene AND: FALSE dominates NULL."""
+    a = lower(expr.args[0], ctx)
+    b = lower(expr.args[1], ctx)
+    if a.valid is None and b.valid is None:
+        return LoweredVal(a.vals & b.vals, None, None)
+    a_valid = a.valid if a.valid is not None else jnp.ones_like(a.vals)
+    b_valid = b.valid if b.valid is not None else jnp.ones_like(b.vals)
+    known_false = ((~a.vals) & a_valid) | ((~b.vals) & b_valid)
+    return LoweredVal(
+        (a.vals | ~a_valid) & (b.vals | ~b_valid),  # unknown -> TRUE for the value
+        known_false | (a_valid & b_valid),
+        None,
+    )
+
+
+def _lower_or(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """Kleene OR: TRUE dominates NULL."""
+    a = lower(expr.args[0], ctx)
+    b = lower(expr.args[1], ctx)
+    if a.valid is None and b.valid is None:
+        return LoweredVal(a.vals | b.vals, None, None)
+    a_valid = a.valid if a.valid is not None else jnp.ones_like(a.vals)
+    b_valid = b.valid if b.valid is not None else jnp.ones_like(b.vals)
+    known_true = (a.vals & a_valid) | (b.vals & b_valid)
+    return LoweredVal(known_true, known_true | (a_valid & b_valid), None)
+
+
+def _lower_not(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    return LoweredVal(~a.vals, a.valid, None)
+
+
+def _lower_is_null(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    if a.valid is None:
+        return LoweredVal(jnp.zeros((ctx.num_rows,), dtype=bool), None, None)
+    return LoweredVal(~a.valid, None, None)
+
+
+def _lower_between(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x, lo, hi = expr.args
+    ge = lower(ir.Call(T.BOOLEAN, "ge", (x, lo)), ctx)
+    le = lower(ir.Call(T.BOOLEAN, "le", (x, hi)), ctx)
+    return LoweredVal(ge.vals & le.vals, and_valid(ge.valid, le.valid), None)
+
+
+def _lower_in_list(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """x IN (c1, ..., cn) — SQL semantics: TRUE if any match; NULL if no
+    match and (x is NULL or any list item is NULL); else FALSE."""
+    hits = None
+    any_null_item = False
+    x = expr.args[0]
+    for item in expr.args[1:]:
+        if isinstance(item, ir.Constant) and item.value is None:
+            any_null_item = True
+            continue
+        eq = lower(ir.Call(T.BOOLEAN, "eq", (x, item)), ctx)
+        h = eq.vals if eq.valid is None else eq.vals & eq.valid
+        hits = h if hits is None else hits | h
+    if hits is None:
+        hits = jnp.zeros((ctx.num_rows,), dtype=bool)
+    xl = lower(x, ctx)
+    x_null = jnp.zeros((ctx.num_rows,), dtype=bool) if xl.valid is None else ~xl.valid
+    unknown = (~hits) & (x_null | any_null_item)
+    return LoweredVal(hits, ~unknown if (any_null_item or xl.valid is not None) else None, None)
+
+
+def _lower_like(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """LIKE on dictionary-coded varchar: evaluate the pattern host-side over
+    the vocabulary once, then gather the boolean LUT by code on device.
+
+    Reference: ``operator/scalar/likematcher`` (Joni/RE2J DFA per pattern) —
+    the dictionary makes it a O(|vocab|) host precompute instead.
+    """
+    x = lower(expr.args[0], ctx)
+    pat = expr.args[1]
+    assert isinstance(pat, ir.Constant), "LIKE pattern must be a literal (round 1)"
+    assert x.dictionary is not None
+    rx = re.compile(_like_to_regex(pat.value), re.S)
+    lut = np.array([rx.fullmatch(v) is not None for v in x.dictionary.values], dtype=bool)
+    lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros((1,), dtype=bool)
+    out = jnp.where(x.vals >= 0, lut_dev[jnp.clip(x.vals, 0, max(len(lut) - 1, 0))], False)
+    return LoweredVal(out, x.valid, None)
+
+
+def _like_to_regex(pattern: str, escape: Optional[str] = None) -> str:
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if escape and c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "".join(out)
+
+
+def _lower_coalesce(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    acc = lower(expr.args[0], ctx)
+    for nxt_expr in expr.args[1:]:
+        if acc.valid is None:
+            return acc
+        nxt = lower(nxt_expr, ctx)
+        vals = jnp.where(acc.valid, acc.vals, nxt.vals)
+        nxt_valid = nxt.valid if nxt.valid is not None else jnp.ones_like(acc.valid)
+        acc = LoweredVal(vals, acc.valid | nxt_valid, acc.dictionary or nxt.dictionary)
+    return acc
+
+
+def _lower_extract(field: str):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = lower(expr.args[0], ctx)
+        out = getattr(dt, f"extract_{field}")(a.vals)
+        return LoweredVal(out, a.valid, None)
+
+    return fn
+
+
+def _lower_date_add_months(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    n = lower(expr.args[1], ctx)
+    out = dt.add_months(a.vals, n.vals).astype(jnp.int32)
+    return LoweredVal(out, and_valid(a.valid, n.valid), None)
+
+
+def _lower_negate(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    return LoweredVal(-a.vals, a.valid, None)
+
+
+def _lower_abs(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    return LoweredVal(jnp.abs(a.vals), a.valid, None)
+
+
+def _lower_nullif(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    eq = lower(ir.Call(T.BOOLEAN, "eq", (expr.args[0], expr.args[1])), ctx)
+    hit = eq.vals if eq.valid is None else eq.vals & eq.valid
+    valid = (~hit) if a.valid is None else (a.valid & ~hit)
+    return LoweredVal(a.vals, valid, a.dictionary)
+
+
+def _lower_case(expr: ir.Case, ctx: LowerCtx) -> LoweredVal:
+    """Searched CASE: first WHEN whose condition is TRUE wins."""
+    dtype = expr.type.np_dtype
+    vals = jnp.zeros((ctx.num_rows,), dtype=dtype)
+    valid = jnp.zeros((ctx.num_rows,), dtype=bool)
+    decided = jnp.zeros((ctx.num_rows,), dtype=bool)
+    dictionary = None
+    for cond_e, val_e in expr.whens:
+        c = lower(cond_e, ctx)
+        cv = c.vals if c.valid is None else c.vals & c.valid
+        take = cv & ~decided
+        v = lower(val_e, ctx)
+        if v.dictionary is not None:
+            if dictionary is not None and dictionary.values != v.dictionary.values:
+                # Mixed-dictionary CASE branches need a recode pass: round 2.
+                raise NotImplementedError("varchar CASE over distinct dictionaries")
+            dictionary = v.dictionary
+        vals = jnp.where(take, v.vals.astype(dtype), vals)
+        valid = jnp.where(take, v.valid if v.valid is not None else True, valid)
+        decided = decided | take
+    if expr.default is not None:
+        d = lower(expr.default, ctx)
+        vals = jnp.where(decided, vals, d.vals.astype(dtype))
+        valid = jnp.where(decided, valid, d.valid if d.valid is not None else True)
+    return LoweredVal(vals, valid, dictionary)
+
+
+def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
+    a = lower(expr.value, ctx)
+    ft, tt = expr.value.type, expr.type
+    if ft == tt:
+        return a
+    if tt.is_floating:
+        v = a.vals.astype(jnp.float64)
+        if ft.is_decimal:
+            v = v / (10.0 ** _scale_of(ft))
+        return LoweredVal(v.astype(tt.np_dtype), a.valid, None)
+    if tt.is_decimal:
+        rs = _scale_of(tt)
+        if ft.is_floating:
+            v = jnp.round(a.vals.astype(jnp.float64) * (10.0**rs)).astype(jnp.int64)
+        elif ft.is_decimal:
+            v = _rescale_decimal(a.vals.astype(jnp.int64), _scale_of(ft), rs)
+        else:
+            v = a.vals.astype(jnp.int64) * (10**rs)
+        return LoweredVal(v, a.valid, None)
+    if tt.is_integer_kind:
+        if ft.is_decimal:
+            v = _rescale_decimal(a.vals.astype(jnp.int64), _scale_of(ft), 0)
+        elif ft.is_floating:
+            v = jnp.round(a.vals)
+        else:
+            v = a.vals
+        return LoweredVal(v.astype(tt.np_dtype), a.valid, None)
+    if tt == T.DATE and ft.is_varchar:
+        raise NotImplementedError("cast(varchar as date) lowering: round 2")
+    if tt.is_varchar:
+        raise NotImplementedError("cast to varchar lowering: round 2")
+    return LoweredVal(a.vals.astype(tt.np_dtype), a.valid, a.dictionary)
+
+
+FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
+    "eq": _comparison(lambda a, b: a == b),
+    "ne": _comparison(lambda a, b: a != b),
+    "lt": _comparison(lambda a, b: a < b),
+    "le": _comparison(lambda a, b: a <= b),
+    "gt": _comparison(lambda a, b: a > b),
+    "ge": _comparison(lambda a, b: a >= b),
+    "add": _arith("add"),
+    "sub": _arith("sub"),
+    "mul": _arith("mul"),
+    "div": _arith("div"),
+    "mod": _arith("mod"),
+    "negate": _lower_negate,
+    "abs": _lower_abs,
+    "and": _lower_and,
+    "or": _lower_or,
+    "not": _lower_not,
+    "is_null": _lower_is_null,
+    "between": _lower_between,
+    "in_list": _lower_in_list,
+    "like": _lower_like,
+    "coalesce": _lower_coalesce,
+    "nullif": _lower_nullif,
+    "extract_year": _lower_extract("year"),
+    "extract_month": _lower_extract("month"),
+    "extract_day": _lower_extract("day"),
+    "extract_quarter": _lower_extract("quarter"),
+    "date_add_months": _lower_date_add_months,
+}

@@ -1,0 +1,77 @@
+"""Group-by kernel: sort/segment based, static shapes.
+
+Reference algorithm being replaced: ``operator/FlatHash.java:42`` (SWAR
+control-byte open addressing) + ``FlatHashStrategyCompiler``. On TPU, a
+sort + segment-reduce formulation maps better onto the VPU than scatter-heavy
+hashing (SURVEY.md §7.1): stable multi-key argsort, boundary detection,
+dense group ids via cumsum, then ``jax.ops.segment_*`` reductions. Exact
+(comparison-based, no hash collisions), null-safe (NULL is its own group),
+and selection-mask aware (dead rows sort last, into discarded groups).
+
+All shapes are static; the true group count comes back as a scalar the host
+reads once per aggregation to slice the padded outputs.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]  # (vals, valid|None)
+
+
+def _sort_order(sort_keys: List[jnp.ndarray]) -> jnp.ndarray:
+    """Stable lexicographic argsort over multiple key arrays (most significant
+    first): chain stable argsorts from least to most significant."""
+    n = sort_keys[0].shape[0]
+    order = jnp.arange(n)
+    for k in reversed(sort_keys):
+        order = order[jnp.argsort(k[order], stable=True)]
+    return order
+
+
+def group_ids(
+    keys: List[Lowered], sel: Optional[jnp.ndarray]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Assign dense group ids per row.
+
+    Returns (gids[n] int32, rep[n] int64 — representative row per group id
+    (padded with n beyond the live groups), num_groups scalar).
+    Dead rows (sel false) get group ids >= num_groups.
+    """
+    n = keys[0][0].shape[0]
+    dead = (
+        jnp.zeros((n,), dtype=bool) if sel is None else ~sel
+    )
+    sort_keys: List[jnp.ndarray] = [dead]
+    for vals, valid in keys:
+        if valid is not None:
+            sort_keys.append(~valid)  # NULLs group together (their own group)
+            sort_keys.append(jnp.where(valid, vals, 0))
+        else:
+            sort_keys.append(vals)
+    order = _sort_order(sort_keys)
+    gathered = [k[order] for k in sort_keys]
+    boundary = jnp.zeros((n,), dtype=bool).at[0].set(True)
+    for g in gathered:
+        boundary = boundary | jnp.concatenate([jnp.ones((1,), bool), g[1:] != g[:-1]])
+    gid_sorted = jnp.cumsum(boundary) - 1
+    dead_sorted = gathered[0]
+    num_groups = jnp.sum(boundary & ~dead_sorted)
+    gids = jnp.zeros((n,), dtype=jnp.int64).at[order].set(gid_sorted)
+    rep = jnp.full((n,), n, dtype=jnp.int64).at[gid_sorted].min(order)
+    return gids.astype(jnp.int32), rep, num_groups
+
+
+def gather_group_keys(
+    keys: List[Lowered], rep: jnp.ndarray
+) -> List[Lowered]:
+    """Group-key output columns: gather each key at the representative row."""
+    n = keys[0][0].shape[0]
+    safe = jnp.clip(rep, 0, n - 1)
+    out = []
+    for vals, valid in keys:
+        v = vals[safe]
+        va = valid[safe] if valid is not None else None
+        out.append((v, va))
+    return out

@@ -1,0 +1,96 @@
+"""Join kernels: lookup (N:1), semi/anti membership — searchsorted-based.
+
+Reference: ``operator/join/`` — PagesHash open addressing + PositionLinks
+chains (JoinHash.java:28-69). TPU formulation: the build side is sorted by
+key once; probes binary-search (``jnp.searchsorted``, log2(n) vectorized
+steps, no scatter). Round-1 scope:
+
+- unique-key build (PK-FK joins, N:1): probe -> at most one match -> output
+  size == probe size (static shapes, no two-pass emit). The planner proves
+  uniqueness (primary keys / group-by outputs) before choosing this kernel.
+- semi/anti joins: membership only (duplicates on build side are fine).
+- composite keys pack into one int64 (32/32 bits) — planner guarantees range.
+
+General M:N inner join (two-pass count+emit) is a round-2 kernel.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+
+_DEAD_KEY = jnp.int64(2**63 - 1)  # sorts last; equality re-checked via sel gather
+
+
+def pack_keys(keys: List[Lowered]) -> Lowered:
+    """Combine multiple int key columns into one int64 (32 bits each for 2
+    keys). Valid only when the planner has proven the ranges fit."""
+    if len(keys) == 1:
+        return keys[0]
+    if len(keys) == 2:
+        (a, av), (b, bv) = keys
+        vals = (a.astype(jnp.int64) << 32) | (b.astype(jnp.int64) & 0xFFFFFFFF)
+        valid = None
+        if av is not None or bv is not None:
+            valid = (av if av is not None else True) & (bv if bv is not None else True)
+        return vals, valid
+    raise NotImplementedError(">2 join key columns")
+
+
+def build_side(key: Lowered, sel: Optional[jnp.ndarray]):
+    """Sort the build side by key; dead/null rows get a sentinel that sorts
+    last and can never match (their liveness is re-checked on gather)."""
+    vals, valid = key
+    n = vals.shape[0]
+    live = jnp.ones((n,), dtype=bool)
+    if sel is not None:
+        live = live & sel
+    if valid is not None:
+        live = live & valid
+    k = jnp.where(live, vals.astype(jnp.int64), _DEAD_KEY)
+    order = jnp.argsort(k, stable=True)
+    return k[order], order, live[order]
+
+
+def probe_unique(
+    build_keys_sorted: jnp.ndarray,
+    build_rows: jnp.ndarray,
+    build_live: jnp.ndarray,
+    probe_key: Lowered,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Probe against a unique-key build. Returns (build_row_idx, matched)."""
+    pvals, pvalid = probe_key
+    n = build_keys_sorted.shape[0]
+    pos = jnp.searchsorted(build_keys_sorted, pvals.astype(jnp.int64))
+    pos = jnp.clip(pos, 0, n - 1)
+    hit = (build_keys_sorted[pos] == pvals.astype(jnp.int64)) & build_live[pos]
+    if pvalid is not None:
+        hit = hit & pvalid
+    return build_rows[pos], hit
+
+
+def membership(
+    build_key: Lowered, build_sel: Optional[jnp.ndarray], probe_key: Lowered
+) -> jnp.ndarray:
+    """Semi-join membership test (build side may have duplicates)."""
+    bk_sorted, _, live = build_side(build_key, build_sel)
+    pvals, pvalid = probe_key
+    n = bk_sorted.shape[0]
+    pos = jnp.clip(jnp.searchsorted(bk_sorted, pvals.astype(jnp.int64)), 0, n - 1)
+    hit = (bk_sorted[pos] == pvals.astype(jnp.int64)) & live[pos]
+    if pvalid is not None:
+        hit = hit & pvalid
+    return hit
+
+
+def gather_column(col: Lowered, rows: jnp.ndarray, matched: jnp.ndarray) -> Lowered:
+    """Gather a build column to probe positions; unmatched rows become NULL
+    (consumed by inner-join sel or left-join null masks)."""
+    vals, valid = col
+    n = vals.shape[0]
+    safe = jnp.clip(rows, 0, n - 1)
+    v = vals[safe]
+    va = matched if valid is None else (valid[safe] & matched)
+    return v, va

@@ -1,0 +1,52 @@
+"""Sort kernel: stable multi-key argsort with SQL null placement.
+
+Reference: ``operator/OrderByOperator.java`` + ``sql/gen/OrderingCompiler``
+(type-specialized comparators). Here: per-key transform to a sortable int64/
+float array (descending = negation, NULLs = +/-inf sentinels per
+nulls_first), then chained stable argsorts (least- to most-significant).
+Dead rows (selection mask false) always sort last so LIMIT/host slicing sees
+live rows first.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+
+
+def _sort_key(vals, valid, ascending: bool, nulls_first: Optional[bool]):
+    """Produce (null_rank_key, value_key) so NULLs land per SQL defaults:
+    NULLS LAST for ASC, NULLS FIRST for DESC, unless specified."""
+    if nulls_first is None:
+        nulls_first = not ascending
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        v = vals.astype(jnp.float64)
+    else:
+        v = vals.astype(jnp.int64)
+    if not ascending:
+        v = -v
+    if valid is None:
+        return [v]
+    null_rank = jnp.where(valid, 1, 0) if nulls_first else jnp.where(valid, 0, 1)
+    return [null_rank, jnp.where(valid, v, 0)]
+
+
+def sort_order(
+    keys: List[Tuple[Lowered, bool, Optional[bool]]],
+    sel: Optional[jnp.ndarray],
+    n: int,
+) -> jnp.ndarray:
+    """Permutation putting rows in sort order, dead rows last. Stable."""
+    sort_keys: List[jnp.ndarray] = []
+    if sel is not None:
+        sort_keys.append(~sel)  # dead rows last
+    for (vals, valid), asc, nf in keys:
+        sort_keys.extend(_sort_key(vals, valid, asc, nf))
+    order = jnp.arange(n)
+    if not sort_keys:
+        return order
+    for k in reversed(sort_keys):
+        order = order[jnp.argsort(k[order], stable=True)]
+    return order

@@ -1,0 +1,150 @@
+"""Post-analysis expression IR.
+
+Reference: ``core/trino-main/.../sql/ir/`` — Trino keeps a small rowful
+expression IR distinct from the parser AST (Call, Case, Cast, Comparison,
+Constant, Reference, Logical, ...). Ours mirrors that scope; analysis resolves
+parser AST names into ``ColumnRef`` channel indices and all operators into
+``Call`` by canonical function name. The IR lowers to jax in
+``trino_tpu.ops.expr_lower`` (the role played by
+``sql/gen/ExpressionCompiler.java`` + ``PageFunctionCompiler.java`` in the
+reference — there bytecode, here traced XLA).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+from trino_tpu import types as T
+
+
+class Expr:
+    type: T.Type
+
+    def children(self) -> Sequence["Expr"]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Constant(Expr):
+    """A literal. ``value`` is a Python value (int/float/bool/str/None).
+
+    Dates are epoch days (int), decimals scaled ints, varchar a Python str
+    (encoded to dictionary codes at lowering time, when the input columns'
+    dictionaries are known).
+    """
+
+    type: T.Type
+    value: Any
+
+    def __repr__(self):
+        return f"Const({self.value!r}:{self.type})"
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnRef(Expr):
+    """Reference to channel ``index`` of the operator's input page."""
+
+    type: T.Type
+    index: int
+    name: str = ""  # debug only
+
+    def __repr__(self):
+        return f"#{self.index}:{self.name or self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class OuterRef(Expr):
+    """Correlated reference to channel ``index`` of the OUTER query's scope.
+
+    Appears only transiently during subquery planning; decorrelation
+    (reference: sql/planner/iterative/rule/ correlated-subquery rules)
+    rewrites it into join criteria before execution.
+    """
+
+    type: T.Type
+    index: int
+    name: str = ""
+
+    def __repr__(self):
+        return f"outer#{self.index}:{self.name or self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(Expr):
+    """Scalar function / operator call by canonical name.
+
+    Canonical names: add sub mul div mod negate, eq ne lt le gt ge,
+    and or not, is_null, between, in_list, like, coalesce, nullif,
+    extract_year extract_month extract_day, date_add_months, abs, ...
+    (registry: trino_tpu.ops.functions.FUNCTIONS).
+    """
+
+    type: T.Type
+    name: str
+    args: Tuple[Expr, ...]
+
+    def children(self):
+        return self.args
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Expr):
+    """CASE WHEN c1 THEN v1 ... ELSE d END (searched form)."""
+
+    type: T.Type
+    whens: Tuple[Tuple[Expr, Expr], ...]
+    default: Optional[Expr]
+
+    def children(self):
+        out: List[Expr] = []
+        for c, v in self.whens:
+            out += [c, v]
+        if self.default is not None:
+            out.append(self.default)
+        return out
+
+    def __repr__(self):
+        parts = " ".join(f"WHEN {c!r} THEN {v!r}" for c, v in self.whens)
+        return f"CASE {parts} ELSE {self.default!r} END"
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expr):
+    type: T.Type
+    value: Expr
+
+    def children(self):
+        return (self.value,)
+
+    def __repr__(self):
+        return f"cast({self.value!r} as {self.type})"
+
+
+def walk(e: Expr):
+    yield e
+    for c in e.children():
+        yield from walk(c)
+
+
+def referenced_channels(e: Expr) -> List[int]:
+    return sorted({n.index for n in walk(e) if isinstance(n, ColumnRef)})
+
+
+def remap_channels(e: Expr, mapping: dict) -> Expr:
+    """Rewrite ColumnRef indices through ``mapping`` (for projection pushdown)."""
+    if isinstance(e, ColumnRef):
+        return ColumnRef(e.type, mapping[e.index], e.name)
+    if isinstance(e, Call):
+        return Call(e.type, e.name, tuple(remap_channels(a, mapping) for a in e.args))
+    if isinstance(e, Case):
+        return Case(
+            e.type,
+            tuple((remap_channels(c, mapping), remap_channels(v, mapping)) for c, v in e.whens),
+            remap_channels(e.default, mapping) if e.default is not None else None,
+        )
+    if isinstance(e, Cast):
+        return Cast(e.type, remap_channels(e.value, mapping))
+    return e

@@ -1,0 +1,273 @@
+"""Parser AST.
+
+Reference: ``core/trino-parser/src/main/java/io/trino/sql/tree/`` (289 node
+classes). This is the *parser* AST — distinct from the post-analysis IR in
+``trino_tpu.sql.ir``, mirroring the reference's AST/IR split. Round-1 scope:
+the query surface TPC-H/TPC-DS need (SELECT/joins/subqueries/CTEs/CASE/
+EXISTS/IN/aggregates/window-less) plus EXPLAIN.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+class Node:
+    pass
+
+
+class Expression(Node):
+    pass
+
+
+class Relation(Node):
+    pass
+
+
+class Statement(Node):
+    pass
+
+
+# --- expressions -----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Expression):
+    kind: str  # 'number' | 'string' | 'boolean' | 'null' | 'date' | 'timestamp'
+    value: object
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalLiteral(Expression):
+    value: int
+    unit: str  # 'year' | 'month' | 'day' | 'hour' | 'minute' | 'second'
+    sign: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Identifier(Expression):
+    parts: Tuple[str, ...]  # possibly qualified: (table, column) or (column,)
+
+    @property
+    def name(self) -> str:
+        return self.parts[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Expression):
+    qualifier: Optional[Tuple[str, ...]] = None  # t.* has qualifier ('t',)
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionCall(Expression):
+    name: str
+    args: Tuple[Expression, ...]
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+
+
+@dataclasses.dataclass(frozen=True)
+class Arithmetic(Expression):
+    op: str  # + - * / %
+    left: Expression
+    right: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class Negative(Expression):
+    value: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class Comparison(Expression):
+    op: str  # = <> < <= > >=
+    left: Expression
+    right: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class LogicalBinary(Expression):
+    op: str  # and | or
+    left: Expression
+    right: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class Not(Expression):
+    value: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Expression):
+    value: Expression
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Expression):
+    value: Expression
+    low: Expression
+    high: Expression
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Expression):
+    value: Expression
+    items: Tuple[Expression, ...]
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Expression):
+    value: Expression
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Expression):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Expression):
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Expression):
+    value: Expression
+    pattern: Expression
+    escape: Optional[Expression] = None
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchedCase(Expression):
+    whens: Tuple[Tuple[Expression, Expression], ...]
+    default: Optional[Expression]
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleCase(Expression):
+    operand: Expression
+    whens: Tuple[Tuple[Expression, Expression], ...]
+    default: Optional[Expression]
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expression):
+    value: Expression
+    type_name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Extract(Expression):
+    field: str  # year month day quarter ...
+    value: Expression
+
+
+# --- relations -------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Table(Relation):
+    parts: Tuple[str, ...]  # catalog.schema.table, schema.table, or table
+
+
+@dataclasses.dataclass(frozen=True)
+class AliasedRelation(Relation):
+    relation: Relation
+    alias: str
+    column_aliases: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(Relation):
+    join_type: str  # inner | left | right | full | cross | implicit
+    left: Relation
+    right: Relation
+    on: Optional[Expression] = None
+    using: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRelation(Relation):
+    query: "Query"
+
+
+# --- query structure -------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Expression
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SortItem(Node):
+    expr: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None = default (last for asc, first for desc)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuerySpec(Node):
+    select_items: Tuple[SelectItem, ...]
+    distinct: bool
+    from_: Optional[Relation]
+    where: Optional[Expression]
+    group_by: Tuple[Expression, ...]
+    having: Optional[Expression]
+
+
+@dataclasses.dataclass(frozen=True)
+class SetOperation(Node):
+    op: str  # union | intersect | except
+    all: bool
+    left: "QueryBody"
+    right: "QueryBody"
+
+
+QueryBody = object  # QuerySpec | SetOperation | Query (parenthesized)
+
+
+@dataclasses.dataclass(frozen=True)
+class WithQuery(Node):
+    name: str
+    query: "Query"
+    column_aliases: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Query(Statement):
+    body: QueryBody
+    with_queries: Tuple[WithQuery, ...] = ()
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Explain(Statement):
+    statement: Statement
+    analyze: bool = False
+    mode: str = "logical"  # logical | distributed
+    fmt: str = "text"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables(Statement):
+    schema: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSchemas(Statement):
+    catalog: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowColumns(Statement):
+    table: Tuple[str, ...] = ()

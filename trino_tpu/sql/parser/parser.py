@@ -1,0 +1,573 @@
+"""Recursive-descent SQL parser.
+
+Reference: ``core/trino-grammar/.../SqlBase.g4`` (ANTLR4, 1420 lines) +
+``core/trino-parser/.../AstBuilder.java:369``. Hand-written Pratt-style
+parser over the same query surface (round-1 scope: SELECT queries with
+joins/subqueries/CTEs/set-ops, EXPLAIN, SHOW).
+
+Grammar precedence (low to high):
+  OR < AND < NOT < predicate (comparison, BETWEEN, IN, LIKE, IS) <
+  || (concat) < + - < * / % < unary - < primary
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from trino_tpu.sql.parser import ast
+from trino_tpu.sql.parser.lexer import Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+def parse_statement(sql: str) -> ast.Statement:
+    p = Parser(tokenize(sql))
+    stmt = p.statement()
+    p.expect_kinds("eof", ";")
+    return stmt
+
+
+def parse_query(sql: str) -> ast.Query:
+    stmt = parse_statement(sql)
+    if not isinstance(stmt, ast.Query):
+        raise ParseError("expected a query")
+    return stmt
+
+
+class Parser:
+    def __init__(self, tokens: List[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # --- token helpers ---
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def advance(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str, ahead: int = 0) -> bool:
+        t = self.peek(ahead)
+        return t.kind == "kw" and t.lower in kws
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.text in ops
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.advance()
+            return True
+        return False
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.advance()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> Token:
+        if not self.at_kw(kw):
+            raise ParseError(f"expected {kw.upper()} but got {self.peek().text!r} at {self.peek().pos}")
+        return self.advance()
+
+    def expect_op(self, op: str) -> Token:
+        if not self.at_op(op):
+            raise ParseError(f"expected {op!r} but got {self.peek().text!r} at {self.peek().pos}")
+        return self.advance()
+
+    def expect_kinds(self, *ok) -> None:
+        t = self.peek()
+        if t.kind == "eof" and "eof" in ok:
+            return
+        if t.kind == "op" and t.text in ok:
+            self.advance()
+            if self.peek().kind != "eof":
+                raise ParseError(f"trailing input at {self.peek().pos}")
+            return
+        raise ParseError(f"unexpected input {t.text!r} at {t.pos}")
+
+    def identifier(self) -> str:
+        t = self.peek()
+        if t.kind == "ident":
+            return self.advance().text
+        # contextual keywords usable as identifiers (e.g. a column named "year")
+        if t.kind == "kw" and t.lower in ("year", "month", "day", "date", "first", "last", "tables", "schemas", "columns", "values", "quarter", "hour", "minute", "second"):
+            return self.advance().text
+        raise ParseError(f"expected identifier but got {t.text!r} at {t.pos}")
+
+    # --- statements ---
+    def statement(self) -> ast.Statement:
+        if self.accept_kw("explain"):
+            analyze = bool(self.accept_kw("analyze"))
+            mode, fmt = "distributed", "text"
+            if self.accept_op("("):
+                while True:
+                    opt = self.identifier().lower()
+                    if opt == "type":
+                        mode = self.identifier().lower()
+                    elif opt == "format":
+                        fmt = self.identifier().lower()
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return ast.Explain(self.statement(), analyze=analyze, mode=mode, fmt=fmt)
+        if self.accept_kw("show"):
+            if self.accept_kw("tables"):
+                schema = None
+                if self.accept_kw("from", "in"):
+                    schema = tuple(self.qualified_name())
+                return ast.ShowTables(schema)
+            if self.accept_kw("schemas"):
+                catalog = None
+                if self.accept_kw("from", "in"):
+                    catalog = self.identifier()
+                return ast.ShowSchemas(catalog)
+            if self.accept_kw("columns"):
+                self.expect_kw("from")
+                return ast.ShowColumns(tuple(self.qualified_name()))
+            raise ParseError(f"unsupported SHOW at {self.peek().pos}")
+        if self.accept_kw("describe"):
+            return ast.ShowColumns(tuple(self.qualified_name()))
+        return self.query()
+
+    # --- queries ---
+    def query(self) -> ast.Query:
+        with_queries: List[ast.WithQuery] = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.identifier()
+                col_aliases = None
+                if self.accept_op("("):
+                    cols = [self.identifier()]
+                    while self.accept_op(","):
+                        cols.append(self.identifier())
+                    self.expect_op(")")
+                    col_aliases = tuple(cols)
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self.query()
+                self.expect_op(")")
+                with_queries.append(ast.WithQuery(name, q, col_aliases))
+                if not self.accept_op(","):
+                    break
+        body = self.query_body()
+        order_by: Tuple[ast.SortItem, ...] = ()
+        limit = None
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by = tuple(self.sort_items())
+        if self.accept_kw("limit"):
+            t = self.advance()
+            if t.kind == "kw" and t.lower == "all":
+                limit = None
+            else:
+                limit = int(t.text)
+        return ast.Query(body, tuple(with_queries), order_by, limit)
+
+    def sort_items(self) -> List[ast.SortItem]:
+        items = []
+        while True:
+            e = self.expr()
+            asc = True
+            if self.accept_kw("asc"):
+                asc = True
+            elif self.accept_kw("desc"):
+                asc = False
+            nulls_first = None
+            if self.accept_kw("nulls"):
+                if self.accept_kw("first"):
+                    nulls_first = True
+                else:
+                    self.expect_kw("last")
+                    nulls_first = False
+            items.append(ast.SortItem(e, asc, nulls_first))
+            if not self.accept_op(","):
+                return items
+
+    def query_body(self):
+        left = self.query_term()
+        while self.at_kw("union", "except"):
+            op = self.advance().lower
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            right = self.query_term()
+            left = ast.SetOperation(op, all_, left, right)
+        return left
+
+    def query_term(self):
+        left = self.query_primary()
+        while self.at_kw("intersect"):
+            self.advance()
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            right = self.query_primary()
+            left = ast.SetOperation("intersect", all_, left, right)
+        return left
+
+    def query_primary(self):
+        if self.accept_op("("):
+            q = self.query()
+            self.expect_op(")")
+            return q
+        if self.at_kw("values"):
+            raise ParseError("VALUES relation: round 2")
+        return self.query_spec()
+
+    def query_spec(self) -> ast.QuerySpec:
+        self.expect_kw("select")
+        distinct = False
+        if self.accept_kw("distinct"):
+            distinct = True
+        else:
+            self.accept_kw("all")
+        items = [self.select_item()]
+        while self.accept_op(","):
+            items.append(self.select_item())
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self.relation()
+        where = self.expr() if self.accept_kw("where") else None
+        group_by: Tuple[ast.Expression, ...] = ()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            gb = [self.expr()]
+            while self.accept_op(","):
+                gb.append(self.expr())
+            group_by = tuple(gb)
+        having = self.expr() if self.accept_kw("having") else None
+        return ast.QuerySpec(tuple(items), distinct, from_, where, group_by, having)
+
+    def select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.advance()
+            return ast.SelectItem(ast.Star())
+        # qualified star: ident . *
+        if self.peek().kind == "ident" and self.peek(1).kind == "op" and self.peek(1).text == "." \
+                and self.peek(2).kind == "op" and self.peek(2).text == "*":
+            q = self.advance().text
+            self.advance()
+            self.advance()
+            return ast.SelectItem(ast.Star(qualifier=(q,)))
+        e = self.expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.identifier()
+        elif self.peek().kind == "ident":
+            alias = self.advance().text
+        return ast.SelectItem(e, alias)
+
+    # --- relations ---
+    def relation(self) -> ast.Relation:
+        left = self.joined_relation()
+        while self.accept_op(","):
+            right = self.joined_relation()
+            left = ast.Join("implicit", left, right)
+        return left
+
+    def joined_relation(self) -> ast.Relation:
+        left = self.table_primary()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.table_primary()
+                left = ast.Join("cross", left, right)
+                continue
+            jt = None
+            if self.at_kw("join"):
+                jt = "inner"
+            elif self.at_kw("inner") and self.at_kw("join", ahead=1):
+                self.advance()
+                jt = "inner"
+            elif self.at_kw("left", "right", "full"):
+                jt = self.peek().lower
+                self.advance()
+                self.accept_kw("outer")
+            if jt is None:
+                return left
+            self.expect_kw("join")
+            right = self.table_primary()
+            if self.accept_kw("using"):
+                self.expect_op("(")
+                cols = [self.identifier()]
+                while self.accept_op(","):
+                    cols.append(self.identifier())
+                self.expect_op(")")
+                left = ast.Join(jt, left, right, using=tuple(cols))
+            else:
+                self.expect_kw("on")
+                left = ast.Join(jt, left, right, on=self.expr())
+
+    def table_primary(self) -> ast.Relation:
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                q = self.query()
+                self.expect_op(")")
+                rel: ast.Relation = ast.SubqueryRelation(q)
+            else:
+                rel = self.relation()
+                self.expect_op(")")
+        else:
+            rel = ast.Table(tuple(self.qualified_name()))
+        alias = None
+        col_aliases = None
+        if self.accept_kw("as"):
+            alias = self.identifier()
+        elif self.peek().kind == "ident":
+            alias = self.advance().text
+        if alias is not None and self.accept_op("("):
+            cols = [self.identifier()]
+            while self.accept_op(","):
+                cols.append(self.identifier())
+            self.expect_op(")")
+            col_aliases = tuple(cols)
+        if alias is not None:
+            return ast.AliasedRelation(rel, alias, col_aliases)
+        return rel
+
+    def qualified_name(self) -> List[str]:
+        parts = [self.identifier()]
+        while self.at_op(".") and self.peek(1).kind in ("ident", "kw"):
+            self.advance()
+            parts.append(self.identifier())
+        return parts
+
+    # --- expressions ---
+    def expr(self) -> ast.Expression:
+        return self.or_expr()
+
+    def or_expr(self) -> ast.Expression:
+        left = self.and_expr()
+        while self.accept_kw("or"):
+            left = ast.LogicalBinary("or", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> ast.Expression:
+        left = self.not_expr()
+        while self.accept_kw("and"):
+            left = ast.LogicalBinary("and", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> ast.Expression:
+        if self.accept_kw("not"):
+            return ast.Not(self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> ast.Expression:
+        left = self.additive()
+        while True:
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.advance().text
+                if op == "!=":
+                    op = "<>"
+                right = self.additive()
+                left = ast.Comparison(op, left, right)
+                continue
+            negated = False
+            if self.at_kw("not") and self.at_kw("between", "in", "like", ahead=1):
+                self.advance()
+                negated = True
+            if self.accept_kw("between"):
+                lo = self.additive()
+                self.expect_kw("and")
+                hi = self.additive()
+                left = ast.Between(left, lo, hi, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    q = self.query()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negated)
+                else:
+                    items = [self.expr()]
+                    while self.accept_op(","):
+                        items.append(self.expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, tuple(items), negated)
+                continue
+            if self.accept_kw("like"):
+                pattern = self.additive()
+                escape = None
+                if self.accept_kw("escape"):
+                    escape = self.additive()
+                left = ast.Like(left, pattern, escape, negated)
+                continue
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                left = ast.IsNull(left, negated=neg)
+                continue
+            if negated:
+                raise ParseError(f"dangling NOT at {self.peek().pos}")
+            return left
+
+    def additive(self) -> ast.Expression:
+        left = self.multiplicative()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.advance().text
+                left = ast.Arithmetic(op, left, self.multiplicative())
+            elif self.at_op("||"):
+                self.advance()
+                left = ast.FunctionCall("concat", (left, self.multiplicative()))
+            else:
+                return left
+
+    def multiplicative(self) -> ast.Expression:
+        left = self.unary()
+        while self.at_op("*", "/", "%"):
+            op = self.advance().text
+            left = ast.Arithmetic(op, left, self.unary())
+        return left
+
+    def unary(self) -> ast.Expression:
+        if self.accept_op("-"):
+            return ast.Negative(self.unary())
+        self.accept_op("+")
+        return self.primary()
+
+    def primary(self) -> ast.Expression:
+        t = self.peek()
+        if t.kind == "number":
+            self.advance()
+            return ast.Literal("number", t.text)
+        if t.kind == "string":
+            self.advance()
+            return ast.Literal("string", t.text)
+        if self.at_kw("null"):
+            self.advance()
+            return ast.Literal("null", None)
+        if self.at_kw("true", "false"):
+            self.advance()
+            return ast.Literal("boolean", t.lower == "true")
+        if self.at_kw("date") and self.peek(1).kind == "string":
+            self.advance()
+            return ast.Literal("date", self.advance().text)
+        if self.at_kw("timestamp") and self.peek(1).kind == "string":
+            self.advance()
+            return ast.Literal("timestamp", self.advance().text)
+        if self.at_kw("interval"):
+            self.advance()
+            sign = 1
+            if self.accept_op("-"):
+                sign = -1
+            else:
+                self.accept_op("+")
+            v = self.advance()
+            if v.kind != "string":
+                raise ParseError(f"expected interval string at {v.pos}")
+            unit_tok = self.advance()
+            unit = unit_tok.lower
+            if unit not in ("year", "month", "day", "hour", "minute", "second"):
+                raise ParseError(f"bad interval unit {unit_tok.text!r}")
+            return ast.IntervalLiteral(int(v.text), unit, sign)
+        if self.at_kw("case"):
+            return self.case_expr()
+        if self.at_kw("cast"):
+            self.advance()
+            self.expect_op("(")
+            value = self.expr()
+            self.expect_kw("as")
+            type_name = self.type_name()
+            self.expect_op(")")
+            return ast.Cast(value, type_name)
+        if self.at_kw("extract"):
+            self.advance()
+            self.expect_op("(")
+            field = self.advance().lower
+            self.expect_kw("from")
+            value = self.expr()
+            self.expect_op(")")
+            return ast.Extract(field, value)
+        if self.at_kw("substring"):
+            self.advance()
+            self.expect_op("(")
+            value = self.expr()
+            if self.accept_kw("from"):
+                start = self.expr()
+                if self.accept_kw("for"):
+                    length = self.expr()
+                    self.expect_op(")")
+                    return ast.FunctionCall("substring", (value, start, length))
+                self.expect_op(")")
+                return ast.FunctionCall("substring", (value, start))
+            args = [value]
+            while self.accept_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+            return ast.FunctionCall("substring", tuple(args))
+        if self.at_kw("exists"):
+            self.advance()
+            self.expect_op("(")
+            q = self.query()
+            self.expect_op(")")
+            return ast.Exists(q)
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                q = self.query()
+                self.expect_op(")")
+                return ast.ScalarSubquery(q)
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "ident" or (t.kind == "kw" and t.lower in (
+            "year", "month", "day", "date", "first", "last", "quarter", "values",
+        )):
+            # function call or (qualified) identifier
+            if self.peek(1).kind == "op" and self.peek(1).text == "(":
+                name = self.advance().text
+                self.advance()  # (
+                if self.accept_op("*"):
+                    self.expect_op(")")
+                    return ast.FunctionCall(name.lower(), (), is_star=True)
+                distinct = bool(self.accept_kw("distinct"))
+                self.accept_kw("all")
+                args: List[ast.Expression] = []
+                if not self.at_op(")"):
+                    args.append(self.expr())
+                    while self.accept_op(","):
+                        args.append(self.expr())
+                self.expect_op(")")
+                return ast.FunctionCall(name.lower(), tuple(args), distinct=distinct)
+            parts = self.qualified_name()
+            return ast.Identifier(tuple(parts))
+        raise ParseError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def case_expr(self) -> ast.Expression:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.expr()
+            self.expect_kw("then")
+            val = self.expr()
+            whens.append((cond, val))
+        default = None
+        if self.accept_kw("else"):
+            default = self.expr()
+        self.expect_kw("end")
+        if operand is not None:
+            return ast.SimpleCase(operand, tuple(whens), default)
+        return ast.SearchedCase(tuple(whens), default)
+
+    def type_name(self) -> str:
+        parts = [self.advance().text]
+        if self.accept_op("("):
+            parts.append("(")
+            parts.append(self.advance().text)
+            while self.accept_op(","):
+                parts.append(",")
+                parts.append(self.advance().text)
+            self.expect_op(")")
+            parts.append(")")
+        name = "".join(parts)
+        if name.lower() == "double" and self.at_kw():
+            pass
+        return name

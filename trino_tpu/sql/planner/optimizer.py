@@ -1,0 +1,401 @@
+"""Logical plan optimizer.
+
+Reference: ``core/trino-main/.../sql/planner/PlanOptimizers.java`` sequences
+227 iterative rules + big-bang passes. Round-1 passes (the load-bearing
+subset):
+
+- ``push_predicates``: PredicatePushDown analog — moves filter conjuncts to
+  their lowest legal position, turning cross joins (from implicit-join SQL)
+  into equi-keyed hash joins along the way (EqualityInference role).
+- ``prune_channels``: PruneUnreferencedOutputs/projection-pushdown analog —
+  trims every node to the channels actually consumed; at scans this becomes
+  connector projection pushdown (the TPC-H generator then only generates the
+  projected columns).
+- ``order_joins``: greedy size-based join ordering (ReorderJoins stand-in)
+  + distribution choice (AddExchanges' broadcast-vs-partitioned decision)
+  happens in the fragmenter for now.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from trino_tpu import types as T
+from trino_tpu.sql import ir
+from trino_tpu.sql.planner import plan as P
+from trino_tpu.sql.planner.planner import combine_conjuncts, ir_conjuncts
+
+
+def optimize(root: P.OutputNode, session=None) -> P.OutputNode:
+    node = push_predicates(root.source, [])
+    node = orient_joins(node, session)
+    node, _ = prune_channels(node, set(range(len(node.output_types))))
+    return P.OutputNode(node, root.column_names)
+
+
+# ----------------------------------------------------- join orientation
+
+
+def unique_key_sets(node: P.PlanNode, session) -> List[frozenset]:
+    """Channel sets whose values are unique in node's output.
+
+    Reference analog: uniqueness/cardinality reasoning the CBO does via
+    stats; here structural (primary keys, group-by outputs) and used to pick
+    the lookup-join build side (executor requires a unique build)."""
+    if isinstance(node, P.TableScanNode):
+        conn = session.catalogs.get(node.catalog) if session else None
+        pk = conn.primary_key(node.schema, node.table) if conn else None
+        if pk and all(c in node.column_names for c in pk):
+            return [frozenset(node.column_names.index(c) for c in pk)]
+        return []
+    if isinstance(node, (P.FilterNode, P.SortNode, P.TopNNode, P.LimitNode, P.ExchangeNode)):
+        return unique_key_sets(node.source, session)
+    if isinstance(node, P.ProjectNode):
+        mapping = {}
+        for out_ch, e in enumerate(node.expressions):
+            if isinstance(e, ir.ColumnRef):
+                mapping.setdefault(e.index, out_ch)
+        out = []
+        for s in unique_key_sets(node.source, session):
+            if all(c in mapping for c in s):
+                out.append(frozenset(mapping[c] for c in s))
+        return out
+    if isinstance(node, P.AggregationNode):
+        k = len(node.group_channels)
+        return [frozenset(range(k))] if k else []
+    if isinstance(node, P.JoinNode):
+        if node.join_type in ("semi", "anti"):
+            return unique_key_sets(node.left, session)
+        if node.right_unique and node.join_type in ("inner", "left"):
+            # N:1 join preserves left-side uniqueness; left channels keep indices
+            return unique_key_sets(node.left, session)
+        return []
+    return []
+
+
+def orient_joins(node: P.PlanNode, session) -> P.PlanNode:
+    """Bottom-up: make the unique-keyed side the build (right) side of each
+    lookup join, flipping sides (and restoring channel order with a Project)
+    when only the left side is unique."""
+    if isinstance(node, P.JoinNode):
+        node.left = orient_joins(node.left, session)
+        node.right = orient_joins(node.right, session)
+    else:
+        new_sources = [orient_joins(s, session) for s in node.sources]
+        _replace_sources(node, new_sources)
+    if not isinstance(node, P.JoinNode) or node.join_type in ("semi", "anti"):
+        return node
+    if not node.left_keys:
+        return node  # scalar-subquery singleton cross join
+    if _covered(node.right_keys, unique_key_sets(node.right, session)):
+        node.right_unique = True
+        return node
+    if node.join_type == "inner" and _covered(
+        node.left_keys, unique_key_sets(node.left, session)
+    ):
+        nleft = len(node.left.output_types)
+        nright = len(node.right.output_types)
+        flipped = P.JoinNode(
+            join_type="inner", left=node.right, right=node.left,
+            left_keys=list(node.right_keys), right_keys=list(node.left_keys),
+            filter=(
+                ir.remap_channels(
+                    node.filter,
+                    {
+                        **{c: nright + c for c in range(nleft)},
+                        **{nleft + c: c for c in range(nright)},
+                    },
+                )
+                if node.filter is not None
+                else None
+            ),
+            distribution=node.distribution,
+            right_unique=True,
+        )
+        # restore original channel order: left channels then right channels
+        tys = node.left.output_types + node.right.output_types
+        nms = node.left.output_names + node.right.output_names
+        order = list(range(nright, nright + nleft)) + list(range(nright))
+        return P.ProjectNode(
+            flipped,
+            [ir.ColumnRef(tys[i], order[i], nms[i]) for i in range(len(order))],
+            nms,
+        )
+    raise NotImplementedError(
+        "M:N join (neither side provably unique on the join keys): round 2 "
+        f"keys L{node.left_keys} R{node.right_keys}"
+    )
+
+
+def _covered(keys: List[int], unique_sets: List[frozenset]) -> bool:
+    ks = set(keys)
+    return any(s <= ks for s in unique_sets)
+
+
+# --------------------------------------------------------------- pushdown
+
+
+def substitute(e: ir.Expr, mapping: Dict[int, ir.Expr]) -> ir.Expr:
+    if isinstance(e, ir.ColumnRef):
+        return mapping[e.index]
+    if isinstance(e, ir.Call):
+        return ir.Call(e.type, e.name, tuple(substitute(a, mapping) for a in e.args))
+    if isinstance(e, ir.Case):
+        return ir.Case(
+            e.type,
+            tuple((substitute(c, mapping), substitute(v, mapping)) for c, v in e.whens),
+            substitute(e.default, mapping) if e.default is not None else None,
+        )
+    if isinstance(e, ir.Cast):
+        return ir.Cast(e.type, substitute(e.value, mapping))
+    return e
+
+
+def push_predicates(node: P.PlanNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
+    """Push ``conjuncts`` (over node's output channels) down through ``node``."""
+    if isinstance(node, P.FilterNode):
+        return push_predicates(node.source, conjuncts + ir_conjuncts(node.predicate))
+    if isinstance(node, P.ProjectNode):
+        mapping = dict(enumerate(node.expressions))
+        inlined = [substitute(c, mapping) for c in conjuncts]
+        src = push_predicates(node.source, inlined)
+        return P.ProjectNode(src, node.expressions, node.names)
+    if isinstance(node, P.JoinNode):
+        return _push_into_join(node, conjuncts)
+    if isinstance(node, (P.LimitNode, P.TopNNode, P.SortNode, P.AggregationNode, P.ExchangeNode)):
+        # not safe/supported to push through in round 1 — recurse with nothing
+        new_sources = [push_predicates(s, []) for s in node.sources]
+        node = _replace_sources(node, new_sources)
+        return _wrap_filter(node, conjuncts)
+    # leaves (scan, values)
+    return _wrap_filter(node, conjuncts)
+
+
+def _wrap_filter(node: P.PlanNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
+    pred = combine_conjuncts(conjuncts)
+    return P.FilterNode(node, pred) if pred is not None else node
+
+
+def _replace_sources(node: P.PlanNode, sources: List[P.PlanNode]) -> P.PlanNode:
+    if isinstance(node, P.JoinNode):
+        node.left, node.right = sources
+    elif sources:
+        node.source = sources[0]
+    return node
+
+
+def _push_into_join(node: P.JoinNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
+    nleft = len(node.left.output_types)
+    nright = len(node.right.output_types)
+    left_conj: List[ir.Expr] = []
+    right_conj: List[ir.Expr] = []
+    new_left_keys = list(node.left_keys)
+    new_right_keys = list(node.right_keys)
+    residual: List[ir.Expr] = []
+    above: List[ir.Expr] = []
+    semi = node.join_type in ("semi", "anti")
+    outer = node.join_type == "left"
+
+    pending = list(conjuncts)
+    if node.filter is not None and node.join_type == "inner":
+        pending += ir_conjuncts(node.filter)
+        node.filter = None
+
+    for c in pending:
+        chans = set(ir.referenced_channels(c))
+        if semi:
+            # output channels == left channels: pushing into left is always legal
+            left_conj.append(c)
+            continue
+        if chans and max(chans, default=-1) < nleft:
+            left_conj.append(c)
+            continue
+        if chans and min(chans, default=nleft) >= nleft:
+            rc = ir.remap_channels(c, {i: i - nleft for i in chans})
+            if outer:
+                above.append(c)  # can't push to right of a left join
+            else:
+                right_conj.append(rc)
+            continue
+        # mixed: equi-join key?
+        if (
+            node.join_type == "inner"
+            and isinstance(c, ir.Call)
+            and c.name == "eq"
+            and isinstance(c.args[0], ir.ColumnRef)
+            and isinstance(c.args[1], ir.ColumnRef)
+        ):
+            a, b = c.args[0].index, c.args[1].index
+            if a < nleft <= b:
+                new_left_keys.append(a)
+                new_right_keys.append(b - nleft)
+                continue
+            if b < nleft <= a:
+                new_left_keys.append(b)
+                new_right_keys.append(a - nleft)
+                continue
+        if node.join_type == "inner":
+            residual.append(c)
+        else:
+            above.append(c)
+
+    node.left = push_predicates(node.left, left_conj)
+    node.right = push_predicates(node.right, right_conj)
+    node.left_keys = new_left_keys
+    node.right_keys = new_right_keys
+    existing_filter = ir_conjuncts(node.filter)
+    node.filter = combine_conjuncts(existing_filter + residual)
+    return _wrap_filter(node, above)
+
+
+def prune_output(node: P.PlanNode) -> P.PlanNode:
+    return node
+
+
+# ----------------------------------------------------------------- pruning
+
+
+def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict[int, int]]:
+    """Rewrite the subtree to produce only ``needed`` output channels.
+
+    Returns (new_node, mapping old_channel -> new_channel)."""
+    if isinstance(node, P.TableScanNode):
+        keep = sorted(needed)
+        mapping = {old: i for i, old in enumerate(keep)}
+        new = P.TableScanNode(
+            catalog=node.catalog, schema=node.schema, table=node.table,
+            column_names=[node.column_names[i] for i in keep],
+            column_types=[node.column_types[i] for i in keep],
+            table_handle=node.table_handle,
+        )
+        return new, mapping
+    if isinstance(node, P.ValuesNode):
+        keep = sorted(needed)
+        mapping = {old: i for i, old in enumerate(keep)}
+        new = P.ValuesNode(
+            [node.types[i] for i in keep],
+            [node.names[i] for i in keep],
+            [tuple(r[i] for i in keep) for r in node.rows],
+        )
+        return new, mapping
+    if isinstance(node, P.ProjectNode):
+        keep = sorted(needed)
+        kept_exprs = [node.expressions[i] for i in keep]
+        src_needed = set()
+        for e in kept_exprs:
+            src_needed.update(ir.referenced_channels(e))
+        src, src_map = prune_channels(node.source, src_needed)
+        new_exprs = [ir.remap_channels(e, src_map) for e in kept_exprs]
+        new = P.ProjectNode(src, new_exprs, [node.names[i] for i in keep])
+        return new, {old: i for i, old in enumerate(keep)}
+    if isinstance(node, P.FilterNode):
+        src_needed = set(needed) | set(ir.referenced_channels(node.predicate))
+        src, src_map = prune_channels(node.source, src_needed)
+        pred = ir.remap_channels(node.predicate, src_map)
+        filt = P.FilterNode(src, pred)
+        if src_needed == needed:
+            return filt, src_map
+        keep = sorted(needed)
+        proj = P.ProjectNode(
+            filt,
+            [
+                ir.ColumnRef(node.source.output_types[i], src_map[i],
+                             node.source.output_names[i])
+                for i in keep
+            ],
+            [node.source.output_names[i] for i in keep],
+        )
+        return proj, {old: i for i, old in enumerate(keep)}
+    if isinstance(node, P.AggregationNode):
+        k = len(node.group_channels)
+        kept_aggs = [
+            (i, a) for i, a in enumerate(node.aggregates) if (k + i) in needed or not needed
+        ]
+        src_needed = set(node.group_channels)
+        for _, a in kept_aggs:
+            if a.arg_channel is not None:
+                src_needed.add(a.arg_channel)
+        src, src_map = prune_channels(node.source, src_needed)
+        new_aggs = [
+            P.AggregateCall(
+                a.function,
+                src_map[a.arg_channel] if a.arg_channel is not None else None,
+                a.output_type,
+                a.distinct,
+            )
+            for _, a in kept_aggs
+        ]
+        new_groups = [src_map[c] for c in node.group_channels]
+        names = [node.names[c] for c in range(k)] + [
+            node.names[k + i] for i, _ in kept_aggs
+        ]
+        new_node = P.AggregationNode(src, new_groups, new_aggs, node.step, names)
+        mapping = {c: c for c in range(k)}
+        for newi, (oldi, _) in enumerate(kept_aggs):
+            mapping[k + oldi] = k + newi
+        return new_node, mapping
+    if isinstance(node, P.JoinNode):
+        nleft = len(node.left.output_types)
+        semi = node.join_type in ("semi", "anti")
+        filter_chans = set(ir.referenced_channels(node.filter)) if node.filter is not None else set()
+        left_needed = {c for c in needed if c < nleft} | set(node.left_keys) | {
+            c for c in filter_chans if c < nleft
+        }
+        right_needed = (
+            set(node.right_keys) | {c - nleft for c in filter_chans if c >= nleft}
+        )
+        if not semi:
+            right_needed |= {c - nleft for c in needed if c >= nleft}
+        new_left, lmap = prune_channels(node.left, left_needed)
+        new_right, rmap = prune_channels(node.right, right_needed)
+        node_filter = node.filter
+        if node_filter is not None:
+            fmap = {c: lmap[c] for c in filter_chans if c < nleft}
+            nl = len(new_left.output_types)
+            fmap.update({c: nl + rmap[c - nleft] for c in filter_chans if c >= nleft})
+            node_filter = ir.remap_channels(node_filter, fmap)
+        new_node = P.JoinNode(
+            join_type=node.join_type, left=new_left, right=new_right,
+            left_keys=[lmap[c] for c in node.left_keys],
+            right_keys=[rmap[c] for c in node.right_keys],
+            filter=node_filter, distribution=node.distribution,
+            right_unique=node.right_unique,
+        )
+        if semi:
+            return new_node, lmap
+        nl = len(new_left.output_types)
+        mapping = dict(lmap)
+        mapping.update({nleft + c: nl + rc for c, rc in rmap.items()})
+        # the join output may contain channels not in `needed` (keys kept for
+        # the join itself); project them away if any extra survive
+        produced = set(mapping[c] for c in needed)
+        total = nl + len(new_right.output_types)
+        if len(produced) != total:
+            keep = sorted(mapping[c] for c in needed)
+            tys = new_node.output_types
+            nms = new_node.output_names
+            proj = P.ProjectNode(
+                new_node,
+                [ir.ColumnRef(tys[c], c, nms[c]) for c in keep],
+                [nms[c] for c in keep],
+            )
+            inv = {c: i for i, c in enumerate(keep)}
+            return proj, {c: inv[mapping[c]] for c in needed}
+        return new_node, mapping
+    if isinstance(node, (P.SortNode, P.TopNNode)):
+        src_needed = set(needed) | {c for c, _, _ in node.sort_channels}
+        src, src_map = prune_channels(node.source, src_needed)
+        node.source = src
+        node.sort_channels = [(src_map[c], a, nf) for c, a, nf in node.sort_channels]
+        return node, src_map
+    if isinstance(node, P.LimitNode):
+        src, src_map = prune_channels(node.source, needed)
+        node.source = src
+        return node, src_map
+    if isinstance(node, P.ExchangeNode):
+        src_needed = set(needed) | set(node.partition_channels or [])
+        src, src_map = prune_channels(node.source, src_needed)
+        node.source = src
+        if node.partition_channels:
+            node.partition_channels = [src_map[c] for c in node.partition_channels]
+        return node, src_map
+    raise NotImplementedError(f"prune_channels: {type(node).__name__}")

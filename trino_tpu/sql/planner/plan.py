@@ -1,0 +1,347 @@
+"""Logical plan nodes.
+
+Reference: ``core/trino-main/.../sql/planner/plan/`` (46 concrete node types).
+Round-1 subset (~15) covering the TPC-H surface; grows with the engine.
+Plans are *channel-positional*: every node exposes ``output_types`` (and
+debug ``output_names``); expressions inside a node are IR over the node's
+input channels (left channels then right channels for joins, as in the
+reference's symbol->channel layout done by LocalExecutionPlanner).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from trino_tpu import types as T
+from trino_tpu.sql import ir
+
+_next_plan_id = itertools.count()
+
+
+@dataclasses.dataclass
+class PlanNode:
+    id: int = dataclasses.field(default_factory=lambda: next(_next_plan_id), init=False)
+
+    @property
+    def sources(self) -> Sequence["PlanNode"]:
+        return ()
+
+    @property
+    def output_types(self) -> List[T.Type]:
+        raise NotImplementedError
+
+    @property
+    def output_names(self) -> List[str]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class TableScanNode(PlanNode):
+    """Reference: plan/TableScanNode.java — here carries the connector handle
+    directly (catalog, schema, table) plus the projected column subset."""
+
+    catalog: str
+    schema: str
+    table: str
+    column_names: List[str]
+    column_types: List[T.Type]
+    table_handle: object = None  # connector-provided
+
+    @property
+    def output_types(self):
+        return list(self.column_types)
+
+    @property
+    def output_names(self):
+        return list(self.column_names)
+
+
+@dataclasses.dataclass
+class FilterNode(PlanNode):
+    source: PlanNode = None
+    predicate: ir.Expr = None
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        return self.source.output_types
+
+    @property
+    def output_names(self):
+        return self.source.output_names
+
+
+@dataclasses.dataclass
+class ProjectNode(PlanNode):
+    source: PlanNode = None
+    expressions: List[ir.Expr] = None
+    names: List[str] = None
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        return [e.type for e in self.expressions]
+
+    @property
+    def output_names(self):
+        return list(self.names)
+
+    @staticmethod
+    def identity_prefix(source: PlanNode, extra: List[ir.Expr], extra_names: List[str]):
+        exprs = [
+            ir.ColumnRef(t, i, n)
+            for i, (t, n) in enumerate(zip(source.output_types, source.output_names))
+        ]
+        return ProjectNode(source, exprs + extra, source.output_names + extra_names)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateCall:
+    function: str  # count | count_star | sum | avg | min | max
+    arg_channel: Optional[int]  # None for count(*)
+    output_type: T.Type
+    distinct: bool = False
+    # count(*) counts rows; count(x) counts non-null x
+
+
+@dataclasses.dataclass
+class AggregationNode(PlanNode):
+    """Reference: plan/AggregationNode.java + HashAggregationOperator.
+    step: 'single' | 'partial' | 'final' (partial/final appear after the
+    fragmenter splits the aggregation across an exchange)."""
+
+    source: PlanNode = None
+    group_channels: List[int] = None
+    aggregates: List[AggregateCall] = None
+    step: str = "single"
+    names: List[str] = None
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        src = self.source.output_types
+        types = [src[c] for c in self.group_channels]
+        if self.step == "partial":
+            types += [t for agg in self.aggregates for t in _acc_types(agg, src)]
+        else:
+            types += [a.output_type for a in self.aggregates]
+        return types
+
+    @property
+    def output_names(self):
+        return list(self.names)
+
+
+def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
+    """Accumulator (partial-state) types for an aggregate (reference:
+    AccumulatorCompiler intermediate state)."""
+    if agg.function in ("count", "count_star"):
+        return [T.BIGINT]
+    if agg.function == "avg":
+        # running (sum, count)
+        base = src_types[agg.arg_channel]
+        return [T.DOUBLE if base.is_floating else base, T.BIGINT]
+    if agg.function in ("min", "max", "sum"):
+        return [agg.output_type if agg.function == "sum" else src_types[agg.arg_channel]]
+    raise NotImplementedError(agg.function)
+
+
+@dataclasses.dataclass
+class JoinNode(PlanNode):
+    """Reference: plan/JoinNode.java. Output = left channels ++ right channels
+    (probe then build). ``distribution``: None until the optimizer picks
+    partitioned vs broadcast (AddExchanges analog)."""
+
+    join_type: str = "inner"  # inner | left | semi | anti (right/full: round 2)
+    left: PlanNode = None
+    right: PlanNode = None
+    left_keys: List[int] = None
+    right_keys: List[int] = None
+    filter: Optional[ir.Expr] = None  # over concatenated channels
+    distribution: Optional[str] = None  # 'partitioned' | 'broadcast'
+    right_unique: bool = False  # build side keys unique (N:1 lookup join)
+
+    @property
+    def sources(self):
+        return (self.left, self.right)
+
+    @property
+    def output_types(self):
+        if self.join_type in ("semi", "anti"):
+            return self.left.output_types
+        return self.left.output_types + self.right.output_types
+
+    @property
+    def output_names(self):
+        if self.join_type in ("semi", "anti"):
+            return self.left.output_names
+        return self.left.output_names + self.right.output_names
+
+
+@dataclasses.dataclass
+class SortNode(PlanNode):
+    source: PlanNode = None
+    sort_channels: List[Tuple[int, bool, Optional[bool]]] = None  # (ch, asc, nulls_first)
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        return self.source.output_types
+
+    @property
+    def output_names(self):
+        return self.source.output_names
+
+
+@dataclasses.dataclass
+class TopNNode(PlanNode):
+    source: PlanNode = None
+    count: int = 0
+    sort_channels: List[Tuple[int, bool, Optional[bool]]] = None
+    step: str = "single"  # single | partial | final
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        return self.source.output_types
+
+    @property
+    def output_names(self):
+        return self.source.output_names
+
+
+@dataclasses.dataclass
+class LimitNode(PlanNode):
+    source: PlanNode = None
+    count: int = 0
+    step: str = "single"
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        return self.source.output_types
+
+    @property
+    def output_names(self):
+        return self.source.output_names
+
+
+@dataclasses.dataclass
+class OutputNode(PlanNode):
+    source: PlanNode = None
+    column_names: List[str] = None
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        return self.source.output_types
+
+    @property
+    def output_names(self):
+        return list(self.column_names)
+
+
+@dataclasses.dataclass
+class ValuesNode(PlanNode):
+    types: List[T.Type] = None
+    names: List[str] = None
+    rows: List[tuple] = None
+
+    @property
+    def output_types(self):
+        return list(self.types)
+
+    @property
+    def output_names(self):
+        return list(self.names)
+
+
+@dataclasses.dataclass
+class ExchangeNode(PlanNode):
+    """Reference: plan/ExchangeNode.java — the fragmenter cuts plans here
+    (PlanFragmenter.java:94). partitioning: 'single' (gather),
+    'hash' (repartition on key channels), 'broadcast' (replicate)."""
+
+    source: PlanNode = None
+    partitioning: str = "single"
+    partition_channels: List[int] = None
+    scope: str = "remote"  # remote | local
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        return self.source.output_types
+
+    @property
+    def output_names(self):
+        return self.source.output_names
+
+
+def walk_plan(node: PlanNode):
+    yield node
+    for s in node.sources:
+        yield from walk_plan(s)
+
+
+def format_plan(node: PlanNode, indent: int = 0) -> str:
+    """Text plan printer (reference: sql/planner/planprinter/PlanPrinter.java)."""
+    pad = "  " * indent
+    label = type(node).__name__.replace("Node", "")
+    detail = ""
+    if isinstance(node, TableScanNode):
+        detail = f" {node.catalog}.{node.schema}.{node.table} -> {node.column_names}"
+    elif isinstance(node, FilterNode):
+        detail = f" {node.predicate!r}"
+    elif isinstance(node, ProjectNode):
+        detail = f" {[f'{n}:={e!r}' for n, e in zip(node.names, node.expressions)]}"
+    elif isinstance(node, AggregationNode):
+        detail = f" [{node.step}] keys={node.group_channels} aggs={[a.function for a in self_aggs(node)]}"
+    elif isinstance(node, JoinNode):
+        detail = (
+            f" [{node.join_type}{'/' + node.distribution if node.distribution else ''}]"
+            f" L{node.left_keys} = R{node.right_keys}"
+            + (f" filter={node.filter!r}" if node.filter is not None else "")
+        )
+    elif isinstance(node, (SortNode, TopNNode)):
+        detail = f" by={node.sort_channels}" + (
+            f" count={node.count}" if isinstance(node, TopNNode) else ""
+        )
+    elif isinstance(node, LimitNode):
+        detail = f" {node.count}"
+    elif isinstance(node, ExchangeNode):
+        detail = f" [{node.scope}/{node.partitioning}] keys={node.partition_channels}"
+    elif isinstance(node, OutputNode):
+        detail = f" {node.column_names}"
+    lines = [f"{pad}- {label}{detail}"]
+    for s in node.sources:
+        lines.append(format_plan(s, indent + 1))
+    return "\n".join(lines)
+
+
+def self_aggs(node: AggregationNode):
+    return node.aggregates or []

@@ -1,0 +1,662 @@
+"""AST -> logical plan.
+
+Reference: ``core/trino-main/.../sql/planner/LogicalPlanner.java:167`` +
+``QueryPlanner``/``RelationPlanner`` — plans relations, predicates,
+aggregations, sorts; subqueries are decorrelated into semi/anti joins or
+single-row cross joins (the role of Trino's ApplyNode + correlated-subquery
+rewrite rules, done here directly at planning time).
+
+Join planning for implicit (comma) joins builds the join from WHERE equi
+conjuncts greedily in FROM order — the CBO join-reordering pass
+(reference ReorderJoins) refines this in the optimizer.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from trino_tpu import types as T
+from trino_tpu.sql import ir
+from trino_tpu.sql.analyzer.expr_analyzer import (
+    AGGREGATE_FUNCTIONS,
+    AnalysisError,
+    ExprAnalyzer,
+    aggregate_result_type,
+    find_aggregates,
+)
+from trino_tpu.sql.analyzer.scope import Field, Scope
+from trino_tpu.sql.parser import ast
+from trino_tpu.sql.planner import plan as P
+
+
+class PlanningError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class RelationPlan:
+    node: P.PlanNode
+    scope: Scope
+
+
+def split_conjuncts(e: Optional[ast.Expression]) -> List[ast.Expression]:
+    if e is None:
+        return []
+    if isinstance(e, ast.LogicalBinary) and e.op == "and":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def ir_conjuncts(e: Optional[ir.Expr]) -> List[ir.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, ir.Call) and e.name == "and":
+        return ir_conjuncts(e.args[0]) + ir_conjuncts(e.args[1])
+    return [e]
+
+
+def combine_conjuncts(parts: Sequence[ir.Expr]) -> Optional[ir.Expr]:
+    out = None
+    for p in parts:
+        out = p if out is None else ir.Call(T.BOOLEAN, "and", (out, p))
+    return out
+
+
+class Planner:
+    def __init__(self, session):
+        self.session = session
+        self.catalogs = session.catalogs
+        self.default_catalog = session.properties.get("catalog", "tpch")
+        self.default_schema = session.properties.get("schema", "tiny")
+
+    # ------------------------------------------------------------------ api
+    def plan(self, query: ast.Query) -> P.OutputNode:
+        rp = self.plan_query(query, outer_scope=None, ctes={})
+        return P.OutputNode(rp.node, [f.name or f"_col{i}" for i, f in enumerate(rp.scope.fields)])
+
+    # ------------------------------------------------------------- relations
+    def plan_query(
+        self, query: ast.Query, outer_scope: Optional[Scope], ctes: Dict[str, ast.WithQuery]
+    ) -> RelationPlan:
+        ctes = dict(ctes)
+        for wq in query.with_queries:
+            ctes[wq.name.lower()] = wq
+        body = query.body
+        if isinstance(body, ast.SetOperation):
+            raise PlanningError("set operations: round 2")
+        if isinstance(body, ast.Query):
+            inner = self.plan_query(body, outer_scope, ctes)
+            body_plan = inner
+        else:
+            body_plan = self.plan_query_spec(body, outer_scope, ctes, query)
+            return body_plan  # ORDER BY/LIMIT handled inside (needs agg scope)
+        # parenthesized query: apply outer ORDER BY/LIMIT
+        node = body_plan.node
+        if query.order_by:
+            raise PlanningError("ORDER BY on parenthesized query: round 2")
+        if query.limit is not None:
+            node = P.LimitNode(node, query.limit)
+        return RelationPlan(node, body_plan.scope)
+
+    def plan_relation(
+        self, rel: ast.Relation, outer_scope: Optional[Scope], ctes: Dict[str, ast.WithQuery]
+    ) -> RelationPlan:
+        if isinstance(rel, ast.Table):
+            name = rel.parts[-1].lower()
+            if len(rel.parts) == 1 and name in ctes:
+                wq = ctes[name]
+                sub = self.plan_query(wq.query, outer_scope, ctes)
+                names = (
+                    list(wq.column_aliases)
+                    if wq.column_aliases
+                    else [f.name for f in sub.scope.fields]
+                )
+                fields = [
+                    Field(n, f.type, wq.name) for n, f in zip(names, sub.scope.fields)
+                ]
+                return RelationPlan(sub.node, Scope(fields, outer_scope))
+            return self.plan_table_scan(rel, outer_scope)
+        if isinstance(rel, ast.AliasedRelation):
+            inner = self.plan_relation(rel.relation, outer_scope, ctes)
+            names = (
+                list(rel.column_aliases)
+                if rel.column_aliases
+                else [f.name for f in inner.scope.fields]
+            )
+            fields = [Field(n, f.type, rel.alias) for n, f in zip(names, inner.scope.fields)]
+            return RelationPlan(inner.node, Scope(fields, outer_scope))
+        if isinstance(rel, ast.SubqueryRelation):
+            sub = self.plan_query(rel.query, outer_scope, ctes)
+            fields = [Field(f.name, f.type, None) for f in sub.scope.fields]
+            return RelationPlan(sub.node, Scope(fields, outer_scope))
+        if isinstance(rel, ast.Join):
+            return self.plan_join(rel, outer_scope, ctes)
+        raise PlanningError(f"unsupported relation {type(rel).__name__}")
+
+    def plan_table_scan(self, rel: ast.Table, outer_scope: Optional[Scope]) -> RelationPlan:
+        parts = [p.lower() for p in rel.parts]
+        if len(parts) == 1:
+            catalog, schema, table = self.default_catalog, self.default_schema, parts[0]
+        elif len(parts) == 2:
+            catalog, schema, table = self.default_catalog, parts[0], parts[1]
+        elif len(parts) == 3:
+            catalog, schema, table = parts
+        else:
+            raise PlanningError(f"bad table name {'.'.join(rel.parts)}")
+        conn = self.catalogs.get(catalog)
+        if conn is None:
+            raise PlanningError(f"catalog not found: {catalog}")
+        meta = conn.get_table(schema, table)
+        if meta is None:
+            raise PlanningError(f"table not found: {catalog}.{schema}.{table}")
+        node = P.TableScanNode(
+            catalog=catalog,
+            schema=schema,
+            table=table,
+            column_names=[c.name for c in meta.columns],
+            column_types=[c.type for c in meta.columns],
+        )
+        fields = [Field(c.name, c.type, table) for c in meta.columns]
+        return RelationPlan(node, Scope(fields, outer_scope))
+
+    def plan_join(
+        self, rel: ast.Join, outer_scope: Optional[Scope], ctes: Dict[str, ast.WithQuery]
+    ) -> RelationPlan:
+        left = self.plan_relation(rel.left, outer_scope, ctes)
+        right = self.plan_relation(rel.right, outer_scope, ctes)
+        joint_fields = left.scope.fields + right.scope.fields
+        joint_scope = Scope(joint_fields, outer_scope)
+        nleft = len(left.scope.fields)
+
+        if rel.join_type in ("cross", "implicit"):
+            node = P.JoinNode(
+                join_type="inner", left=left.node, right=right.node,
+                left_keys=[], right_keys=[], filter=None,
+            )
+            return RelationPlan(node, joint_scope)
+
+        if rel.using:
+            conj = []
+            for c in rel.using:
+                conj.append(
+                    ast.Comparison("=", ast.Identifier((c,)), ast.Identifier((c,)))
+                )
+            raise PlanningError("JOIN USING: round 2")
+
+        analyzer = ExprAnalyzer(joint_scope)
+        predicate = analyzer.analyze(rel.on) if rel.on is not None else None
+        left_keys, right_keys, residual = self._extract_equi_keys(predicate, nleft)
+        if rel.join_type in ("inner", "left"):
+            node = P.JoinNode(
+                join_type=rel.join_type, left=left.node, right=right.node,
+                left_keys=left_keys, right_keys=right_keys,
+                filter=combine_conjuncts(residual),
+            )
+            return RelationPlan(node, joint_scope)
+        raise PlanningError(f"{rel.join_type} join: round 2")
+
+    @staticmethod
+    def _extract_equi_keys(
+        predicate: Optional[ir.Expr], nleft: int
+    ) -> Tuple[List[int], List[int], List[ir.Expr]]:
+        left_keys: List[int] = []
+        right_keys: List[int] = []
+        residual: List[ir.Expr] = []
+        for c in ir_conjuncts(predicate):
+            if (
+                isinstance(c, ir.Call)
+                and c.name == "eq"
+                and isinstance(c.args[0], ir.ColumnRef)
+                and isinstance(c.args[1], ir.ColumnRef)
+            ):
+                a, b = c.args[0].index, c.args[1].index
+                if a < nleft <= b:
+                    left_keys.append(a)
+                    right_keys.append(b - nleft)
+                    continue
+                if b < nleft <= a:
+                    left_keys.append(b)
+                    right_keys.append(a - nleft)
+                    continue
+            residual.append(c)
+        return left_keys, right_keys, residual
+
+    # ---------------------------------------------------------- query spec
+    def plan_query_spec(
+        self,
+        spec: ast.QuerySpec,
+        outer_scope: Optional[Scope],
+        ctes: Dict[str, ast.WithQuery],
+        query: ast.Query,
+    ) -> RelationPlan:
+        # FROM
+        if spec.from_ is not None:
+            rp = self.plan_relation(spec.from_, outer_scope, ctes)
+        else:
+            rp = RelationPlan(P.ValuesNode([], [], [()]), Scope([], outer_scope))
+        node, scope = rp.node, rp.scope
+
+        # WHERE: split into plain conjuncts and subquery predicates
+        plain: List[ir.Expr] = []
+        for conj in split_conjuncts(spec.where):
+            node, scope, handled = self._plan_predicate_subquery(conj, node, scope, ctes)
+            if handled:
+                continue
+            analyzer = ExprAnalyzer(scope)
+            e = analyzer.analyze(conj)
+            if analyzer.outer_refs:
+                raise PlanningError("correlated predicate in unsupported position")
+            plain.append(e)
+        if plain:
+            node = P.FilterNode(node, combine_conjuncts(plain))
+
+        has_aggs = (
+            bool(spec.group_by)
+            or bool(spec.having)
+            or any(find_aggregates(si.expr) for si in spec.select_items if not isinstance(si.expr, ast.Star))
+        )
+        if has_aggs:
+            return self._plan_aggregation(spec, query, node, scope, outer_scope, ctes)
+
+        # plain SELECT
+        select_irs, names, scope_after = self._plan_select_items(spec, scope, ctes, node)
+        node_proj = P.ProjectNode(node, select_irs, names)
+        out_fields = [Field(n, e.type, None) for n, e in zip(names, select_irs)]
+        out_scope = Scope(out_fields, outer_scope)
+        node = node_proj
+        if spec.distinct:
+            node = P.AggregationNode(
+                node, list(range(len(select_irs))), [], step="single", names=names
+            )
+        if query.order_by:
+            node = self._plan_order_by(
+                query, node, out_scope, replacements={}, select_asts=spec.select_items
+            )
+        if query.limit is not None:
+            if query.order_by and isinstance(node, P.SortNode):
+                node = P.TopNNode(node.source, query.limit, node.sort_channels)
+            else:
+                node = P.LimitNode(node, query.limit)
+        return RelationPlan(node, out_scope)
+
+    def _plan_select_items(self, spec, scope, ctes, node):
+        select_irs: List[ir.Expr] = []
+        names: List[str] = []
+        for si in spec.select_items:
+            if isinstance(si.expr, ast.Star):
+                chans = (
+                    scope.channels_of_alias(si.expr.qualifier[0])
+                    if si.expr.qualifier
+                    else range(len(scope.fields))
+                )
+                for ch in chans:
+                    f = scope.fields[ch]
+                    select_irs.append(ir.ColumnRef(f.type, ch, f.name or ""))
+                    names.append(f.name or f"_col{len(names)}")
+                continue
+            analyzer = ExprAnalyzer(scope)
+            e = analyzer.analyze(si.expr)
+            select_irs.append(e)
+            names.append(si.alias or _derive_name(si.expr) or f"_col{len(names)}")
+        return select_irs, names, scope
+
+    # ---------------------------------------------------------- aggregation
+    def _plan_aggregation(self, spec, query, node, scope, outer_scope, ctes) -> RelationPlan:
+        # Collect aggregate calls from SELECT, HAVING, ORDER BY
+        agg_asts: List[ast.FunctionCall] = []
+        for si in spec.select_items:
+            if not isinstance(si.expr, ast.Star):
+                agg_asts.extend(find_aggregates(si.expr))
+        if spec.having is not None:
+            agg_asts.extend(find_aggregates(spec.having))
+        for s in query.order_by:
+            agg_asts.extend(find_aggregates(s.expr))
+        # dedupe by structural equality
+        uniq_aggs: List[ast.FunctionCall] = []
+        for a in agg_asts:
+            if a not in uniq_aggs:
+                uniq_aggs.append(a)
+
+        # group keys: resolve ordinals (GROUP BY 1) to select expressions
+        group_asts: List[ast.Expression] = []
+        for g in spec.group_by:
+            if isinstance(g, ast.Literal) and g.kind == "number":
+                idx = int(g.value) - 1
+                if not 0 <= idx < len(spec.select_items):
+                    raise PlanningError("GROUP BY ordinal out of range")
+                group_asts.append(spec.select_items[idx].expr)
+            else:
+                group_asts.append(g)
+
+        analyzer = ExprAnalyzer(scope, allow_aggregates=True)
+        group_irs = [analyzer.analyze(g) for g in group_asts]
+        agg_arg_irs: List[Optional[ir.Expr]] = []
+        agg_calls: List[P.AggregateCall] = []
+        pre_exprs: List[ir.Expr] = list(group_irs)
+        pre_names: List[str] = [_derive_name(g) or f"gk{i}" for i, g in enumerate(group_asts)]
+        for a in uniq_aggs:
+            if a.is_star:
+                agg_arg_irs.append(None)
+                agg_calls.append(P.AggregateCall("count", None, T.BIGINT))
+                continue
+            if len(a.args) != 1:
+                raise PlanningError(f"{a.name} expects 1 argument")
+            arg = ExprAnalyzer(scope).analyze(a.args[0])
+            out_t = aggregate_result_type(a.name, arg.type)
+            ch = len(pre_exprs)
+            pre_exprs.append(arg)
+            pre_names.append(f"aggarg{len(agg_calls)}")
+            agg_calls.append(P.AggregateCall(a.name, ch, out_t, distinct=a.distinct))
+            agg_arg_irs.append(arg)
+
+        pre_project = P.ProjectNode(node, pre_exprs, pre_names)
+        k = len(group_irs)
+        agg_names = [pre_names[i] for i in range(k)] + [
+            f"agg{i}" for i in range(len(agg_calls))
+        ]
+        agg_node = P.AggregationNode(
+            pre_project, list(range(k)), agg_calls, step="single", names=agg_names
+        )
+
+        # scope over aggregation output + replacement map for outer exprs
+        agg_fields = [
+            Field(scope.fields[g.index].name if isinstance(g, ir.ColumnRef) else None,
+                  g.type,
+                  scope.fields[g.index].relation_alias if isinstance(g, ir.ColumnRef) else None)
+            for g in group_irs
+        ] + [Field(None, c.output_type, None) for c in agg_calls]
+        agg_scope = Scope(agg_fields, outer_scope)
+        replacements: Dict[ast.Expression, ir.Expr] = {}
+        for i, g in enumerate(group_asts):
+            replacements[g] = ir.ColumnRef(group_irs[i].type, i, pre_names[i])
+        for i, a in enumerate(uniq_aggs):
+            replacements[a] = ir.ColumnRef(agg_calls[i].output_type, k + i, f"agg{i}")
+
+        node = agg_node
+        if spec.having is not None:
+            han = ExprAnalyzer(agg_scope, replacements).analyze(spec.having)
+            node = P.FilterNode(node, han)
+
+        select_irs: List[ir.Expr] = []
+        names: List[str] = []
+        for si in spec.select_items:
+            if isinstance(si.expr, ast.Star):
+                raise PlanningError("SELECT * with GROUP BY")
+            e = ExprAnalyzer(agg_scope, replacements).analyze(si.expr)
+            select_irs.append(e)
+            names.append(si.alias or _derive_name(si.expr) or f"_col{len(names)}")
+        proj = P.ProjectNode(node, select_irs, names)
+        out_fields = [Field(n, e.type, None) for n, e in zip(names, select_irs)]
+        out_scope = Scope(out_fields, outer_scope)
+        node = proj
+
+        if spec.distinct:
+            node = P.AggregationNode(
+                node, list(range(len(select_irs))), [], step="single", names=names
+            )
+        if query.order_by:
+            node = self._plan_order_by(
+                query, node, out_scope,
+                replacements=replacements, select_asts=spec.select_items,
+                inner_scope=agg_scope,
+            )
+        if query.limit is not None:
+            if isinstance(node, P.SortNode):
+                node = P.TopNNode(node.source, query.limit, node.sort_channels)
+            else:
+                node = P.LimitNode(node, query.limit)
+        return RelationPlan(node, out_scope)
+
+    def _plan_order_by(
+        self, query, node, out_scope, replacements, select_asts, inner_scope=None
+    ):
+        """ORDER BY resolves against select aliases/ordinals first, then the
+        select expressions themselves (by structure)."""
+        sort_channels = []
+        alias_to_ch = {}
+        ast_to_ch = {}
+        for i, si in enumerate(select_asts):
+            if isinstance(si, ast.SelectItem):
+                if si.alias:
+                    alias_to_ch[si.alias.lower()] = i
+                if not isinstance(si.expr, ast.Star):
+                    ast_to_ch[si.expr] = i
+        for s in query.order_by:
+            ch = None
+            if isinstance(s.expr, ast.Identifier) and len(s.expr.parts) == 1:
+                ch = alias_to_ch.get(s.expr.parts[0].lower())
+            if ch is None and isinstance(s.expr, ast.Literal) and s.expr.kind == "number":
+                ch = int(s.expr.value) - 1
+            if ch is None and s.expr in ast_to_ch:
+                ch = ast_to_ch[s.expr]
+            if ch is None:
+                # resolve as a plain column of the output scope
+                try:
+                    analyzer = ExprAnalyzer(out_scope, replacements)
+                    e = analyzer.analyze(s.expr)
+                    if isinstance(e, ir.ColumnRef):
+                        ch = e.index
+                except AnalysisError:
+                    ch = None
+            if ch is None:
+                raise PlanningError(f"cannot resolve ORDER BY expression {s.expr}")
+            sort_channels.append((ch, s.ascending, s.nulls_first))
+        return P.SortNode(node, sort_channels)
+
+    # ------------------------------------------------------- subquery preds
+    def _plan_predicate_subquery(self, conj, node, scope, ctes):
+        """Handle IN (subquery) / EXISTS / scalar-subquery comparisons.
+        Returns (node, scope, handled)."""
+        if isinstance(conj, ast.InSubquery):
+            value_ir = ExprAnalyzer(scope).analyze(conj.value)
+            sub = self.plan_query(conj.query, None, ctes)  # uncorrelated only
+            if len(sub.scope.fields) != 1:
+                raise PlanningError("IN subquery must return one column")
+            if not isinstance(value_ir, ir.ColumnRef):
+                raise PlanningError("IN subquery over expressions: round 2")
+            jt = "anti" if conj.negated else "semi"
+            new_node = P.JoinNode(
+                join_type=jt, left=node, right=sub.node,
+                left_keys=[value_ir.index], right_keys=[0],
+            )
+            return new_node, scope, True
+        if isinstance(conj, ast.Exists) or (
+            isinstance(conj, ast.Not) and isinstance(conj.value, ast.Exists)
+        ):
+            negated = isinstance(conj, ast.Not)
+            ex: ast.Exists = conj.value if negated else conj
+            return self._plan_exists(ex, negated, node, scope, ctes)
+        if isinstance(conj, ast.Comparison) and isinstance(conj.right, ast.ScalarSubquery):
+            return self._plan_scalar_comparison(conj, node, scope, ctes)
+        return node, scope, False
+
+    def _plan_exists(self, ex: ast.Exists, negated: bool, node, scope, ctes):
+        """Correlated EXISTS -> semi/anti join on the equi-correlation keys.
+
+        The subquery is planned against the outer scope as parent; its WHERE
+        conjuncts of shape outer_col = inner_col become join keys
+        (reference: TransformExistsApplyToCorrelatedJoin + decorrelation)."""
+        q = ex.query
+        if q.with_queries or not isinstance(q.body, ast.QuerySpec):
+            raise PlanningError("complex EXISTS subquery: round 2")
+        spec = q.body
+        inner_rp = self.plan_relation(spec.from_, scope, ctes) if spec.from_ else None
+        if inner_rp is None:
+            raise PlanningError("EXISTS without FROM")
+        inner_node, inner_scope = inner_rp.node, inner_rp.scope
+        corr_outer: List[int] = []
+        corr_inner: List[int] = []
+        inner_filters: List[ir.Expr] = []
+        for c in split_conjuncts(spec.where):
+            analyzer = ExprAnalyzer(inner_scope)
+            e = analyzer.analyze(c)
+            if not analyzer.outer_refs:
+                inner_filters.append(e)
+                continue
+            if (
+                isinstance(e, ir.Call)
+                and e.name == "eq"
+                and {type(e.args[0]), type(e.args[1])} == {ir.OuterRef, ir.ColumnRef}
+            ):
+                outer_arg = e.args[0] if isinstance(e.args[0], ir.OuterRef) else e.args[1]
+                inner_arg = e.args[1] if isinstance(e.args[1], ir.OuterRef) else e.args[0]
+                corr_outer.append(outer_arg.index)
+                corr_inner.append(inner_arg.index)
+                continue
+            raise PlanningError(
+                "correlated EXISTS predicate too complex (only outer=inner "
+                "equality supported in round 1)"
+            )
+        if not corr_outer:
+            raise PlanningError("uncorrelated EXISTS: round 2")
+        if inner_filters:
+            inner_node = P.FilterNode(inner_node, combine_conjuncts(inner_filters))
+        # project the inner correlation keys
+        proj = P.ProjectNode(
+            inner_node,
+            [ir.ColumnRef(inner_scope.fields[ch].type, ch) for ch in corr_inner],
+            [f"ck{i}" for i in range(len(corr_inner))],
+        )
+        jt = "anti" if negated else "semi"
+        new_node = P.JoinNode(
+            join_type=jt, left=node, right=proj,
+            left_keys=corr_outer, right_keys=list(range(len(corr_inner))),
+        )
+        return new_node, scope, True
+
+    def _plan_scalar_comparison(self, conj: ast.Comparison, node, scope, ctes):
+        """x <op> (SELECT agg(...) [FROM ... WHERE outer = inner]) —
+        uncorrelated: single-row cross join; correlated equi: group the
+        subquery by its correlation keys and equi-join."""
+        sub_ast = conj.right.query
+        # Try planning as uncorrelated first
+        try:
+            sub = self.plan_query(sub_ast, None, ctes)
+            correlated = False
+        except Exception:
+            correlated = True
+        if not correlated:
+            if len(sub.scope.fields) != 1:
+                raise PlanningError("scalar subquery must return one column")
+            nleft = len(scope.fields)
+            f = sub.scope.fields[0]
+            join = P.JoinNode(
+                join_type="inner", left=node, right=sub.node,
+                left_keys=[], right_keys=[], distribution="broadcast",
+            )
+            new_scope = Scope(scope.fields + [Field(None, f.type, "$scalar")], scope.parent)
+            left_ir = ExprAnalyzer(new_scope).analyze(conj.left)
+            from trino_tpu.sql.analyzer.expr_analyzer import _COMPARISON_OPS
+
+            pred = ir.Call(
+                T.BOOLEAN,
+                _COMPARISON_OPS[conj.op],
+                (left_ir, ir.ColumnRef(f.type, nleft)),
+            )
+            filt = P.FilterNode(join, pred)
+            # project away the scalar channel
+            proj = P.ProjectNode(
+                filt,
+                [ir.ColumnRef(fl.type, i, fl.name or "") for i, fl in enumerate(scope.fields)],
+                [fl.name or f"_c{i}" for i, fl in enumerate(scope.fields)],
+            )
+            return proj, scope, True
+        return self._plan_correlated_scalar(conj, sub_ast, node, scope, ctes)
+
+    def _plan_correlated_scalar(self, conj, sub_ast: ast.Query, node, scope, ctes):
+        """Decorrelate agg scalar subquery: SELECT agg(e) FROM R WHERE
+        outer.k = R.j AND rest  ==>  join on k with (SELECT j, agg(e) FROM R
+        WHERE rest GROUP BY j)."""
+        if not isinstance(sub_ast.body, ast.QuerySpec):
+            raise PlanningError("complex correlated scalar subquery")
+        spec = sub_ast.body
+        if spec.group_by or spec.having or len(spec.select_items) != 1:
+            raise PlanningError("correlated scalar subquery must be a bare aggregate")
+        agg_calls = find_aggregates(spec.select_items[0].expr)
+        if len(agg_calls) == 0:
+            raise PlanningError("correlated scalar subquery must aggregate")
+        inner_rp = self.plan_relation(spec.from_, scope, ctes)
+        inner_node, inner_scope = inner_rp.node, inner_rp.scope
+        corr_outer: List[int] = []
+        corr_inner: List[int] = []
+        inner_filters: List[ast.Expression] = []
+        for c in split_conjuncts(spec.where):
+            analyzer = ExprAnalyzer(inner_scope)
+            e = analyzer.analyze(c)
+            if not analyzer.outer_refs:
+                inner_filters.append(c)
+                continue
+            if (
+                isinstance(e, ir.Call)
+                and e.name == "eq"
+                and {type(e.args[0]), type(e.args[1])} == {ir.OuterRef, ir.ColumnRef}
+            ):
+                outer_arg = e.args[0] if isinstance(e.args[0], ir.OuterRef) else e.args[1]
+                inner_arg = e.args[1] if isinstance(e.args[1], ir.OuterRef) else e.args[0]
+                corr_outer.append(outer_arg.index)
+                corr_inner.append(inner_arg.index)
+                continue
+            raise PlanningError("correlated scalar subquery predicate too complex")
+        if not corr_outer:
+            raise PlanningError("scalar subquery planning failed")
+        # rebuild: SELECT ck..., agg FROM inner WHERE rest GROUP BY ck
+        if inner_filters:
+            fil_ir = [ExprAnalyzer(inner_scope).analyze(c) for c in inner_filters]
+            inner_node = P.FilterNode(inner_node, combine_conjuncts(fil_ir))
+        # pre-project: corr keys + agg args
+        agg_ast = agg_calls[0]
+        if spec.select_items[0].expr is not agg_ast:
+            raise PlanningError("correlated scalar subquery must be a bare aggregate call")
+        arg_ir = None
+        pre_exprs = [
+            ir.ColumnRef(inner_scope.fields[ch].type, ch) for ch in corr_inner
+        ]
+        pre_names = [f"ck{i}" for i in range(len(corr_inner))]
+        if agg_ast.is_star:
+            call = P.AggregateCall("count", None, T.BIGINT)
+        else:
+            arg_ir = ExprAnalyzer(inner_scope).analyze(agg_ast.args[0])
+            call = P.AggregateCall(
+                agg_ast.name, len(pre_exprs), aggregate_result_type(agg_ast.name, arg_ir.type),
+                distinct=agg_ast.distinct,
+            )
+            pre_exprs.append(arg_ir)
+            pre_names.append("aggarg")
+        pre = P.ProjectNode(inner_node, pre_exprs, pre_names)
+        k = len(corr_inner)
+        agg_node = P.AggregationNode(
+            pre, list(range(k)), [call], step="single",
+            names=pre_names[:k] + ["aggval"],
+        )
+        nleft = len(scope.fields)
+        join = P.JoinNode(
+            join_type="inner", left=node, right=agg_node,
+            left_keys=corr_outer, right_keys=list(range(k)),
+            right_unique=True,
+        )
+        # predicate: left <op> aggval
+        ext_fields = scope.fields + [Field(None, t, "$sub") for t in agg_node.output_types]
+        ext_scope = Scope(ext_fields, scope.parent)
+        left_ir = ExprAnalyzer(ext_scope).analyze(conj.left)
+        from trino_tpu.sql.analyzer.expr_analyzer import _COMPARISON_OPS
+
+        pred = ir.Call(
+            T.BOOLEAN,
+            _COMPARISON_OPS[conj.op],
+            (left_ir, ir.ColumnRef(call.output_type, nleft + k)),
+        )
+        filt = P.FilterNode(join, pred)
+        proj = P.ProjectNode(
+            filt,
+            [ir.ColumnRef(fl.type, i, fl.name or "") for i, fl in enumerate(scope.fields)],
+            [fl.name or f"_c{i}" for i, fl in enumerate(scope.fields)],
+        )
+        return proj, scope, True
+
+
+def _derive_name(e: ast.Expression) -> Optional[str]:
+    if isinstance(e, ast.Identifier):
+        return e.parts[-1]
+    if isinstance(e, ast.FunctionCall):
+        return e.name
+    return None
