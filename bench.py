@@ -1,0 +1,77 @@
+"""Benchmark: TPC-H Q1 throughput on the flagship compiled path.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config #1 of BASELINE.md (TPC-H Q1 group-by over lineitem), scaled to sf1
+(~6M rows), measured as steady-state rows/sec/chip on the whole compiled
+query body (filter + group-by + 8 aggregates + sort), input resident on
+device, host transfer excluded — matching how the reference benchmarks
+operator throughput (JMH over in-memory pages, BenchmarkHashAggregation).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md). We use the
+north-star anchor from BASELINE.json — >=5x a Java operator pipeline,
+taken as ~3M rows/sec/core for this shape — so vs_baseline = value / 3e6
+(>=5.0 means the north star is met against that assumed anchor).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+
+    from trino_tpu import Session
+    from trino_tpu.exec.compiled import CompiledQuery
+    from trino_tpu.exec.query import plan_sql
+
+    schema = "sf1"
+    q1 = """
+select
+    l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc, count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+    session = Session(properties={"schema": schema})
+    root = plan_sql(session, q1)
+    print(f"device: {jax.devices()[0]}", file=sys.stderr)
+    t0 = time.time()
+    cq = CompiledQuery.build(session, root)
+    n_rows = int(cq.input_arrays[0].shape[0])
+    print(f"staged {n_rows} lineitem rows in {time.time()-t0:.1f}s", file=sys.stderr)
+
+    page = cq.run()  # compile + first run
+    rows = page.to_pylist()
+    assert len(rows) == 4, rows
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        out_arrays, flags = cq.fn(cq.input_arrays)
+        jax.block_until_ready(out_arrays)
+        best = min(best, time.time() - t0)
+    value = n_rows / best
+    print(f"steady-state: {best*1000:.1f} ms, {value/1e6:.1f}M rows/s", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "tpch_sf1_q1_rows_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "rows/sec/chip",
+                "vs_baseline": round(value / 3e6, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Distributed (shard_map) and compiled execution tests on the 8-device
+virtual CPU mesh — the DistributedQueryRunner analog (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from trino_tpu import Session
+from trino_tpu.exec.compiled import CompiledQuery
+from trino_tpu.exec.query import plan_sql, run_query
+from trino_tpu.parallel.spmd import DistributedQuery
+
+Q1 = """
+select l_returnflag, l_linestatus, sum(l_quantity) q, avg(l_extendedprice) p,
+       count(*) c
+from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus order by 1, 2
+"""
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15' and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+Q18 = """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice, sum(l_quantity)
+from customer, orders, lineitem
+where o_orderkey in (
+        select l_orderkey from lineitem group by l_orderkey
+        having sum(l_quantity) > 300)
+  and c_custkey = o_custkey and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate limit 100
+"""
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest should provide 8 virtual CPU devices"
+    return Mesh(np.array(devs[:8]), ("d",))
+
+
+@pytest.mark.parametrize("sql", [Q1, Q3, Q18], ids=["q1", "q3", "q18"])
+def test_distributed_matches_local(session, mesh, sql):
+    root = plan_sql(session, sql)
+    dq = DistributedQuery.build(session, root, mesh)
+    assert dq.run().to_pylist() == run_query(session, sql).rows
+
+
+def test_compiled_matches_eager(session):
+    root = plan_sql(session, Q1)
+    cq = CompiledQuery.build(session, root)
+    page = cq.run()
+    assert page.to_pylist() == run_query(session, Q1).rows
+    # second run reuses the executable
+    assert cq.run().to_pylist() == page.to_pylist()
+
+
+def test_compiled_error_flags(session):
+    root = plan_sql(
+        session, "select n_nationkey/(n_nationkey - n_nationkey) from nation"
+    )
+    cq = CompiledQuery.build(session, root)
+    from trino_tpu.exec.executor import QueryError
+
+    with pytest.raises(QueryError, match="Division by zero"):
+        cq.run()
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out_arrays, flags = jax.jit(fn)(*args)
+    assert len(out_arrays) >= 10
+
+
+def test_uneven_splits(session, mesh):
+    # nation has 25 rows over 8 devices: unequal shard sizes exercise padding
+    sql = "select n_regionkey, count(*) from nation group by n_regionkey order by 1"
+    root = plan_sql(session, sql)
+    dq = DistributedQuery.build(session, root, mesh)
+    assert dq.run().to_pylist() == run_query(session, sql).rows
+
+
+def test_distributed_no_exchange_query(session, mesh):
+    # scan/filter/project-only plan: needs the final gather, not shard 0 only
+    sql = "select n_name from nation where n_regionkey = 1"
+    root = plan_sql(session, sql)
+    dq = DistributedQuery.build(session, root, mesh)
+    assert sorted(dq.run().to_pylist()) == sorted(run_query(session, sql).rows)
+
+
+def test_distributed_error_on_any_shard(session, mesh):
+    from trino_tpu.exec.executor import QueryError
+
+    root = plan_sql(session, "select 10/(n_nationkey-10) from nation")
+    dq = DistributedQuery.build(session, root, mesh)
+    with pytest.raises(QueryError, match="Division by zero"):
+        dq.run()
+
+
+def test_error_ignores_filtered_rows(session):
+    # rows excluded by WHERE must not trigger runtime errors
+    rows = run_query(
+        session, "select 10/(n_nationkey-3) from nation where n_nationkey > 5"
+    ).rows
+    assert len(rows) == 19

@@ -142,3 +142,18 @@ def test_show_and_describe(session):
     assert ("lineitem",) in r.rows
     r = session.execute("describe tpch.tiny.nation")
     assert ("n_nationkey", "bigint") in r.rows
+
+
+def test_count_star_only(session):
+    # regression: pruning once dropped all scan channels, losing the row count
+    r = session.execute("select count(*) from nation")
+    assert r.rows == [(25,)]
+    r = session.execute("select count(*) from lineitem where l_quantity < 10")
+    assert r.rows[0][0] > 0
+
+
+def test_division_by_zero_from_table(session):
+    from trino_tpu.exec.executor import QueryError
+
+    with pytest.raises(QueryError, match="Division by zero"):
+        session.execute("select 1/0 from nation")

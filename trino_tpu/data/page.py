@@ -125,10 +125,15 @@ class Page:
     ``sel`` (bool[n], True = row is live) realizes filtering without
     compaction — XLA-friendly static shapes (SURVEY.md §7.3 item 1). ``None``
     means all rows live.
+
+    ``replicated``: under SPMD execution (parallel/spmd.py), True means every
+    device holds the same rows (post-broadcast/gather); False means this is a
+    per-device shard. Purely host-side bookkeeping (not traced).
     """
 
     columns: List[Column]
     sel: Optional[jnp.ndarray] = None
+    replicated: bool = False
 
     @property
     def num_rows(self) -> int:

@@ -1,20 +1,25 @@
-"""Local (single-device) plan executor.
+"""Plan executor: fully traceable array program over device Pages.
 
 Reference: the worker execution engine — ``LocalExecutionPlanner.java:532``
 turning plan nodes into operator pipelines + ``Driver.java:372``'s page loop.
 TPU-first difference (SURVEY.md §7.1): no page-at-a-time pull loop — each
-plan node is a whole-column array transformation; XLA traces/fuses the
-per-node work, and data-dependent result sizes (group counts, sort/limit
-compaction) surface as one host-read scalar per materialization point.
+plan node is a whole-column array transformation with *static shapes*:
+filters keep selection masks instead of compacting, aggregations emit
+padded outputs with a live-group prefix, sorts move dead rows last. Because
+every step is shape-static and host-sync-free, the entire query body can be
+traced once and compiled by XLA (``exec.compiled``), and the same recursion
+runs under ``shard_map`` for multi-chip SPMD (``parallel.spmd``).
 
-This eager executor is the correctness path; ``exec.compiled`` (bench path)
-jits whole fragments.
+Data-dependent runtime errors (division by zero, multi-row scalar subquery)
+are collected as boolean flags and checked once after execution — the
+deferred-error contract of ops/expr_lower.
 """
 from __future__ import annotations
 
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -33,20 +38,14 @@ class QueryError(RuntimeError):
     pass
 
 
-def _check_errors(ctx: L.LowerCtx):
-    for code, flag in ctx.errors:
-        if bool(flag):
+def raise_query_errors(codes, flags):
+    """Raise the first deferred runtime error whose flag fired. Shared by
+    the eager, compiled, and SPMD paths."""
+    import numpy as _np
+
+    for code, flag in zip(codes, flags):
+        if bool(_np.asarray(flag).any()):
             raise QueryError(code.replace("_", " ").capitalize())
-
-
-def _lower_expr(e: ir.Expr, page: Page) -> Tuple[L.LoweredVal, L.LowerCtx]:
-    ctx = L.LowerCtx(page.columns, page.num_rows)
-    out = L.lower(e, ctx)
-    # errors only matter on live rows
-    if ctx.errors and page.sel is not None:
-        ctx.errors = [(c, f) for c, f in ctx.errors]
-    _check_errors(ctx)
-    return out, ctx
 
 
 def _col_from_lowered(t: T.Type, lv: L.LoweredVal) -> Column:
@@ -59,14 +58,35 @@ def _col_to_lowered(c: Column) -> join_ops.Lowered:
 
 
 class Executor:
+    """Traceable plan interpreter. ``execute_checked`` runs eagerly and
+    raises deferred errors; the recursion itself (``execute``) is pure and
+    jit-safe."""
+
     def __init__(self, session):
         self.session = session
+        self.errors: List[Tuple[str, jnp.ndarray]] = []
+
+    # ------------------------------------------------------------------ api
+    def execute_checked(self, node: P.PlanNode) -> Page:
+        page = self.execute(node)
+        self.raise_errors()
+        return page
+
+    def raise_errors(self):
+        raise_query_errors([c for c, _ in self.errors], [f for _, f in self.errors])
 
     def execute(self, node: P.PlanNode) -> Page:
         method = getattr(self, f"_exec_{type(node).__name__}", None)
         if method is None:
             raise NotImplementedError(f"executor: {type(node).__name__}")
         return method(node)
+
+    def _lower(self, e: ir.Expr, page: Page) -> L.LoweredVal:
+        ctx = L.LowerCtx(page.columns, page.num_rows, page.sel)
+        out = L.lower(e, ctx)
+        for code, flag in ctx.errors:
+            self.errors.append((code, flag))
+        return out
 
     # ----------------------------------------------------------------- scan
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
@@ -101,91 +121,153 @@ class Executor:
             Column.from_python(t, [r[i] for r in node.rows])
             for i, t in enumerate(node.types)
         ]
+        # identical on every device under SPMD -> replicated
         if not cols:
             # zero-column single row (SELECT without FROM)
-            return Page([Column(T.BIGINT, jnp.zeros(len(node.rows), dtype=jnp.int64))])
-        return Page(cols)
+            return Page(
+                [Column(T.BIGINT, jnp.zeros(len(node.rows), dtype=jnp.int64))],
+                replicated=True,
+            )
+        return Page(cols, replicated=True)
 
     # --------------------------------------------------------------- filter
     def _exec_FilterNode(self, node: P.FilterNode) -> Page:
         page = self.execute(node.source)
-        lv, _ = _lower_expr(node.predicate, page)
+        lv = self._lower(node.predicate, page)
         passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
         sel = passed if page.sel is None else (page.sel & passed)
-        return Page(page.columns, sel)
+        return Page(page.columns, sel, page.replicated)
 
     def _exec_ProjectNode(self, node: P.ProjectNode) -> Page:
         page = self.execute(node.source)
         cols = []
         for e in node.expressions:
-            lv, _ = _lower_expr(e, page)
+            lv = self._lower(e, page)
             cols.append(_col_from_lowered(e.type, lv))
-        return Page(cols, page.sel)
+        return Page(cols, page.sel, page.replicated)
 
     # ---------------------------------------------------------- aggregation
     def _exec_AggregationNode(self, node: P.AggregationNode) -> Page:
         page = self.execute(node.source)
+        return self.aggregate_page(node, page)
+
+    def group_structure(self, group_channels: List[int], page: Page):
+        """(gids, rep, out_sel, capacity): group assignment for a page.
+
+        Two strategies (the FlatHash vs BigintGroupByHash specialization
+        split in the reference, re-chosen for TPU):
+        - direct-mapped: all keys are null-free dictionary codes (or
+          booleans) with a small cardinality product -> gid is a perfect
+          index, NO sort, output compacted to `capacity` slots (the Q1-shape
+          fast path; out_sel is the occupancy mask, in key order).
+        - sort-based: exact comparison grouping for arbitrary keys
+          (ops/groupby.py); capacity == input length, out_sel a prefix.
+        """
         n = page.num_rows
-        keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
-        if node.group_channels:
-            gids, rep, num_groups_dev = gb.group_ids(keys, page.sel)
-            num_groups = int(num_groups_dev)
-            key_cols = gb.gather_group_keys(keys, rep)
-        else:
-            gids = jnp.zeros((max(n, 1),), dtype=jnp.int32)
-            num_groups = 1
-            key_cols = []
-        cap = max(n, 1)
-        out_cols: List[Column] = []
-        for i, c in enumerate(node.group_channels):
-            src = page.columns[c]
-            v, valid = key_cols[i]
-            nulls = None if valid is None else ~valid
-            out_cols.append(
-                Column(
-                    src.type,
-                    v[:num_groups],
-                    nulls[:num_groups] if nulls is not None else None,
-                    src.dictionary,
+        keys = [_col_to_lowered(page.columns[c]) for c in group_channels]
+        sel = page.sel
+        if not group_channels:
+            gids = jnp.zeros((n,), dtype=jnp.int32)
+            return gids, None, jnp.arange(1) < 1, 1
+        direct = self._direct_strides(group_channels, page)
+        if direct is not None:
+            strides, capacity = direct
+            gids = jnp.zeros((n,), dtype=jnp.int32)
+            for (vals, _), stride in zip(keys, strides):
+                gids = gids + vals.astype(jnp.int32) * stride
+            occupied = (
+                jax.ops.segment_sum(
+                    jnp.ones((n,), jnp.int32) if sel is None else sel.astype(jnp.int32),
+                    gids,
+                    num_segments=capacity,
                 )
+                > 0
             )
-        sel_for_agg = page.sel
+            rep = jax.ops.segment_min(jnp.arange(n), gids, num_segments=capacity)
+            return gids, rep, occupied, capacity
+        gids, rep, num_groups = gb.group_ids(keys, sel)
+        return gids, rep, jnp.arange(n) < num_groups, n
+
+    @staticmethod
+    def _direct_strides(group_channels: List[int], page: Page):
+        sizes = []
+        for c in group_channels:
+            col = page.columns[c]
+            if col.nulls is not None:
+                return None
+            if col.type.is_varchar and col.dictionary is not None:
+                sizes.append(max(len(col.dictionary), 1))
+            elif col.type == T.BOOLEAN:
+                sizes.append(2)
+            else:
+                return None
+        capacity = 1
+        for s in sizes:
+            capacity *= s
+        if not 1 <= capacity <= (1 << 20):
+            return None
+        strides = []
+        acc = 1
+        for s in reversed(sizes):
+            strides.append(acc)
+            acc *= s
+        return list(reversed(strides)), capacity
+
+    def aggregate_page(self, node: P.AggregationNode, page: Page) -> Page:
+        """Group and aggregate; output has `capacity` rows, sel marking live
+        groups (prefix for the sort path, occupancy mask for the direct
+        path — both in group-key order)."""
+        n = page.num_rows
+        sel = page.sel
         if n == 0:
-            # pad a zero-row page so segment ops have shape (1,)
-            sel_for_agg = jnp.zeros((1,), dtype=bool)
+            page = Page(
+                [
+                    Column(c.type, jnp.zeros((1,), dtype=c.values.dtype), None, c.dictionary)
+                    for c in page.columns
+                ],
+                jnp.zeros((1,), dtype=bool),
+            )
+            n = 1
+            sel = page.sel
+        keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
+        gids, rep, out_sel, cap = self.group_structure(node.group_channels, page)
+        out_cols: List[Column] = []
+        if node.group_channels:
+            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
+            for i, c in enumerate(node.group_channels):
+                src = page.columns[c]
+                v, valid = key_cols[i]
+                nulls = None if valid is None else ~valid
+                out_cols.append(Column(src.type, v, nulls, src.dictionary))
         for call in node.aggregates:
-            col = self._exec_aggregate(call, page, sel_for_agg, gids, cap, n)
+            vals, valid = self._exec_aggregate(call, page, sel, gids, cap)
             out_cols.append(
                 Column(
                     call.output_type,
-                    col[0][:num_groups],
-                    (~col[1][:num_groups]) if col[1] is not None else None,
+                    vals,
+                    (~valid) if valid is not None else None,
                     None,
                 )
             )
-        return Page(out_cols)
+        return Page(out_cols, out_sel, page.replicated)
 
-    def _exec_aggregate(self, call: P.AggregateCall, page, sel, gids, cap, n):
+    def _exec_aggregate(self, call: P.AggregateCall, page, sel, gids, cap):
         if call.distinct:
             raise NotImplementedError("DISTINCT aggregates: round 2")
         if call.function == "count" and call.arg_channel is None:
-            return agg_ops.agg_count_star(sel, gids, cap, max(n, 1))
-        arg_col = page.columns[call.arg_channel]
-        arg = _col_to_lowered(arg_col)
-        if n == 0:
-            arg = (jnp.zeros((1,), dtype=arg_col.values.dtype), jnp.zeros((1,), bool))
+            return agg_ops.agg_count_star(sel, gids, cap, page.num_rows)
+        arg = _col_to_lowered(page.columns[call.arg_channel])
         if call.function == "count":
             return agg_ops.agg_count(arg, sel, gids, cap)
         if call.function == "sum":
-            dt = call.output_type.np_dtype
-            return agg_ops.agg_sum(arg, sel, gids, cap, dt)
+            return agg_ops.agg_sum(arg, sel, gids, cap, call.output_type.np_dtype)
         if call.function == "avg":
             base = (
                 call.output_type.np_dtype
                 if call.output_type.is_decimal
                 else np.dtype(np.float64)
             )
-            s, s_valid = agg_ops.agg_sum(arg, sel, gids, cap, base)
+            s, _ = agg_ops.agg_sum(arg, sel, gids, cap, base)
             cnt, _ = agg_ops.agg_count(arg, sel, gids, cap)
             return agg_ops.finish_avg(s, cnt, call.output_type)
         if call.function == "min":
@@ -199,9 +281,12 @@ class Executor:
         left = self.execute(node.left)
         right = self.execute(node.right)
         if node.join_type in ("semi", "anti"):
-            return self._exec_semi(node, left, right)
+            return self.semi_join(node, left, right)
         if not node.left_keys:
-            return self._exec_singleton_cross(node, left, right)
+            return self.singleton_cross(node, left, right)
+        return self.lookup_join(node, left, right)
+
+    def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         build_key = join_ops.pack_keys(
             [_col_to_lowered(right.columns[c]) for c in node.right_keys]
         )
@@ -213,21 +298,23 @@ class Executor:
         out_cols = list(left.columns)
         for rc in right.columns:
             v, valid = join_ops.gather_column(_col_to_lowered(rc), rows, matched)
-            out_cols.append(Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary))
+            out_cols.append(
+                Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary)
+            )
         if node.join_type == "inner":
             sel = matched if left.sel is None else (left.sel & matched)
         else:  # left outer: probe rows always survive; build cols null when unmatched
             sel = left.sel
-        page = Page(out_cols, sel)
+        page = Page(out_cols, sel, left.replicated)
         if node.filter is not None:
-            lv, _ = _lower_expr(node.filter, page)
-            passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
             if node.join_type == "left":
                 raise NotImplementedError("filtered left join: round 2")
-            page = Page(out_cols, passed if page.sel is None else page.sel & passed)
+            lv = self._lower(node.filter, page)
+            passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
+            page = Page(out_cols, passed if page.sel is None else page.sel & passed, left.replicated)
         return page
 
-    def _exec_semi(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+    def semi_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         build = join_ops.pack_keys(
             [_col_to_lowered(right.columns[c]) for c in node.right_keys]
         )
@@ -237,23 +324,21 @@ class Executor:
         hit = join_ops.membership(build, right.sel, probe)
         keep = hit if node.join_type == "semi" else ~hit
         sel = keep if left.sel is None else left.sel & keep
-        return Page(left.columns, sel)
+        return Page(left.columns, sel, left.replicated)
 
-    def _exec_singleton_cross(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+    def singleton_cross(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         """Cross join against a single-row relation (scalar subquery)."""
-        r_live = right.live_count()
-        if r_live != 1:
-            raise QueryError(
-                "Scalar sub-query has returned multiple rows"
-                if r_live > 1
-                else "Scalar sub-query returned no rows"  # SQL says NULL; round 2
-            )
-        n = left.num_rows
-        # find live row index host-side
-        if right.sel is None:
+        r_sel = right.sel
+        nr = right.num_rows
+        if r_sel is None:
+            live = jnp.asarray(nr, dtype=jnp.int64)
             idx = 0
         else:
-            idx = int(np.argmax(np.asarray(right.sel)))
+            live = jnp.sum(r_sel)
+            idx = jnp.argmax(r_sel)
+        self.errors.append(("SCALAR_SUBQUERY_MULTIPLE_ROWS", live > 1))
+        self.errors.append(("SCALAR_SUBQUERY_NO_ROWS", live < 1))
+        n = left.num_rows
         out_cols = list(left.columns)
         for rc in right.columns:
             v = jnp.broadcast_to(rc.values[idx], (n,))
@@ -261,28 +346,32 @@ class Executor:
                 jnp.broadcast_to(rc.nulls[idx], (n,)) if rc.nulls is not None else None
             )
             out_cols.append(Column(rc.type, v, nulls, rc.dictionary))
-        page = Page(out_cols, left.sel)
+        page = Page(out_cols, left.sel, left.replicated)
         if node.filter is not None:
-            lv, _ = _lower_expr(node.filter, page)
+            lv = self._lower(node.filter, page)
             passed = lv.vals if lv.valid is None else lv.vals & lv.valid
-            page = Page(out_cols, passed if page.sel is None else page.sel & passed)
+            page = Page(out_cols, passed if page.sel is None else page.sel & passed, left.replicated)
         return page
 
     # ------------------------------------------------------------- ordering
     def _exec_SortNode(self, node: P.SortNode) -> Page:
         page = self.execute(node.source)
-        return self._sorted_page(page, node.sort_channels)
+        return self.sorted_page(page, node.sort_channels)
 
-    def _sorted_page(self, page: Page, sort_channels, limit: Optional[int] = None) -> Page:
+    def sorted_page(self, page: Page, sort_channels, limit: Optional[int] = None) -> Page:
+        """Gather rows into sort order (dead rows last); sel becomes a prefix
+        mask of the live (and limit-capped) rows."""
         n = page.num_rows
         keys = [
             (_col_to_lowered(page.columns[c]), asc, nf) for c, asc, nf in sort_channels
         ]
         order = sort_ops.sort_order(keys, page.sel, n)
-        live = page.live_count()
+        live = (
+            jnp.asarray(n, dtype=jnp.int64) if page.sel is None else jnp.sum(page.sel)
+        )
         if limit is not None:
-            live = min(live, limit)
-        order = order[:live]
+            live = jnp.minimum(live, limit)
+        sel = jnp.arange(n) < live
         cols = [
             Column(
                 c.type,
@@ -292,15 +381,15 @@ class Executor:
             )
             for c in page.columns
         ]
-        return Page(cols)
+        return Page(cols, sel, page.replicated)
 
     def _exec_TopNNode(self, node: P.TopNNode) -> Page:
         page = self.execute(node.source)
-        return self._sorted_page(page, node.sort_channels, limit=node.count)
+        return self.sorted_page(page, node.sort_channels, limit=node.count)
 
     def _exec_LimitNode(self, node: P.LimitNode) -> Page:
         page = self.execute(node.source)
-        return self._sorted_page(page, [], limit=node.count)
+        return self.sorted_page(page, [], limit=node.count)
 
     def _exec_OutputNode(self, node: P.OutputNode) -> Page:
         return self.execute(node.source)

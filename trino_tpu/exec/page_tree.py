@@ -1,0 +1,60 @@
+"""Page <-> flat array-list conversion (pytree-style) for jit boundaries.
+
+The dynamic parts of a Page (values, null masks, selection) flatten to a
+list of arrays; the static parts (types, dictionaries) go into a PageSpec
+captured in the compiled closure.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+from trino_tpu.data.dictionary import Dictionary
+from trino_tpu.data.page import Column, Page
+
+
+@dataclasses.dataclass
+class PageSpec:
+    types: List[T.Type]
+    dictionaries: List[Optional[Dictionary]]
+    has_nulls: List[bool]
+    has_sel: bool
+
+
+def flatten_page(page: Page) -> Tuple[List[jnp.ndarray], PageSpec]:
+    arrays: List[jnp.ndarray] = []
+    has_nulls = []
+    for c in page.columns:
+        arrays.append(c.values)
+        if c.nulls is not None:
+            arrays.append(c.nulls)
+            has_nulls.append(True)
+        else:
+            has_nulls.append(False)
+    if page.sel is not None:
+        arrays.append(page.sel)
+    spec = PageSpec(
+        [c.type for c in page.columns],
+        [c.dictionary for c in page.columns],
+        has_nulls,
+        page.sel is not None,
+    )
+    return arrays, spec
+
+
+def unflatten_page(spec: PageSpec, arrays: List[jnp.ndarray]) -> Page:
+    cols: List[Column] = []
+    i = 0
+    for t, d, hn in zip(spec.types, spec.dictionaries, spec.has_nulls):
+        vals = arrays[i]
+        i += 1
+        nulls = None
+        if hn:
+            nulls = arrays[i]
+            i += 1
+        cols.append(Column(t, vals, nulls, d))
+    sel = arrays[i] if spec.has_sel else None
+    return Page(cols, sel)

@@ -39,7 +39,7 @@ def run_query(session, sql: str) -> QueryResult:
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
     root = Planner(session).plan(stmt)
     root = optimize(root, session)
-    page = Executor(session).execute(root)
+    page = Executor(session).execute_checked(root)
     return QueryResult(root.column_names, page.columns, page.to_pylist())
 
 

@@ -46,16 +46,23 @@ class LoweredVal:
 
 
 class LowerCtx:
-    """Lowering context: the input columns and collected error conditions."""
+    """Lowering context: input columns, the page's selection mask, and
+    collected error conditions. Errors only fire for rows that are both
+    valid (non-NULL inputs) and selected (survived upstream filters) —
+    matching the reference's semantics where filtered-out rows are never
+    evaluated."""
 
-    def __init__(self, columns: List[Column], num_rows: int):
+    def __init__(self, columns: List[Column], num_rows: int, sel: Optional[jnp.ndarray] = None):
         self.columns = columns
         self.num_rows = num_rows
+        self.sel = sel
         self.errors: List[Tuple[str, jnp.ndarray]] = []
 
     def add_error(self, code: str, cond: jnp.ndarray, live: Optional[jnp.ndarray]):
         if live is not None:
             cond = cond & live
+        if self.sel is not None:
+            cond = cond & self.sel
         self.errors.append((code, jnp.any(cond)))
 
 

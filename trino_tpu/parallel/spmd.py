@@ -1,0 +1,391 @@
+"""SPMD distributed execution: one `shard_map` program per query body.
+
+Reference: the distributed data plane — splits scheduled across workers
+(SourcePartitionedScheduler), hash-repartition shuffles between stages
+(PartitionedOutputOperator -> HTTP -> ExchangeOperator, SURVEY.md §2.6/§3.4).
+TPU-first redesign (SURVEY.md §7.1 "shuffle = collective"): the whole
+multi-stage pipeline compiles into a single SPMD program over a device mesh:
+
+- leaf scans = data-parallel splits, one shard per device (padded to a
+  common shape; the pad rows carry sel=False) — SOURCE_DISTRIBUTION analog;
+- aggregation = local partial aggregate, `all_gather` of the (small)
+  partial-state pages over ICI, local final aggregate — the
+  partial/FINAL split HashAggregationOperator does across an exchange;
+- lookup/semi join build sides = `all_gather` of the build page =
+  FIXED_BROADCAST_DISTRIBUTION (replicated build, like Trino's broadcast
+  join); probes stay local;
+- sort/topN/limit run on the gathered (replicated) result.
+
+Collectives ride ICI inside the compiled program — there is no serialized
+page shuttle between stages on this path. (Hash-partitioned `all_to_all`
+exchanges for high-cardinality aggregations/joins are the round-2 upgrade;
+the structure — exchange boundaries as collectives — is the same.)
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as PSpec
+
+from trino_tpu import types as T
+from trino_tpu.data.page import Column, Page
+from trino_tpu.exec.executor import Executor, QueryError
+from trino_tpu.exec.page_tree import PageSpec, flatten_page, unflatten_page
+from trino_tpu.ops import aggregate as agg_ops
+from trino_tpu.ops import groupby as gb
+from trino_tpu.sql.planner import plan as P
+
+AXIS = "d"
+
+
+def _gather_flat(x: jnp.ndarray) -> jnp.ndarray:
+    """all_gather along the mesh axis and flatten device dim into rows."""
+    g = jax.lax.all_gather(x, AXIS)  # [ndev, n, ...]
+    return g.reshape((-1,) + g.shape[2:])
+
+
+def gather_page(page: Page) -> Page:
+    """Replicate a sharded page on every device (broadcast exchange).
+    Idempotent: already-replicated pages pass through."""
+    if page.replicated:
+        return page
+    cols = [
+        Column(
+            c.type,
+            _gather_flat(c.values),
+            _gather_flat(c.nulls) if c.nulls is not None else None,
+            c.dictionary,
+        )
+        for c in page.columns
+    ]
+    sel = (
+        _gather_flat(page.sel)
+        if page.sel is not None
+        else None
+    )
+    return Page(cols, sel, replicated=True)
+
+
+class SpmdExecutor(Executor):
+    """Runs the plan per-shard inside shard_map; exchanges are collectives."""
+
+    def __init__(self, session, staged: Dict[int, Page]):
+        super().__init__(session)
+        self.staged = staged
+
+    def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
+        return self.staged[node.id]
+
+    # ----------------------------------------------------- distributed agg
+    def aggregate_page(self, node: P.AggregationNode, page: Page) -> Page:
+        """partial aggregate -> all_gather partial states -> final combine.
+
+        The exact split HashAggregationOperator(PARTIAL) -> remote exchange ->
+        HashAggregationOperator(FINAL) does, as one compiled program."""
+        if page.replicated:
+            # every device already holds all rows: single-step local aggregate
+            return super().aggregate_page(node, page)
+        n = max(page.num_rows, 1)
+        keys = [
+            (page.columns[c].values, None if page.columns[c].nulls is None else ~page.columns[c].nulls)
+            for c in node.group_channels
+        ]
+        gids, rep, part_sel, cap = self.group_structure(node.group_channels, page)
+        # partial states per aggregate
+        partial_cols: List[Column] = []
+        if node.group_channels:
+            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
+            for i, c in enumerate(node.group_channels):
+                src = page.columns[c]
+                v, valid = key_cols[i]
+                partial_cols.append(
+                    Column(src.type, v, None if valid is None else ~valid, src.dictionary)
+                )
+        state_layout: List[Tuple[str, int]] = []  # (combine_fn, n_arrays)
+        for call in node.aggregates:
+            states = self._partial_states(call, page, gids, cap)
+            state_layout.append((call.function, len(states)))
+            for sv in states:
+                partial_cols.append(Column(T.BIGINT, sv[0], None if sv[1] is None else ~sv[1], None))
+        partial = Page(partial_cols, part_sel)
+        # exchange: gather every device's partial groups (cap-sized states,
+        # not input rows, when a compact capacity is known)
+        gathered = gather_page(partial)
+        # final combine: re-group gathered keys, merge states
+        return self._final_combine(node, gathered, len(node.group_channels), state_layout)
+
+    def _partial_states(self, call: P.AggregateCall, page, gids, cap):
+        """Partial-aggregation state arrays (reference: AccumulatorCompiler
+        intermediate states shipped through the partial->final exchange)."""
+        if call.distinct:
+            raise NotImplementedError("DISTINCT aggregates: round 2")
+        sel = page.sel
+        if call.function == "count" and call.arg_channel is None:
+            v, _ = agg_ops.agg_count_star(sel, gids, cap, page.num_rows)
+            return [(v, None)]
+        arg_col = page.columns[call.arg_channel]
+        arg = (arg_col.values, None if arg_col.nulls is None else ~arg_col.nulls)
+        if call.function == "count":
+            v, _ = agg_ops.agg_count(arg, sel, gids, cap)
+            return [(v, None)]
+        if call.function == "sum":
+            v, valid = agg_ops.agg_sum(arg, sel, gids, cap, call.output_type.np_dtype)
+            return [(v, valid)]
+        if call.function == "avg":
+            base = (
+                call.output_type.np_dtype if call.output_type.is_decimal else np.dtype(np.float64)
+            )
+            s, s_valid = agg_ops.agg_sum(arg, sel, gids, cap, base)
+            cnt, _ = agg_ops.agg_count(arg, sel, gids, cap)
+            return [(s, s_valid), (cnt, None)]
+        if call.function == "min":
+            v, valid = agg_ops.agg_min(arg, sel, gids, cap)
+            return [(v, valid)]
+        if call.function == "max":
+            v, valid = agg_ops.agg_max(arg, sel, gids, cap)
+            return [(v, valid)]
+        raise NotImplementedError(call.function)
+
+    def _final_combine(self, node, gathered: Page, k: int, state_layout):
+        n = max(gathered.num_rows, 1)
+        keys = [
+            (gathered.columns[i].values, None if gathered.columns[i].nulls is None else ~gathered.columns[i].nulls)
+            for i in range(k)
+        ]
+        gids, rep, out_sel, _cap = self.group_structure(list(range(k)), gathered)
+        out_cols: List[Column] = []
+        if k:
+            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
+            for i in range(k):
+                src = gathered.columns[i]
+                v, valid = key_cols[i]
+                out_cols.append(
+                    Column(src.type, v, None if valid is None else ~valid, src.dictionary)
+                )
+        ci = k
+        for call, (fn_name, n_states) in zip(node.aggregates, state_layout):
+            states = gathered.columns[ci : ci + n_states]
+            ci += n_states
+            out_cols.append(self._combine_state(call, states, gathered.sel, gids, _cap))
+        return Page(out_cols, out_sel, replicated=True)
+
+    def _combine_state(self, call: P.AggregateCall, states: List[Column], sel, gids, cap) -> Column:
+        def as_arg(col: Column):
+            return (col.values, None if col.nulls is None else ~col.nulls)
+
+        if call.function in ("count",):
+            v, _ = agg_ops.agg_sum(as_arg(states[0]), sel, gids, cap, np.dtype(np.int64))
+            return Column(T.BIGINT, v, None, None)
+        if call.function == "sum":
+            v, valid = agg_ops.agg_sum(
+                as_arg(states[0]), sel, gids, cap, call.output_type.np_dtype
+            )
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        if call.function == "avg":
+            base = (
+                call.output_type.np_dtype if call.output_type.is_decimal else np.dtype(np.float64)
+            )
+            s, s_valid = agg_ops.agg_sum(as_arg(states[0]), sel, gids, cap, base)
+            cnt, _ = agg_ops.agg_sum(as_arg(states[1]), sel, gids, cap, np.dtype(np.int64))
+            v, valid = agg_ops.finish_avg(s, cnt, call.output_type)
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        if call.function == "min":
+            v, valid = agg_ops.agg_min(as_arg(states[0]), sel, gids, cap)
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        if call.function == "max":
+            v, valid = agg_ops.agg_max(as_arg(states[0]), sel, gids, cap)
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        raise NotImplementedError(call.function)
+
+    # -------------------------------------------------- distributed joins
+    def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        # broadcast exchange: replicate the (small, unique-keyed) build side
+        return super().lookup_join(node, left, gather_page(right))
+
+    def semi_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        return super().semi_join(node, left, gather_page(right))
+
+    def singleton_cross(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        return super().singleton_cross(node, left, gather_page(right))
+
+    # ---------------------------------------------- ordering on gathered
+    def sorted_page(self, page: Page, sort_channels, limit=None) -> Page:
+        return super().sorted_page(gather_page(page), sort_channels, limit)
+
+
+def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
+    """Enumerate splits per scan, load per-device shards, pad to a common
+    per-device shape, stack [ndev, rows]. This is the SOURCE_DISTRIBUTION
+    split assignment done statically (scheduler integration: later round)."""
+    staged: Dict[int, List] = {}
+    specs: Dict[int, PageSpec] = {}
+    for node in P.walk_plan(root):
+        if not isinstance(node, P.TableScanNode):
+            continue
+        conn = session.catalogs[node.catalog]
+        splits = conn.get_splits(node.schema, node.table, n_devices)
+        shard_pages = []
+        for di in range(n_devices):
+            if di < len(splits):
+                data = conn.scan(splits[di], node.column_names)
+            else:
+                data = conn.scan(dataclasses.replace(splits[0], lo=0, hi=0), node.column_names)
+            cols = []
+            for name, typ in zip(node.column_names, node.column_types):
+                cd = data[name]
+                cols.append(
+                    Column(
+                        typ,
+                        np.asarray(cd.values),
+                        np.asarray(cd.nulls) if cd.nulls is not None else None,
+                        cd.dictionary,
+                    )
+                )
+            shard_pages.append(cols)
+        max_rows = max((len(c[0].values) if c else 0) for c in shard_pages)
+        max_rows = max(max_rows, 1)
+        # unify per-shard dictionaries: codes must mean the same string on
+        # every device (the "stable dictionary ids" FTE determinism concern,
+        # SURVEY.md §7.3 item 8)
+        for ci, typ in enumerate(node.column_types):
+            if not typ.is_varchar:
+                continue
+            merged = shard_pages[0][ci].dictionary
+            for p in shard_pages[1:]:
+                if p[ci].dictionary.values != merged.values:
+                    merged = merged.merge(p[ci].dictionary)
+            for p in shard_pages:
+                d = p[ci].dictionary
+                if d.values != merged.values:
+                    table = np.asarray(d.recode_table(merged))
+                    codes = np.asarray(p[ci].values)
+                    p[ci] = Column(
+                        typ,
+                        np.where(codes >= 0, table[np.clip(codes, 0, None)], -1).astype(np.int32),
+                        p[ci].nulls,
+                        merged,
+                    )
+                else:
+                    p[ci] = Column(typ, p[ci].values, p[ci].nulls, merged)
+        stacked_cols = []
+        for ci in range(len(node.column_names)):
+            vals = np.stack(
+                [_pad(np.asarray(p[ci].values), max_rows) for p in shard_pages]
+            )
+            anynull = any(p[ci].nulls is not None for p in shard_pages)
+            nulls = (
+                np.stack(
+                    [
+                        _pad(
+                            np.asarray(p[ci].nulls)
+                            if p[ci].nulls is not None
+                            else np.zeros(len(p[ci].values), bool),
+                            max_rows,
+                        )
+                        for p in shard_pages
+                    ]
+                )
+                if anynull
+                else None
+            )
+            stacked_cols.append((vals, nulls, shard_pages[0][ci].dictionary))
+        sel = np.stack(
+            [
+                np.arange(max_rows) < len(p[0].values) if p else np.zeros(max_rows, bool)
+                for p in shard_pages
+            ]
+        )
+        arrays = []
+        types = []
+        dicts = []
+        has_nulls = []
+        for (vals, nulls, d), typ in zip(stacked_cols, node.column_types):
+            arrays.append(vals)
+            types.append(typ)
+            dicts.append(d)
+            if nulls is not None:
+                arrays.append(nulls)
+                has_nulls.append(True)
+            else:
+                has_nulls.append(False)
+        arrays.append(sel)
+        staged[node.id] = arrays
+        specs[node.id] = PageSpec(types, dicts, has_nulls, True)
+    return staged, specs
+
+
+def _pad(a: np.ndarray, n: int) -> np.ndarray:
+    if len(a) == n:
+        return a
+    pad = np.zeros((n - len(a),) + a.shape[1:], dtype=a.dtype)
+    return np.concatenate([a, pad])
+
+
+@dataclasses.dataclass
+class DistributedQuery:
+    """A query compiled to one shard_map program over a device mesh."""
+
+    mesh: Mesh
+    fn: object
+    inputs: List
+    out_spec_cell: List
+    error_codes_cell: List
+
+    @classmethod
+    def build(cls, session, root: P.OutputNode, mesh: Mesh) -> "DistributedQuery":
+        n_devices = mesh.devices.size
+        staged_arrays, specs = stage_sharded_scans(session, root, n_devices)
+        layout = [(nid, len(arrs)) for nid, arrs in staged_arrays.items()]
+        flat_inputs: List = []
+        for _, arrs in staged_arrays.items():
+            flat_inputs.extend(jnp.asarray(a) for a in arrs)
+        out_spec_cell: List = [None]
+        error_codes_cell: List = [None]
+
+        def per_shard(flat):
+            # flat arrays arrive with the device axis stripped by shard_map
+            pages: Dict[int, Page] = {}
+            i = 0
+            for nid, count in layout:
+                local = [a.reshape(a.shape[1:]) for a in flat[i : i + count]]
+                pages[nid] = unflatten_page(specs[nid], local)
+                i += count
+            ex = SpmdExecutor(session, pages)
+            out_page = ex.execute(root)
+            if not out_page.replicated:
+                # scan/filter/project-only plans never hit an exchange:
+                # gather so run() sees the full result, not shard 0's slice
+                out_page = gather_page(out_page)
+            out_arrays, out_spec = flatten_page(out_page)
+            out_spec_cell[0] = out_spec
+            error_codes_cell[0] = [c for c, _ in ex.errors]
+            # re-add a leading device axis so out_specs can shard it
+            return (
+                [a[None] for a in out_arrays],
+                [f[None] for _, f in ex.errors],
+            )
+
+        shard_fn = jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(PSpec(AXIS),),
+            out_specs=(PSpec(AXIS), PSpec(AXIS)),
+            check_vma=False,
+        )
+        fn = jax.jit(shard_fn)
+        return cls(mesh, fn, flat_inputs, out_spec_cell, error_codes_cell)
+
+    def run(self) -> Page:
+        from trino_tpu.exec.executor import raise_query_errors
+
+        out_arrays, error_flags = self.fn(self.inputs)
+        # flags are stacked per device: an error on ANY shard fails the query
+        raise_query_errors(self.error_codes_cell[0], error_flags)
+        # results are replicated across shards post-gather: take shard 0
+        local = [np.asarray(a)[0] for a in out_arrays]
+        return unflatten_page(self.out_spec_cell[0], local)

@@ -1,0 +1,176 @@
+"""Plan fragmenter: cut the plan into exchange-separated fragments.
+
+Reference: ``core/trino-main/.../sql/planner/PlanFragmenter.java:94`` cuts at
+remote ExchangeNodes into PlanFragments with PartitioningHandles
+(SystemPartitioningHandle.java:48-57). Here the same cuts describe how the
+SPMD executor maps the query onto the mesh (parallel/spmd.py):
+
+- SOURCE fragments: sharded scans + local work, one shard per device;
+- partial->final aggregations cut at a GATHER_STATES exchange (all_gather of
+  partial-state pages);
+- lookup/semi join build sides cut at BROADCAST exchanges (all_gather of the
+  build page);
+- the root fragment is SINGLE (sort/topN/limit/output over the gathered,
+  replicated result).
+
+Unlike the reference, a fragment boundary is not a process/wire boundary on
+the intra-slice path — every exchange compiles to a collective inside one
+program. The fragment tree is still the scheduling unit for the multi-host
+tier (DCN streaming / spooled exchange — later round) and drives
+EXPLAIN (TYPE DISTRIBUTED).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import List, Optional, Tuple
+
+from trino_tpu.sql.planner import plan as P
+
+_frag_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class RemoteSourceNode(P.PlanNode):
+    """Leaf standing for another fragment's output (reference:
+    plan/RemoteSourceNode.java)."""
+
+    fragment_id: int = 0
+    types: List = None
+    names: List[str] = None
+    exchange_type: str = "gather"  # gather | broadcast | gather_states
+
+    @property
+    def output_types(self):
+        return list(self.types)
+
+    @property
+    def output_names(self):
+        return list(self.names)
+
+
+@dataclasses.dataclass
+class PlanFragment:
+    id: int
+    partitioning: str  # 'source' (sharded over devices) | 'single' (replicated)
+    root: P.PlanNode
+
+
+def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
+    """Cut the optimized plan into fragments mirroring the SPMD execution."""
+    global _frag_ids
+    _frag_ids = itertools.count()
+    fragments: List[PlanFragment] = []
+
+    def cut(node: P.PlanNode, fragments: List[PlanFragment]) -> Tuple[P.PlanNode, bool]:
+        """Returns (node-in-current-fragment, is_replicated)."""
+        if isinstance(node, P.TableScanNode):
+            return node, False
+        if isinstance(node, (P.FilterNode, P.ProjectNode, P.LimitNode)):
+            src, rep = cut(node.source, fragments)
+            node.source = src
+            return node, rep
+        if isinstance(node, P.AggregationNode):
+            src, rep = cut(node.source, fragments)
+            if rep:
+                node.source = src
+                return node, True
+            # partial in a source fragment, final here above a state exchange
+            partial = P.AggregationNode(
+                src, node.group_channels, node.aggregates, step="partial",
+                names=node.names,
+            )
+            fid = next(_frag_ids)
+            fragments.append(PlanFragment(fid, "source", partial))
+            remote = RemoteSourceNode(
+                fragment_id=fid,
+                types=partial.output_types,
+                names=partial.output_names,
+                exchange_type="gather_states",
+            )
+            k = len(node.group_channels)
+            final = P.AggregationNode(
+                remote, list(range(k)), node.aggregates, step="final", names=node.names
+            )
+            return final, True
+        if isinstance(node, P.JoinNode):
+            left, lrep = cut(node.left, fragments)
+            right, rrep = cut(node.right, fragments)
+            node.left = left
+            if not rrep:
+                # build side broadcast: its own source fragment
+                fid = next(_frag_ids)
+                fragments.append(PlanFragment(fid, "source", right))
+                node.right = RemoteSourceNode(
+                    fragment_id=fid,
+                    types=right.output_types,
+                    names=right.output_names,
+                    exchange_type="broadcast",
+                )
+                node.distribution = node.distribution or "broadcast"
+            else:
+                node.right = right
+            return node, lrep
+        if isinstance(node, (P.SortNode, P.TopNNode)):
+            src, rep = cut(node.source, fragments)
+            if not rep:
+                fid = next(_frag_ids)
+                fragments.append(PlanFragment(fid, "source", src))
+                src = RemoteSourceNode(
+                    fragment_id=fid,
+                    types=src.output_types,
+                    names=src.output_names,
+                    exchange_type="gather",
+                )
+            node.source = src
+            return node, True
+        if isinstance(node, P.ValuesNode):
+            return node, True
+        raise NotImplementedError(f"fragmenter: {type(node).__name__}")
+
+    import copy
+
+    body, rep = cut(copy.deepcopy(root.source), fragments)
+    out = P.OutputNode(body, root.column_names)
+    if not rep:
+        fid = next(_frag_ids)
+        fragments.append(PlanFragment(fid, "source", body))
+        out = P.OutputNode(
+            RemoteSourceNode(
+                fragment_id=fid,
+                types=body.output_types,
+                names=body.output_names,
+                exchange_type="gather",
+            ),
+            root.column_names,
+        )
+    fragments.append(PlanFragment(next(_frag_ids), "single", out))
+    return fragments
+
+
+def format_fragments(fragments: List[PlanFragment]) -> str:
+    """EXPLAIN (TYPE DISTRIBUTED) rendering (reference: PlanPrinter's
+    fragmented text plan)."""
+    lines = []
+    for f in reversed(fragments):
+        lines.append(f"Fragment {f.id} [{f.partitioning}]")
+        lines.append(_format(f.root, 1))
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+def _format(node: P.PlanNode, indent: int) -> str:
+    if isinstance(node, RemoteSourceNode):
+        pad = "  " * indent
+        return f"{pad}- RemoteSource[{node.exchange_type}] <- Fragment {node.fragment_id}"
+    pad = "  " * indent
+    base = P.format_plan(node, indent).split("\n")
+    out = [base[0]]
+    # re-render children so RemoteSourceNodes print specially
+    kids = list(node.sources)
+    if kids:
+        out = [base[0]]
+        for k in kids:
+            out.append(_format(k, indent + 1))
+        return "\n".join(out)
+    return base[0]

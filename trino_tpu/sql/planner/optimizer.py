@@ -29,7 +29,22 @@ def optimize(root: P.OutputNode, session=None) -> P.OutputNode:
     node = push_predicates(root.source, [])
     node = orient_joins(node, session)
     node, _ = prune_channels(node, set(range(len(node.output_types))))
+    node = merge_identity_projects(node)
     return P.OutputNode(node, root.column_names)
+
+
+def merge_identity_projects(node: P.PlanNode) -> P.PlanNode:
+    """Drop Projects that are pure identity over their source (reference:
+    iterative rule RemoveRedundantIdentityProjections)."""
+    new_sources = [merge_identity_projects(s) for s in node.sources]
+    _replace_sources(node, new_sources)
+    if isinstance(node, P.ProjectNode):
+        src = node.source
+        if len(node.expressions) == len(src.output_types) and all(
+            isinstance(e, ir.ColumnRef) and e.index == i for i, e in enumerate(node.expressions)
+        ):
+            return src
+    return node
 
 
 # ----------------------------------------------------- join orientation
@@ -257,7 +272,13 @@ def prune_output(node: P.PlanNode) -> P.PlanNode:
 def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict[int, int]]:
     """Rewrite the subtree to produce only ``needed`` output channels.
 
-    Returns (new_node, mapping old_channel -> new_channel)."""
+    Returns (new_node, mapping old_channel -> new_channel).
+
+    Invariant: no node is ever pruned to zero channels — a Page's row count
+    lives in its columns, so count(*)-style consumers that need no values
+    still need one channel."""
+    if not needed and node.output_types:
+        needed = {0}
     if isinstance(node, P.TableScanNode):
         keep = sorted(needed)
         mapping = {old: i for i, old in enumerate(keep)}

@@ -349,6 +349,11 @@ class Planner:
             agg_calls.append(P.AggregateCall(a.name, ch, out_t, distinct=a.distinct))
             agg_arg_irs.append(arg)
 
+        if not pre_exprs:
+            # count(*)-only aggregation: carry a constant channel so the page
+            # keeps its row count through projection pruning
+            pre_exprs = [ir.Constant(T.BIGINT, 0)]
+            pre_names = ["$zero"]
         pre_project = P.ProjectNode(node, pre_exprs, pre_names)
         k = len(group_irs)
         agg_names = [pre_names[i] for i in range(k)] + [
