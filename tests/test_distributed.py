@@ -53,7 +53,52 @@ def mesh():
     return Mesh(np.array(devs[:8]), ("d",))
 
 
-@pytest.mark.parametrize("sql", [Q1, Q3, Q18], ids=["q1", "q3", "q18"])
+Q_MN = """
+select n1.n_name a, n2.n_name b from nation n1, nation n2
+where n1.n_regionkey = n2.n_regionkey and n1.n_nationkey < n2.n_nationkey
+order by 1, 2
+"""
+
+Q13 = """
+select c_count, count(*) as custdist
+from (
+    select c_custkey, count(o_orderkey) as c_count
+    from customer left outer join orders on
+        c_custkey = o_custkey and o_comment not like '%special%requests%'
+    group by c_custkey
+    ) as c_orders (c_custkey, c_count)
+group by c_count
+order by custdist desc, c_count desc
+"""
+
+Q21_CORE = """
+select s_name, count(*) as numwait
+from supplier, lineitem l1, orders, nation
+where s_suppkey = l1.l_suppkey
+    and o_orderkey = l1.l_orderkey
+    and o_orderstatus = 'F'
+    and l1.l_receiptdate > l1.l_commitdate
+    and exists (
+        select * from lineitem l2
+        where l2.l_orderkey = l1.l_orderkey
+            and l2.l_suppkey <> l1.l_suppkey)
+    and not exists (
+        select * from lineitem l3
+        where l3.l_orderkey = l1.l_orderkey
+            and l3.l_suppkey <> l1.l_suppkey
+            and l3.l_receiptdate > l3.l_commitdate)
+    and s_nationkey = n_nationkey
+    and n_name = 'SAUDI ARABIA'
+group by s_name
+order by numwait desc, s_name
+limit 100
+"""
+
+
+@pytest.mark.parametrize(
+    "sql", [Q1, Q3, Q18, Q_MN, Q13, Q21_CORE],
+    ids=["q1", "q3", "q18", "mn_join", "q13_left_mn", "q21_filtered_exists"],
+)
 def test_distributed_matches_local(session, mesh, sql):
     root = plan_sql(session, sql)
     dq = DistributedQuery.build(session, root, mesh)

@@ -147,6 +147,33 @@ class Page:
     def from_pydict(cls, schema: Dict[str, T.Type], data: Dict[str, Sequence]) -> "Page":
         return cls([Column.from_python(t, data[name]) for name, t in schema.items()])
 
+    @staticmethod
+    def concat_pages(a: "Page", b: "Page") -> "Page":
+        """Row-wise concatenation (static shapes: n_a + n_b). Dictionaries are
+        merged host-side with device recode gathers when they differ."""
+        cols: List[Column] = []
+        for ca, cb in zip(a.columns, b.columns):
+            va, vb = ca.values, cb.values
+            d = ca.dictionary
+            if ca.dictionary is not None and cb.dictionary is not None:
+                if ca.dictionary is not cb.dictionary and ca.dictionary.values != cb.dictionary.values:
+                    d = ca.dictionary.merge(cb.dictionary)
+                    ra = jnp.asarray(ca.dictionary.recode_table(d))
+                    rb = jnp.asarray(cb.dictionary.recode_table(d))
+                    va = jnp.where(va >= 0, ra[jnp.clip(va, 0)], NULL_CODE)
+                    vb = jnp.where(vb >= 0, rb[jnp.clip(vb, 0)], NULL_CODE)
+            vals = jnp.concatenate([va, vb])
+            if ca.nulls is None and cb.nulls is None:
+                nulls = None
+            else:
+                na = ca.nulls if ca.nulls is not None else jnp.zeros((len(ca),), bool)
+                nb = cb.nulls if cb.nulls is not None else jnp.zeros((len(cb),), bool)
+                nulls = jnp.concatenate([na, nb])
+            cols.append(Column(ca.type, vals, nulls, d))
+        sa = a.sel if a.sel is not None else jnp.ones((a.num_rows,), bool)
+        sb = b.sel if b.sel is not None else jnp.ones((b.num_rows,), bool)
+        return Page(cols, jnp.concatenate([sa, sb]), a.replicated and b.replicated)
+
     def live_count(self) -> int:
         if self.sel is None:
             return self.num_rows

@@ -27,8 +27,8 @@ class PreloadedExecutor(Executor):
     """Executor that reads table scans from pre-staged pages (the traced
     inputs) instead of calling the connector."""
 
-    def __init__(self, session, staged: Dict[int, Page]):
-        super().__init__(session)
+    def __init__(self, session, staged: Dict[int, Page], capacity_hints=None):
+        super().__init__(session, capacity_hints)
         self.staged = staged
 
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
@@ -50,6 +50,14 @@ class CompiledQuery:
         base = Executor(session)
         scans = [n for n in P.walk_plan(root) if isinstance(n, P.TableScanNode)]
         staged_pages = {n.id: base._exec_TableScanNode(n) for n in scans}
+        # shape-hint collection: one eager pass discovers the M:N join output
+        # capacities that the traced program needs as static constants
+        # (SURVEY.md §7.3 "two-pass kernels + bucketed recompiles")
+        capacity_hints: Dict[int, int] = {}
+        if P.needs_capacity_hints(root):
+            hint_ex = PreloadedExecutor(session, staged_pages)
+            hint_ex.execute(root)
+            capacity_hints = dict(hint_ex.capacity_hints)
         flat_inputs: List = []
         specs: Dict[int, PageSpec] = {}
         layout: List[Tuple[int, int]] = []  # (node_id, num_arrays)
@@ -67,7 +75,7 @@ class CompiledQuery:
             for nid, count in layout:
                 pages[nid] = unflatten_page(specs[nid], flat[i : i + count])
                 i += count
-            ex = PreloadedExecutor(session, pages)
+            ex = PreloadedExecutor(session, pages, dict(capacity_hints))
             out_page = ex.execute(root)
             out_arrays, out_spec = flatten_page(out_page)
             out_spec_cell[0] = out_spec

@@ -62,9 +62,14 @@ class Executor:
     raises deferred errors; the recursion itself (``execute``) is pure and
     jit-safe."""
 
-    def __init__(self, session):
+    def __init__(self, session, capacity_hints: Optional[Dict[int, int]] = None):
         self.session = session
         self.errors: List[Tuple[str, jnp.ndarray]] = []
+        # M:N join output capacities by plan-node id. Eager runs compute the
+        # exact total (one device sync) and record a padded power-of-two here;
+        # traced runs (compiled/SPMD) require the hint to pre-exist — the
+        # bucketed-recompile strategy of SURVEY.md §7.3 (dynamic shapes).
+        self.capacity_hints: Dict[int, int] = capacity_hints if capacity_hints is not None else {}
 
     # ------------------------------------------------------------------ api
     def execute_checked(self, node: P.PlanNode) -> Page:
@@ -253,7 +258,10 @@ class Executor:
 
     def _exec_aggregate(self, call: P.AggregateCall, page, sel, gids, cap):
         if call.distinct:
-            raise NotImplementedError("DISTINCT aggregates: round 2")
+            if call.function != "count":
+                raise NotImplementedError(f"{call.function}(DISTINCT): round 2")
+            arg = _col_to_lowered(page.columns[call.arg_channel])
+            return agg_ops.agg_count_distinct(arg, sel, gids, cap)
         if call.function == "count" and call.arg_channel is None:
             return agg_ops.agg_count_star(sel, gids, cap, page.num_rows)
         arg = _col_to_lowered(page.columns[call.arg_channel])
@@ -281,10 +289,148 @@ class Executor:
         left = self.execute(node.left)
         right = self.execute(node.right)
         if node.join_type in ("semi", "anti"):
+            if node.filter is not None:
+                return self.semi_join_filtered(node, left, right)
             return self.semi_join(node, left, right)
         if not node.left_keys:
-            return self.singleton_cross(node, left, right)
-        return self.lookup_join(node, left, right)
+            if node.singleton:
+                return self.singleton_cross(node, left, right)
+            return self.expand_join(node, left, right)  # true cross join
+        if node.right_unique:
+            return self.lookup_join(node, left, right)
+        return self.expand_join(node, left, right)
+
+    def hint_capacity(self, node_id: int, emit_counts) -> int:
+        """Static output capacity for an expansion join (see __init__)."""
+        cap = self.capacity_hints.get(node_id)
+        if cap is not None:
+            return cap
+        try:
+            total = int(jnp.sum(emit_counts))
+        except jax.errors.ConcretizationTypeError:
+            raise RuntimeError(
+                f"M:N join (plan node {node_id}) traced without a capacity "
+                "hint — run the plan eagerly first to collect shape hints "
+                "(CompiledQuery/DistributedQuery do this automatically)"
+            )
+        cap = max(16, 1 << (max(total, 1) - 1).bit_length())
+        self.capacity_hints[node_id] = cap
+        return cap
+
+    def _expansion_keys(self, node: P.JoinNode, left: Page, right: Page):
+        if node.left_keys:
+            build_key = join_ops.pack_keys(
+                [_col_to_lowered(right.columns[c]) for c in node.right_keys]
+            )
+            probe_key = join_ops.pack_keys(
+                [_col_to_lowered(left.columns[c]) for c in node.left_keys]
+            )
+        else:  # cross join: everything matches everything (constant key)
+            build_key = (jnp.zeros((right.num_rows,), jnp.int64), None)
+            probe_key = (jnp.zeros((left.num_rows,), jnp.int64), None)
+        return build_key, probe_key
+
+    def expand_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        """General M:N inner/left join: count matches per probe row, then
+        gather into a static-capacity probe-major output (ops/join.py
+        probe_counts + expand; reference JoinHash position-links chains)."""
+        build_key, probe_key = self._expansion_keys(node, left, right)
+        bk_sorted, b_rows, b_live = join_ops.build_side(build_key, right.sel)
+        lo, counts = join_ops.probe_counts(bk_sorted, b_live, probe_key, left.sel)
+        n = left.num_rows
+        outer = node.join_type == "left"
+        probe_live = (
+            left.sel if left.sel is not None else jnp.ones((n,), dtype=bool)
+        )
+        plain_outer = outer and node.filter is None
+        emit = jnp.where(probe_live, jnp.maximum(counts, 1), 0) if plain_outer else counts
+        capacity = self.hint_capacity(node.id, emit)
+        p, k, live, total = join_ops.expand(emit, capacity)
+        self.errors.append(("JOIN_OUTPUT_CAPACITY_EXCEEDED", total > capacity))
+        matched = live & (k < counts[p])
+        b_idx = jnp.clip(lo[p] + k, 0, bk_sorted.shape[0] - 1)
+        rows = b_rows[b_idx]
+        out_cols = [
+            Column(
+                c.type,
+                c.values[p],
+                c.nulls[p] if c.nulls is not None else None,
+                c.dictionary,
+            )
+            for c in left.columns
+        ]
+        for rc in right.columns:
+            v, valid = join_ops.gather_column(_col_to_lowered(rc), rows, matched)
+            out_cols.append(
+                Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary)
+            )
+        page = Page(out_cols, live, left.replicated and right.replicated)
+        if node.filter is None:
+            return page
+        lv = self._lower(node.filter, page)
+        passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
+        if not outer:
+            return Page(out_cols, live & passed, page.replicated)
+        # left join with filter: expanded rows that pass, plus one null-build
+        # row for each probe row with no passing match
+        passing = live & matched & passed
+        any_pass = (
+            jax.ops.segment_sum(passing.astype(jnp.int32), p, num_segments=n) > 0
+        )
+        tail_sel = probe_live & ~any_pass
+        tail_cols = []
+        for c in left.columns:
+            tail_cols.append(c)
+        for rc in right.columns:
+            tail_cols.append(
+                Column(
+                    rc.type,
+                    jnp.zeros((n,), dtype=rc.values.dtype),
+                    jnp.ones((n,), dtype=bool),
+                    rc.dictionary,
+                )
+            )
+        head = Page(out_cols, passing, page.replicated)
+        tail = Page(tail_cols, tail_sel, page.replicated)
+        return Page.concat_pages(head, tail)
+
+    def semi_join_filtered(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        """Semi/anti join with a residual filter (correlated EXISTS with
+        non-equality predicates): expand the matches, evaluate the filter,
+        then reduce any-passing back to the probe rows."""
+        build_key, probe_key = self._expansion_keys(node, left, right)
+        bk_sorted, b_rows, b_live = join_ops.build_side(build_key, right.sel)
+        lo, counts = join_ops.probe_counts(bk_sorted, b_live, probe_key, left.sel)
+        n = left.num_rows
+        capacity = self.hint_capacity(node.id, counts)
+        p, k, live, total = join_ops.expand(counts, capacity)
+        self.errors.append(("JOIN_OUTPUT_CAPACITY_EXCEEDED", total > capacity))
+        b_idx = jnp.clip(lo[p] + k, 0, bk_sorted.shape[0] - 1)
+        rows = b_rows[b_idx]
+        exp_cols = [
+            Column(
+                c.type,
+                c.values[p],
+                c.nulls[p] if c.nulls is not None else None,
+                c.dictionary,
+            )
+            for c in left.columns
+        ]
+        for rc in right.columns:
+            v, valid = join_ops.gather_column(_col_to_lowered(rc), rows, live)
+            exp_cols.append(
+                Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary)
+            )
+        exp_page = Page(exp_cols, live, left.replicated and right.replicated)
+        lv = self._lower(node.filter, exp_page)
+        passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
+        hit = (
+            jax.ops.segment_sum((live & passed).astype(jnp.int32), p, num_segments=n)
+            > 0
+        )
+        keep = hit if node.join_type == "semi" else ~hit
+        sel = keep if left.sel is None else left.sel & keep
+        return Page(left.columns, sel, left.replicated)
 
     def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         build_key = join_ops.pack_keys(
@@ -307,10 +453,16 @@ class Executor:
             sel = left.sel
         page = Page(out_cols, sel, left.replicated)
         if node.filter is not None:
-            if node.join_type == "left":
-                raise NotImplementedError("filtered left join: round 2")
             lv = self._lower(node.filter, page)
             passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
+            if node.join_type == "left":
+                # probe rows survive; a failing filter just voids the match
+                keep_match = matched & passed
+                new_cols = list(left.columns)
+                for rc, oc in zip(right.columns, out_cols[len(left.columns):]):
+                    nulls = ~keep_match if oc.nulls is None else (oc.nulls | ~keep_match)
+                    new_cols.append(Column(oc.type, oc.values, nulls, oc.dictionary))
+                return Page(new_cols, left.sel, left.replicated)
             page = Page(out_cols, passed if page.sel is None else page.sel & passed, left.replicated)
         return page
 

@@ -48,6 +48,27 @@ def agg_sum(arg: Lowered, sel, gids, num_segments: int, out_dtype):
     return total, cnt > 0
 
 
+def agg_count_distinct(arg: Lowered, sel, gids, num_segments: int):
+    """count(DISTINCT x) per group: re-group on (gid, x) pairs (same
+    sort/segment machinery as ops/groupby.py), then count one per live pair
+    group into its outer group. Reference: MarkDistinct + count, or the
+    distinct-accumulator path of AccumulatorCompiler."""
+    from trino_tpu.ops import groupby as gb
+
+    vals, valid = arg
+    n = vals.shape[0]
+    live = _live(sel, valid, n)
+    _, rep2, num2 = gb.group_ids([(gids.astype(jnp.int64), None), (vals, None)], live)
+    mask = jnp.arange(n) < num2
+    outer = gids[jnp.clip(rep2, 0, n - 1)]
+    cnt = jax.ops.segment_sum(
+        mask.astype(jnp.int64),
+        jnp.where(mask, outer, 0),
+        num_segments=num_segments,
+    )
+    return cnt, None
+
+
 def agg_min(arg: Lowered, sel, gids, num_segments: int):
     return _agg_minmax(arg, sel, gids, num_segments, is_min=True)
 

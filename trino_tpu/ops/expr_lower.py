@@ -356,6 +356,92 @@ def _like_to_regex(pattern: str, escape: Optional[str] = None) -> str:
     return "".join(out)
 
 
+def _vocab_transform(ctx: LowerCtx, x: LoweredVal, fn) -> LoweredVal:
+    """Apply a host-side string->string function over the dictionary
+    vocabulary once, rebuild an (order-preserving) dictionary, and recode on
+    device — the dictionary-first analog of Trino's per-row scalar string
+    functions (operator/scalar/StringFunctions.java)."""
+    assert x.dictionary is not None
+    mapped = [fn(v) for v in x.dictionary.values]
+    d_new = Dictionary.build(mapped)
+    lut = np.array([d_new.code_of(m) for m in mapped], dtype=np.int32)
+    lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros((1,), dtype=np.int32)
+    out = jnp.where(
+        x.vals >= 0, lut_dev[jnp.clip(x.vals, 0, max(len(lut) - 1, 0))], NULL_CODE
+    )
+    return LoweredVal(out, x.valid, d_new)
+
+
+def _sql_substring(v: str, start: int, length: Optional[int]) -> str:
+    """Trino substr semantics (StringFunctions.substr): 1-based; start 0 or
+    out of range yields ''; negative start counts from the end; the optional
+    length bounds the window from the (normalized) start."""
+    n = len(v)
+    if start == 0:
+        return ""
+    if start > 0:
+        if start > n:
+            return ""
+        i = start - 1
+    else:
+        if -start > n:
+            return ""
+        i = n + start
+    end = n if length is None else min(n, i + max(length, 0))
+    return v[i:end]
+
+
+def _lower_substring(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    start_e = expr.args[1]
+    len_e = expr.args[2] if len(expr.args) > 2 else None
+    assert isinstance(start_e, ir.Constant), "substring start must be a literal"
+    start = int(start_e.value)
+    length = None
+    if len_e is not None:
+        assert isinstance(len_e, ir.Constant), "substring length must be a literal"
+        length = int(len_e.value)
+    return _vocab_transform(ctx, x, lambda v: _sql_substring(v, start, length))
+
+
+def _lower_str_fn(pyfn) -> Callable:
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        x = lower(expr.args[0], ctx)
+        return _vocab_transform(ctx, x, pyfn)
+
+    return fn
+
+
+def _lower_length(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    assert x.dictionary is not None
+    lut = np.array([len(v) for v in x.dictionary.values], dtype=np.int64)
+    lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros((1,), dtype=np.int64)
+    out = jnp.where(x.vals >= 0, lut_dev[jnp.clip(x.vals, 0, max(len(lut) - 1, 0))], 0)
+    return LoweredVal(out, x.valid, None)
+
+
+def _lower_concat(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """concat where at most one argument is a column (vocab transform);
+    general column||column needs a pairwise dictionary product: round 2."""
+    col_args = [a for a in expr.args if not isinstance(a, ir.Constant)]
+    if not col_args:
+        s = "".join(str(a.value) for a in expr.args)
+        d = Dictionary([s])
+        return LoweredVal(_const_array(ctx, np.int32, 0), None, d)
+    if len(col_args) > 1:
+        raise NotImplementedError("concat of multiple varchar columns")
+    (col_e,) = col_args
+    x = lower(col_e, ctx)
+    pre = "".join(
+        str(a.value) for a in expr.args[: expr.args.index(col_e)]
+    )
+    post = "".join(
+        str(a.value) for a in expr.args[expr.args.index(col_e) + 1 :]
+    )
+    return _vocab_transform(ctx, x, lambda v: pre + v + post)
+
+
 def _lower_coalesce(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     acc = lower(expr.args[0], ctx)
     for nxt_expr in expr.args[1:]:
@@ -486,6 +572,14 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "like": _lower_like,
     "coalesce": _lower_coalesce,
     "nullif": _lower_nullif,
+    "substring": _lower_substring,
+    "lower": _lower_str_fn(str.lower),
+    "upper": _lower_str_fn(str.upper),
+    "trim": _lower_str_fn(str.strip),
+    "ltrim": _lower_str_fn(str.lstrip),
+    "rtrim": _lower_str_fn(str.rstrip),
+    "length": _lower_length,
+    "concat": _lower_concat,
     "extract_year": _lower_extract("year"),
     "extract_month": _lower_extract("month"),
     "extract_day": _lower_extract("day"),

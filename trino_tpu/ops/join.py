@@ -1,17 +1,20 @@
-"""Join kernels: lookup (N:1), semi/anti membership — searchsorted-based.
+"""Join kernels: lookup (N:1), M:N expansion, semi/anti — searchsorted-based.
 
 Reference: ``operator/join/`` — PagesHash open addressing + PositionLinks
 chains (JoinHash.java:28-69). TPU formulation: the build side is sorted by
 key once; probes binary-search (``jnp.searchsorted``, log2(n) vectorized
-steps, no scatter). Round-1 scope:
+steps, no scatter):
 
 - unique-key build (PK-FK joins, N:1): probe -> at most one match -> output
   size == probe size (static shapes, no two-pass emit). The planner proves
   uniqueness (primary keys / group-by outputs) before choosing this kernel.
+- general M:N join: two-pass count+emit (``probe_counts`` + ``expand``) —
+  the role of PositionLinks chain-following (JoinHash.java:28-69), done as
+  one vectorized gather into a *static-capacity* output (capacity from the
+  executor's shape-hint mechanism; exceeding it raises a deferred error and
+  triggers a bucketed recompile).
 - semi/anti joins: membership only (duplicates on build side are fine).
 - composite keys pack into one int64 (32/32 bits) — planner guarantees range.
-
-General M:N inner join (two-pass count+emit) is a round-2 kernel.
 """
 from __future__ import annotations
 
@@ -83,6 +86,50 @@ def membership(
     if pvalid is not None:
         hit = hit & pvalid
     return hit
+
+
+def probe_counts(
+    build_keys_sorted: jnp.ndarray,
+    build_live: jnp.ndarray,
+    probe_key: Lowered,
+    probe_sel: Optional[jnp.ndarray],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pass 1 of the M:N join: per probe row, the sorted-build range start
+    and match count. Dead probe rows (sel/NULL key) count 0."""
+    pvals, pvalid = probe_key
+    pv = pvals.astype(jnp.int64)
+    lo = jnp.searchsorted(build_keys_sorted, pv, side="left")
+    hi = jnp.searchsorted(build_keys_sorted, pv, side="right")
+    counts = hi - lo
+    # ranges of a real key contain only live rows (dead keys got the sentinel)
+    # but guard the all-dead-build edge anyway
+    counts = jnp.where(
+        build_live[jnp.clip(lo, 0, build_live.shape[0] - 1)], counts, 0
+    )
+    if pvalid is not None:
+        counts = jnp.where(pvalid, counts, 0)
+    if probe_sel is not None:
+        counts = jnp.where(probe_sel, counts, 0)
+    return lo, counts
+
+
+def expand(
+    counts: jnp.ndarray, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pass 2: map output slot j -> (probe_row, within-range offset).
+
+    Returns (probe_row[cap], offset_in_range[cap], live[cap], total).
+    Output is probe-major (all matches of probe row 0, then row 1, ...).
+    """
+    n = counts.shape[0]
+    offsets = jnp.cumsum(counts)  # inclusive
+    total = offsets[n - 1]
+    starts = offsets - counts
+    j = jnp.arange(capacity, dtype=counts.dtype)
+    p = jnp.clip(jnp.searchsorted(offsets, j, side="right"), 0, n - 1)
+    k = j - starts[p]
+    live = j < total
+    return p, k, live, total
 
 
 def gather_column(col: Lowered, rows: jnp.ndarray, matched: jnp.ndarray) -> Lowered:

@@ -74,8 +74,8 @@ def gather_page(page: Page) -> Page:
 class SpmdExecutor(Executor):
     """Runs the plan per-shard inside shard_map; exchanges are collectives."""
 
-    def __init__(self, session, staged: Dict[int, Page]):
-        super().__init__(session)
+    def __init__(self, session, staged: Dict[int, Page], capacity_hints=None):
+        super().__init__(session, capacity_hints)
         self.staged = staged
 
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
@@ -213,6 +213,15 @@ class SpmdExecutor(Executor):
     def singleton_cross(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         return super().singleton_cross(node, left, gather_page(right))
 
+    def expand_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        # M:N expansion probes stay local; the build side is broadcast.
+        # Capacity hints collected on full data upper-bound every shard's
+        # local match count (probe shard ⊆ all probes).
+        return super().expand_join(node, left, gather_page(right))
+
+    def semi_join_filtered(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        return super().semi_join_filtered(node, left, gather_page(right))
+
     # ---------------------------------------------- ordering on gathered
     def sorted_page(self, page: Page, sort_channels, limit=None) -> Page:
         return super().sorted_page(gather_page(page), sort_channels, limit)
@@ -339,6 +348,13 @@ class DistributedQuery:
     @classmethod
     def build(cls, session, root: P.OutputNode, mesh: Mesh) -> "DistributedQuery":
         n_devices = mesh.devices.size
+        capacity_hints: Dict[int, int] = {}
+        if P.needs_capacity_hints(root):
+            # eager full-data pre-run: global match totals upper-bound each
+            # shard's expansion capacity (SURVEY.md §7.3 bucketed recompiles)
+            hint_ex = Executor(session)
+            hint_ex.execute(root)
+            capacity_hints = dict(hint_ex.capacity_hints)
         staged_arrays, specs = stage_sharded_scans(session, root, n_devices)
         layout = [(nid, len(arrs)) for nid, arrs in staged_arrays.items()]
         flat_inputs: List = []
@@ -355,7 +371,7 @@ class DistributedQuery:
                 local = [a.reshape(a.shape[1:]) for a in flat[i : i + count]]
                 pages[nid] = unflatten_page(specs[nid], local)
                 i += count
-            ex = SpmdExecutor(session, pages)
+            ex = SpmdExecutor(session, pages, dict(capacity_hints))
             out_page = ex.execute(root)
             if not out_page.replicated:
                 # scan/filter/project-only plans never hit an exchange:

@@ -100,7 +100,7 @@ def orient_joins(node: P.PlanNode, session) -> P.PlanNode:
     if not isinstance(node, P.JoinNode) or node.join_type in ("semi", "anti"):
         return node
     if not node.left_keys:
-        return node  # scalar-subquery singleton cross join
+        return node  # scalar-subquery singleton or true cross join
     if _covered(node.right_keys, unique_key_sets(node.right, session)):
         node.right_unique = True
         return node
@@ -135,10 +135,7 @@ def orient_joins(node: P.PlanNode, session) -> P.PlanNode:
             [ir.ColumnRef(tys[i], order[i], nms[i]) for i in range(len(order))],
             nms,
         )
-    raise NotImplementedError(
-        "M:N join (neither side provably unique on the join keys): round 2 "
-        f"keys L{node.left_keys} R{node.right_keys}"
-    )
+    return node  # M:N join: executor uses the two-pass expansion kernel
 
 
 def _covered(keys: List[int], unique_sets: List[frozenset]) -> bool:
@@ -165,8 +162,43 @@ def substitute(e: ir.Expr, mapping: Dict[int, ir.Expr]) -> ir.Expr:
     return e
 
 
+def or_disjuncts(e: ir.Expr) -> List[ir.Expr]:
+    if isinstance(e, ir.Call) and e.name == "or":
+        return or_disjuncts(e.args[0]) + or_disjuncts(e.args[1])
+    return [e]
+
+
+def combine_disjuncts(parts: List[ir.Expr]) -> ir.Expr:
+    out = parts[0]
+    for p in parts[1:]:
+        out = ir.Call(T.BOOLEAN, "or", (out, p))
+    return out
+
+
+def extract_common_or_conjuncts(c: ir.Expr) -> List[ir.Expr]:
+    """or(and(a,b), and(a,c)) -> [a, or(b, c)] — factoring common conjuncts
+    out of a disjunction (reference: ExtractCommonPredicatesExpressionRewrite)
+    so e.g. TPC-H Q19's repeated `p_partkey = l_partkey` becomes a join key."""
+    branches = or_disjuncts(c)
+    if len(branches) < 2:
+        return [c]
+    branch_conjs = [ir_conjuncts(b) for b in branches]
+    common = [
+        x for x in branch_conjs[0] if all(x in bc for bc in branch_conjs[1:])
+    ]
+    if not common:
+        return [c]
+    rest = [
+        combine_conjuncts([x for x in bc if x not in common]) for bc in branch_conjs
+    ]
+    if any(r is None for r in rest):  # a branch reduced to TRUE
+        return common
+    return common + [combine_disjuncts(rest)]
+
+
 def push_predicates(node: P.PlanNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
     """Push ``conjuncts`` (over node's output channels) down through ``node``."""
+    conjuncts = [x for c in conjuncts for x in extract_common_or_conjuncts(c)]
     if isinstance(node, P.FilterNode):
         return push_predicates(node.source, conjuncts + ir_conjuncts(node.predicate))
     if isinstance(node, P.ProjectNode):
@@ -214,6 +246,18 @@ def _push_into_join(node: P.JoinNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
     if node.filter is not None and node.join_type == "inner":
         pending += ir_conjuncts(node.filter)
         node.filter = None
+    kept_filter: List[ir.Expr] = []
+    if node.filter is not None and outer:
+        # ON-clause conjuncts of a left join: right-only ones can be pushed
+        # into the build side (they only restrict match candidates); all
+        # others must stay in the join filter
+        for c in ir_conjuncts(node.filter):
+            chans = set(ir.referenced_channels(c))
+            if chans and min(chans) >= nleft:
+                right_conj.append(ir.remap_channels(c, {i: i - nleft for i in chans}))
+            else:
+                kept_filter.append(c)
+        node.filter = combine_conjuncts(kept_filter)
 
     for c in pending:
         chans = set(ir.referenced_channels(c))
@@ -231,9 +275,11 @@ def _push_into_join(node: P.JoinNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
             else:
                 right_conj.append(rc)
             continue
-        # mixed: equi-join key?
+        # mixed: equi-join key? (not into singleton joins — the scalar
+        # subquery's 0/multi-row error semantics live in the cross kernel)
         if (
             node.join_type == "inner"
+            and not node.singleton
             and isinstance(c, ir.Call)
             and c.name == "eq"
             and isinstance(c.args[0], ir.ColumnRef)
@@ -379,7 +425,7 @@ def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict
             left_keys=[lmap[c] for c in node.left_keys],
             right_keys=[rmap[c] for c in node.right_keys],
             filter=node_filter, distribution=node.distribution,
-            right_unique=node.right_unique,
+            right_unique=node.right_unique, singleton=node.singleton,
         )
         if semi:
             return new_node, lmap

@@ -170,6 +170,7 @@ class JoinNode(PlanNode):
     filter: Optional[ir.Expr] = None  # over concatenated channels
     distribution: Optional[str] = None  # 'partitioned' | 'broadcast'
     right_unique: bool = False  # build side keys unique (N:1 lookup join)
+    singleton: bool = False  # right side is a scalar subquery (exactly 1 row)
 
     @property
     def sources(self):
@@ -306,6 +307,21 @@ def walk_plan(node: PlanNode):
     yield node
     for s in node.sources:
         yield from walk_plan(s)
+
+
+def needs_capacity_hints(root: PlanNode) -> bool:
+    """True when the plan contains a join that executes via the two-pass
+    expansion kernel, whose static output capacity must be discovered by an
+    eager pre-run (Executor.hint_capacity)."""
+    for n in walk_plan(root):
+        if not isinstance(n, JoinNode):
+            continue
+        if n.join_type in ("semi", "anti"):
+            if n.filter is not None:
+                return True
+        elif not n.right_unique and not n.singleton:
+            return True
+    return False
 
 
 def format_plan(node: PlanNode, indent: int = 0) -> str:

@@ -62,6 +62,30 @@ def combine_conjuncts(parts: Sequence[ir.Expr]) -> Optional[ir.Expr]:
     return out
 
 
+def decorrelate_to_joint(e: ir.Expr, nleft: int) -> ir.Expr:
+    """Rewrite an expression analyzed in an inner scope (OuterRefs to the
+    outer query) onto the joint channel space of a join: OuterRef(i) ->
+    channel i, inner ColumnRef(j) -> channel nleft + j."""
+    if isinstance(e, ir.OuterRef):
+        return ir.ColumnRef(e.type, e.index, e.name)
+    if isinstance(e, ir.ColumnRef):
+        return ir.ColumnRef(e.type, nleft + e.index, e.name)
+    if isinstance(e, ir.Call):
+        return ir.Call(e.type, e.name, tuple(decorrelate_to_joint(a, nleft) for a in e.args))
+    if isinstance(e, ir.Case):
+        return ir.Case(
+            e.type,
+            tuple(
+                (decorrelate_to_joint(c, nleft), decorrelate_to_joint(v, nleft))
+                for c, v in e.whens
+            ),
+            decorrelate_to_joint(e.default, nleft) if e.default is not None else None,
+        )
+    if isinstance(e, ir.Cast):
+        return ir.Cast(e.type, decorrelate_to_joint(e.value, nleft))
+    return e
+
+
 class Planner:
     def __init__(self, session):
         self.session = session
@@ -379,8 +403,16 @@ class Planner:
 
         node = agg_node
         if spec.having is not None:
-            han = ExprAnalyzer(agg_scope, replacements).analyze(spec.having)
-            node = P.FilterNode(node, han)
+            plain_having: List[ir.Expr] = []
+            for conj in split_conjuncts(spec.having):
+                node, agg_scope, handled = self._plan_predicate_subquery(
+                    conj, node, agg_scope, ctes, replacements
+                )
+                if handled:
+                    continue
+                plain_having.append(ExprAnalyzer(agg_scope, replacements).analyze(conj))
+            if plain_having:
+                node = P.FilterNode(node, combine_conjuncts(plain_having))
 
         select_irs: List[ir.Expr] = []
         names: List[str] = []
@@ -449,9 +481,10 @@ class Planner:
         return P.SortNode(node, sort_channels)
 
     # ------------------------------------------------------- subquery preds
-    def _plan_predicate_subquery(self, conj, node, scope, ctes):
+    def _plan_predicate_subquery(self, conj, node, scope, ctes, replacements=None):
         """Handle IN (subquery) / EXISTS / scalar-subquery comparisons.
         Returns (node, scope, handled)."""
+        replacements = replacements or {}
         if isinstance(conj, ast.InSubquery):
             value_ir = ExprAnalyzer(scope).analyze(conj.value)
             sub = self.plan_query(conj.query, None, ctes)  # uncorrelated only
@@ -472,14 +505,14 @@ class Planner:
             ex: ast.Exists = conj.value if negated else conj
             return self._plan_exists(ex, negated, node, scope, ctes)
         if isinstance(conj, ast.Comparison) and isinstance(conj.right, ast.ScalarSubquery):
-            return self._plan_scalar_comparison(conj, node, scope, ctes)
+            return self._plan_scalar_comparison(conj, node, scope, ctes, replacements)
         return node, scope, False
 
     def _plan_exists(self, ex: ast.Exists, negated: bool, node, scope, ctes):
-        """Correlated EXISTS -> semi/anti join on the equi-correlation keys.
-
-        The subquery is planned against the outer scope as parent; its WHERE
-        conjuncts of shape outer_col = inner_col become join keys
+        """Correlated EXISTS -> semi/anti join on the equi-correlation keys;
+        non-equality correlated conjuncts (e.g. TPC-H Q21's
+        ``l2.l_suppkey <> l1.l_suppkey``) become the join's residual filter,
+        which the executor evaluates with the expansion kernel
         (reference: TransformExistsApplyToCorrelatedJoin + decorrelation)."""
         q = ex.query
         if q.with_queries or not isinstance(q.body, ast.QuerySpec):
@@ -489,9 +522,11 @@ class Planner:
         if inner_rp is None:
             raise PlanningError("EXISTS without FROM")
         inner_node, inner_scope = inner_rp.node, inner_rp.scope
+        nleft = len(scope.fields)
         corr_outer: List[int] = []
         corr_inner: List[int] = []
         inner_filters: List[ir.Expr] = []
+        residual: List[ir.Expr] = []  # over joint (outer ++ inner) channels
         for c in split_conjuncts(spec.where):
             analyzer = ExprAnalyzer(inner_scope)
             e = analyzer.analyze(c)
@@ -504,35 +539,41 @@ class Planner:
                 and {type(e.args[0]), type(e.args[1])} == {ir.OuterRef, ir.ColumnRef}
             ):
                 outer_arg = e.args[0] if isinstance(e.args[0], ir.OuterRef) else e.args[1]
-                inner_arg = e.args[1] if isinstance(e.args[1], ir.OuterRef) else e.args[0]
+                inner_arg = e.args[0] if isinstance(e.args[1], ir.OuterRef) else e.args[1]
                 corr_outer.append(outer_arg.index)
                 corr_inner.append(inner_arg.index)
                 continue
-            raise PlanningError(
-                "correlated EXISTS predicate too complex (only outer=inner "
-                "equality supported in round 1)"
-            )
+            residual.append(decorrelate_to_joint(e, nleft))
         if not corr_outer:
             raise PlanningError("uncorrelated EXISTS: round 2")
         if inner_filters:
             inner_node = P.FilterNode(inner_node, combine_conjuncts(inner_filters))
+        jt = "anti" if negated else "semi"
+        if residual:
+            # keep the full inner relation: the filter references its columns
+            new_node = P.JoinNode(
+                join_type=jt, left=node, right=inner_node,
+                left_keys=corr_outer, right_keys=corr_inner,
+                filter=combine_conjuncts(residual),
+            )
+            return new_node, scope, True
         # project the inner correlation keys
         proj = P.ProjectNode(
             inner_node,
             [ir.ColumnRef(inner_scope.fields[ch].type, ch) for ch in corr_inner],
             [f"ck{i}" for i in range(len(corr_inner))],
         )
-        jt = "anti" if negated else "semi"
         new_node = P.JoinNode(
             join_type=jt, left=node, right=proj,
             left_keys=corr_outer, right_keys=list(range(len(corr_inner))),
         )
         return new_node, scope, True
 
-    def _plan_scalar_comparison(self, conj: ast.Comparison, node, scope, ctes):
+    def _plan_scalar_comparison(self, conj: ast.Comparison, node, scope, ctes, replacements=None):
         """x <op> (SELECT agg(...) [FROM ... WHERE outer = inner]) —
         uncorrelated: single-row cross join; correlated equi: group the
         subquery by its correlation keys and equi-join."""
+        replacements = replacements or {}
         sub_ast = conj.right.query
         # Try planning as uncorrelated first
         try:
@@ -548,9 +589,10 @@ class Planner:
             join = P.JoinNode(
                 join_type="inner", left=node, right=sub.node,
                 left_keys=[], right_keys=[], distribution="broadcast",
+                singleton=True,
             )
             new_scope = Scope(scope.fields + [Field(None, f.type, "$scalar")], scope.parent)
-            left_ir = ExprAnalyzer(new_scope).analyze(conj.left)
+            left_ir = ExprAnalyzer(new_scope, replacements).analyze(conj.left)
             from trino_tpu.sql.analyzer.expr_analyzer import _COMPARISON_OPS
 
             pred = ir.Call(
@@ -566,9 +608,10 @@ class Planner:
                 [fl.name or f"_c{i}" for i, fl in enumerate(scope.fields)],
             )
             return proj, scope, True
-        return self._plan_correlated_scalar(conj, sub_ast, node, scope, ctes)
+        return self._plan_correlated_scalar(conj, sub_ast, node, scope, ctes, replacements)
 
-    def _plan_correlated_scalar(self, conj, sub_ast: ast.Query, node, scope, ctes):
+    def _plan_correlated_scalar(self, conj, sub_ast: ast.Query, node, scope, ctes, replacements=None):
+        replacements = replacements or {}
         """Decorrelate agg scalar subquery: SELECT agg(e) FROM R WHERE
         outer.k = R.j AND rest  ==>  join on k with (SELECT j, agg(e) FROM R
         WHERE rest GROUP BY j)."""
@@ -597,58 +640,74 @@ class Planner:
                 and {type(e.args[0]), type(e.args[1])} == {ir.OuterRef, ir.ColumnRef}
             ):
                 outer_arg = e.args[0] if isinstance(e.args[0], ir.OuterRef) else e.args[1]
-                inner_arg = e.args[1] if isinstance(e.args[1], ir.OuterRef) else e.args[0]
+                inner_arg = e.args[0] if isinstance(e.args[1], ir.OuterRef) else e.args[1]
                 corr_outer.append(outer_arg.index)
                 corr_inner.append(inner_arg.index)
                 continue
             raise PlanningError("correlated scalar subquery predicate too complex")
         if not corr_outer:
             raise PlanningError("scalar subquery planning failed")
-        # rebuild: SELECT ck..., agg FROM inner WHERE rest GROUP BY ck
+        # rebuild: SELECT ck..., expr-over-aggs FROM inner WHERE rest GROUP BY ck
         if inner_filters:
             fil_ir = [ExprAnalyzer(inner_scope).analyze(c) for c in inner_filters]
             inner_node = P.FilterNode(inner_node, combine_conjuncts(fil_ir))
-        # pre-project: corr keys + agg args
-        agg_ast = agg_calls[0]
-        if spec.select_items[0].expr is not agg_ast:
-            raise PlanningError("correlated scalar subquery must be a bare aggregate call")
-        arg_ir = None
+        # pre-project: corr keys + one arg channel per aggregate
+        k = len(corr_inner)
         pre_exprs = [
             ir.ColumnRef(inner_scope.fields[ch].type, ch) for ch in corr_inner
         ]
-        pre_names = [f"ck{i}" for i in range(len(corr_inner))]
-        if agg_ast.is_star:
-            call = P.AggregateCall("count", None, T.BIGINT)
-        else:
-            arg_ir = ExprAnalyzer(inner_scope).analyze(agg_ast.args[0])
-            call = P.AggregateCall(
-                agg_ast.name, len(pre_exprs), aggregate_result_type(agg_ast.name, arg_ir.type),
-                distinct=agg_ast.distinct,
+        pre_names = [f"ck{i}" for i in range(k)]
+        calls: List[P.AggregateCall] = []
+        for a in agg_calls:
+            if a.is_star:
+                calls.append(P.AggregateCall("count", None, T.BIGINT))
+                continue
+            arg_ir = ExprAnalyzer(inner_scope).analyze(a.args[0])
+            calls.append(
+                P.AggregateCall(
+                    a.name, len(pre_exprs),
+                    aggregate_result_type(a.name, arg_ir.type),
+                    distinct=a.distinct,
+                )
             )
             pre_exprs.append(arg_ir)
-            pre_names.append("aggarg")
+            pre_names.append(f"aggarg{len(calls) - 1}")
         pre = P.ProjectNode(inner_node, pre_exprs, pre_names)
-        k = len(corr_inner)
         agg_node = P.AggregationNode(
-            pre, list(range(k)), [call], step="single",
-            names=pre_names[:k] + ["aggval"],
+            pre, list(range(k)), calls, step="single",
+            names=pre_names[:k] + [f"aggval{i}" for i in range(len(calls))],
+        )
+        # the select item may be an expression over the aggregates
+        # (e.g. Q17's ``0.2 * avg(l_quantity)``): substitute agg calls with
+        # their output channels and project the value alongside the keys
+        agg_fields = [Field(None, t, None) for t in agg_node.output_types]
+        repl = {
+            a: ir.ColumnRef(calls[i].output_type, k + i) for i, a in enumerate(agg_calls)
+        }
+        value_ir = ExprAnalyzer(Scope(agg_fields, None), repl).analyze(
+            spec.select_items[0].expr
+        )
+        value_proj = P.ProjectNode(
+            agg_node,
+            [ir.ColumnRef(agg_node.output_types[i], i) for i in range(k)] + [value_ir],
+            pre_names[:k] + ["value"],
         )
         nleft = len(scope.fields)
         join = P.JoinNode(
-            join_type="inner", left=node, right=agg_node,
+            join_type="inner", left=node, right=value_proj,
             left_keys=corr_outer, right_keys=list(range(k)),
             right_unique=True,
         )
-        # predicate: left <op> aggval
-        ext_fields = scope.fields + [Field(None, t, "$sub") for t in agg_node.output_types]
+        # predicate: left <op> value
+        ext_fields = scope.fields + [Field(None, t, "$sub") for t in value_proj.output_types]
         ext_scope = Scope(ext_fields, scope.parent)
-        left_ir = ExprAnalyzer(ext_scope).analyze(conj.left)
+        left_ir = ExprAnalyzer(ext_scope, replacements).analyze(conj.left)
         from trino_tpu.sql.analyzer.expr_analyzer import _COMPARISON_OPS
 
         pred = ir.Call(
             T.BOOLEAN,
             _COMPARISON_OPS[conj.op],
-            (left_ir, ir.ColumnRef(call.output_type, nleft + k)),
+            (left_ir, ir.ColumnRef(value_ir.type, nleft + k)),
         )
         filt = P.FilterNode(join, pred)
         proj = P.ProjectNode(
