@@ -154,7 +154,125 @@ class Executor:
     # ---------------------------------------------------------- aggregation
     def _exec_AggregationNode(self, node: P.AggregationNode) -> Page:
         page = self.execute(node.source)
+        if node.step == "partial":
+            return self.aggregate_partial(node, page)
+        if node.step == "final":
+            return self.aggregate_final(node, page)
         return self.aggregate_page(node, page)
+
+    def aggregate_partial(self, node: P.AggregationNode, page: Page) -> Page:
+        """Partial aggregation: emit group keys + accumulator-state columns
+        (reference: HashAggregationOperator(PARTIAL) shipping
+        AccumulatorCompiler intermediate states through an exchange).
+        State column types follow plan._acc_types so the page can cross the
+        wire (serde needs faithful dtypes)."""
+        n = max(page.num_rows, 1)
+        keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
+        gids, rep, part_sel, cap = self.group_structure(node.group_channels, page)
+        out_cols: List[Column] = []
+        if node.group_channels:
+            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
+            for i, c in enumerate(node.group_channels):
+                src = page.columns[c]
+                v, valid = key_cols[i]
+                out_cols.append(
+                    Column(src.type, v, None if valid is None else ~valid, src.dictionary)
+                )
+        src_types = node.source.output_types
+        for call in node.aggregates:
+            states = self._partial_states(call, page, gids, cap)
+            state_types = P._acc_types(call, src_types)
+            for (sv, valid), st in zip(states, state_types):
+                out_cols.append(
+                    Column(st, sv, None if valid is None else ~valid, None)
+                )
+        return Page(out_cols, part_sel, page.replicated)
+
+    def aggregate_final(self, node: P.AggregationNode, page: Page) -> Page:
+        """Final aggregation over gathered partial-state pages."""
+        k = len(node.group_channels)
+        n = max(page.num_rows, 1)
+        keys = [_col_to_lowered(page.columns[c]) for c in range(k)]
+        gids, rep, out_sel, cap = self.group_structure(list(range(k)), page)
+        out_cols: List[Column] = []
+        if k:
+            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
+            for i in range(k):
+                src = page.columns[i]
+                v, valid = key_cols[i]
+                out_cols.append(
+                    Column(src.type, v, None if valid is None else ~valid, src.dictionary)
+                )
+        ci = k
+        for call in node.aggregates:
+            n_states = 2 if call.function == "avg" else 1
+            states = page.columns[ci : ci + n_states]
+            ci += n_states
+            out_cols.append(self._combine_state(call, states, page.sel, gids, cap))
+        return Page(out_cols, out_sel, page.replicated)
+
+    def _partial_states(self, call: P.AggregateCall, page, gids, cap):
+        """State arrays per aggregate: [(values, valid)], layout matching
+        plan._acc_types."""
+        if call.distinct:
+            raise NotImplementedError(
+                "DISTINCT aggregates cannot be split partial/final (the "
+                "planner routes them through a gather exchange instead)"
+            )
+        sel = page.sel
+        if call.function == "count" and call.arg_channel is None:
+            v, _ = agg_ops.agg_count_star(sel, gids, cap, page.num_rows)
+            return [(v, None)]
+        arg = _col_to_lowered(page.columns[call.arg_channel])
+        if call.function == "count":
+            v, _ = agg_ops.agg_count(arg, sel, gids, cap)
+            return [(v, None)]
+        if call.function == "sum":
+            return [agg_ops.agg_sum(arg, sel, gids, cap, call.output_type.np_dtype)]
+        if call.function == "avg":
+            base = (
+                call.output_type.np_dtype
+                if call.output_type.is_decimal
+                else np.dtype(np.float64)
+            )
+            s, s_valid = agg_ops.agg_sum(arg, sel, gids, cap, base)
+            cnt, _ = agg_ops.agg_count(arg, sel, gids, cap)
+            return [(s, s_valid), (cnt, None)]
+        if call.function == "min":
+            return [agg_ops.agg_min(arg, sel, gids, cap)]
+        if call.function == "max":
+            return [agg_ops.agg_max(arg, sel, gids, cap)]
+        raise NotImplementedError(call.function)
+
+    def _combine_state(self, call: P.AggregateCall, states: List[Column], sel, gids, cap) -> Column:
+        def as_arg(col: Column):
+            return (col.values, None if col.nulls is None else ~col.nulls)
+
+        if call.function == "count":
+            v, _ = agg_ops.agg_sum(as_arg(states[0]), sel, gids, cap, np.dtype(np.int64))
+            return Column(T.BIGINT, v, None, None)
+        if call.function == "sum":
+            v, valid = agg_ops.agg_sum(
+                as_arg(states[0]), sel, gids, cap, call.output_type.np_dtype
+            )
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        if call.function == "avg":
+            base = (
+                call.output_type.np_dtype
+                if call.output_type.is_decimal
+                else np.dtype(np.float64)
+            )
+            s, _sv = agg_ops.agg_sum(as_arg(states[0]), sel, gids, cap, base)
+            cnt, _ = agg_ops.agg_sum(as_arg(states[1]), sel, gids, cap, np.dtype(np.int64))
+            v, valid = agg_ops.finish_avg(s, cnt, call.output_type)
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        if call.function == "min":
+            v, valid = agg_ops.agg_min(as_arg(states[0]), sel, gids, cap)
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        if call.function == "max":
+            v, valid = agg_ops.agg_max(as_arg(states[0]), sel, gids, cap)
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        raise NotImplementedError(call.function)
 
     def group_structure(self, group_channels: List[int], page: Page):
         """(gids, rep, out_sel, capacity): group assignment for a page.

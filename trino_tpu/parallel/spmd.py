@@ -86,121 +86,22 @@ class SpmdExecutor(Executor):
         """partial aggregate -> all_gather partial states -> final combine.
 
         The exact split HashAggregationOperator(PARTIAL) -> remote exchange ->
-        HashAggregationOperator(FINAL) does, as one compiled program."""
+        HashAggregationOperator(FINAL) does, as one compiled program.
+        DISTINCT aggregates can't be split: gather raw rows and aggregate
+        single-step (the MarkDistinct-over-gather fallback)."""
         if page.replicated:
             # every device already holds all rows: single-step local aggregate
             return super().aggregate_page(node, page)
-        n = max(page.num_rows, 1)
-        keys = [
-            (page.columns[c].values, None if page.columns[c].nulls is None else ~page.columns[c].nulls)
-            for c in node.group_channels
-        ]
-        gids, rep, part_sel, cap = self.group_structure(node.group_channels, page)
-        # partial states per aggregate
-        partial_cols: List[Column] = []
-        if node.group_channels:
-            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
-            for i, c in enumerate(node.group_channels):
-                src = page.columns[c]
-                v, valid = key_cols[i]
-                partial_cols.append(
-                    Column(src.type, v, None if valid is None else ~valid, src.dictionary)
-                )
-        state_layout: List[Tuple[str, int]] = []  # (combine_fn, n_arrays)
-        for call in node.aggregates:
-            states = self._partial_states(call, page, gids, cap)
-            state_layout.append((call.function, len(states)))
-            for sv in states:
-                partial_cols.append(Column(T.BIGINT, sv[0], None if sv[1] is None else ~sv[1], None))
-        partial = Page(partial_cols, part_sel)
-        # exchange: gather every device's partial groups (cap-sized states,
-        # not input rows, when a compact capacity is known)
+        if any(c.distinct for c in node.aggregates):
+            return super().aggregate_page(node, gather_page(page))
+        partial = self.aggregate_partial(node, page)
         gathered = gather_page(partial)
-        # final combine: re-group gathered keys, merge states
-        return self._final_combine(node, gathered, len(node.group_channels), state_layout)
-
-    def _partial_states(self, call: P.AggregateCall, page, gids, cap):
-        """Partial-aggregation state arrays (reference: AccumulatorCompiler
-        intermediate states shipped through the partial->final exchange)."""
-        if call.distinct:
-            raise NotImplementedError("DISTINCT aggregates: round 2")
-        sel = page.sel
-        if call.function == "count" and call.arg_channel is None:
-            v, _ = agg_ops.agg_count_star(sel, gids, cap, page.num_rows)
-            return [(v, None)]
-        arg_col = page.columns[call.arg_channel]
-        arg = (arg_col.values, None if arg_col.nulls is None else ~arg_col.nulls)
-        if call.function == "count":
-            v, _ = agg_ops.agg_count(arg, sel, gids, cap)
-            return [(v, None)]
-        if call.function == "sum":
-            v, valid = agg_ops.agg_sum(arg, sel, gids, cap, call.output_type.np_dtype)
-            return [(v, valid)]
-        if call.function == "avg":
-            base = (
-                call.output_type.np_dtype if call.output_type.is_decimal else np.dtype(np.float64)
-            )
-            s, s_valid = agg_ops.agg_sum(arg, sel, gids, cap, base)
-            cnt, _ = agg_ops.agg_count(arg, sel, gids, cap)
-            return [(s, s_valid), (cnt, None)]
-        if call.function == "min":
-            v, valid = agg_ops.agg_min(arg, sel, gids, cap)
-            return [(v, valid)]
-        if call.function == "max":
-            v, valid = agg_ops.agg_max(arg, sel, gids, cap)
-            return [(v, valid)]
-        raise NotImplementedError(call.function)
-
-    def _final_combine(self, node, gathered: Page, k: int, state_layout):
-        n = max(gathered.num_rows, 1)
-        keys = [
-            (gathered.columns[i].values, None if gathered.columns[i].nulls is None else ~gathered.columns[i].nulls)
-            for i in range(k)
-        ]
-        gids, rep, out_sel, _cap = self.group_structure(list(range(k)), gathered)
-        out_cols: List[Column] = []
-        if k:
-            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
-            for i in range(k):
-                src = gathered.columns[i]
-                v, valid = key_cols[i]
-                out_cols.append(
-                    Column(src.type, v, None if valid is None else ~valid, src.dictionary)
-                )
-        ci = k
-        for call, (fn_name, n_states) in zip(node.aggregates, state_layout):
-            states = gathered.columns[ci : ci + n_states]
-            ci += n_states
-            out_cols.append(self._combine_state(call, states, gathered.sel, gids, _cap))
-        return Page(out_cols, out_sel, replicated=True)
-
-    def _combine_state(self, call: P.AggregateCall, states: List[Column], sel, gids, cap) -> Column:
-        def as_arg(col: Column):
-            return (col.values, None if col.nulls is None else ~col.nulls)
-
-        if call.function in ("count",):
-            v, _ = agg_ops.agg_sum(as_arg(states[0]), sel, gids, cap, np.dtype(np.int64))
-            return Column(T.BIGINT, v, None, None)
-        if call.function == "sum":
-            v, valid = agg_ops.agg_sum(
-                as_arg(states[0]), sel, gids, cap, call.output_type.np_dtype
-            )
-            return Column(call.output_type, v, None if valid is None else ~valid, None)
-        if call.function == "avg":
-            base = (
-                call.output_type.np_dtype if call.output_type.is_decimal else np.dtype(np.float64)
-            )
-            s, s_valid = agg_ops.agg_sum(as_arg(states[0]), sel, gids, cap, base)
-            cnt, _ = agg_ops.agg_sum(as_arg(states[1]), sel, gids, cap, np.dtype(np.int64))
-            v, valid = agg_ops.finish_avg(s, cnt, call.output_type)
-            return Column(call.output_type, v, None if valid is None else ~valid, None)
-        if call.function == "min":
-            v, valid = agg_ops.agg_min(as_arg(states[0]), sel, gids, cap)
-            return Column(call.output_type, v, None if valid is None else ~valid, None)
-        if call.function == "max":
-            v, valid = agg_ops.agg_max(as_arg(states[0]), sel, gids, cap)
-            return Column(call.output_type, v, None if valid is None else ~valid, None)
-        raise NotImplementedError(call.function)
+        final = P.AggregationNode(
+            None, list(range(len(node.group_channels))), node.aggregates,
+            step="final", names=node.names,
+        )
+        out = self.aggregate_final(final, gathered)
+        return Page(out.columns, out.sel, replicated=True)
 
     # -------------------------------------------------- distributed joins
     def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:

@@ -75,6 +75,18 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
             if rep:
                 node.source = src
                 return node, True
+            if any(a.distinct for a in node.aggregates):
+                # DISTINCT aggregates can't be split partial/final: gather the
+                # raw rows, aggregate single-step above the exchange
+                fid = next(_frag_ids)
+                fragments.append(PlanFragment(fid, "source", src))
+                node.source = RemoteSourceNode(
+                    fragment_id=fid,
+                    types=src.output_types,
+                    names=src.output_names,
+                    exchange_type="gather",
+                )
+                return node, True
             # partial in a source fragment, final here above a state exchange
             partial = P.AggregationNode(
                 src, node.group_channels, node.aggregates, step="partial",
