@@ -1,34 +1,36 @@
-"""Benchmark: TPC-H Q1 throughput on the flagship compiled path.
+"""Benchmark: TPC-H throughput on the flagship compiled path.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
-Config #1 of BASELINE.md (TPC-H Q1 group-by over lineitem), scaled to sf1
-(~6M rows), measured as steady-state rows/sec/chip on the whole compiled
-query body (filter + group-by + 8 aggregates + sort), input resident on
-device, host transfer excluded — matching how the reference benchmarks
-operator throughput (JMH over in-memory pages, BenchmarkHashAggregation).
+Queries: TPC-H Q1 (headline, BASELINE config #1 scaled to sf1), plus Q3 and
+Q18 (BASELINE configs #2/#3 shapes at sf1) as extra fields. Rows/sec =
+total scanned input rows / best wall-clock of the steady-state compiled
+body (inputs device-resident, like the reference's JMH operator benchmarks
+over in-memory pages).
 
-vs_baseline: the reference publishes no numbers (BASELINE.md). We use the
-north-star anchor from BASELINE.json — >=5x a Java operator pipeline,
-taken as ~3M rows/sec/core for this shape — so vs_baseline = value / 3e6
-(>=5.0 means the north star is met against that assumed anchor).
+Measurement honesty (round-2 fixes per VERDICT.md):
+- The axon TPU tunnel's ``block_until_ready`` does NOT actually block, so
+  every iteration is timed by forcing a one-element device->host transfer
+  of each output array (and the tunnel is first warmed into its
+  synchronous state with a dummy transfer).
+- Backend init is retried with backoff (round-1 failure mode: transient
+  "Unable to initialize backend" at first device touch).
+- ``vs_baseline`` divides by a MEASURED anchor: the same engine + same
+  queries run on the host CPU backend (subprocess with JAX_PLATFORMS=cpu),
+  not an assumed constant.
+
+Reference perf role: testing/trino-benchto-benchmarks/.../tpch.yaml:1-30.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-
-def main():
-    import jax
-
-    from trino_tpu import Session
-    from trino_tpu.exec.compiled import CompiledQuery
-    from trino_tpu.exec.query import plan_sql
-
-    schema = "sf1"
-    q1 = """
+QUERIES = {
+    "q1": """
 select
     l_returnflag, l_linestatus,
     sum(l_quantity) as sum_qty,
@@ -41,36 +43,158 @@ from lineitem
 where l_shipdate <= date '1998-12-01' - interval '90' day
 group by l_returnflag, l_linestatus
 order by l_returnflag, l_linestatus
-"""
-    session = Session(properties={"schema": schema})
-    root = plan_sql(session, q1)
-    print(f"device: {jax.devices()[0]}", file=sys.stderr)
-    t0 = time.time()
-    cq = CompiledQuery.build(session, root)
-    n_rows = int(cq.input_arrays[0].shape[0])
-    print(f"staged {n_rows} lineitem rows in {time.time()-t0:.1f}s", file=sys.stderr)
+""",
+    "q3": """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+""",
+    "q18": """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity)
+from customer, orders, lineitem
+where o_orderkey in (
+        select l_orderkey from lineitem
+        group by l_orderkey having sum(l_quantity) > 300)
+  and c_custkey = o_custkey and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate limit 100
+""",
+}
 
-    page = cq.run()  # compile + first run
-    rows = page.to_pylist()
-    assert len(rows) == 4, rows
-    best = float("inf")
-    for _ in range(3):
+SCHEMA = "sf1"
+ITERS = 3
+
+
+def _init_backend_with_retry(max_attempts=4):
+    import jax
+
+    last = None
+    for attempt in range(max_attempts):
+        try:
+            devs = jax.devices()
+            print(f"devices: {devs}", file=sys.stderr)
+            return devs
+        except RuntimeError as e:  # transient tunnel/backend init failures
+            last = e
+            wait = 5 * (attempt + 1)
+            print(
+                f"backend init failed (attempt {attempt + 1}/{max_attempts}): "
+                f"{e}; retrying in {wait}s",
+                file=sys.stderr,
+            )
+            time.sleep(wait)
+    raise SystemExit(f"TPU backend init failed after {max_attempts} attempts: {last}")
+
+
+def _force(out_arrays):
+    """Force completion of every output (tunnel-safe sync)."""
+    import numpy as np
+
+    for a in out_arrays:
+        np.asarray(a.ravel()[0] if a.ndim else a)
+
+
+def run_suite(emit_audit=False):
+    """Returns {name: {"rows": n, "seconds": best, "rows_per_sec": v}}."""
+    import numpy as np
+
+    from trino_tpu import Session
+    from trino_tpu.exec.compiled import CompiledQuery
+    from trino_tpu.exec.query import plan_sql
+
+    session = Session(properties={"schema": SCHEMA})
+    results = {}
+    for name, sql in QUERIES.items():
         t0 = time.time()
-        out_arrays, flags = cq.fn(cq.input_arrays)
-        jax.block_until_ready(out_arrays)
-        best = min(best, time.time() - t0)
-    value = n_rows / best
-    print(f"steady-state: {best*1000:.1f} ms, {value/1e6:.1f}M rows/s", file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                "metric": "tpch_sf1_q1_rows_per_sec_per_chip",
-                "value": round(value, 1),
-                "unit": "rows/sec/chip",
-                "vs_baseline": round(value / 3e6, 3),
-            }
+        root = plan_sql(session, sql)
+        cq = CompiledQuery.build(session, root)
+        n_rows = _scan_rows(cq)
+        print(f"[{name}] staged {n_rows} rows in {time.time()-t0:.1f}s", file=sys.stderr)
+        if emit_audit:
+            dtypes = sorted({str(a.dtype) for a in cq.input_arrays})
+            print(f"[{name}] input dtypes: {dtypes}", file=sys.stderr)
+        page = cq.run()  # compile + first run + error check
+        _ = page.to_pylist()
+        best = float("inf")
+        for _i in range(ITERS):
+            t0 = time.time()
+            out_arrays, _flags = cq.fn(cq.input_arrays)
+            _force(out_arrays)
+            best = min(best, time.time() - t0)
+        results[name] = {
+            "rows": n_rows,
+            "seconds": round(best, 4),
+            "rows_per_sec": round(n_rows / best, 1),
+        }
+        print(
+            f"[{name}] steady-state {best*1000:.1f} ms, "
+            f"{n_rows/best/1e6:.1f}M rows/s",
+            file=sys.stderr,
         )
-    )
+    return results
+
+
+def _scan_rows(cq) -> int:
+    """Total input rows across all table scans (sum of per-scan lengths)."""
+    total = 0
+    i = 0
+    for spec in cq.input_specs.values():
+        # first array of each scan's flattened page is its first column
+        total += int(cq.input_arrays[i].shape[0])
+        i += spec.array_count()
+    return total
+
+
+def main():
+    if os.environ.get("_BENCH_CHILD") == "cpu":
+        # CPU anchor subprocess: run the same suite on host CPU
+        res = run_suite()
+        print("BENCH_CHILD_RESULT " + json.dumps(res))
+        return
+
+    _init_backend_with_retry()
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print(f"WARNING: benchmarking on {dev.platform}, not TPU", file=sys.stderr)
+    results = run_suite(emit_audit=True)
+
+    # measured CPU anchor (same engine, same queries, host CPU backend)
+    cpu = None
+    try:
+        env = dict(os.environ, _BENCH_CHILD="cpu", JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=1800, env=env,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("BENCH_CHILD_RESULT "):
+                cpu = json.loads(line[len("BENCH_CHILD_RESULT "):])
+        if cpu is None:
+            print(f"CPU anchor failed:\n{proc.stderr[-2000:]}", file=sys.stderr)
+    except Exception as e:  # anchor is best-effort; TPU number still reported
+        print(f"CPU anchor failed: {e}", file=sys.stderr)
+
+    headline = results["q1"]["rows_per_sec"]
+    vs = round(headline / cpu["q1"]["rows_per_sec"], 3) if cpu else None
+    out = {
+        "metric": "tpch_sf1_q1_rows_per_sec_per_chip",
+        "value": headline,
+        "unit": "rows/sec/chip",
+        # measured anchor: same engine on host CPU (JAX_PLATFORMS=cpu);
+        # vs_baseline = TPU throughput / CPU throughput for Q1
+        "vs_baseline": vs,
+        "tpu": results,
+        "cpu_anchor": cpu,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -29,6 +29,7 @@ from trino_tpu.ops import aggregate as agg_ops
 from trino_tpu.ops import expr_lower as L
 from trino_tpu.ops import groupby as gb
 from trino_tpu.ops import join as join_ops
+from trino_tpu.ops import segments as seg
 from trino_tpu.ops import sort as sort_ops
 from trino_tpu.sql import ir
 from trino_tpu.sql.planner import plan as P
@@ -166,12 +167,11 @@ class Executor:
         AccumulatorCompiler intermediate states through an exchange).
         State column types follow plan._acc_types so the page can cross the
         wire (serde needs faithful dtypes)."""
-        n = max(page.num_rows, 1)
         keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
-        gids, rep, part_sel, cap = self.group_structure(node.group_channels, page)
+        layout, part_sel = self.group_structure(node.group_channels, page)
         out_cols: List[Column] = []
         if node.group_channels:
-            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
+            key_cols = gb.gather_group_keys(keys, layout.rep)
             for i, c in enumerate(node.group_channels):
                 src = page.columns[c]
                 v, valid = key_cols[i]
@@ -180,7 +180,7 @@ class Executor:
                 )
         src_types = node.source.output_types
         for call in node.aggregates:
-            states = self._partial_states(call, page, gids, cap)
+            states = self._partial_states(call, page, layout)
             state_types = P._acc_types(call, src_types)
             for (sv, valid), st in zip(states, state_types):
                 out_cols.append(
@@ -191,12 +191,11 @@ class Executor:
     def aggregate_final(self, node: P.AggregationNode, page: Page) -> Page:
         """Final aggregation over gathered partial-state pages."""
         k = len(node.group_channels)
-        n = max(page.num_rows, 1)
         keys = [_col_to_lowered(page.columns[c]) for c in range(k)]
-        gids, rep, out_sel, cap = self.group_structure(list(range(k)), page)
+        layout, out_sel = self.group_structure(list(range(k)), page)
         out_cols: List[Column] = []
         if k:
-            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
+            key_cols = gb.gather_group_keys(keys, layout.rep)
             for i in range(k):
                 src = page.columns[i]
                 v, valid = key_cols[i]
@@ -205,13 +204,14 @@ class Executor:
                 )
         ci = k
         for call in node.aggregates:
-            n_states = 2 if call.function == "avg" else 1
+            # state layout must match what aggregate_partial emitted
+            n_states = P._acc_state_count(call)
             states = page.columns[ci : ci + n_states]
             ci += n_states
-            out_cols.append(self._combine_state(call, states, page.sel, gids, cap))
+            out_cols.append(self._combine_state(call, states, page.sel, layout))
         return Page(out_cols, out_sel, page.replicated)
 
-    def _partial_states(self, call: P.AggregateCall, page, gids, cap):
+    def _partial_states(self, call: P.AggregateCall, page, layout):
         """State arrays per aggregate: [(values, valid)], layout matching
         plan._acc_types."""
         if call.distinct:
@@ -221,39 +221,39 @@ class Executor:
             )
         sel = page.sel
         if call.function == "count" and call.arg_channel is None:
-            v, _ = agg_ops.agg_count_star(sel, gids, cap, page.num_rows)
+            v, _ = agg_ops.agg_count_star(layout, sel)
             return [(v, None)]
         arg = _col_to_lowered(page.columns[call.arg_channel])
         if call.function == "count":
-            v, _ = agg_ops.agg_count(arg, sel, gids, cap)
+            v, _ = agg_ops.agg_count(layout, arg, sel)
             return [(v, None)]
         if call.function == "sum":
-            return [agg_ops.agg_sum(arg, sel, gids, cap, call.output_type.np_dtype)]
+            return [agg_ops.agg_sum(layout, arg, sel, call.output_type.np_dtype)]
         if call.function == "avg":
             base = (
                 call.output_type.np_dtype
                 if call.output_type.is_decimal
                 else np.dtype(np.float64)
             )
-            s, s_valid = agg_ops.agg_sum(arg, sel, gids, cap, base)
-            cnt, _ = agg_ops.agg_count(arg, sel, gids, cap)
+            s, s_valid = agg_ops.agg_sum(layout, arg, sel, base)
+            cnt, _ = agg_ops.agg_count(layout, arg, sel)
             return [(s, s_valid), (cnt, None)]
         if call.function == "min":
-            return [agg_ops.agg_min(arg, sel, gids, cap)]
+            return [agg_ops.agg_min(layout, arg, sel)]
         if call.function == "max":
-            return [agg_ops.agg_max(arg, sel, gids, cap)]
+            return [agg_ops.agg_max(layout, arg, sel)]
         raise NotImplementedError(call.function)
 
-    def _combine_state(self, call: P.AggregateCall, states: List[Column], sel, gids, cap) -> Column:
+    def _combine_state(self, call: P.AggregateCall, states: List[Column], sel, layout) -> Column:
         def as_arg(col: Column):
             return (col.values, None if col.nulls is None else ~col.nulls)
 
         if call.function == "count":
-            v, _ = agg_ops.agg_sum(as_arg(states[0]), sel, gids, cap, np.dtype(np.int64))
+            v, _ = agg_ops.agg_sum(layout, as_arg(states[0]), sel, np.dtype(np.int64))
             return Column(T.BIGINT, v, None, None)
         if call.function == "sum":
             v, valid = agg_ops.agg_sum(
-                as_arg(states[0]), sel, gids, cap, call.output_type.np_dtype
+                layout, as_arg(states[0]), sel, call.output_type.np_dtype
             )
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         if call.function == "avg":
@@ -262,27 +262,28 @@ class Executor:
                 if call.output_type.is_decimal
                 else np.dtype(np.float64)
             )
-            s, _sv = agg_ops.agg_sum(as_arg(states[0]), sel, gids, cap, base)
-            cnt, _ = agg_ops.agg_sum(as_arg(states[1]), sel, gids, cap, np.dtype(np.int64))
+            s, _sv = agg_ops.agg_sum(layout, as_arg(states[0]), sel, base)
+            cnt, _ = agg_ops.agg_sum(layout, as_arg(states[1]), sel, np.dtype(np.int64))
             v, valid = agg_ops.finish_avg(s, cnt, call.output_type)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         if call.function == "min":
-            v, valid = agg_ops.agg_min(as_arg(states[0]), sel, gids, cap)
+            v, valid = agg_ops.agg_min(layout, as_arg(states[0]), sel)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         if call.function == "max":
-            v, valid = agg_ops.agg_max(as_arg(states[0]), sel, gids, cap)
+            v, valid = agg_ops.agg_max(layout, as_arg(states[0]), sel)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         raise NotImplementedError(call.function)
 
     def group_structure(self, group_channels: List[int], page: Page):
-        """(gids, rep, out_sel, capacity): group assignment for a page.
+        """(GroupLayout, out_sel): group assignment for a page.
 
         Two strategies (the FlatHash vs BigintGroupByHash specialization
-        split in the reference, re-chosen for TPU):
+        split in the reference, re-chosen for TPU — see ops/segments.py):
         - direct-mapped: all keys are null-free dictionary codes (or
           booleans) with a small cardinality product -> gid is a perfect
-          index, NO sort, output compacted to `capacity` slots (the Q1-shape
-          fast path; out_sel is the occupancy mask, in key order).
+          index, NO sort, aggregation via unrolled masked reductions
+          (the Q1-shape fast path; out_sel is the occupancy mask, in key
+          order).
         - sort-based: exact comparison grouping for arbitrary keys
           (ops/groupby.py); capacity == input length, out_sel a prefix.
         """
@@ -291,25 +292,19 @@ class Executor:
         sel = page.sel
         if not group_channels:
             gids = jnp.zeros((n,), dtype=jnp.int32)
-            return gids, None, jnp.arange(1) < 1, 1
+            layout = seg.direct_layout(gids, 1, sel)
+            return layout, jnp.arange(1) < 1
         direct = self._direct_strides(group_channels, page)
         if direct is not None:
             strides, capacity = direct
             gids = jnp.zeros((n,), dtype=jnp.int32)
             for (vals, _), stride in zip(keys, strides):
                 gids = gids + vals.astype(jnp.int32) * stride
-            occupied = (
-                jax.ops.segment_sum(
-                    jnp.ones((n,), jnp.int32) if sel is None else sel.astype(jnp.int32),
-                    gids,
-                    num_segments=capacity,
-                )
-                > 0
-            )
-            rep = jax.ops.segment_min(jnp.arange(n), gids, num_segments=capacity)
-            return gids, rep, occupied, capacity
-        gids, rep, num_groups = gb.group_ids(keys, sel)
-        return gids, rep, jnp.arange(n) < num_groups, n
+            layout = seg.direct_layout(gids, capacity, sel)
+            return layout, seg.occupancy(layout, sel)
+        order, gid_sorted, num_groups = gb.group_plan(keys, sel)
+        layout = seg.sorted_layout(order, gid_sorted, num_groups)
+        return layout, jnp.arange(n) < num_groups
 
     @staticmethod
     def _direct_strides(group_channels: List[int], page: Page):
@@ -327,7 +322,7 @@ class Executor:
         capacity = 1
         for s in sizes:
             capacity *= s
-        if not 1 <= capacity <= (1 << 20):
+        if not 1 <= capacity <= seg.DIRECT_CAPACITY_MAX:
             return None
         strides = []
         acc = 1
@@ -353,17 +348,17 @@ class Executor:
             n = 1
             sel = page.sel
         keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
-        gids, rep, out_sel, cap = self.group_structure(node.group_channels, page)
+        layout, out_sel = self.group_structure(node.group_channels, page)
         out_cols: List[Column] = []
         if node.group_channels:
-            key_cols = gb.gather_group_keys(keys, jnp.clip(rep, 0, n - 1))
+            key_cols = gb.gather_group_keys(keys, layout.rep)
             for i, c in enumerate(node.group_channels):
                 src = page.columns[c]
                 v, valid = key_cols[i]
                 nulls = None if valid is None else ~valid
                 out_cols.append(Column(src.type, v, nulls, src.dictionary))
         for call in node.aggregates:
-            vals, valid = self._exec_aggregate(call, page, sel, gids, cap)
+            vals, valid = self._exec_aggregate(call, page, sel, layout)
             out_cols.append(
                 Column(
                     call.output_type,
@@ -374,32 +369,32 @@ class Executor:
             )
         return Page(out_cols, out_sel, page.replicated)
 
-    def _exec_aggregate(self, call: P.AggregateCall, page, sel, gids, cap):
+    def _exec_aggregate(self, call: P.AggregateCall, page, sel, layout):
         if call.distinct:
             if call.function != "count":
                 raise NotImplementedError(f"{call.function}(DISTINCT): round 2")
             arg = _col_to_lowered(page.columns[call.arg_channel])
-            return agg_ops.agg_count_distinct(arg, sel, gids, cap)
+            return agg_ops.agg_count_distinct(layout, arg, sel)
         if call.function == "count" and call.arg_channel is None:
-            return agg_ops.agg_count_star(sel, gids, cap, page.num_rows)
+            return agg_ops.agg_count_star(layout, sel)
         arg = _col_to_lowered(page.columns[call.arg_channel])
         if call.function == "count":
-            return agg_ops.agg_count(arg, sel, gids, cap)
+            return agg_ops.agg_count(layout, arg, sel)
         if call.function == "sum":
-            return agg_ops.agg_sum(arg, sel, gids, cap, call.output_type.np_dtype)
+            return agg_ops.agg_sum(layout, arg, sel, call.output_type.np_dtype)
         if call.function == "avg":
             base = (
                 call.output_type.np_dtype
                 if call.output_type.is_decimal
                 else np.dtype(np.float64)
             )
-            s, _ = agg_ops.agg_sum(arg, sel, gids, cap, base)
-            cnt, _ = agg_ops.agg_count(arg, sel, gids, cap)
+            s, _ = agg_ops.agg_sum(layout, arg, sel, base)
+            cnt, _ = agg_ops.agg_count(layout, arg, sel)
             return agg_ops.finish_avg(s, cnt, call.output_type)
         if call.function == "min":
-            return agg_ops.agg_min(arg, sel, gids, cap)
+            return agg_ops.agg_min(layout, arg, sel)
         if call.function == "max":
-            return agg_ops.agg_max(arg, sel, gids, cap)
+            return agg_ops.agg_max(layout, arg, sel)
         raise NotImplementedError(call.function)
 
     # -------------------------------------------------------------- joins
@@ -492,8 +487,9 @@ class Executor:
         # left join with filter: expanded rows that pass, plus one null-build
         # row for each probe row with no passing match
         passing = live & matched & passed
+        # p is probe-major (non-decreasing) — monotonic segment sum, no scatter
         any_pass = (
-            jax.ops.segment_sum(passing.astype(jnp.int32), p, num_segments=n) > 0
+            seg.monotonic_segment_sum(passing.astype(jnp.int32), p, n) > 0
         )
         tail_sel = probe_live & ~any_pass
         tail_cols = []
@@ -543,8 +539,7 @@ class Executor:
         lv = self._lower(node.filter, exp_page)
         passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
         hit = (
-            jax.ops.segment_sum((live & passed).astype(jnp.int32), p, num_segments=n)
-            > 0
+            seg.monotonic_segment_sum((live & passed).astype(jnp.int32), p, n) > 0
         )
         keep = hit if node.join_type == "semi" else ~hit
         sel = keep if left.sel is None else left.sel & keep

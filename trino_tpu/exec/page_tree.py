@@ -23,6 +23,10 @@ class PageSpec:
     has_nulls: List[bool]
     has_sel: bool
 
+    def array_count(self) -> int:
+        """How many flat arrays a page with this spec occupies."""
+        return len(self.types) + sum(self.has_nulls) + (1 if self.has_sel else 0)
+
 
 def flatten_page(page: Page) -> Tuple[List[jnp.ndarray], PageSpec]:
     arrays: List[jnp.ndarray] = []

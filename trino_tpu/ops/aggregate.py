@@ -1,97 +1,96 @@
-"""Aggregate accumulation over group ids: segment reductions.
+"""Aggregate accumulation over a GroupLayout: streaming segment reductions.
 
 Reference: ``operator/aggregation/`` Accumulators (AccumulatorCompiler
-bytecode); here each aggregate is a masked ``jax.ops.segment_*`` over the
-dense group ids from ops/groupby.py. NULL inputs are excluded per SQL
-semantics; count(*) counts live rows; avg carries (sum, count) state
-(the same intermediate state Trino's partial aggregation ships).
+bytecode); here each aggregate is a masked reduction over the grouping
+layout from ops/segments.py (masked unrolled loops for direct layouts,
+cumsum-diff / segmented scans for sorted layouts — never an integer
+scatter). NULL inputs are excluded per SQL semantics; count(*) counts live
+rows; avg carries (sum, count) state (the same intermediate state Trino's
+partial aggregation ships).
 """
 from __future__ import annotations
 
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 
 from trino_tpu import types as T
+from trino_tpu.ops import segments as seg
 
 Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+GroupLayout = seg.GroupLayout
 
 
-def _live(sel: Optional[jnp.ndarray], valid: Optional[jnp.ndarray], n: int) -> jnp.ndarray:
-    m = jnp.ones((n,), dtype=bool)
-    if sel is not None:
-        m = m & sel
-    if valid is not None:
-        m = m & valid
-    return m
+def _live(sel: Optional[jnp.ndarray], valid: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
+    if sel is None:
+        return valid
+    if valid is None:
+        return sel
+    return sel & valid
 
 
-def agg_count_star(sel: Optional[jnp.ndarray], gids, num_segments: int, n: int):
-    w = jnp.ones((n,), dtype=jnp.int64) if sel is None else sel.astype(jnp.int64)
-    return jax.ops.segment_sum(w, gids, num_segments=num_segments), None
+def agg_count_star(layout: GroupLayout, sel: Optional[jnp.ndarray]):
+    return seg.seg_count(layout, sel), None
 
 
-def agg_count(arg: Lowered, sel, gids, num_segments: int):
+def agg_count(layout: GroupLayout, arg: Lowered, sel):
     vals, valid = arg
-    m = _live(sel, valid, vals.shape[0])
-    return jax.ops.segment_sum(m.astype(jnp.int64), gids, num_segments=num_segments), None
+    return seg.seg_count(layout, _live(sel, valid)), None
 
 
-def agg_sum(arg: Lowered, sel, gids, num_segments: int, out_dtype):
+def agg_sum(layout: GroupLayout, arg: Lowered, sel, out_dtype):
     vals, valid = arg
-    m = _live(sel, valid, vals.shape[0])
-    v = jnp.where(m, vals, 0).astype(out_dtype)
-    total = jax.ops.segment_sum(v, gids, num_segments=num_segments)
-    cnt = jax.ops.segment_sum(m.astype(jnp.int64), gids, num_segments=num_segments)
+    m = _live(sel, valid)
+    total = seg.seg_sum(layout, vals, m, out_dtype)
+    cnt = seg.seg_count(layout, m)
     # SQL: sum of empty/all-null group is NULL
     return total, cnt > 0
 
 
-def agg_count_distinct(arg: Lowered, sel, gids, num_segments: int):
-    """count(DISTINCT x) per group: re-group on (gid, x) pairs (same
-    sort/segment machinery as ops/groupby.py), then count one per live pair
-    group into its outer group. Reference: MarkDistinct + count, or the
-    distinct-accumulator path of AccumulatorCompiler."""
+def agg_count_distinct(layout: GroupLayout, arg: Lowered, sel):
+    """count(DISTINCT x) per group: re-group on (gid, x) pairs, then count
+    distinct pairs back into the outer group. Reference: MarkDistinct +
+    count, or the distinct-accumulator path of AccumulatorCompiler.
+
+    The inner grouping sorts by (outer gid, x), so the outer gid of each
+    distinct pair is non-decreasing across inner slots — the per-outer-group
+    counts are a monotonic segment sum (no scatter)."""
     from trino_tpu.ops import groupby as gb
 
     vals, valid = arg
     n = vals.shape[0]
-    live = _live(sel, valid, n)
-    _, rep2, num2 = gb.group_ids([(gids.astype(jnp.int64), None), (vals, None)], live)
-    mask = jnp.arange(n) < num2
-    outer = gids[jnp.clip(rep2, 0, n - 1)]
-    cnt = jax.ops.segment_sum(
-        mask.astype(jnp.int64),
-        jnp.where(mask, outer, 0),
-        num_segments=num_segments,
+    live = _live(sel, valid)
+    outer_gids = layout.gids_orig()
+    order, gid_sorted, num_inner = gb.group_plan(
+        [(outer_gids, None), (vals, None)], live
+    )
+    inner = seg.sorted_layout(order, gid_sorted, num_inner)
+    inner_live = jnp.arange(n) < num_inner
+    # outer gid per inner slot; dead slots pushed past every real group
+    outer_of_slot = jnp.where(
+        inner_live,
+        outer_gids[jnp.clip(inner.rep, 0, n - 1)].astype(jnp.int32),
+        jnp.int32(layout.capacity),
+    )
+    cnt = seg.monotonic_segment_sum(
+        inner_live.astype(jnp.int64), outer_of_slot, layout.capacity
     )
     return cnt, None
 
 
-def agg_min(arg: Lowered, sel, gids, num_segments: int):
-    return _agg_minmax(arg, sel, gids, num_segments, is_min=True)
+def agg_min(layout: GroupLayout, arg: Lowered, sel):
+    return _agg_minmax(layout, arg, sel, is_min=True)
 
 
-def agg_max(arg: Lowered, sel, gids, num_segments: int):
-    return _agg_minmax(arg, sel, gids, num_segments, is_min=False)
+def agg_max(layout: GroupLayout, arg: Lowered, sel):
+    return _agg_minmax(layout, arg, sel, is_min=False)
 
 
-def _agg_minmax(arg: Lowered, sel, gids, num_segments: int, is_min: bool):
+def _agg_minmax(layout: GroupLayout, arg: Lowered, sel, is_min: bool):
     vals, valid = arg
-    m = _live(sel, valid, vals.shape[0])
-    if jnp.issubdtype(vals.dtype, jnp.floating):
-        sentinel = jnp.inf if is_min else -jnp.inf
-    elif vals.dtype == jnp.bool_:
-        vals = vals.astype(jnp.int32)
-        sentinel = 1 if is_min else 0
-    else:
-        info = jnp.iinfo(vals.dtype)
-        sentinel = info.max if is_min else info.min
-    v = jnp.where(m, vals, sentinel)
-    fn = jax.ops.segment_min if is_min else jax.ops.segment_max
-    out = fn(v, gids, num_segments=num_segments)
-    cnt = jax.ops.segment_sum(m.astype(jnp.int64), gids, num_segments=num_segments)
+    m = _live(sel, valid)
+    out = seg.seg_minmax(layout, vals, m, is_min)
+    cnt = seg.seg_count(layout, m)
     return out, cnt > 0
 
 

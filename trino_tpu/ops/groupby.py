@@ -1,15 +1,16 @@
-"""Group-by kernel: sort/segment based, static shapes.
+"""Group-by kernel: sort/segment based, static shapes, scatter-free.
 
 Reference algorithm being replaced: ``operator/FlatHash.java:42`` (SWAR
 control-byte open addressing) + ``FlatHashStrategyCompiler``. On TPU, a
-sort + segment-reduce formulation maps better onto the VPU than scatter-heavy
+sort + segment formulation maps better onto the VPU than scatter-heavy
 hashing (SURVEY.md §7.1): stable multi-key argsort, boundary detection,
-dense group ids via cumsum, then ``jax.ops.segment_*`` reductions. Exact
-(comparison-based, no hash collisions), null-safe (NULL is its own group),
-and selection-mask aware (dead rows sort last, into discarded groups).
+dense group ids via cumsum. Exact (comparison-based, no hash collisions),
+null-safe (NULL is its own group), and selection-mask aware (dead rows sort
+last, into trailing groups past ``num_groups``).
 
-All shapes are static; the true group count comes back as a scalar the host
-reads once per aggregation to slice the padded outputs.
+All downstream consumption happens in *sorted space* through
+ops/segments.GroupLayout — integer scatters never appear (measured ~50x
+slower than streaming ops on v5e; see ops/segments.py).
 """
 from __future__ import annotations
 
@@ -24,49 +25,44 @@ def _sort_order(sort_keys: List[jnp.ndarray]) -> jnp.ndarray:
     """Stable lexicographic argsort over multiple key arrays (most significant
     first): chain stable argsorts from least to most significant."""
     n = sort_keys[0].shape[0]
-    order = jnp.arange(n)
+    order = jnp.arange(n, dtype=jnp.int32)
     for k in reversed(sort_keys):
         order = order[jnp.argsort(k[order], stable=True)]
     return order
 
 
-def group_ids(
+def group_plan(
     keys: List[Lowered], sel: Optional[jnp.ndarray]
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Assign dense group ids per row.
+    """Permute rows group-contiguous and assign dense group ids.
 
-    Returns (gids[n] int32, rep[n] int64 — representative row per group id
-    (padded with n beyond the live groups), num_groups scalar).
-    Dead rows (sel false) get group ids >= num_groups.
+    Returns (order[n] int32, gid_sorted[n] int32 non-decreasing,
+    num_groups scalar). Dead rows (sel false) sort last and receive group
+    ids >= num_groups; NULL keys group together (their own group).
     """
     n = keys[0][0].shape[0]
-    dead = (
-        jnp.zeros((n,), dtype=bool) if sel is None else ~sel
-    )
+    dead = jnp.zeros((n,), dtype=bool) if sel is None else ~sel
     sort_keys: List[jnp.ndarray] = [dead]
     for vals, valid in keys:
         if valid is not None:
-            sort_keys.append(~valid)  # NULLs group together (their own group)
-            sort_keys.append(jnp.where(valid, vals, 0))
+            sort_keys.append(~valid)
+            sort_keys.append(jnp.where(valid, vals, jnp.zeros((), vals.dtype)))
         else:
             sort_keys.append(vals)
     order = _sort_order(sort_keys)
     gathered = [k[order] for k in sort_keys]
-    boundary = jnp.zeros((n,), dtype=bool).at[0].set(True)
+    boundary = jnp.zeros((n,), dtype=bool)
     for g in gathered:
         boundary = boundary | jnp.concatenate([jnp.ones((1,), bool), g[1:] != g[:-1]])
-    gid_sorted = jnp.cumsum(boundary) - 1
+    gid_sorted = (jnp.cumsum(boundary.astype(jnp.int32)) - 1).astype(jnp.int32)
     dead_sorted = gathered[0]
     num_groups = jnp.sum(boundary & ~dead_sorted)
-    gids = jnp.zeros((n,), dtype=jnp.int64).at[order].set(gid_sorted)
-    rep = jnp.full((n,), n, dtype=jnp.int64).at[gid_sorted].min(order)
-    return gids.astype(jnp.int32), rep, num_groups
+    return order, gid_sorted, num_groups
 
 
-def gather_group_keys(
-    keys: List[Lowered], rep: jnp.ndarray
-) -> List[Lowered]:
-    """Group-key output columns: gather each key at the representative row."""
+def gather_group_keys(keys: List[Lowered], rep: jnp.ndarray) -> List[Lowered]:
+    """Group-key output columns: gather each key at the representative row
+    (rep indexes original row order; empty slots carry rep == n, clipped)."""
     n = keys[0][0].shape[0]
     safe = jnp.clip(rep, 0, n - 1)
     out = []

@@ -1,0 +1,216 @@
+"""Scatter-free segment reductions: the TPU group-by/aggregate substrate.
+
+Reference role: ``operator/FlatHash.java`` + ``AccumulatorCompiler`` — the
+grouped-accumulation inner loop. On TPU, scatter (``jax.ops.segment_*``)
+compiles to serialized HBM read-modify-write and is ~50x slower than the
+streaming alternatives (measured on v5e: 6M-row int64 segment_sum = 513 ms vs
+9.5 ms for masked reductions). So grouped aggregation here never scatters
+integers; it uses one of two layouts:
+
+- **direct** (the BigintGroupByHash analog): group keys are small perfect
+  indices (dictionary codes / booleans); per-group values come from an
+  unrolled masked-reduction loop over the (small, static) capacity — each
+  reduction is a streaming VPU pass, XLA fuses the whole unrolled set into
+  few passes.
+- **sorted** (the FlatHash analog): rows are permuted group-contiguous
+  (stable multi-key argsort, dead rows last); per-group sums are
+  cumsum-then-boundary-difference (exact in int64), min/max are a segmented
+  associative scan — all streaming ops, no scatter.
+
+Float sums still use ``jax.ops.segment_sum`` (f32 scatter is fast on TPU and
+per-slot accumulation order is deterministic).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+
+# Above this capacity the unrolled masked loop stops making sense and the
+# sort-based layout wins (threshold: capacity reads of the column).
+DIRECT_CAPACITY_MAX = 128
+
+
+@dataclasses.dataclass
+class GroupLayout:
+    """Grouping structure shared by every aggregate of one aggregation node.
+
+    Exactly one of (``gids``,) / (``order``, ``gid_sorted``) is populated:
+    direct layouts keep per-row perfect-index group ids in original row
+    order; sorted layouts keep the permutation to group-contiguous order
+    plus per-slot [start, end) ranges in that sorted space.
+    """
+
+    n: int  # input rows
+    capacity: int  # static output slots
+    # direct layout
+    gids: Optional[jnp.ndarray] = None  # int32[n] perfect index
+    # sorted layout
+    order: Optional[jnp.ndarray] = None  # int32[n] permutation
+    gid_sorted: Optional[jnp.ndarray] = None  # int32[n] non-decreasing
+    starts: Optional[jnp.ndarray] = None  # int32[capacity]
+    ends: Optional[jnp.ndarray] = None  # int32[capacity]
+    num_groups: Optional[jnp.ndarray] = None  # scalar (sorted only)
+    rep: Optional[jnp.ndarray] = None  # int[capacity] representative row (orig order)
+
+    @property
+    def is_direct(self) -> bool:
+        return self.gids is not None
+
+    def gids_orig(self) -> jnp.ndarray:
+        """Per-row group ids in original row order (rarely needed: only
+        nested regroupings like count(DISTINCT) ask for it)."""
+        if self.gids is not None:
+            return self.gids
+        inverse = jnp.argsort(self.order)  # inverse permutation
+        return self.gid_sorted[inverse]
+
+
+def direct_layout(gids: jnp.ndarray, capacity: int, live: Optional[jnp.ndarray]) -> GroupLayout:
+    """Layout for perfect-index group ids (capacity <= DIRECT_CAPACITY_MAX)."""
+    n = gids.shape[0]
+    assert capacity <= DIRECT_CAPACITY_MAX
+    idx = jnp.arange(n, dtype=jnp.int32)
+    dead_idx = jnp.int32(n)
+    reps = []
+    for g in range(capacity):
+        m = gids == g
+        if live is not None:
+            m = m & live
+        reps.append(jnp.min(jnp.where(m, idx, dead_idx)))
+    rep = jnp.stack(reps)
+    return GroupLayout(n=n, capacity=capacity, gids=gids, rep=rep)
+
+
+def sorted_layout(
+    order: jnp.ndarray, gid_sorted: jnp.ndarray, num_groups: jnp.ndarray
+) -> GroupLayout:
+    """Layout from a group-contiguous permutation (ops/groupby.py)."""
+    n = order.shape[0]
+    slots = jnp.arange(n, dtype=gid_sorted.dtype)
+    starts = jnp.searchsorted(gid_sorted, slots, side="left").astype(jnp.int32)
+    ends = jnp.searchsorted(gid_sorted, slots, side="right").astype(jnp.int32)
+    rep = order[jnp.clip(starts, 0, n - 1)]
+    return GroupLayout(
+        n=n,
+        capacity=n,
+        order=order,
+        gid_sorted=gid_sorted,
+        starts=starts,
+        ends=ends,
+        num_groups=num_groups,
+        rep=rep,
+    )
+
+
+def occupancy(layout: GroupLayout, live: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """bool[capacity]: slots holding at least one live row (the live mask is
+    already baked into ``rep`` by direct_layout)."""
+    if layout.is_direct:
+        return layout.rep < layout.n
+    return jnp.arange(layout.capacity) < layout.num_groups
+
+
+def _cumsum_diff_ranges(
+    starts: jnp.ndarray, ends: jnp.ndarray, x_sorted: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-range sums of a segment-contiguous array via cumsum + boundary
+    difference (exact for ints: wraparound cancels mod 2^64)."""
+    c = jnp.cumsum(x_sorted)
+    c0 = jnp.concatenate([jnp.zeros((1,), c.dtype), c])
+    return c0[ends] - c0[starts]
+
+
+def _cumsum_diff(layout: GroupLayout, x_sorted: jnp.ndarray) -> jnp.ndarray:
+    return _cumsum_diff_ranges(layout.starts, layout.ends, x_sorted)
+
+
+def seg_sum(
+    layout: GroupLayout, vals: jnp.ndarray, m: Optional[jnp.ndarray], out_dtype
+) -> jnp.ndarray:
+    """Per-slot sum of ``vals`` over rows where mask ``m`` holds."""
+    x = vals.astype(out_dtype)
+    if m is not None:
+        x = jnp.where(m, x, jnp.zeros((), out_dtype))
+    if layout.is_direct:
+        return jnp.stack([jnp.sum(jnp.where(layout.gids == g, x, 0)) for g in range(layout.capacity)])
+    if jnp.issubdtype(jnp.dtype(out_dtype), jnp.floating):
+        # f32/f64 scatter-add is fast on TPU and avoids cumsum error growth
+        return jax.ops.segment_sum(
+            x[layout.order], layout.gid_sorted, num_segments=layout.capacity
+        )
+    return _cumsum_diff(layout, x[layout.order])
+
+
+def seg_count(layout: GroupLayout, m: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Per-slot count of rows where mask ``m`` holds (int64)."""
+    ones = (
+        jnp.ones((layout.n,), jnp.int64)
+        if m is None
+        else m.astype(jnp.int64)
+    )
+    if layout.is_direct:
+        return jnp.stack(
+            [jnp.sum(jnp.where(layout.gids == g, ones, 0)) for g in range(layout.capacity)]
+        )
+    if m is None:
+        return (layout.ends - layout.starts).astype(jnp.int64)
+    return _cumsum_diff(layout, ones[layout.order])
+
+
+def _segmented_scan_minmax(v: jnp.ndarray, boundary: jnp.ndarray, is_min: bool):
+    op = jnp.minimum if is_min else jnp.maximum
+
+    def comb(l, r):
+        lv, lb = l
+        rv, rb = r
+        return jnp.where(rb, rv, op(lv, rv)), lb | rb
+
+    sv, _ = jax.lax.associative_scan(comb, (v, boundary))
+    return sv
+
+
+def seg_minmax(
+    layout: GroupLayout, vals: jnp.ndarray, m: Optional[jnp.ndarray], is_min: bool
+) -> jnp.ndarray:
+    """Per-slot min/max of vals over rows where ``m`` holds (sentinel-filled
+    for empty slots — pair with seg_count to derive validity)."""
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        sentinel = jnp.inf if is_min else -jnp.inf
+    elif vals.dtype == jnp.bool_:
+        vals = vals.astype(jnp.int32)
+        sentinel = 1 if is_min else 0
+    else:
+        info = jnp.iinfo(vals.dtype)
+        sentinel = info.max if is_min else info.min
+    x = vals if m is None else jnp.where(m, vals, sentinel)
+    if layout.is_direct:
+        red = jnp.min if is_min else jnp.max
+        return jnp.stack(
+            [red(jnp.where(layout.gids == g, x, sentinel)) for g in range(layout.capacity)]
+        )
+    xs = x[layout.order]
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), layout.gid_sorted[1:] != layout.gid_sorted[:-1]]
+    )
+    scanned = _segmented_scan_minmax(xs, boundary, is_min)
+    n = layout.n
+    at_end = jnp.clip(layout.ends - 1, 0, n - 1)
+    out = scanned[at_end]
+    return jnp.where(layout.ends > layout.starts, out, sentinel)
+
+
+def monotonic_segment_sum(
+    x: jnp.ndarray, seg: jnp.ndarray, n_segments: int
+) -> jnp.ndarray:
+    """Segment sums when ``seg`` is already non-decreasing (e.g. the
+    probe-major output of a join expansion) — cumsum + boundary diff,
+    no scatter."""
+    slots = jnp.arange(n_segments, dtype=seg.dtype)
+    starts = jnp.searchsorted(seg, slots, side="left")
+    ends = jnp.searchsorted(seg, slots, side="right")
+    return _cumsum_diff_ranges(starts, ends, x)

@@ -144,16 +144,26 @@ class AggregationNode(PlanNode):
 
 def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
     """Accumulator (partial-state) types for an aggregate (reference:
-    AccumulatorCompiler intermediate state)."""
+    AccumulatorCompiler intermediate state). Length must equal
+    ``_acc_state_count(agg)`` — the executor's final step uses that to
+    slice gathered state columns."""
     if agg.function in ("count", "count_star"):
-        return [T.BIGINT]
-    if agg.function == "avg":
+        out = [T.BIGINT]
+    elif agg.function == "avg":
         # running (sum, count)
         base = src_types[agg.arg_channel]
-        return [T.DOUBLE if base.is_floating else base, T.BIGINT]
-    if agg.function in ("min", "max", "sum"):
-        return [agg.output_type if agg.function == "sum" else src_types[agg.arg_channel]]
-    raise NotImplementedError(agg.function)
+        out = [T.DOUBLE if base.is_floating else base, T.BIGINT]
+    elif agg.function in ("min", "max", "sum"):
+        out = [agg.output_type if agg.function == "sum" else src_types[agg.arg_channel]]
+    else:
+        raise NotImplementedError(agg.function)
+    assert len(out) == _acc_state_count(agg)
+    return out
+
+
+def _acc_state_count(agg: AggregateCall) -> int:
+    """Number of accumulator state columns an aggregate ships partial->final."""
+    return 2 if agg.function == "avg" else 1
 
 
 @dataclasses.dataclass
