@@ -1,0 +1,156 @@
+"""Join-kernel correctness at the edges the round-1 implementation got wrong:
+composite keys of any width (lexicographic search, no bit packing) and key
+values beyond 2^32 (TPC-H orderkey exceeds 2^32 at sf~300).
+
+Reference behavior matched: arbitrary-width key hashing
+(InterpretedHashGenerator.java:85), JoinHash chains (JoinHash.java:28-69).
+"""
+import pytest
+
+from trino_tpu import Session
+from trino_tpu import types as T
+
+
+@pytest.fixture()
+def session():
+    s = Session()
+    mem = s.catalogs["memory"]
+    # Keys straddling 2^32: packed 32/32 keys would silently corrupt these.
+    big = 1 << 33
+    mem.create_table(
+        "t",
+        "fact",
+        [("k1", T.BIGINT), ("k2", T.BIGINT), ("k3", T.BIGINT), ("v", T.BIGINT)],
+        [
+            (big + 1, 1, 10, 100),
+            (big + 1, 1, 10, 101),  # duplicate composite key (M side)
+            (big + 1, 2, 10, 102),
+            (big + 2, 1, 10, 103),
+            (None, 1, 10, 104),  # NULL key never matches
+            (7, 7, 7, 105),
+        ],
+    )
+    mem.create_table(
+        "t",
+        "dim",
+        [("k1", T.BIGINT), ("k2", T.BIGINT), ("k3", T.BIGINT), ("name", T.BIGINT)],
+        [
+            (big + 1, 1, 10, 1),
+            (big + 2, 1, 10, 2),
+            (big + 2, 2, 99, 3),
+            (None, 1, 10, 4),
+            (7, 7, 7, 5),
+        ],
+    )
+    return s
+
+
+def q(session, sql):
+    return session.execute(sql).rows
+
+
+def test_three_column_equi_join(session):
+    rows = q(
+        session,
+        """select f.v, d.name from memory.t.fact f, memory.t.dim d
+           where f.k1 = d.k1 and f.k2 = d.k2 and f.k3 = d.k3 order by f.v""",
+    )
+    assert rows == [(100, 1), (101, 1), (103, 2), (105, 5)]
+
+
+def test_two_column_join_keys_beyond_32_bits(session):
+    # Under 32/32 packing, (2^33+1, 1) and (2^33+2, 1) would collide or
+    # corrupt; lexicographic search keeps them distinct.
+    rows = q(
+        session,
+        """select f.v, d.name from memory.t.fact f, memory.t.dim d
+           where f.k1 = d.k1 and f.k2 = d.k2 order by f.v, d.name""",
+    )
+    assert rows == [(100, 1), (101, 1), (103, 2), (105, 5)]
+
+
+def test_single_key_beyond_32_bits(session):
+    rows = q(
+        session,
+        """select f.v, d.name from memory.t.fact f, memory.t.dim d
+           where f.k1 = d.k1 order by f.v, d.name""",
+    )
+    assert rows == [
+        (100, 1),
+        (101, 1),
+        (102, 1),
+        (103, 2),
+        (103, 3),
+        (105, 5),
+    ]
+
+
+def test_semi_join_multi_key(session):
+    rows = q(
+        session,
+        """select v from memory.t.fact f where exists (
+             select 1 from memory.t.dim d
+             where d.k1 = f.k1 and d.k2 = f.k2 and d.k3 = f.k3)
+           order by v""",
+    )
+    assert rows == [(100,), (101,), (103,), (105,)]
+
+
+def test_left_join_multi_key_null_fill(session):
+    rows = q(
+        session,
+        """select f.v, d.name from memory.t.fact f
+           left join memory.t.dim d
+             on f.k1 = d.k1 and f.k2 = d.k2 and f.k3 = d.k3
+           order by f.v""",
+    )
+    assert rows == [
+        (100, 1),
+        (101, 1),
+        (102, None),
+        (103, 2),
+        (104, None),
+        (105, 5),
+    ]
+
+
+def test_bucketed_recompile_on_capacity_overflow():
+    """An M:N join whose true output exceeds the stats-estimated bucket must
+    complete via the doubling recompile loop, never an eager pre-run
+    (VERDICT round-1 item 3)."""
+    from trino_tpu.exec.compiled import CompiledQuery
+    from trino_tpu.exec.query import plan_sql
+
+    s = Session()
+    mem = s.catalogs["memory"]
+    # 64 x 64 rows on one hot key: output 4096 > initial MIN_CAPACITY bucket
+    mem.create_table("t", "a", [("k", T.BIGINT), ("v", T.BIGINT)],
+                     [(1, i) for i in range(64)])
+    mem.create_table("t", "b", [("k", T.BIGINT), ("w", T.BIGINT)],
+                     [(1, i) for i in range(64)])
+    root = plan_sql(s, "select count(*) from memory.t.a a, memory.t.b b where a.k = b.k")
+    cq = CompiledQuery.build(s, root)
+    initial = dict(cq.capacity_hints)
+    assert all(cap <= 2048 for cap in initial.values()), initial
+    page = cq.run()
+    assert page.to_pylist() == [(4096,)]
+    assert cq.capacity_hints != initial  # buckets grew via recompile
+
+
+def test_empty_table_joins():
+    """Zero-row inputs must not crash static-shape gathers (scan pads to one
+    dead row)."""
+    s = Session()
+    mem = s.catalogs["memory"]
+    mem.create_table("t", "e", [("k", T.BIGINT), ("v", T.BIGINT)], [])
+    mem.create_table("t", "f", [("k", T.BIGINT), ("v", T.BIGINT)], [(1, 10), (1, 11)])
+    assert s.execute(
+        "select f.v, e.v from memory.t.f f, memory.t.e e where f.k = e.k"
+    ).rows == []
+    assert s.execute(
+        "select e.v from memory.t.e e, memory.t.f f where e.k = f.k"
+    ).rows == []
+    assert s.execute(
+        "select f.v from memory.t.f f left join memory.t.e e on f.k = e.k order by 1"
+    ).rows == [(10,), (11,)]
+    assert s.execute("select count(*) from memory.t.e").rows == [(0,)]

@@ -44,20 +44,24 @@ class CompiledQuery:
     fn: object  # jitted
     out_spec_cell: List
     error_codes_cell: List
+    capacity_hints: Dict[int, int] = dataclasses.field(default_factory=dict)
+
+    MAX_RECOMPILES = 16  # doubling buckets: 2^16x headroom over the estimate
 
     @classmethod
-    def build(cls, session, root: P.OutputNode) -> "CompiledQuery":
+    def build(
+        cls, session, root: P.OutputNode, capacity_hints: Dict[int, int] = None
+    ) -> "CompiledQuery":
+        """Compile without executing: expansion-join capacities come from
+        connector stats (sql/planner/stats.py), not an eager pre-run. If a
+        run overflows a bucket, ``run()`` doubles it and recompiles."""
+        from trino_tpu.sql.planner import stats
+
         base = Executor(session)
         scans = [n for n in P.walk_plan(root) if isinstance(n, P.TableScanNode)]
         staged_pages = {n.id: base._exec_TableScanNode(n) for n in scans}
-        # shape-hint collection: one eager pass discovers the M:N join output
-        # capacities that the traced program needs as static constants
-        # (SURVEY.md §7.3 "two-pass kernels + bucketed recompiles")
-        capacity_hints: Dict[int, int] = {}
-        if P.needs_capacity_hints(root):
-            hint_ex = PreloadedExecutor(session, staged_pages)
-            hint_ex.execute(root)
-            capacity_hints = dict(hint_ex.capacity_hints)
+        if capacity_hints is None:
+            capacity_hints = stats.estimate_capacity_hints(session, root)
         flat_inputs: List = []
         specs: Dict[int, PageSpec] = {}
         layout: List[Tuple[int, int]] = []  # (node_id, num_arrays)
@@ -66,8 +70,15 @@ class CompiledQuery:
             specs[nid] = spec
             layout.append((nid, len(arrays)))
             flat_inputs.extend(arrays)
-        out_spec_cell: List = [None]
-        error_codes_cell: List = [None]
+        cq = cls(session, root, flat_inputs, specs, None, [None], [None], dict(capacity_hints))
+        cq._layout = layout
+        cq._jit()
+        return cq
+
+    def _jit(self):
+        session, root, specs = self.session, self.root, self.input_specs
+        layout, hints = self._layout, self.capacity_hints
+        out_spec_cell, error_codes_cell = self.out_spec_cell, self.error_codes_cell
 
         def run(flat):
             pages: Dict[int, Page] = {}
@@ -75,21 +86,33 @@ class CompiledQuery:
             for nid, count in layout:
                 pages[nid] = unflatten_page(specs[nid], flat[i : i + count])
                 i += count
-            ex = PreloadedExecutor(session, pages, dict(capacity_hints))
+            ex = PreloadedExecutor(session, pages, dict(hints))
             out_page = ex.execute(root)
             out_arrays, out_spec = flatten_page(out_page)
             out_spec_cell[0] = out_spec
             error_codes_cell[0] = [c for c, _ in ex.errors]
             return out_arrays, [f for _, f in ex.errors]
 
-        fn = jax.jit(run)
-        cq = cls(session, root, flat_inputs, specs, fn, out_spec_cell, error_codes_cell)
-        cq.raw_fn = run  # unjitted closure (for AOT/compile-check harnesses)
-        return cq
+        self.raw_fn = run  # unjitted closure (for AOT/compile-check harnesses)
+        self.fn = jax.jit(run)
 
     def run(self) -> Page:
-        from trino_tpu.exec.executor import raise_query_errors
+        """Execute; on a capacity overflow, double the offending join's
+        bucket and recompile (reference analog: the spill/partition FSM of
+        HashBuilderOperator — growth instead of spill)."""
+        from trino_tpu.exec.executor import QueryError, raise_query_errors
+        from trino_tpu.sql.planner import stats
 
-        out_arrays, error_flags = self.fn(self.input_arrays)
-        raise_query_errors(self.error_codes_cell[0], error_flags)
-        return unflatten_page(self.out_spec_cell[0], out_arrays)
+        for _ in range(self.MAX_RECOMPILES):
+            out_arrays, error_flags = self.fn(self.input_arrays)
+            codes = self.error_codes_cell[0]
+            # capacity overflows first: any other flag fired on the same run
+            # may be an artifact of the truncated join output
+            grown = stats.grow_overflowed_hints(self.capacity_hints, codes, error_flags)
+            if grown is not None:
+                self.capacity_hints = grown
+                self._jit()
+                continue
+            raise_query_errors(codes, error_flags)
+            return unflatten_page(self.out_spec_cell[0], out_arrays)
+        raise QueryError("join output capacity still exceeded after recompiles")

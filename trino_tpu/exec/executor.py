@@ -36,7 +36,9 @@ from trino_tpu.sql.planner import plan as P
 
 
 class QueryError(RuntimeError):
-    pass
+    def __init__(self, message: str, code: str = ""):
+        super().__init__(message)
+        self.code = code
 
 
 def raise_query_errors(codes, flags):
@@ -46,7 +48,7 @@ def raise_query_errors(codes, flags):
 
     for code, flag in zip(codes, flags):
         if bool(_np.asarray(flag).any()):
-            raise QueryError(code.replace("_", " ").capitalize())
+            raise QueryError(code.replace("_", " ").capitalize(), code=code)
 
 
 def _col_from_lowered(t: T.Type, lv: L.LoweredVal) -> Column:
@@ -120,6 +122,19 @@ class Executor:
                     dictionary,
                 )
             )
+        if cols and cols[0].values.shape[0] == 0:
+            # empty table: pad to one all-dead row — zero-length arrays break
+            # downstream gathers (joins index counts[p], build.rows, etc.)
+            pad_cols = [
+                Column(
+                    c.type,
+                    jnp.zeros((1,) + c.values.shape[1:], c.values.dtype),
+                    None,
+                    c.dictionary,
+                )
+                for c in cols
+            ]
+            return Page(pad_cols, jnp.zeros((1,), bool))
         return Page(cols)
 
     def _exec_ValuesNode(self, node: P.ValuesNode) -> Page:
@@ -432,24 +447,20 @@ class Executor:
 
     def _expansion_keys(self, node: P.JoinNode, left: Page, right: Page):
         if node.left_keys:
-            build_key = join_ops.pack_keys(
-                [_col_to_lowered(right.columns[c]) for c in node.right_keys]
-            )
-            probe_key = join_ops.pack_keys(
-                [_col_to_lowered(left.columns[c]) for c in node.left_keys]
-            )
+            build_keys = [_col_to_lowered(right.columns[c]) for c in node.right_keys]
+            probe_keys = [_col_to_lowered(left.columns[c]) for c in node.left_keys]
         else:  # cross join: everything matches everything (constant key)
-            build_key = (jnp.zeros((right.num_rows,), jnp.int64), None)
-            probe_key = (jnp.zeros((left.num_rows,), jnp.int64), None)
-        return build_key, probe_key
+            build_keys = [(jnp.zeros((right.num_rows,), jnp.int64), None)]
+            probe_keys = [(jnp.zeros((left.num_rows,), jnp.int64), None)]
+        return build_keys, probe_keys
 
     def expand_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         """General M:N inner/left join: count matches per probe row, then
         gather into a static-capacity probe-major output (ops/join.py
         probe_counts + expand; reference JoinHash position-links chains)."""
-        build_key, probe_key = self._expansion_keys(node, left, right)
-        bk_sorted, b_rows, b_live = join_ops.build_side(build_key, right.sel)
-        lo, counts = join_ops.probe_counts(bk_sorted, b_live, probe_key, left.sel)
+        build_keys, probe_keys = self._expansion_keys(node, left, right)
+        build = join_ops.build_side(build_keys, right.sel)
+        lo, counts = join_ops.probe_counts(build, probe_keys, left.sel)
         n = left.num_rows
         outer = node.join_type == "left"
         probe_live = (
@@ -459,10 +470,10 @@ class Executor:
         emit = jnp.where(probe_live, jnp.maximum(counts, 1), 0) if plain_outer else counts
         capacity = self.hint_capacity(node.id, emit)
         p, k, live, total = join_ops.expand(emit, capacity)
-        self.errors.append(("JOIN_OUTPUT_CAPACITY_EXCEEDED", total > capacity))
+        self.errors.append((f"JOIN_OUTPUT_CAPACITY_EXCEEDED:{node.id}", total > capacity))
         matched = live & (k < counts[p])
-        b_idx = jnp.clip(lo[p] + k, 0, bk_sorted.shape[0] - 1)
-        rows = b_rows[b_idx]
+        b_idx = jnp.clip(lo[p] + k, 0, build.n - 1)
+        rows = build.rows[b_idx]
         out_cols = [
             Column(
                 c.type,
@@ -512,15 +523,15 @@ class Executor:
         """Semi/anti join with a residual filter (correlated EXISTS with
         non-equality predicates): expand the matches, evaluate the filter,
         then reduce any-passing back to the probe rows."""
-        build_key, probe_key = self._expansion_keys(node, left, right)
-        bk_sorted, b_rows, b_live = join_ops.build_side(build_key, right.sel)
-        lo, counts = join_ops.probe_counts(bk_sorted, b_live, probe_key, left.sel)
+        build_keys, probe_keys = self._expansion_keys(node, left, right)
+        build = join_ops.build_side(build_keys, right.sel)
+        lo, counts = join_ops.probe_counts(build, probe_keys, left.sel)
         n = left.num_rows
         capacity = self.hint_capacity(node.id, counts)
         p, k, live, total = join_ops.expand(counts, capacity)
-        self.errors.append(("JOIN_OUTPUT_CAPACITY_EXCEEDED", total > capacity))
-        b_idx = jnp.clip(lo[p] + k, 0, bk_sorted.shape[0] - 1)
-        rows = b_rows[b_idx]
+        self.errors.append((f"JOIN_OUTPUT_CAPACITY_EXCEEDED:{node.id}", total > capacity))
+        b_idx = jnp.clip(lo[p] + k, 0, build.n - 1)
+        rows = build.rows[b_idx]
         exp_cols = [
             Column(
                 c.type,
@@ -546,14 +557,10 @@ class Executor:
         return Page(left.columns, sel, left.replicated)
 
     def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
-        build_key = join_ops.pack_keys(
-            [_col_to_lowered(right.columns[c]) for c in node.right_keys]
-        )
-        probe_key = join_ops.pack_keys(
-            [_col_to_lowered(left.columns[c]) for c in node.left_keys]
-        )
-        bk_sorted, b_rows, b_live = join_ops.build_side(build_key, right.sel)
-        rows, matched = join_ops.probe_unique(bk_sorted, b_rows, b_live, probe_key)
+        build_keys = [_col_to_lowered(right.columns[c]) for c in node.right_keys]
+        probe_keys = [_col_to_lowered(left.columns[c]) for c in node.left_keys]
+        build = join_ops.build_side(build_keys, right.sel)
+        rows, matched = join_ops.probe_unique(build, probe_keys)
         out_cols = list(left.columns)
         for rc in right.columns:
             v, valid = join_ops.gather_column(_col_to_lowered(rc), rows, matched)
@@ -580,13 +587,9 @@ class Executor:
         return page
 
     def semi_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
-        build = join_ops.pack_keys(
-            [_col_to_lowered(right.columns[c]) for c in node.right_keys]
-        )
-        probe = join_ops.pack_keys(
-            [_col_to_lowered(left.columns[c]) for c in node.left_keys]
-        )
-        hit = join_ops.membership(build, right.sel, probe)
+        build_keys = [_col_to_lowered(right.columns[c]) for c in node.right_keys]
+        probe_keys = [_col_to_lowered(left.columns[c]) for c in node.left_keys]
+        hit = join_ops.membership(build_keys, right.sel, probe_keys)
         keep = hit if node.join_type == "semi" else ~hit
         sel = keep if left.sel is None else left.sel & keep
         return Page(left.columns, sel, left.replicated)

@@ -2,8 +2,7 @@
 
 Reference: ``operator/join/`` — PagesHash open addressing + PositionLinks
 chains (JoinHash.java:28-69). TPU formulation: the build side is sorted by
-key once; probes binary-search (``jnp.searchsorted``, log2(n) vectorized
-steps, no scatter):
+key once; probes binary-search (log2(n) vectorized steps, no scatter):
 
 - unique-key build (PK-FK joins, N:1): probe -> at most one match -> output
   size == probe size (static shapes, no two-pass emit). The planner proves
@@ -14,98 +13,179 @@ steps, no scatter):
   executor's shape-hint mechanism; exceeding it raises a deferred error and
   triggers a bucketed recompile).
 - semi/anti joins: membership only (duplicates on build side are fine).
-- composite keys pack into one int64 (32/32 bits) — planner guarantees range.
+
+Composite keys are handled by TRUE lexicographic search (``searchsorted_lex``:
+a fixed-depth vectorized binary search comparing all key columns per step) —
+arbitrary column count and full int64 range, no bit packing. The reference
+hashes arbitrary-width keys the same way (InterpretedHashGenerator.java:85).
+A single int key takes the ``jnp.searchsorted`` fast path with a sentinel
+for dead rows.
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
 
 Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
 
-_DEAD_KEY = jnp.int64(2**63 - 1)  # sorts last; equality re-checked via sel gather
+_DEAD_KEY = jnp.int64(2**63 - 1)  # sorts last; equality re-checked via live mask
 
 
-def pack_keys(keys: List[Lowered]) -> Lowered:
-    """Combine multiple int key columns into one int64 (32 bits each for 2
-    keys). Valid only when the planner has proven the ranges fit."""
-    if len(keys) == 1:
-        return keys[0]
-    if len(keys) == 2:
-        (a, av), (b, bv) = keys
-        vals = (a.astype(jnp.int64) << 32) | (b.astype(jnp.int64) & 0xFFFFFFFF)
-        valid = None
-        if av is not None or bv is not None:
-            valid = (av if av is not None else True) & (bv if bv is not None else True)
-        return vals, valid
-    raise NotImplementedError(">2 join key columns")
+@dataclasses.dataclass
+class SortedBuild:
+    """Build side sorted lexicographically by key, dead rows last.
+
+    ``cols`` are the search columns in sorted order, most significant first.
+    Single-key builds carry one sentinel-masked column (fast path); multi-key
+    builds carry a leading dead-flag column (0 live / 1 dead) so dead rows
+    can never equal a probe (whose flag is implicitly 0).
+    """
+
+    cols: List[jnp.ndarray]
+    rows: jnp.ndarray  # original row index per sorted slot
+    live: jnp.ndarray  # bool per sorted slot
+    single: bool  # True -> cols == [sentinel-masked key], no flag column
+
+    @property
+    def n(self) -> int:
+        return self.rows.shape[0]
 
 
-def build_side(key: Lowered, sel: Optional[jnp.ndarray]):
-    """Sort the build side by key; dead/null rows get a sentinel that sorts
-    last and can never match (their liveness is re-checked on gather)."""
-    vals, valid = key
-    n = vals.shape[0]
+def _live_mask(keys: List[Lowered], sel: Optional[jnp.ndarray]) -> jnp.ndarray:
+    n = keys[0][0].shape[0]
     live = jnp.ones((n,), dtype=bool)
     if sel is not None:
         live = live & sel
-    if valid is not None:
-        live = live & valid
-    k = jnp.where(live, vals.astype(jnp.int64), _DEAD_KEY)
-    order = jnp.argsort(k, stable=True)
-    return k[order], order, live[order]
+    for _, valid in keys:
+        if valid is not None:
+            live = live & valid
+    return live
+
+
+def build_side(keys: List[Lowered], sel: Optional[jnp.ndarray]) -> SortedBuild:
+    """Sort the build side by composite key; dead/null rows sort last and can
+    never match (single-key: sentinel; multi-key: leading dead-flag column)."""
+    live = _live_mask(keys, sel)
+    if len(keys) == 1:
+        vals = keys[0][0].astype(jnp.int64)
+        k = jnp.where(live, vals, _DEAD_KEY)
+        order = jnp.argsort(k, stable=True)
+        return SortedBuild([k[order]], order.astype(jnp.int32), live[order], True)
+    dead = (~live).astype(jnp.int8)
+    masked = [jnp.where(live, v.astype(jnp.int64), 0) for v, _ in keys]
+    sort_keys = [dead] + masked
+    n = live.shape[0]
+    order = jnp.arange(n, dtype=jnp.int32)
+    for k in reversed(sort_keys):
+        order = order[jnp.argsort(k[order], stable=True)]
+    return SortedBuild(
+        [k[order] for k in sort_keys], order, live[order], False
+    )
+
+
+def _probe_cols(build: SortedBuild, probe_keys: List[Lowered]) -> List[jnp.ndarray]:
+    """Probe-side search columns aligned with ``build.cols``."""
+    if build.single:
+        return [probe_keys[0][0].astype(jnp.int64)]
+    m = probe_keys[0][0].shape[0]
+    return [jnp.zeros((m,), jnp.int8)] + [v.astype(jnp.int64) for v, _ in probe_keys]
+
+
+def probe_valid(probe_keys: List[Lowered]) -> Optional[jnp.ndarray]:
+    """AND of per-column probe validity (NULL keys never match)."""
+    valid = None
+    for _, v in probe_keys:
+        if v is not None:
+            valid = v if valid is None else (valid & v)
+    return valid
+
+
+def searchsorted_lex(
+    cols: List[jnp.ndarray], probe: List[jnp.ndarray], side: str
+) -> jnp.ndarray:
+    """Vectorized lexicographic binary search: for each probe tuple, the
+    insertion point into the lex-sorted ``cols``. Fixed depth (static shapes);
+    per step, one gather + compare per key column."""
+    n = cols[0].shape[0]
+    m = probe[0].shape[0]
+    lo = jnp.zeros((m,), jnp.int32)
+    hi = jnp.full((m,), n, jnp.int32)
+    for _ in range(max(1, (n + 1).bit_length())):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        midc = jnp.clip(mid, 0, max(n - 1, 0))
+        # lexicographic compare build[mid] vs probe: -1 lt / 0 eq / 1 gt
+        cmp = jnp.zeros((m,), jnp.int8)
+        for bc, pc in zip(cols, probe):
+            bv = bc[midc]
+            col_cmp = jnp.where(bv < pc, jnp.int8(-1), jnp.where(bv > pc, jnp.int8(1), jnp.int8(0)))
+            cmp = jnp.where(cmp == 0, col_cmp, cmp)
+        go_right = (cmp < 0) if side == "left" else (cmp <= 0)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def _search(build: SortedBuild, probe: List[jnp.ndarray], side: str) -> jnp.ndarray:
+    if build.single:
+        return jnp.searchsorted(build.cols[0], probe[0], side=side).astype(jnp.int32)
+    return searchsorted_lex(build.cols, probe, side)
+
+
+def _eq_at(build: SortedBuild, pos: jnp.ndarray, probe: List[jnp.ndarray]) -> jnp.ndarray:
+    """Whether the build tuple at (clipped) ``pos`` equals the probe tuple."""
+    hit = jnp.ones((pos.shape[0],), bool)
+    for bc, pc in zip(build.cols, probe):
+        hit = hit & (bc[pos] == pc)
+    return hit
 
 
 def probe_unique(
-    build_keys_sorted: jnp.ndarray,
-    build_rows: jnp.ndarray,
-    build_live: jnp.ndarray,
-    probe_key: Lowered,
+    build: SortedBuild, probe_keys: List[Lowered]
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Probe against a unique-key build. Returns (build_row_idx, matched)."""
-    pvals, pvalid = probe_key
-    n = build_keys_sorted.shape[0]
-    pos = jnp.searchsorted(build_keys_sorted, pvals.astype(jnp.int64))
-    pos = jnp.clip(pos, 0, n - 1)
-    hit = (build_keys_sorted[pos] == pvals.astype(jnp.int64)) & build_live[pos]
+    probe = _probe_cols(build, probe_keys)
+    pos = jnp.clip(_search(build, probe, "left"), 0, build.n - 1)
+    hit = _eq_at(build, pos, probe) & build.live[pos]
+    pvalid = probe_valid(probe_keys)
     if pvalid is not None:
         hit = hit & pvalid
-    return build_rows[pos], hit
+    return build.rows[pos], hit
 
 
 def membership(
-    build_key: Lowered, build_sel: Optional[jnp.ndarray], probe_key: Lowered
+    build_keys: List[Lowered],
+    build_sel: Optional[jnp.ndarray],
+    probe_keys: List[Lowered],
 ) -> jnp.ndarray:
     """Semi-join membership test (build side may have duplicates)."""
-    bk_sorted, _, live = build_side(build_key, build_sel)
-    pvals, pvalid = probe_key
-    n = bk_sorted.shape[0]
-    pos = jnp.clip(jnp.searchsorted(bk_sorted, pvals.astype(jnp.int64)), 0, n - 1)
-    hit = (bk_sorted[pos] == pvals.astype(jnp.int64)) & live[pos]
+    build = build_side(build_keys, build_sel)
+    probe = _probe_cols(build, probe_keys)
+    pos = jnp.clip(_search(build, probe, "left"), 0, build.n - 1)
+    hit = _eq_at(build, pos, probe) & build.live[pos]
+    pvalid = probe_valid(probe_keys)
     if pvalid is not None:
         hit = hit & pvalid
     return hit
 
 
 def probe_counts(
-    build_keys_sorted: jnp.ndarray,
-    build_live: jnp.ndarray,
-    probe_key: Lowered,
+    build: SortedBuild,
+    probe_keys: List[Lowered],
     probe_sel: Optional[jnp.ndarray],
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pass 1 of the M:N join: per probe row, the sorted-build range start
     and match count. Dead probe rows (sel/NULL key) count 0."""
-    pvals, pvalid = probe_key
-    pv = pvals.astype(jnp.int64)
-    lo = jnp.searchsorted(build_keys_sorted, pv, side="left")
-    hi = jnp.searchsorted(build_keys_sorted, pv, side="right")
+    probe = _probe_cols(build, probe_keys)
+    lo = _search(build, probe, "left")
+    hi = _search(build, probe, "right")
     counts = hi - lo
-    # ranges of a real key contain only live rows (dead keys got the sentinel)
-    # but guard the all-dead-build edge anyway
-    counts = jnp.where(
-        build_live[jnp.clip(lo, 0, build_live.shape[0] - 1)], counts, 0
-    )
+    # ranges of a real key contain only live rows (dead rows sort last with
+    # unmatchable key) but guard the all-dead-build edge anyway
+    counts = jnp.where(build.live[jnp.clip(lo, 0, build.n - 1)], counts, 0)
+    pvalid = probe_valid(probe_keys)
     if pvalid is not None:
         counts = jnp.where(pvalid, counts, 0)
     if probe_sel is not None:
@@ -122,10 +202,14 @@ def expand(
     Output is probe-major (all matches of probe row 0, then row 1, ...).
     """
     n = counts.shape[0]
-    offsets = jnp.cumsum(counts)  # inclusive
+    c64 = counts.astype(jnp.int64)  # cumsum in int64: totals can exceed 2^31
+    if n == 0:  # zero-row probe page: all output slots dead
+        z = jnp.zeros((capacity,), jnp.int64)
+        return z, z, jnp.zeros((capacity,), bool), jnp.zeros((), jnp.int64)
+    offsets = jnp.cumsum(c64)  # inclusive
     total = offsets[n - 1]
-    starts = offsets - counts
-    j = jnp.arange(capacity, dtype=counts.dtype)
+    starts = offsets - c64
+    j = jnp.arange(capacity, dtype=jnp.int64)
     p = jnp.clip(jnp.searchsorted(offsets, j, side="right"), 0, n - 1)
     k = j - starts[p]
     live = j < total

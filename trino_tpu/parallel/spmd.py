@@ -245,24 +245,39 @@ class DistributedQuery:
     inputs: List
     out_spec_cell: List
     error_codes_cell: List
+    session: object = None
+    root: P.OutputNode = None
+    capacity_hints: Dict[int, int] = dataclasses.field(default_factory=dict)
+
+    MAX_RECOMPILES = 16
 
     @classmethod
-    def build(cls, session, root: P.OutputNode, mesh: Mesh) -> "DistributedQuery":
+    def build(
+        cls, session, root: P.OutputNode, mesh: Mesh, capacity_hints: Dict[int, int] = None
+    ) -> "DistributedQuery":
+        """Compile without executing: expansion capacities come from connector
+        stats (global totals upper-bound each shard); overflow at runtime
+        doubles the bucket and recompiles (see CompiledQuery.run)."""
+        from trino_tpu.sql.planner import stats
+
         n_devices = mesh.devices.size
-        capacity_hints: Dict[int, int] = {}
-        if P.needs_capacity_hints(root):
-            # eager full-data pre-run: global match totals upper-bound each
-            # shard's expansion capacity (SURVEY.md §7.3 bucketed recompiles)
-            hint_ex = Executor(session)
-            hint_ex.execute(root)
-            capacity_hints = dict(hint_ex.capacity_hints)
+        if capacity_hints is None:
+            capacity_hints = stats.estimate_capacity_hints(session, root)
         staged_arrays, specs = stage_sharded_scans(session, root, n_devices)
         layout = [(nid, len(arrs)) for nid, arrs in staged_arrays.items()]
         flat_inputs: List = []
         for _, arrs in staged_arrays.items():
             flat_inputs.extend(jnp.asarray(a) for a in arrs)
-        out_spec_cell: List = [None]
-        error_codes_cell: List = [None]
+        dq = cls(mesh, None, flat_inputs, [None], [None], session, root, dict(capacity_hints))
+        dq._layout = layout
+        dq._specs = specs
+        dq._jit()
+        return dq
+
+    def _jit(self):
+        session, root = self.session, self.root
+        layout, specs, hints = self._layout, self._specs, self.capacity_hints
+        out_spec_cell, error_codes_cell = self.out_spec_cell, self.error_codes_cell
 
         def per_shard(flat):
             # flat arrays arrive with the device axis stripped by shard_map
@@ -272,7 +287,7 @@ class DistributedQuery:
                 local = [a.reshape(a.shape[1:]) for a in flat[i : i + count]]
                 pages[nid] = unflatten_page(specs[nid], local)
                 i += count
-            ex = SpmdExecutor(session, pages, dict(capacity_hints))
+            ex = SpmdExecutor(session, pages, dict(hints))
             out_page = ex.execute(root)
             if not out_page.replicated:
                 # scan/filter/project-only plans never hit an exchange:
@@ -289,20 +304,29 @@ class DistributedQuery:
 
         shard_fn = jax.shard_map(
             per_shard,
-            mesh=mesh,
+            mesh=self.mesh,
             in_specs=(PSpec(AXIS),),
             out_specs=(PSpec(AXIS), PSpec(AXIS)),
             check_vma=False,
         )
-        fn = jax.jit(shard_fn)
-        return cls(mesh, fn, flat_inputs, out_spec_cell, error_codes_cell)
+        self.fn = jax.jit(shard_fn)
 
     def run(self) -> Page:
-        from trino_tpu.exec.executor import raise_query_errors
+        from trino_tpu.exec.executor import QueryError, raise_query_errors
+        from trino_tpu.sql.planner import stats
 
-        out_arrays, error_flags = self.fn(self.inputs)
-        # flags are stacked per device: an error on ANY shard fails the query
-        raise_query_errors(self.error_codes_cell[0], error_flags)
-        # results are replicated across shards post-gather: take shard 0
-        local = [np.asarray(a)[0] for a in out_arrays]
-        return unflatten_page(self.out_spec_cell[0], local)
+        for _ in range(self.MAX_RECOMPILES):
+            out_arrays, error_flags = self.fn(self.inputs)
+            codes = self.error_codes_cell[0]
+            # flags are stacked per device: overflow on ANY shard grows the
+            # bucket (capacity first — other flags may be truncation artifacts)
+            grown = stats.grow_overflowed_hints(self.capacity_hints, codes, error_flags)
+            if grown is not None:
+                self.capacity_hints = grown
+                self._jit()
+                continue
+            raise_query_errors(codes, error_flags)
+            # results are replicated across shards post-gather: take shard 0
+            local = [np.asarray(a)[0] for a in out_arrays]
+            return unflatten_page(self.out_spec_cell[0], local)
+        raise QueryError("join output capacity still exceeded after recompiles")

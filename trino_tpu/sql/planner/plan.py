@@ -319,19 +319,14 @@ def walk_plan(node: PlanNode):
         yield from walk_plan(s)
 
 
-def needs_capacity_hints(root: PlanNode) -> bool:
-    """True when the plan contains a join that executes via the two-pass
-    expansion kernel, whose static output capacity must be discovered by an
-    eager pre-run (Executor.hint_capacity)."""
-    for n in walk_plan(root):
-        if not isinstance(n, JoinNode):
-            continue
-        if n.join_type in ("semi", "anti"):
-            if n.filter is not None:
-                return True
-        elif not n.right_unique and not n.singleton:
-            return True
-    return False
+def uses_expansion_kernel(n: JoinNode) -> bool:
+    """True when the executor dispatches this join to the two-pass expansion
+    kernel (expand_join / semi_join_filtered), whose static output capacity
+    comes from stats (sql/planner/stats.py) with overflow-triggered
+    recompiles. Must mirror Executor._exec_JoinNode's dispatch."""
+    if n.join_type in ("semi", "anti"):
+        return n.filter is not None
+    return not n.right_unique and not n.singleton
 
 
 def format_plan(node: PlanNode, indent: int = 0) -> str:

@@ -1,0 +1,104 @@
+"""Plan cardinality estimates + expansion-join capacity hints.
+
+Reference role: ``core/trino-main/.../cost/`` (StatsCalculator,
+FilterStatsCalculator, JoinStatsRule) in miniature. Estimates flow from
+connector row counts (``Connector.table_row_count``) through simple
+selectivity heuristics. They are NOT trusted for correctness — an expansion
+join whose true output exceeds its estimated static capacity raises the
+deferred ``JOIN_OUTPUT_CAPACITY_EXCEEDED:<node-id>`` flag, and the compiled
+paths double that node's bucket and recompile (the bucketed-recompile loop of
+SURVEY.md §7.3; the spill-FSM analog of HashBuilderOperator.java:162-177).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from trino_tpu.sql.planner import plan as P
+
+# Heuristic fudge factors, biased high — capacity hints should over- rather
+# than under-estimate to avoid recompiles. Filters don't discount (the
+# reference's FilterStatsCalculator discounts by 0.9 per unknown conjunct;
+# a capacity hint must survive the filter being non-selective).
+JOIN_FANOUT = 1.25  # M:N fudge over the FK-join output (= probe rows)
+MIN_CAPACITY = 1024
+
+
+def estimate_rows(session, node: P.PlanNode) -> int:
+    """Rough output-row estimate per plan node (upper-bound biased)."""
+    if isinstance(node, P.TableScanNode):
+        conn = session.catalogs.get(node.catalog)
+        n = conn.table_row_count(node.schema, node.table) if conn else None
+        return int(n) if n else MIN_CAPACITY
+    if isinstance(node, P.ValuesNode):
+        return max(1, len(node.rows or ()))
+    if isinstance(node, (P.LimitNode, P.TopNNode)):
+        return min(node.count, estimate_rows(session, node.source))
+    if isinstance(node, P.JoinNode):
+        left = estimate_rows(session, node.left)
+        right = estimate_rows(session, node.right)
+        if node.join_type in ("semi", "anti"):
+            return left
+        if node.singleton:
+            return left
+        if node.right_unique:
+            return left  # N:1 lookup join: output == probe rows
+        if not node.left_keys:  # cross join
+            return left * right
+        return int(max(left, right) * JOIN_FANOUT)
+    if isinstance(node, P.AggregationNode):
+        # group count <= input rows; the sort-based kernel's capacity is the
+        # input row count anyway
+        return estimate_rows(session, node.source)
+    srcs = node.sources
+    if not srcs:
+        return MIN_CAPACITY
+    return max(estimate_rows(session, s) for s in srcs)
+
+
+def _expansion_capacity(session, node: P.JoinNode) -> int:
+    left = estimate_rows(session, node.left)
+    right = estimate_rows(session, node.right)
+    if not node.left_keys:  # true cross join: exact
+        est = left * right
+    elif node.join_type in ("semi", "anti"):
+        # filtered-semi expansion materializes all key matches
+        est = int(max(left, right) * JOIN_FANOUT)
+    else:
+        est = int(max(left, right) * JOIN_FANOUT)
+        if node.join_type == "left":
+            est = max(est, left)  # outer emits >= one slot per probe row
+    return _pow2(max(est, MIN_CAPACITY))
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length()
+
+
+def estimate_capacity_hints(session, root: P.PlanNode) -> Dict[int, int]:
+    """Static output capacities for every expansion-join node in the plan,
+    from stats alone (no eager pre-run)."""
+    hints: Dict[int, int] = {}
+    for n in P.walk_plan(root):
+        if isinstance(n, P.JoinNode) and P.uses_expansion_kernel(n):
+            hints[n.id] = _expansion_capacity(session, n)
+    return hints
+
+
+CAPACITY_ERROR_PREFIX = "JOIN_OUTPUT_CAPACITY_EXCEEDED:"
+
+
+def grow_overflowed_hints(hints: Dict[int, int], codes, flags) -> Dict[int, int]:
+    """Scan deferred-error (code, flag) pairs; double the bucket of every
+    expansion join whose capacity flag fired (flags may be per-device
+    stacks). Returns a new dict, or None when nothing overflowed — the
+    shared half of the bucketed-recompile loop (CompiledQuery.run /
+    DistributedQuery.run)."""
+    import numpy as np
+
+    out = None
+    for code, flag in zip(codes, flags):
+        if code.startswith(CAPACITY_ERROR_PREFIX) and bool(np.asarray(flag).any()):
+            nid = int(code[len(CAPACITY_ERROR_PREFIX):])
+            out = dict(hints) if out is None else out
+            out[nid] = out.get(nid, MIN_CAPACITY) * 2
+    return out
