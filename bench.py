@@ -9,10 +9,13 @@ body (inputs device-resident, like the reference's JMH operator benchmarks
 over in-memory pages).
 
 Measurement honesty (round-2 fixes per VERDICT.md):
-- The axon TPU tunnel's ``block_until_ready`` does NOT actually block, so
-  every iteration is timed by forcing a one-element device->host transfer
-  of each output array (and the tunnel is first warmed into its
-  synchronous state with a dummy transfer).
+- Completion is forced by a one-element device->host transfer of each output
+  (the tunnel's ``block_until_ready`` does not actually block).
+- That sync costs ~100-500 ms of tunnel round-trip per call — dispatch
+  artifact, not engine time — so throughput is measured AMORTIZED: K
+  dispatches pipelined back-to-back, one final sync, (tK - t1)/(K-1).
+  The chip runs the K programs serially, so this is true device time per
+  run. Single-call latency is reported alongside.
 - Backend init is retried with backoff (round-1 failure mode: transient
   "Unable to initialize backend" at first device touch).
 - ``vs_baseline`` divides by a MEASURED anchor: the same engine + same
@@ -68,7 +71,8 @@ order by o_totalprice desc, o_orderdate limit 100
 }
 
 SCHEMA = "sf1"
-ITERS = 3
+ITERS = 2
+AMORTIZE_K = 6  # extra pipelined dispatches per amortized measurement
 
 
 def _init_backend_with_retry(max_attempts=4):
@@ -121,20 +125,38 @@ def run_suite(emit_audit=False):
             print(f"[{name}] input dtypes: {dtypes}", file=sys.stderr)
         page = cq.run()  # compile + first run + error check
         _ = page.to_pylist()
-        best = float("inf")
-        for _i in range(ITERS):
+
+        def run_k(k):
             t0 = time.time()
-            out_arrays, _flags = cq.fn(cq.input_arrays)
+            for _i in range(k):
+                out_arrays, _flags = cq.fn(cq.input_arrays)
             _force(out_arrays)
-            best = min(best, time.time() - t0)
+            return time.time() - t0
+
+        # Single-call latency includes one host<->device sync; the sync is
+        # ~100-500 ms through the axon tunnel (pure dispatch artifact, not
+        # engine time), so throughput is measured amortized: K dispatches
+        # pipelined back-to-back with one final sync — the chip executes the
+        # programs serially, so (tK - t1)/(K-1) is true per-run device time.
+        run_k(1)  # warm
+        t1 = min(run_k(1) for _ in range(ITERS))
+        tk = min(run_k(1 + AMORTIZE_K) for _ in range(ITERS))
+        per_run = (tk - t1) / AMORTIZE_K
+        if per_run <= 0:
+            # tunnel-latency noise swamped the K extra runs; fall back to the
+            # single-call time (an upper bound) rather than emit garbage
+            print(f"[{name}] amortized delta non-positive; using single-call time", file=sys.stderr)
+            per_run = t1
         results[name] = {
             "rows": n_rows,
-            "seconds": round(best, 4),
-            "rows_per_sec": round(n_rows / best, 1),
+            "seconds": round(per_run, 4),
+            "single_call_seconds": round(t1, 4),
+            "rows_per_sec": round(n_rows / per_run, 1),
         }
         print(
-            f"[{name}] steady-state {best*1000:.1f} ms, "
-            f"{n_rows/best/1e6:.1f}M rows/s",
+            f"[{name}] steady-state {per_run*1000:.1f} ms/run "
+            f"(single call {t1*1000:.1f} ms), "
+            f"{n_rows/per_run/1e6:.1f}M rows/s",
             file=sys.stderr,
         )
     return results

@@ -44,13 +44,13 @@ class CompiledQuery:
     fn: object  # jitted
     out_spec_cell: List
     error_codes_cell: List
-    capacity_hints: Dict[int, int] = dataclasses.field(default_factory=dict)
+    capacity_hints: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     MAX_RECOMPILES = 16  # doubling buckets: 2^16x headroom over the estimate
 
     @classmethod
     def build(
-        cls, session, root: P.OutputNode, capacity_hints: Dict[int, int] = None
+        cls, session, root: P.OutputNode, capacity_hints: Dict[str, int] = None
     ) -> "CompiledQuery":
         """Compile without executing: expansion-join capacities come from
         connector stats (sql/planner/stats.py), not an eager pre-run. If a
@@ -115,4 +115,4 @@ class CompiledQuery:
                 continue
             raise_query_errors(codes, error_flags)
             return unflatten_page(self.out_spec_cell[0], out_arrays)
-        raise QueryError("join output capacity still exceeded after recompiles")
+        raise QueryError("capacity still exceeded after recompiles (join or exchange bucket)")

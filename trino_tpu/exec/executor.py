@@ -65,14 +65,14 @@ class Executor:
     raises deferred errors; the recursion itself (``execute``) is pure and
     jit-safe."""
 
-    def __init__(self, session, capacity_hints: Optional[Dict[int, int]] = None):
+    def __init__(self, session, capacity_hints: Optional[Dict[str, int]] = None):
         self.session = session
         self.errors: List[Tuple[str, jnp.ndarray]] = []
         # M:N join output capacities by plan-node id. Eager runs compute the
         # exact total (one device sync) and record a padded power-of-two here;
         # traced runs (compiled/SPMD) require the hint to pre-exist — the
         # bucketed-recompile strategy of SURVEY.md §7.3 (dynamic shapes).
-        self.capacity_hints: Dict[int, int] = capacity_hints if capacity_hints is not None else {}
+        self.capacity_hints: Dict[str, int] = capacity_hints if capacity_hints is not None else {}
 
     # ------------------------------------------------------------------ api
     def execute_checked(self, node: P.PlanNode) -> Page:
@@ -428,21 +428,26 @@ class Executor:
             return self.lookup_join(node, left, right)
         return self.expand_join(node, left, right)
 
-    def hint_capacity(self, node_id: int, emit_counts) -> int:
-        """Static output capacity for an expansion join (see __init__)."""
-        cap = self.capacity_hints.get(node_id)
+    def hint_capacity(self, key: str, emit_counts) -> int:
+        """Static output capacity for an expansion join or exchange, by hint
+        key ("join:<id>" / "xchg*:<id>", see sql/planner/stats.py)."""
+        cap = self.capacity_hints.get(key)
         if cap is not None:
             return cap
+        if emit_counts is None:  # exchanges have no eager fallback
+            raise RuntimeError(
+                f"{key} has no capacity hint — estimate_exchange_hints and "
+                "the executor's dispatch disagree (sql/planner/stats.py)"
+            )
         try:
             total = int(jnp.sum(emit_counts))
         except jax.errors.ConcretizationTypeError:
             raise RuntimeError(
-                f"M:N join (plan node {node_id}) traced without a capacity "
-                "hint — run the plan eagerly first to collect shape hints "
-                "(CompiledQuery/DistributedQuery do this automatically)"
+                f"{key} traced without a capacity hint — compiled paths "
+                "estimate hints from stats (sql/planner/stats.py)"
             )
         cap = max(16, 1 << (max(total, 1) - 1).bit_length())
-        self.capacity_hints[node_id] = cap
+        self.capacity_hints[key] = cap
         return cap
 
     def _expansion_keys(self, node: P.JoinNode, left: Page, right: Page):
@@ -468,9 +473,9 @@ class Executor:
         )
         plain_outer = outer and node.filter is None
         emit = jnp.where(probe_live, jnp.maximum(counts, 1), 0) if plain_outer else counts
-        capacity = self.hint_capacity(node.id, emit)
+        capacity = self.hint_capacity(f"join:{node.id}", emit)
         p, k, live, total = join_ops.expand(emit, capacity)
-        self.errors.append((f"JOIN_OUTPUT_CAPACITY_EXCEEDED:{node.id}", total > capacity))
+        self.errors.append((f"CAPACITY_EXCEEDED:join:{node.id}", total > capacity))
         matched = live & (k < counts[p])
         b_idx = jnp.clip(lo[p] + k, 0, build.n - 1)
         rows = build.rows[b_idx]
@@ -527,9 +532,9 @@ class Executor:
         build = join_ops.build_side(build_keys, right.sel)
         lo, counts = join_ops.probe_counts(build, probe_keys, left.sel)
         n = left.num_rows
-        capacity = self.hint_capacity(node.id, counts)
+        capacity = self.hint_capacity(f"join:{node.id}", counts)
         p, k, live, total = join_ops.expand(counts, capacity)
-        self.errors.append((f"JOIN_OUTPUT_CAPACITY_EXCEEDED:{node.id}", total > capacity))
+        self.errors.append((f"CAPACITY_EXCEEDED:join:{node.id}", total > capacity))
         b_idx = jnp.clip(lo[p] + k, 0, build.n - 1)
         rows = build.rows[b_idx]
         exp_cols = [

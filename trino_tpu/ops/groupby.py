@@ -23,12 +23,10 @@ Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]  # (vals, valid|None)
 
 def _sort_order(sort_keys: List[jnp.ndarray]) -> jnp.ndarray:
     """Stable lexicographic argsort over multiple key arrays (most significant
-    first): chain stable argsorts from least to most significant."""
-    n = sort_keys[0].shape[0]
-    order = jnp.arange(n, dtype=jnp.int32)
-    for k in reversed(sort_keys):
-        order = order[jnp.argsort(k[order], stable=True)]
-    return order
+    first): one fused multi-operand lax.sort with an int32 payload."""
+    from trino_tpu.ops import ranks
+
+    return ranks.lex_argsort32(sort_keys)
 
 
 def group_plan(

@@ -1,8 +1,10 @@
-"""Join kernels: lookup (N:1), M:N expansion, semi/anti — searchsorted-based.
+"""Join kernels: lookup (N:1), M:N expansion, semi/anti — sort-merge based.
 
 Reference: ``operator/join/`` — PagesHash open addressing + PositionLinks
 chains (JoinHash.java:28-69). TPU formulation: the build side is sorted by
-key once; probes binary-search (log2(n) vectorized steps, no scatter):
+key once (one fused multi-operand ``lax.sort``); probe ranges come from
+merge ranks (ops/ranks.py: one combined stable sort + streaming prefixes —
+binary search and its log2(n) random-gather passes never appear):
 
 - unique-key build (PK-FK joins, N:1): probe -> at most one match -> output
   size == probe size (static shapes, no two-pass emit). The planner proves
@@ -14,12 +16,9 @@ key once; probes binary-search (log2(n) vectorized steps, no scatter):
   triggers a bucketed recompile).
 - semi/anti joins: membership only (duplicates on build side are fine).
 
-Composite keys are handled by TRUE lexicographic search (``searchsorted_lex``:
-a fixed-depth vectorized binary search comparing all key columns per step) —
-arbitrary column count and full int64 range, no bit packing. The reference
+Composite keys of any column count and full int64 range are supported (the
+lex sort and merge ranks compare all columns; no bit packing). The reference
 hashes arbitrary-width keys the same way (InterpretedHashGenerator.java:85).
-A single int key takes the ``jnp.searchsorted`` fast path with a sentinel
-for dead rows.
 """
 from __future__ import annotations
 
@@ -27,6 +26,8 @@ import dataclasses
 from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
+
+from trino_tpu.ops import ranks
 
 Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
 
@@ -71,15 +72,12 @@ def build_side(keys: List[Lowered], sel: Optional[jnp.ndarray]) -> SortedBuild:
     if len(keys) == 1:
         vals = keys[0][0].astype(jnp.int64)
         k = jnp.where(live, vals, _DEAD_KEY)
-        order = jnp.argsort(k, stable=True)
-        return SortedBuild([k[order]], order.astype(jnp.int32), live[order], True)
+        order = ranks.argsort32(k)
+        return SortedBuild([k[order]], order, live[order], True)
     dead = (~live).astype(jnp.int8)
     masked = [jnp.where(live, v.astype(jnp.int64), 0) for v, _ in keys]
     sort_keys = [dead] + masked
-    n = live.shape[0]
-    order = jnp.arange(n, dtype=jnp.int32)
-    for k in reversed(sort_keys):
-        order = order[jnp.argsort(k[order], stable=True)]
+    order = ranks.lex_argsort32(sort_keys)
     return SortedBuild(
         [k[order] for k in sort_keys], order, live[order], False
     )
@@ -102,57 +100,13 @@ def probe_valid(probe_keys: List[Lowered]) -> Optional[jnp.ndarray]:
     return valid
 
 
-def searchsorted_lex(
-    cols: List[jnp.ndarray], probe: List[jnp.ndarray], side: str
-) -> jnp.ndarray:
-    """Vectorized lexicographic binary search: for each probe tuple, the
-    insertion point into the lex-sorted ``cols``. Fixed depth (static shapes);
-    per step, one gather + compare per key column."""
-    n = cols[0].shape[0]
-    m = probe[0].shape[0]
-    lo = jnp.zeros((m,), jnp.int32)
-    hi = jnp.full((m,), n, jnp.int32)
-    for _ in range(max(1, (n + 1).bit_length())):
-        active = lo < hi
-        mid = (lo + hi) >> 1
-        midc = jnp.clip(mid, 0, max(n - 1, 0))
-        # lexicographic compare build[mid] vs probe: -1 lt / 0 eq / 1 gt
-        cmp = jnp.zeros((m,), jnp.int8)
-        for bc, pc in zip(cols, probe):
-            bv = bc[midc]
-            col_cmp = jnp.where(bv < pc, jnp.int8(-1), jnp.where(bv > pc, jnp.int8(1), jnp.int8(0)))
-            cmp = jnp.where(cmp == 0, col_cmp, cmp)
-        go_right = (cmp < 0) if side == "left" else (cmp <= 0)
-        lo = jnp.where(active & go_right, mid + 1, lo)
-        hi = jnp.where(active & ~go_right, mid, hi)
-    return lo
-
-
-def _search(build: SortedBuild, probe: List[jnp.ndarray], side: str) -> jnp.ndarray:
-    if build.single:
-        return jnp.searchsorted(build.cols[0], probe[0], side=side).astype(jnp.int32)
-    return searchsorted_lex(build.cols, probe, side)
-
-
-def _eq_at(build: SortedBuild, pos: jnp.ndarray, probe: List[jnp.ndarray]) -> jnp.ndarray:
-    """Whether the build tuple at (clipped) ``pos`` equals the probe tuple."""
-    hit = jnp.ones((pos.shape[0],), bool)
-    for bc, pc in zip(build.cols, probe):
-        hit = hit & (bc[pos] == pc)
-    return hit
-
-
 def probe_unique(
     build: SortedBuild, probe_keys: List[Lowered]
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Probe against a unique-key build. Returns (build_row_idx, matched)."""
-    probe = _probe_cols(build, probe_keys)
-    pos = jnp.clip(_search(build, probe, "left"), 0, build.n - 1)
-    hit = _eq_at(build, pos, probe) & build.live[pos]
-    pvalid = probe_valid(probe_keys)
-    if pvalid is not None:
-        hit = hit & pvalid
-    return build.rows[pos], hit
+    lo, counts = probe_counts(build, probe_keys, None)
+    pos = jnp.clip(lo, 0, build.n - 1)
+    return build.rows[pos], counts > 0
 
 
 def membership(
@@ -162,13 +116,8 @@ def membership(
 ) -> jnp.ndarray:
     """Semi-join membership test (build side may have duplicates)."""
     build = build_side(build_keys, build_sel)
-    probe = _probe_cols(build, probe_keys)
-    pos = jnp.clip(_search(build, probe, "left"), 0, build.n - 1)
-    hit = _eq_at(build, pos, probe) & build.live[pos]
-    pvalid = probe_valid(probe_keys)
-    if pvalid is not None:
-        hit = hit & pvalid
-    return hit
+    _, counts = probe_counts(build, probe_keys, None)
+    return counts > 0
 
 
 def probe_counts(
@@ -177,11 +126,10 @@ def probe_counts(
     probe_sel: Optional[jnp.ndarray],
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pass 1 of the M:N join: per probe row, the sorted-build range start
-    and match count. Dead probe rows (sel/NULL key) count 0."""
+    and match count (merge ranks, ops/ranks.py — no binary search). Dead
+    probe rows (sel/NULL key) count 0."""
     probe = _probe_cols(build, probe_keys)
-    lo = _search(build, probe, "left")
-    hi = _search(build, probe, "right")
-    counts = hi - lo
+    lo, counts = ranks.sorted_ranks(build.cols, probe)
     # ranges of a real key contain only live rows (dead rows sort last with
     # unmatchable key) but guard the all-dead-build edge anyway
     counts = jnp.where(build.live[jnp.clip(lo, 0, build.n - 1)], counts, 0)
@@ -210,7 +158,8 @@ def expand(
     total = offsets[n - 1]
     starts = offsets - c64
     j = jnp.arange(capacity, dtype=jnp.int64)
-    p = jnp.clip(jnp.searchsorted(offsets, j, side="right"), 0, n - 1)
+    # both sides sorted -> merge ranks, not binary search
+    p = jnp.clip(ranks.ranks_sorted_queries(offsets, j, side="right"), 0, n - 1)
     k = j - starts[p]
     live = j < total
     return p, k, live, total

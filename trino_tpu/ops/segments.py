@@ -28,6 +28,8 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from trino_tpu.ops import ranks
+
 Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
 
 # Above this capacity the unrolled masked loop stops making sense and the
@@ -66,7 +68,7 @@ class GroupLayout:
         nested regroupings like count(DISTINCT) ask for it)."""
         if self.gids is not None:
             return self.gids
-        inverse = jnp.argsort(self.order)  # inverse permutation
+        inverse = ranks.inverse_permutation(self.order)
         return self.gid_sorted[inverse]
 
 
@@ -89,11 +91,12 @@ def direct_layout(gids: jnp.ndarray, capacity: int, live: Optional[jnp.ndarray])
 def sorted_layout(
     order: jnp.ndarray, gid_sorted: jnp.ndarray, num_groups: jnp.ndarray
 ) -> GroupLayout:
-    """Layout from a group-contiguous permutation (ops/groupby.py)."""
+    """Layout from a group-contiguous permutation (ops/groupby.py). Slot
+    ranges come from merge ranks (one combined sort), not binary search."""
     n = order.shape[0]
     slots = jnp.arange(n, dtype=gid_sorted.dtype)
-    starts = jnp.searchsorted(gid_sorted, slots, side="left").astype(jnp.int32)
-    ends = jnp.searchsorted(gid_sorted, slots, side="right").astype(jnp.int32)
+    starts, cnt = ranks.sorted_ranks([gid_sorted], [slots])
+    ends = starts + cnt
     rep = order[jnp.clip(starts, 0, n - 1)]
     return GroupLayout(
         n=n,
@@ -162,23 +165,16 @@ def seg_count(layout: GroupLayout, m: Optional[jnp.ndarray]) -> jnp.ndarray:
     return _cumsum_diff(layout, ones[layout.order])
 
 
-def _segmented_scan_minmax(v: jnp.ndarray, boundary: jnp.ndarray, is_min: bool):
-    op = jnp.minimum if is_min else jnp.maximum
-
-    def comb(l, r):
-        lv, lb = l
-        rv, rb = r
-        return jnp.where(rb, rv, op(lv, rv)), lb | rb
-
-    sv, _ = jax.lax.associative_scan(comb, (v, boundary))
-    return sv
-
-
 def seg_minmax(
     layout: GroupLayout, vals: jnp.ndarray, m: Optional[jnp.ndarray], is_min: bool
 ) -> jnp.ndarray:
     """Per-slot min/max of vals over rows where ``m`` holds (sentinel-filled
-    for empty slots — pair with seg_count to derive validity)."""
+    for empty slots — pair with seg_count to derive validity).
+
+    Sorted path: one fused sort by (gid, value) puts each group's min at its
+    start and max at its end — two gathers finish the job. (A segmented
+    associative_scan would be the textbook formulation, but its unrolled
+    log-depth graph does not compile at multi-million rows on v5e.)"""
     if jnp.issubdtype(vals.dtype, jnp.floating):
         sentinel = jnp.inf if is_min else -jnp.inf
     elif vals.dtype == jnp.bool_:
@@ -194,13 +190,10 @@ def seg_minmax(
             [red(jnp.where(layout.gids == g, x, sentinel)) for g in range(layout.capacity)]
         )
     xs = x[layout.order]
-    boundary = jnp.concatenate(
-        [jnp.ones((1,), bool), layout.gid_sorted[1:] != layout.gid_sorted[:-1]]
-    )
-    scanned = _segmented_scan_minmax(xs, boundary, is_min)
+    _, x_by_group = jax.lax.sort((layout.gid_sorted, xs), num_keys=2)
     n = layout.n
-    at_end = jnp.clip(layout.ends - 1, 0, n - 1)
-    out = scanned[at_end]
+    pos = layout.starts if is_min else jnp.clip(layout.ends - 1, 0, n - 1)
+    out = x_by_group[jnp.clip(pos, 0, n - 1)]
     return jnp.where(layout.ends > layout.starts, out, sentinel)
 
 
@@ -211,6 +204,5 @@ def monotonic_segment_sum(
     probe-major output of a join expansion) — cumsum + boundary diff,
     no scatter."""
     slots = jnp.arange(n_segments, dtype=seg.dtype)
-    starts = jnp.searchsorted(seg, slots, side="left")
-    ends = jnp.searchsorted(seg, slots, side="right")
-    return _cumsum_diff_ranges(starts, ends, x)
+    starts, cnt = ranks.sorted_ranks([seg], [slots])
+    return _cumsum_diff_ranges(starts, starts + cnt, x)

@@ -2,16 +2,18 @@
 
 Reference: ``operator/OrderByOperator.java`` + ``sql/gen/OrderingCompiler``
 (type-specialized comparators). Here: per-key transform to a sortable int64/
-float array (descending = negation, NULLs = +/-inf sentinels per
-nulls_first), then chained stable argsorts (least- to most-significant).
-Dead rows (selection mask false) always sort last so LIMIT/host slicing sees
-live rows first.
+float array (descending = negation, NULLs = rank-prefix keys per
+nulls_first), then ONE fused multi-operand stable ``lax.sort`` with an int32
+payload (ops/ranks.lex_argsort32). Dead rows (selection mask false) always
+sort last so LIMIT/host slicing sees live rows first.
 """
 from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
+
+from trino_tpu.ops import ranks
 
 Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
 
@@ -44,9 +46,6 @@ def sort_order(
         sort_keys.append(~sel)  # dead rows last
     for (vals, valid), asc, nf in keys:
         sort_keys.extend(_sort_key(vals, valid, asc, nf))
-    order = jnp.arange(n)
     if not sort_keys:
-        return order
-    for k in reversed(sort_keys):
-        order = order[jnp.argsort(k[order], stable=True)]
-    return order
+        return jnp.arange(n, dtype=jnp.int32)
+    return ranks.lex_argsort32(sort_keys)

@@ -1,0 +1,106 @@
+"""Hash-partitioned exchange: `all_to_all` over ICI inside the compiled program.
+
+Reference: the FIXED_HASH_DISTRIBUTION repartition shuffle —
+``SystemPartitioningHandle.java:50``, producer ``PagePartitioner.java:134-149``
+(column-wise partition strategy: compute all partition assignments, then per
+partition append each column's selected positions), consumer
+``ExchangeOperator``/``DirectExchangeClient``. TPU redesign (SURVEY.md §7.1
+"shuffle = collective"): the producer/wire/consumer trio compiles into the
+query program itself —
+
+1. partition id per row = mix64 hash of the key columns mod n_devices
+   (identical on every device; NULL keys hash to a constant so equal keys —
+   and all NULLs — co-locate);
+2. rows sort by partition id (one fused int32 sort — the column-wise
+   gather-by-partition strategy, which is exactly the sorted formulation);
+3. each partition's rows gather into a static [n_devices, capacity] send
+   buffer (capacity from stats; overflow raises the deferred
+   ``CAPACITY_EXCEEDED:xchg*`` flag and the run loop doubles + recompiles —
+   the skew story);
+4. ``jax.lax.all_to_all`` swaps blocks across the mesh axis (ICI);
+5. received blocks flatten into a new sharded Page (pad slots dead).
+
+The wire format IS the device layout — no serialization, no backpressure,
+no HTTP: XLA schedules the collective against compute.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu.data.page import Column, Page
+from trino_tpu.ops import ranks
+
+Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+
+_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_M2 = jnp.uint64(0x94D049BB133111EB)
+_NULL_HASH = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer (public-domain constant mix; wraps mod 2^64)."""
+    x = (x ^ (x >> 30)) * _M1
+    x = (x ^ (x >> 27)) * _M2
+    return x ^ (x >> 31)
+
+
+def partition_ids(keys: List[Lowered], n_devices: int) -> jnp.ndarray:
+    """int32[n] partition id per row: combined key hash mod n_devices.
+    Deterministic and device-independent (FTE determinism: replayed
+    exchanges produce identical partitions, SURVEY.md §5.4)."""
+    n = keys[0][0].shape[0]
+    h = jnp.zeros((n,), jnp.uint64)
+    for vals, valid in keys:
+        k = _mix64(vals.astype(jnp.int64).astype(jnp.uint64))
+        if valid is not None:
+            k = jnp.where(valid, k, _NULL_HASH)
+        h = _mix64(h ^ k)
+    return (h % jnp.uint64(n_devices)).astype(jnp.int32)
+
+
+def repartition_page(
+    page: Page,
+    key_channels: List[int],
+    n_devices: int,
+    capacity: int,
+    axis: str,
+) -> Tuple[Page, jnp.ndarray]:
+    """Hash-repartition a sharded page over the mesh axis.
+
+    Returns (received_page [n_devices*capacity rows, sharded], overflow_flag).
+    Dead rows (sel False) are not sent; received pad slots carry sel False.
+    """
+    n = page.num_rows
+    live = page.sel if page.sel is not None else jnp.ones((n,), bool)
+    keys = [
+        (page.columns[c].values, None if page.columns[c].nulls is None else ~page.columns[c].nulls)
+        for c in key_channels
+    ]
+    pid = partition_ids(keys, n_devices)
+    pid = jnp.where(live, pid, jnp.int32(n_devices))  # dead rows sort last
+    order = ranks.argsort32(pid)
+    pid_sorted = pid[order]
+    # per-partition [start, count) in sorted space (merge ranks, no search)
+    starts, counts = ranks.sorted_ranks(
+        [pid_sorted], [jnp.arange(n_devices, dtype=jnp.int32)]
+    )
+    overflow = jnp.any(counts > capacity)
+    j = jnp.arange(capacity, dtype=jnp.int32)
+    slot_idx = jnp.clip(starts[:, None] + j[None, :], 0, n - 1)  # [ndev, cap]
+    send_live = j[None, :] < counts[:, None]
+    rows = order[slot_idx]  # original row index per send slot
+
+    def xchg(a: jnp.ndarray) -> jnp.ndarray:
+        recv = jax.lax.all_to_all(a, axis, split_axis=0, concat_axis=0, tiled=False)
+        return recv.reshape((n_devices * capacity,) + recv.shape[2:])
+
+    out_cols = []
+    for c in page.columns:
+        vals = xchg(c.values[rows])
+        nulls = xchg(c.nulls[rows]) if c.nulls is not None else None
+        out_cols.append(Column(c.type, vals, nulls, c.dictionary))
+    sel = xchg(send_live)
+    return Page(out_cols, sel, replicated=False), overflow

@@ -8,18 +8,19 @@ multi-stage pipeline compiles into a single SPMD program over a device mesh:
 
 - leaf scans = data-parallel splits, one shard per device (padded to a
   common shape; the pad rows carry sel=False) — SOURCE_DISTRIBUTION analog;
-- aggregation = local partial aggregate, `all_gather` of the (small)
-  partial-state pages over ICI, local final aggregate — the
+- low-cardinality aggregation = local partial aggregate, `all_gather` of the
+  (small) partial-state pages over ICI, local final aggregate — the
   partial/FINAL split HashAggregationOperator does across an exchange;
-- lookup/semi join build sides = `all_gather` of the build page =
-  FIXED_BROADCAST_DISTRIBUTION (replicated build, like Trino's broadcast
-  join); probes stay local;
+- high-cardinality aggregation = hash-repartition raw rows by group-key
+  hash (`all_to_all`, parallel/exchange.py — FIXED_HASH_DISTRIBUTION),
+  aggregate locally, keep the result sharded;
+- join build sides: `all_gather` (FIXED_BROADCAST_DISTRIBUTION) when small,
+  else co-partition both sides by key hash and join locally (partitioned
+  join) — the DetermineJoinDistributionType choice, from connector stats;
 - sort/topN/limit run on the gathered (replicated) result.
 
 Collectives ride ICI inside the compiled program — there is no serialized
-page shuttle between stages on this path. (Hash-partitioned `all_to_all`
-exchanges for high-cardinality aggregations/joins are the round-2 upgrade;
-the structure — exchange boundaries as collectives — is the same.)
+page shuttle between stages on this path.
 """
 from __future__ import annotations
 
@@ -72,26 +73,64 @@ def gather_page(page: Page) -> Page:
 
 
 class SpmdExecutor(Executor):
-    """Runs the plan per-shard inside shard_map; exchanges are collectives."""
+    """Runs the plan per-shard inside shard_map; exchanges are collectives.
 
-    def __init__(self, session, staged: Dict[int, Page], capacity_hints=None):
+    Distribution choice per exchange (reference: AddExchanges.java:138 +
+    DetermineJoinDistributionType): broadcast (all_gather) for small build
+    sides / low-cardinality aggregations, hash repartition (all_to_all,
+    parallel/exchange.py) when stats say the data is too big to replicate —
+    the same predicates (sql/planner/stats.py) drive build-time capacity
+    hints, so the trace always finds its hints."""
+
+    def __init__(self, session, staged: Dict[int, Page], capacity_hints=None, n_devices: int = 1):
         super().__init__(session, capacity_hints)
         self.staged = staged
+        self.n_devices = n_devices
 
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
         return self.staged[node.id]
 
+    # ------------------------------------------------------ hash exchange
+    def _repartition(self, page: Page, key_channels, hint_key: str) -> Page:
+        from trino_tpu.parallel import exchange
+
+        capacity = self.hint_capacity(hint_key, None)
+        out, overflow = exchange.repartition_page(
+            page, key_channels, self.n_devices, capacity, AXIS
+        )
+        self.errors.append((f"CAPACITY_EXCEEDED:{hint_key}", overflow))
+        return out
+
+    def _join_repartitioned(self, node: P.JoinNode, left: Page, right: Page):
+        """Co-partition both join sides by key hash when stats prefer it and
+        neither side is already replicated. Returns None to fall back to the
+        broadcast path."""
+        from trino_tpu.sql.planner import stats
+
+        if left.replicated or right.replicated:
+            return None
+        if not stats.join_repartitions(self.session, node, self.n_devices):
+            return None
+        left2 = self._repartition(left, node.left_keys, f"xchgl:{node.id}")
+        right2 = self._repartition(right, node.right_keys, f"xchgr:{node.id}")
+        return left2, right2
+
     # ----------------------------------------------------- distributed agg
     def aggregate_page(self, node: P.AggregationNode, page: Page) -> Page:
-        """partial aggregate -> all_gather partial states -> final combine.
+        """Low cardinality: partial aggregate -> all_gather partial states ->
+        final combine (HashAggregationOperator PARTIAL -> exchange -> FINAL).
+        High cardinality: hash-repartition RAW rows by group key, aggregate
+        single-step locally, output stays sharded (the partial step would not
+        reduce — the SkipAggregationBuilder insight). DISTINCT aggregates
+        can't be split: gather raw rows and aggregate single-step."""
+        from trino_tpu.sql.planner import stats
 
-        The exact split HashAggregationOperator(PARTIAL) -> remote exchange ->
-        HashAggregationOperator(FINAL) does, as one compiled program.
-        DISTINCT aggregates can't be split: gather raw rows and aggregate
-        single-step (the MarkDistinct-over-gather fallback)."""
         if page.replicated:
             # every device already holds all rows: single-step local aggregate
             return super().aggregate_page(node, page)
+        if stats.agg_repartitions(self.session, node, self.n_devices):
+            page2 = self._repartition(page, node.group_channels, f"xchg:{node.id}")
+            return Executor.aggregate_page(self, node, page2)  # sharded out
         if any(c.distinct for c in node.aggregates):
             return super().aggregate_page(node, gather_page(page))
         partial = self.aggregate_partial(node, page)
@@ -105,22 +144,34 @@ class SpmdExecutor(Executor):
 
     # -------------------------------------------------- distributed joins
     def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        rp = self._join_repartitioned(node, left, right)
+        if rp is not None:
+            return Executor.lookup_join(self, node, *rp)
         # broadcast exchange: replicate the (small, unique-keyed) build side
         return super().lookup_join(node, left, gather_page(right))
 
     def semi_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        rp = self._join_repartitioned(node, left, right)
+        if rp is not None:
+            return Executor.semi_join(self, node, *rp)
         return super().semi_join(node, left, gather_page(right))
 
     def singleton_cross(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         return super().singleton_cross(node, left, gather_page(right))
 
     def expand_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        rp = self._join_repartitioned(node, left, right)
+        if rp is not None:
+            return Executor.expand_join(self, node, *rp)
         # M:N expansion probes stay local; the build side is broadcast.
-        # Capacity hints collected on full data upper-bound every shard's
-        # local match count (probe shard ⊆ all probes).
+        # Stats-estimated capacity hints upper-bound every shard's local
+        # match count (probe shard ⊆ all probes).
         return super().expand_join(node, left, gather_page(right))
 
     def semi_join_filtered(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        rp = self._join_repartitioned(node, left, right)
+        if rp is not None:
+            return Executor.semi_join_filtered(self, node, *rp)
         return super().semi_join_filtered(node, left, gather_page(right))
 
     # ---------------------------------------------- ordering on gathered
@@ -247,13 +298,13 @@ class DistributedQuery:
     error_codes_cell: List
     session: object = None
     root: P.OutputNode = None
-    capacity_hints: Dict[int, int] = dataclasses.field(default_factory=dict)
+    capacity_hints: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     MAX_RECOMPILES = 16
 
     @classmethod
     def build(
-        cls, session, root: P.OutputNode, mesh: Mesh, capacity_hints: Dict[int, int] = None
+        cls, session, root: P.OutputNode, mesh: Mesh, capacity_hints: Dict[str, int] = None
     ) -> "DistributedQuery":
         """Compile without executing: expansion capacities come from connector
         stats (global totals upper-bound each shard); overflow at runtime
@@ -263,6 +314,7 @@ class DistributedQuery:
         n_devices = mesh.devices.size
         if capacity_hints is None:
             capacity_hints = stats.estimate_capacity_hints(session, root)
+            capacity_hints.update(stats.estimate_exchange_hints(session, root, n_devices))
         staged_arrays, specs = stage_sharded_scans(session, root, n_devices)
         layout = [(nid, len(arrs)) for nid, arrs in staged_arrays.items()]
         flat_inputs: List = []
@@ -287,7 +339,7 @@ class DistributedQuery:
                 local = [a.reshape(a.shape[1:]) for a in flat[i : i + count]]
                 pages[nid] = unflatten_page(specs[nid], local)
                 i += count
-            ex = SpmdExecutor(session, pages, dict(hints))
+            ex = SpmdExecutor(session, pages, dict(hints), n_devices=self.mesh.devices.size)
             out_page = ex.execute(root)
             if not out_page.replicated:
                 # scan/filter/project-only plans never hit an exchange:
@@ -329,4 +381,4 @@ class DistributedQuery:
             # results are replicated across shards post-gather: take shard 0
             local = [np.asarray(a)[0] for a in out_arrays]
             return unflatten_page(self.out_spec_cell[0], local)
-        raise QueryError("join output capacity still exceeded after recompiles")
+        raise QueryError("capacity still exceeded after recompiles (join or exchange bucket)")

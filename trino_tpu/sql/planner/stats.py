@@ -4,10 +4,15 @@ Reference role: ``core/trino-main/.../cost/`` (StatsCalculator,
 FilterStatsCalculator, JoinStatsRule) in miniature. Estimates flow from
 connector row counts (``Connector.table_row_count``) through simple
 selectivity heuristics. They are NOT trusted for correctness — an expansion
-join whose true output exceeds its estimated static capacity raises the
-deferred ``JOIN_OUTPUT_CAPACITY_EXCEEDED:<node-id>`` flag, and the compiled
-paths double that node's bucket and recompile (the bucketed-recompile loop of
+join or hash exchange whose true size exceeds its estimated static capacity
+raises a deferred ``CAPACITY_EXCEEDED:<hint-key>`` flag, and the compiled
+paths double that bucket and recompile (the bucketed-recompile loop of
 SURVEY.md §7.3; the spill-FSM analog of HashBuilderOperator.java:162-177).
+
+Also home to the broadcast-vs-repartition distribution choice (reference:
+DetermineJoinDistributionType + AddExchanges.java:138): both the build-time
+hint estimation and SpmdExecutor's trace-time dispatch consult the same
+predicates, so hints always exist for the exchanges the trace creates.
 """
 from __future__ import annotations
 
@@ -74,31 +79,101 @@ def _pow2(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length()
 
 
-def estimate_capacity_hints(session, root: P.PlanNode) -> Dict[int, int]:
+def estimate_capacity_hints(session, root: P.PlanNode) -> Dict[str, int]:
     """Static output capacities for every expansion-join node in the plan,
     from stats alone (no eager pre-run)."""
-    hints: Dict[int, int] = {}
+    hints: Dict[str, int] = {}
     for n in P.walk_plan(root):
         if isinstance(n, P.JoinNode) and P.uses_expansion_kernel(n):
-            hints[n.id] = _expansion_capacity(session, n)
+            hints[f"join:{n.id}"] = _expansion_capacity(session, n)
     return hints
 
 
-CAPACITY_ERROR_PREFIX = "JOIN_OUTPUT_CAPACITY_EXCEEDED:"
+# ---------------------------------------------------------------- exchanges
+
+# Build sides larger than this repartition instead of broadcasting
+# (join_max_broadcast_table_size analog, in rows).
+BROADCAST_BUILD_MAX = 1 << 17
+# Aggregations whose per-device input exceeds this repartition raw rows by
+# group-key hash instead of gathering partial states.
+GATHER_AGG_MAX_ROWS_PER_DEVICE = 1 << 16
+MIN_EXCHANGE_CAPACITY = 256
 
 
-def grow_overflowed_hints(hints: Dict[int, int], codes, flags) -> Dict[int, int]:
+def _keys_low_cardinality(node: P.AggregationNode) -> bool:
+    """Group keys whose domain is small enough for the gather exchange no
+    matter the row count (dictionary codes / booleans — the direct-layout
+    grouping fast path)."""
+    src_types = node.source.output_types
+    for c in node.group_channels:
+        t = src_types[c]
+        if not (t.is_varchar or t.name == "boolean"):
+            return False
+    return True
+
+
+def agg_repartitions(session, node: P.AggregationNode, n_devices: int) -> bool:
+    """True when a distributed single-step aggregation should hash-repartition
+    raw rows by group key (FIXED_HASH_DISTRIBUTION) instead of gathering
+    partial states (the low-cardinality path)."""
+    if not node.group_channels:
+        return False  # global aggregate: partial states are one row
+    if any(c.distinct for c in node.aggregates):
+        return False  # distinct fallback gathers raw rows (for now)
+    if _keys_low_cardinality(node):
+        return False
+    rows = estimate_rows(session, node.source)
+    return rows // max(n_devices, 1) > GATHER_AGG_MAX_ROWS_PER_DEVICE
+
+
+def join_repartitions(session, node: P.JoinNode, n_devices: int) -> bool:
+    """True when a distributed join should co-partition both sides by key
+    hash instead of broadcasting the build side."""
+    if not node.left_keys:
+        return False  # cross join: broadcast is the only option
+    build = estimate_rows(session, node.right)
+    return build > BROADCAST_BUILD_MAX
+
+
+def exchange_capacity(session, source: P.PlanNode, n_devices: int) -> int:
+    """Static per-(source device, destination device) block size for a hash
+    exchange of ``source``'s rows: ~2x the uniform share, doubled on
+    overflow by the recompile loop (skewed keys land here)."""
+    rows = estimate_rows(session, source)
+    per_block = (2 * rows) // max(n_devices * n_devices, 1)
+    return _pow2(max(per_block, MIN_EXCHANGE_CAPACITY))
+
+
+def estimate_exchange_hints(session, root: P.PlanNode, n_devices: int) -> Dict[str, int]:
+    """Capacity hints for every hash exchange the SPMD trace will create —
+    consults the same predicates as SpmdExecutor's dispatch."""
+    hints: Dict[str, int] = {}
+    for n in P.walk_plan(root):
+        if isinstance(n, P.AggregationNode) and n.step == "single":
+            if agg_repartitions(session, n, n_devices):
+                hints[f"xchg:{n.id}"] = exchange_capacity(session, n.source, n_devices)
+        elif isinstance(n, P.JoinNode):
+            if join_repartitions(session, n, n_devices):
+                hints[f"xchgl:{n.id}"] = exchange_capacity(session, n.left, n_devices)
+                hints[f"xchgr:{n.id}"] = exchange_capacity(session, n.right, n_devices)
+    return hints
+
+
+CAPACITY_ERROR_PREFIX = "CAPACITY_EXCEEDED:"
+
+
+def grow_overflowed_hints(hints: Dict[str, int], codes, flags) -> Dict[str, int]:
     """Scan deferred-error (code, flag) pairs; double the bucket of every
-    expansion join whose capacity flag fired (flags may be per-device
-    stacks). Returns a new dict, or None when nothing overflowed — the
-    shared half of the bucketed-recompile loop (CompiledQuery.run /
+    expansion join / exchange whose capacity flag fired (flags may be
+    per-device stacks). Returns a new dict, or None when nothing overflowed
+    — the shared half of the bucketed-recompile loop (CompiledQuery.run /
     DistributedQuery.run)."""
     import numpy as np
 
     out = None
     for code, flag in zip(codes, flags):
         if code.startswith(CAPACITY_ERROR_PREFIX) and bool(np.asarray(flag).any()):
-            nid = int(code[len(CAPACITY_ERROR_PREFIX):])
+            key = code[len(CAPACITY_ERROR_PREFIX):]
             out = dict(hints) if out is None else out
-            out[nid] = out.get(nid, MIN_CAPACITY) * 2
+            out[key] = out.get(key, MIN_CAPACITY) * 2
     return out
