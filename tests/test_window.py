@@ -1,0 +1,193 @@
+"""Window-function tests against sqlite3 as an independent oracle
+(reference test strategy: H2 oracle, QueryAssertions.java:151-176).
+
+sqlite3 (stdlib) supports the same window subset; both engines run the
+identical SQL over the identical rows.
+"""
+import sqlite3
+
+import numpy as np
+import pytest
+
+from trino_tpu import Session
+from trino_tpu import types as T
+
+ROWS = []
+_rng = np.random.default_rng(11)
+for i in range(200):
+    dept = int(_rng.integers(0, 6))
+    salary = int(_rng.integers(1000, 9000))
+    ROWS.append((i, dept, salary, None if i % 23 == 0 else int(_rng.integers(0, 50))))
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "emp",
+        [("id", T.BIGINT), ("dept", T.BIGINT), ("salary", T.BIGINT), ("bonus", T.BIGINT)],
+        ROWS,
+    )
+    return s
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    db = sqlite3.connect(":memory:")
+    db.execute("create table emp (id integer, dept integer, salary integer, bonus integer)")
+    db.executemany("insert into emp values (?,?,?,?)", ROWS)
+    return db
+
+
+def check(session, oracle, sql):
+    got = session.execute(sql.replace("memory.t.emp", "memory.t.emp")).rows
+    want = [tuple(r) for r in oracle.execute(sql.replace("memory.t.emp", "emp"))]
+    assert got == want, f"{sql}\ngot:  {got[:6]}\nwant: {want[:6]}"
+
+
+def test_ranking_functions(session, oracle):
+    check(
+        session, oracle,
+        """select id, rank() over (partition by dept order by salary desc),
+                  dense_rank() over (partition by dept order by salary desc),
+                  row_number() over (partition by dept order by salary desc, id)
+           from memory.t.emp order by id""",
+    )
+
+
+def test_running_and_partition_aggregates(session, oracle):
+    check(
+        session, oracle,
+        """select id,
+                  sum(salary) over (partition by dept order by id),
+                  count(*) over (partition by dept),
+                  sum(bonus) over (partition by dept),
+                  min(salary) over (partition by dept),
+                  max(salary) over (partition by dept)
+           from memory.t.emp order by id""",
+    )
+
+
+def test_rows_frame_and_peers(session, oracle):
+    # duplicate order keys: RANGE (default) includes peers, ROWS does not
+    check(
+        session, oracle,
+        """select id,
+                  sum(salary) over (partition by dept order by salary),
+                  sum(salary) over (partition by dept order by salary
+                                    rows between unbounded preceding and current row)
+           from memory.t.emp order by id""",
+    )
+
+
+def test_lag_lead_first_last(session, oracle):
+    check(
+        session, oracle,
+        """select id,
+                  lag(salary) over (partition by dept order by id),
+                  lead(salary, 2) over (partition by dept order by id),
+                  first_value(salary) over (partition by dept order by id),
+                  last_value(salary) over (partition by dept order by id)
+           from memory.t.emp order by id""",
+    )
+
+
+def test_window_without_partition(session, oracle):
+    check(
+        session, oracle,
+        """select id, rank() over (order by salary desc, id),
+                  sum(salary) over (order by id)
+           from memory.t.emp order by id""",
+    )
+
+
+def test_window_over_group_by(session, oracle):
+    check(
+        session, oracle,
+        """select dept, sum(salary) s,
+                  rank() over (order by sum(salary) desc)
+           from memory.t.emp group by dept order by dept""",
+    )
+
+
+def test_window_null_partition_keys(session, oracle):
+    check(
+        session, oracle,
+        """select id, count(*) over (partition by bonus),
+                  row_number() over (partition by bonus order by id)
+           from memory.t.emp order by id""",
+    )
+
+
+def test_window_in_expression_and_order_by(session, oracle):
+    check(
+        session, oracle,
+        """select id, salary - avg(salary) over (partition by dept) d
+           from memory.t.emp order by id""",
+    )
+
+
+def test_distributed_window_matches_local(session):
+    import jax
+    from jax.sharding import Mesh
+
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.parallel.spmd import DistributedQuery
+
+    sql = """select id, rank() over (partition by dept order by salary desc, id),
+                    sum(salary) over (partition by dept order by id)
+             from memory.t.emp order by id"""
+    expected = session.execute(sql).rows
+    mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+    dq = DistributedQuery.build(session, plan_sql(session, sql), mesh)
+    assert dq.run().to_pylist() == expected
+
+
+def test_window_only_in_order_by(session, oracle):
+    check(
+        session, oracle,
+        """select id from memory.t.emp
+           order by rank() over (partition by dept order by salary desc), id""",
+    )
+
+
+def test_varchar_window_values(session):
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "ev",
+        [("id", T.BIGINT), ("name", T.VARCHAR)],
+        [(1, "alpha"), (2, "beta"), (3, "gamma"), (4, None)],
+    )
+    rows = s.execute(
+        """select id, lag(name) over (order by id),
+                  first_value(name) over (order by id)
+           from memory.t.ev order by id"""
+    ).rows
+    assert rows == [
+        (1, None, "alpha"),
+        (2, "alpha", "alpha"),
+        (3, "beta", "alpha"),
+        (4, "gamma", "alpha"),
+    ]
+
+
+def test_running_minmax_rejected_cleanly(session):
+    from trino_tpu.sql.planner.planner import PlanningError
+
+    with pytest.raises((PlanningError, Exception)) as ei:
+        session.execute(
+            "select min(salary) over (partition by dept order by id) from memory.t.emp"
+        )
+    assert "running frame" in str(ei.value)
+
+
+def test_window_keywords_stay_identifiers(session):
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "kwcols",
+        [("row", T.BIGINT), ("rows", T.BIGINT), ("range", T.BIGINT), ("current", T.BIGINT)],
+        [(1, 2, 3, 4)],
+    )
+    assert s.execute(
+        'select row, rows, range, current from memory.t.kwcols'
+    ).rows == [(1, 2, 3, 4)]

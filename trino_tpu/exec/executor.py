@@ -412,6 +412,66 @@ class Executor:
             return agg_ops.agg_max(layout, arg, sel)
         raise NotImplementedError(call.function)
 
+    # -------------------------------------------------------------- window
+    def _exec_WindowNode(self, node: P.WindowNode) -> Page:
+        return self.window_over_page(node, self.execute(node.source))
+
+    def window_over_page(self, node: P.WindowNode, page: Page) -> Page:
+        from trino_tpu.ops import window as win_ops
+
+        n = page.num_rows
+        pkeys = [_col_to_lowered(page.columns[c]) for c in node.partition_channels]
+        okeys = [
+            (_col_to_lowered(page.columns[c]), asc, nf)
+            for c, asc, nf in node.order_channels
+        ]
+        layout = win_ops.build_layout(pkeys, okeys, page.sel, n)
+        out_cols = list(page.columns)
+        for call, name in zip(node.calls, node.names):
+            arg = (
+                _col_to_lowered(page.columns[call.arg_channel])
+                if call.arg_channel is not None
+                else None
+            )
+            fn = call.function
+            if fn == "row_number":
+                v, valid = win_ops.row_number(layout)
+            elif fn == "rank":
+                v, valid = win_ops.rank(layout)
+            elif fn == "dense_rank":
+                v, valid = win_ops.dense_rank(layout)
+            elif fn == "sum":
+                v, valid = win_ops.agg_sum(layout, arg, call.frame, call.output_type.np_dtype)
+            elif fn == "avg":
+                s, s_valid = win_ops.agg_sum(
+                    layout, arg, call.frame,
+                    call.output_type.np_dtype if call.output_type.is_decimal
+                    else np.dtype(np.float64),
+                )
+                cnt, _ = win_ops.agg_count(layout, arg, call.frame)
+                v, dvalid = agg_ops.finish_avg(s, cnt, call.output_type)
+                valid = s_valid if dvalid is None else (
+                    dvalid if s_valid is None else (s_valid & dvalid)
+                )
+            elif fn in ("count", "count_star"):
+                v, valid = win_ops.agg_count(layout, arg, call.frame)
+            elif fn in ("min", "max"):
+                v, valid = win_ops.agg_minmax(layout, arg, call.frame, fn == "min")
+            elif fn in ("lag", "lead"):
+                v, valid = win_ops.shifted_value(layout, arg, call.offset, fn == "lead")
+            elif fn in ("first_value", "last_value"):
+                v, valid = win_ops.edge_value(layout, arg, call.frame, fn == "first_value")
+            else:
+                raise NotImplementedError(f"window function {fn}")
+            # value-carrying functions keep the source column's dictionary
+            dictionary = None
+            if fn in ("min", "max", "lag", "lead", "first_value", "last_value"):
+                dictionary = page.columns[call.arg_channel].dictionary
+            out_cols.append(
+                Column(call.output_type, v, None if valid is None else ~valid, dictionary)
+            )
+        return Page(out_cols, page.sel, page.replicated)
+
     # -------------------------------------------------------------- joins
     def _exec_JoinNode(self, node: P.JoinNode) -> Page:
         left = self.execute(node.left)

@@ -178,6 +178,11 @@ class SpmdExecutor(Executor):
     def sorted_page(self, page: Page, sort_channels, limit=None) -> Page:
         return super().sorted_page(gather_page(page), sort_channels, limit)
 
+    def window_over_page(self, node, page: Page) -> Page:
+        # windows need whole partitions co-located; gather for now
+        # (repartition-by-partition-keys is the scalable upgrade)
+        return super().window_over_page(node, gather_page(page))
+
 
 def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
     """Enumerate splits per scan, load per-device shards, pad to a common

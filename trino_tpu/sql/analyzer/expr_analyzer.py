@@ -313,13 +313,23 @@ def _case_type(values: List[ir.Expr], default: Optional[ir.Expr]) -> T.Type:
 
 
 def find_aggregates(e: ast.Expression) -> List[ast.FunctionCall]:
-    """Collect aggregate FunctionCall subtrees (no nesting inside them)."""
+    """Collect aggregate FunctionCall subtrees (no nesting inside them).
+    Descends into window functions: ``rank() over (order by sum(x))`` uses
+    the grouped aggregate as a window input."""
     out: List[ast.FunctionCall] = []
 
     def visit(x):
         if isinstance(x, ast.FunctionCall) and x.name in AGGREGATE_FUNCTIONS:
             out.append(x)
             return  # don't descend: nested aggregates are invalid anyway
+        if isinstance(x, ast.WindowFunction):
+            for a in x.args:
+                visit(a)
+            for p in x.partition_by:
+                visit(p)
+            for s in x.order_by:
+                visit(s.expr)
+            return
         if isinstance(x, tuple):
             for y in x:
                 visit(y)
@@ -332,3 +342,41 @@ def find_aggregates(e: ast.Expression) -> List[ast.FunctionCall]:
 
     visit(e)
     return out
+
+
+WINDOW_ONLY_FUNCTIONS = {
+    "rank", "dense_rank", "row_number", "lag", "lead",
+    "first_value", "last_value",
+}
+
+
+def find_windows(e: ast.Expression) -> List[ast.WindowFunction]:
+    """Collect window-function subtrees (no window nesting)."""
+    out: List[ast.WindowFunction] = []
+
+    def visit(x):
+        if isinstance(x, ast.WindowFunction):
+            out.append(x)
+            return
+        if isinstance(x, tuple):
+            for y in x:
+                visit(y)
+            return
+        if hasattr(x, "__dataclass_fields__"):
+            for f in x.__dataclass_fields__:
+                v = getattr(x, f)
+                if isinstance(v, (ast.Expression, tuple)):
+                    visit(v)
+
+    visit(e)
+    return out
+
+
+def window_result_type(fn: str, arg: Optional[T.Type]) -> T.Type:
+    """Reference: window function signatures (window/ + ranking fns)."""
+    if fn in ("rank", "dense_rank", "row_number"):
+        return T.BIGINT
+    if fn in ("lag", "lead", "first_value", "last_value"):
+        assert arg is not None
+        return arg
+    return aggregate_result_type(fn, arg)

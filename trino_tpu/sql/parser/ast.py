@@ -67,6 +67,23 @@ class FunctionCall(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowFunction(Expression):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ... [frame]).
+
+    Reference: sql/tree/WindowSpecification + FunctionCall.window. ``frame``
+    is (mode, start_bound, end_bound) as lowercase strings, None = default
+    (RANGE UNBOUNDED PRECEDING -> CURRENT ROW when ORDER BY present, whole
+    partition otherwise)."""
+
+    name: str
+    args: Tuple[Expression, ...]
+    partition_by: Tuple[Expression, ...] = ()
+    order_by: Tuple["SortItem", ...] = ()
+    is_star: bool = False  # count(*) over (...)
+    frame: Optional[Tuple[str, str, str]] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Arithmetic(Expression):
     op: str  # + - * / %
     left: Expression

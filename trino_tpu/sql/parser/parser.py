@@ -58,6 +58,25 @@ class Parser:
         t = self.peek()
         return t.kind == "op" and t.text in ops
 
+    def at_soft(self, *kws: str, ahead: int = 0) -> bool:
+        """Non-reserved (soft) keyword test: matches ident or kw tokens —
+        OVER/PARTITION/ROWS/... stay usable as identifiers elsewhere
+        (reference: SqlBase.g4 nonReserved rule)."""
+        t = self.peek(ahead)
+        return t.kind in ("kw", "ident") and t.lower in kws
+
+    def accept_soft(self, *kws: str) -> bool:
+        if self.at_soft(*kws):
+            self.advance()
+            return True
+        return False
+
+    def expect_soft(self, kw: str) -> Token:
+        if not self.at_soft(kw):
+            t = self.peek()
+            raise ParseError(f"expected {kw!r}, got {t.text!r} at {t.pos}")
+        return self.advance()
+
     def accept_kw(self, *kws: str) -> bool:
         if self.at_kw(*kws):
             self.advance()
@@ -524,6 +543,8 @@ class Parser:
                 self.advance()  # (
                 if self.accept_op("*"):
                     self.expect_op(")")
+                    if self.at_soft("over") and self.peek(1).text == "(":
+                        return self.window_suffix(name.lower(), (), is_star=True)
                     return ast.FunctionCall(name.lower(), (), is_star=True)
                 distinct = bool(self.accept_kw("distinct"))
                 self.accept_kw("all")
@@ -533,10 +554,59 @@ class Parser:
                     while self.accept_op(","):
                         args.append(self.expr())
                 self.expect_op(")")
+                if self.at_soft("over") and self.peek(1).text == "(":
+                    if distinct:
+                        raise ParseError("DISTINCT window aggregates not supported")
+                    return self.window_suffix(name.lower(), tuple(args))
                 return ast.FunctionCall(name.lower(), tuple(args), distinct=distinct)
             parts = self.qualified_name()
             return ast.Identifier(tuple(parts))
         raise ParseError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def window_suffix(self, name, args, is_star=False) -> ast.WindowFunction:
+        """OVER ( [PARTITION BY ...] [ORDER BY ...] [frame] )"""
+        self.expect_soft("over")
+        self.expect_op("(")
+        partition_by: List[ast.Expression] = []
+        order_by: List[ast.SortItem] = []
+        frame = None
+        if self.accept_soft("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.expr())
+            while self.accept_op(","):
+                partition_by.append(self.expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by = self.sort_items()
+        if self.at_soft("rows", "range", "groups"):
+            mode = self.advance().lower
+            if self.accept_kw("between"):
+                lo = self._frame_bound()
+                self.expect_kw("and")
+                hi = self._frame_bound()
+            else:
+                lo = self._frame_bound()
+                hi = "current row"
+            frame = (mode, lo, hi)
+        self.expect_op(")")
+        return ast.WindowFunction(
+            name, args, tuple(partition_by), tuple(order_by), is_star, frame
+        )
+
+    def _frame_bound(self) -> str:
+        if self.accept_soft("unbounded"):
+            if self.accept_soft("preceding"):
+                return "unbounded preceding"
+            self.expect_soft("following")
+            return "unbounded following"
+        if self.accept_soft("current"):
+            self.expect_soft("row")
+            return "current row"
+        t = self.advance()  # numeric offset
+        if self.accept_soft("preceding"):
+            return f"{t.text} preceding"
+        self.expect_soft("following")
+        return f"{t.text} following"
 
     def case_expr(self) -> ast.Expression:
         self.expect_kw("case")

@@ -17,6 +17,7 @@ subset):
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional, Set, Tuple
 
 from trino_tpu import types as T
@@ -208,8 +209,12 @@ def push_predicates(node: P.PlanNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
         return P.ProjectNode(src, node.expressions, node.names)
     if isinstance(node, P.JoinNode):
         return _push_into_join(node, conjuncts)
-    if isinstance(node, (P.LimitNode, P.TopNNode, P.SortNode, P.AggregationNode, P.ExchangeNode)):
-        # not safe/supported to push through in round 1 — recurse with nothing
+    if isinstance(
+        node,
+        (P.LimitNode, P.TopNNode, P.SortNode, P.AggregationNode, P.ExchangeNode, P.WindowNode),
+    ):
+        # not safe/supported to push through — recurse with nothing
+        # (predicates over window outputs change which rows a window sees)
         new_sources = [push_predicates(s, []) for s in node.sources]
         node = _replace_sources(node, new_sources)
         return _wrap_filter(node, conjuncts)
@@ -465,4 +470,36 @@ def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict
         if node.partition_channels:
             node.partition_channels = [src_map[c] for c in node.partition_channels]
         return node, src_map
+    if isinstance(node, P.WindowNode):
+        w = len(node.source.output_types)
+        keep_calls = [i for i in range(len(node.calls)) if (w + i) in needed]
+        src_needed = {c for c in needed if c < w}
+        src_needed |= set(node.partition_channels)
+        src_needed |= {c for c, _, _ in node.order_channels}
+        for i in keep_calls:
+            if node.calls[i].arg_channel is not None:
+                src_needed.add(node.calls[i].arg_channel)
+        src, src_map = prune_channels(node.source, src_needed)
+        if not keep_calls:  # window outputs unused: drop the node entirely
+            return src, {c: src_map[c] for c in needed if c < w}
+        node.source = src
+        node.partition_channels = [src_map[c] for c in node.partition_channels]
+        node.order_channels = [(src_map[c], a, nf) for c, a, nf in node.order_channels]
+        node.calls = [
+            dataclasses.replace(
+                node.calls[i],
+                arg_channel=(
+                    src_map[node.calls[i].arg_channel]
+                    if node.calls[i].arg_channel is not None
+                    else None
+                ),
+            )
+            for i in keep_calls
+        ]
+        node.names = [node.names[i] for i in keep_calls]
+        new_w = len(src.output_types)
+        mapping = {c: src_map[c] for c in needed if c < w}
+        for j, i in enumerate(keep_calls):
+            mapping[w + i] = new_w + j
+        return node, mapping
     raise NotImplementedError(f"prune_channels: {type(node).__name__}")

@@ -199,6 +199,48 @@ class JoinNode(PlanNode):
         return self.left.output_names + self.right.output_names
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowCall:
+    """One window function evaluation (reference: WindowNode.Function)."""
+
+    function: str  # rank | dense_rank | row_number | sum | count | count_star
+    #              | avg | min | max | lag | lead | first_value | last_value
+    arg_channel: Optional[int]
+    output_type: T.Type = None
+    offset: int = 1  # lag/lead distance (static)
+    # 'running': RANGE UNBOUNDED PRECEDING..CURRENT ROW (peers included) —
+    # the default frame with ORDER BY; 'rows_running': ROWS ..CURRENT ROW;
+    # 'partition': whole partition (default without ORDER BY / UNBOUNDED
+    # PRECEDING..UNBOUNDED FOLLOWING)
+    frame: str = "running"
+
+
+@dataclasses.dataclass
+class WindowNode(PlanNode):
+    """Window functions over sorted partitions; output = source channels ++
+    one channel per call. Reference: plan/WindowNode.java +
+    operator/WindowOperator.java:69 (redesigned: one fused sort + streaming
+    prefix kernels instead of per-partition iteration, ops/window.py)."""
+
+    source: PlanNode = None
+    partition_channels: List[int] = None
+    order_channels: List[Tuple[int, bool, Optional[bool]]] = None  # (ch, asc, nulls_first)
+    calls: List[WindowCall] = None
+    names: List[str] = None  # names for the appended channels
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        return self.source.output_types + [c.output_type for c in self.calls]
+
+    @property
+    def output_names(self):
+        return self.source.output_names + list(self.names)
+
+
 @dataclasses.dataclass
 class SortNode(PlanNode):
     source: PlanNode = None

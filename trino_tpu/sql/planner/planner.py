@@ -21,8 +21,11 @@ from trino_tpu.sql.analyzer.expr_analyzer import (
     AGGREGATE_FUNCTIONS,
     AnalysisError,
     ExprAnalyzer,
+    WINDOW_ONLY_FUNCTIONS,
     aggregate_result_type,
     find_aggregates,
+    find_windows,
+    window_result_type,
 )
 from trino_tpu.sql.analyzer.scope import Field, Scope
 from trino_tpu.sql.parser import ast
@@ -282,28 +285,44 @@ class Planner:
         if has_aggs:
             return self._plan_aggregation(spec, query, node, scope, outer_scope, ctes)
 
-        # plain SELECT
-        select_irs, names, scope_after = self._plan_select_items(spec, scope, ctes, node)
+        # plain SELECT (window functions evaluate between FROM/WHERE and the
+        # final projection — reference: QueryPlanner.window())
+        replacements: Dict[ast.Expression, ir.Expr] = {}
+        node = self._plan_windows(spec, query, node, scope, replacements)
+        select_irs, names, scope_after = self._plan_select_items(
+            spec, scope, ctes, node, replacements
+        )
+        n_visible = len(select_irs)
+        extra_ast_to_ch = self._append_order_by_windows(
+            query, spec, select_irs, names, replacements
+        )
         node_proj = P.ProjectNode(node, select_irs, names)
-        out_fields = [Field(n, e.type, None) for n, e in zip(names, select_irs)]
+        out_fields = [
+            Field(n, e.type, None)
+            for n, e in zip(names[:n_visible], select_irs[:n_visible])
+        ]
         out_scope = Scope(out_fields, outer_scope)
         node = node_proj
         if spec.distinct:
+            if extra_ast_to_ch:
+                raise PlanningError("DISTINCT with window in ORDER BY only")
             node = P.AggregationNode(
                 node, list(range(len(select_irs))), [], step="single", names=names
             )
         if query.order_by:
             node = self._plan_order_by(
-                query, node, out_scope, replacements={}, select_asts=spec.select_items
+                query, node, out_scope, replacements=replacements,
+                select_asts=spec.select_items, extra_ast_to_ch=extra_ast_to_ch,
             )
         if query.limit is not None:
             if query.order_by and isinstance(node, P.SortNode):
                 node = P.TopNNode(node.source, query.limit, node.sort_channels)
             else:
                 node = P.LimitNode(node, query.limit)
+        node = self._drop_hidden(node, names, n_visible)
         return RelationPlan(node, out_scope)
 
-    def _plan_select_items(self, spec, scope, ctes, node):
+    def _plan_select_items(self, spec, scope, ctes, node, replacements=None):
         select_irs: List[ir.Expr] = []
         names: List[str] = []
         for si in spec.select_items:
@@ -318,11 +337,156 @@ class Planner:
                     select_irs.append(ir.ColumnRef(f.type, ch, f.name or ""))
                     names.append(f.name or f"_col{len(names)}")
                 continue
-            analyzer = ExprAnalyzer(scope)
+            analyzer = ExprAnalyzer(scope, replacements)
             e = analyzer.analyze(si.expr)
             select_irs.append(e)
             names.append(si.alias or _derive_name(si.expr) or f"_col{len(names)}")
         return select_irs, names, scope
+
+    # -------------------------------------------------------------- windows
+    def _plan_windows(self, spec, query, node, scope, replacements):
+        """Plan window functions in the SELECT list: append a WindowNode per
+        distinct (PARTITION BY, ORDER BY) spec, each adding one output
+        channel per call; post-window expressions see the calls through
+        ``replacements`` (reference: QueryPlanner.window + WindowNode)."""
+        windows: List[ast.WindowFunction] = []
+        for si in spec.select_items:
+            if not isinstance(si.expr, ast.Star):
+                for w in find_windows(si.expr):
+                    if w not in windows:
+                        windows.append(w)
+        for s in query.order_by:
+            for w in find_windows(s.expr):
+                if w not in windows:
+                    windows.append(w)
+        if not windows:
+            return node
+        if spec.where is not None and find_windows(spec.where):
+            raise PlanningError("window functions are not allowed in WHERE")
+
+        # group by identical window specification -> one WindowNode each
+        def spec_key(w: ast.WindowFunction):
+            return (w.partition_by, w.order_by)
+
+        groups: Dict[tuple, List[ast.WindowFunction]] = {}
+        for w in windows:
+            groups.setdefault(spec_key(w), []).append(w)
+
+        for (pby, oby), ws in groups.items():
+            width = len(node.output_types)
+            analyzer = ExprAnalyzer(scope, replacements)
+            # inputs: identity prefix + partition keys + order keys + args
+            extra: List[ir.Expr] = []
+            extra_names: List[str] = []
+
+            def add_input(e: ir.Expr, tag: str) -> int:
+                if isinstance(e, ir.ColumnRef) and e.index < width:
+                    return e.index
+                extra.append(e)
+                extra_names.append(f"${tag}{len(extra)}")
+                return width + len(extra) - 1
+
+            part_ch = [add_input(analyzer.analyze(p), "pk") for p in pby]
+            order_ch = [
+                (add_input(analyzer.analyze(s.expr), "ok"), s.ascending, s.nulls_first)
+                for s in oby
+            ]
+            calls: List[P.WindowCall] = []
+            call_names: List[str] = []
+            for w in ws:
+                calls.append(self._window_call(w, analyzer, add_input, bool(oby)))
+                call_names.append(w.name)
+            if extra:
+                node = P.ProjectNode.identity_prefix(node, extra, extra_names)
+            wnode = P.WindowNode(node, part_ch, order_ch, calls, call_names)
+            base = len(node.output_types)
+            for i, w in enumerate(ws):
+                replacements[w] = ir.ColumnRef(calls[i].output_type, base + i, w.name)
+            node = wnode
+        return node
+
+    def _window_call(self, w: ast.WindowFunction, analyzer, add_input, has_order) -> P.WindowCall:
+        fn = w.name
+        if fn not in WINDOW_ONLY_FUNCTIONS and fn not in AGGREGATE_FUNCTIONS:
+            raise PlanningError(f"unknown window function {fn}")
+        frame = self._window_frame(w, has_order)
+        if fn in ("rank", "dense_rank", "row_number"):
+            if not has_order:
+                raise PlanningError(f"{fn}() requires window ORDER BY")
+            if w.args:
+                raise PlanningError(f"{fn}() takes no arguments")
+            return P.WindowCall(fn, None, window_result_type(fn, None), frame=frame)
+        if fn in ("lag", "lead"):
+            if not has_order:
+                raise PlanningError(f"{fn}() requires window ORDER BY")
+            if not 1 <= len(w.args) <= 2:
+                raise PlanningError(f"{fn}(value[, offset]) supported")
+            offset = 1
+            if len(w.args) == 2:
+                off = w.args[1]
+                if not (isinstance(off, ast.Literal) and off.kind == "number"):
+                    raise PlanningError(f"{fn} offset must be a literal")
+                offset = int(off.value)
+            arg = analyzer.analyze(w.args[0])
+            ch = add_input(arg, "a")
+            return P.WindowCall(fn, ch, window_result_type(fn, arg.type), offset=offset, frame=frame)
+        if fn in ("first_value", "last_value"):
+            if len(w.args) != 1:
+                raise PlanningError(f"{fn}(value) expects 1 argument")
+            arg = analyzer.analyze(w.args[0])
+            ch = add_input(arg, "a")
+            return P.WindowCall(fn, ch, window_result_type(fn, arg.type), frame=frame)
+        # aggregates over the window
+        if w.is_star or (fn == "count" and not w.args):
+            return P.WindowCall("count", None, T.BIGINT, frame=frame)
+        if len(w.args) != 1:
+            raise PlanningError(f"{fn} window aggregate expects 1 argument")
+        if fn in ("min", "max") and frame != "partition":
+            raise PlanningError(
+                f"{fn}() with a window ORDER BY (running frame) is not supported; "
+                "omit the ORDER BY for whole-partition min/max"
+            )
+        arg = analyzer.analyze(w.args[0])
+        ch = add_input(arg, "a")
+        return P.WindowCall(fn, ch, window_result_type(fn, arg.type), frame=frame)
+
+    def _append_order_by_windows(self, query, spec, select_irs, names, replacements):
+        """Windows appearing only in ORDER BY get hidden projection channels
+        (dropped again after the sort by _drop_hidden). Returns AST->channel
+        for _plan_order_by."""
+        extra: Dict[ast.Expression, int] = {}
+        select_asts = [
+            si.expr for si in spec.select_items if not isinstance(si.expr, ast.Star)
+        ]
+        for s in query.order_by:
+            for w in find_windows(s.expr):
+                if w in replacements and w not in select_asts and w not in extra:
+                    extra[w] = len(select_irs)
+                    select_irs.append(replacements[w])
+                    names.append(f"$ob_win{len(extra)}")
+        return extra
+
+    @staticmethod
+    def _drop_hidden(node, names, n_visible):
+        if len(names) == n_visible:
+            return node
+        tys = node.output_types
+        return P.ProjectNode(
+            node,
+            [ir.ColumnRef(tys[i], i, names[i]) for i in range(n_visible)],
+            list(names[:n_visible]),
+        )
+
+    @staticmethod
+    def _window_frame(w: ast.WindowFunction, has_order: bool) -> str:
+        if w.frame is None:
+            return "running" if has_order else "partition"
+        mode, lo, hi = w.frame
+        if lo == "unbounded preceding" and hi == "unbounded following":
+            return "partition"
+        if lo == "unbounded preceding" and hi == "current row":
+            return "rows_running" if mode == "rows" else "running"
+        raise PlanningError(f"unsupported window frame {w.frame}")
 
     # ---------------------------------------------------------- aggregation
     def _plan_aggregation(self, spec, query, node, scope, outer_scope, ctes) -> RelationPlan:
@@ -414,6 +578,9 @@ class Planner:
             if plain_having:
                 node = P.FilterNode(node, combine_conjuncts(plain_having))
 
+        # windows over the aggregation output (rank() over (order by sum(x)))
+        node = self._plan_windows(spec, query, node, agg_scope, replacements)
+
         select_irs: List[ir.Expr] = []
         names: List[str] = []
         for si in spec.select_items:
@@ -422,12 +589,21 @@ class Planner:
             e = ExprAnalyzer(agg_scope, replacements).analyze(si.expr)
             select_irs.append(e)
             names.append(si.alias or _derive_name(si.expr) or f"_col{len(names)}")
+        n_visible = len(select_irs)
+        extra_ast_to_ch = self._append_order_by_windows(
+            query, spec, select_irs, names, replacements
+        )
         proj = P.ProjectNode(node, select_irs, names)
-        out_fields = [Field(n, e.type, None) for n, e in zip(names, select_irs)]
+        out_fields = [
+            Field(n, e.type, None)
+            for n, e in zip(names[:n_visible], select_irs[:n_visible])
+        ]
         out_scope = Scope(out_fields, outer_scope)
         node = proj
 
         if spec.distinct:
+            if extra_ast_to_ch:
+                raise PlanningError("DISTINCT with window in ORDER BY only")
             node = P.AggregationNode(
                 node, list(range(len(select_irs))), [], step="single", names=names
             )
@@ -435,23 +611,26 @@ class Planner:
             node = self._plan_order_by(
                 query, node, out_scope,
                 replacements=replacements, select_asts=spec.select_items,
-                inner_scope=agg_scope,
+                inner_scope=agg_scope, extra_ast_to_ch=extra_ast_to_ch,
             )
         if query.limit is not None:
             if isinstance(node, P.SortNode):
                 node = P.TopNNode(node.source, query.limit, node.sort_channels)
             else:
                 node = P.LimitNode(node, query.limit)
+        node = self._drop_hidden(node, names, n_visible)
         return RelationPlan(node, out_scope)
 
     def _plan_order_by(
-        self, query, node, out_scope, replacements, select_asts, inner_scope=None
+        self, query, node, out_scope, replacements, select_asts,
+        inner_scope=None, extra_ast_to_ch=None,
     ):
         """ORDER BY resolves against select aliases/ordinals first, then the
-        select expressions themselves (by structure)."""
+        select expressions themselves (by structure). ``extra_ast_to_ch``
+        maps hidden projection channels (windows only in ORDER BY)."""
         sort_channels = []
         alias_to_ch = {}
-        ast_to_ch = {}
+        ast_to_ch = dict(extra_ast_to_ch or {})
         for i, si in enumerate(select_asts):
             if isinstance(si, ast.SelectItem):
                 if si.alias:
