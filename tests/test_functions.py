@@ -1,0 +1,197 @@
+"""Aggregate/scalar function breadth tests (VERDICT round-1 item 6).
+
+Oracles: Python ``statistics`` for the variance family, ``math`` for scalar
+math, exact set counting for approx_distinct, and Python Decimal bigints for
+the int128 long-decimal arithmetic path (reference: Int128Math.java).
+"""
+import math
+import statistics
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from trino_tpu import Session
+from trino_tpu import types as T
+from trino_tpu.exec.executor import QueryError
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = Session()
+    rng = np.random.default_rng(13)
+    rows = []
+    for i in range(500):
+        g = int(rng.integers(0, 4))
+        x = float(rng.normal(100.0, 15.0))
+        rows.append((i, g, x, int(rng.integers(0, 40))))
+    s.catalogs["memory"].create_table(
+        "t", "samples",
+        [("id", T.BIGINT), ("g", T.BIGINT), ("x", T.DOUBLE), ("k", T.BIGINT)],
+        rows,
+    )
+    s._rows = rows
+    return s
+
+
+def test_variance_family(session):
+    got = session.execute(
+        """select g, var_samp(x), var_pop(x), stddev_samp(x), stddev_pop(x),
+                  variance(x), stddev(x)
+           from memory.t.samples group by g order by g"""
+    ).rows
+    by_g = {}
+    for _, g, x, _k in session._rows:
+        by_g.setdefault(g, []).append(x)
+    for row in got:
+        xs = by_g[row[0]]
+        want = (
+            statistics.variance(xs), statistics.pvariance(xs),
+            statistics.stdev(xs), statistics.pstdev(xs),
+            statistics.variance(xs), statistics.stdev(xs),
+        )
+        for gv, wv in zip(row[1:], want):
+            assert gv == pytest.approx(wv, rel=1e-9), (row[0], gv, wv)
+
+
+def test_variance_distributed(session):
+    import jax
+    from jax.sharding import Mesh
+
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.parallel.spmd import DistributedQuery
+
+    sql = "select g, stddev(x), var_pop(x) from memory.t.samples group by g order by g"
+    expected = session.execute(sql).rows
+    mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+    got = DistributedQuery.build(session, plan_sql(session, sql), mesh).run().to_pylist()
+    for e, g in zip(expected, got):
+        assert g[0] == e[0]
+        assert g[1] == pytest.approx(e[1], rel=1e-9)
+        assert g[2] == pytest.approx(e[2], rel=1e-9)
+
+
+def test_approx_distinct_exact(session):
+    got = session.execute(
+        "select g, approx_distinct(k) from memory.t.samples group by g order by g"
+    ).rows
+    by_g = {}
+    for _, g, _x, k in session._rows:
+        by_g.setdefault(g, set()).add(k)
+    assert got == [(g, len(ks)) for g, ks in sorted(by_g.items())]
+
+
+def test_scalar_math(session):
+    (row,) = session.execute(
+        """select sqrt(2.25e0), ln(exp(2e0)), log10(1000e0), power(2e0, 10),
+                  sign(-5), sign(0.0), ceil(2.1e0), floor(-2.1e0),
+                  round(2.5e0), round(-2.5e0), round(3.14159e0, 2),
+                  greatest(1, 7, 3), least(4, 2, 9)
+           from memory.t.samples limit 1"""
+    ).rows
+    assert row == (
+        1.5, 2.0, 3.0, 1024.0, -1, 0.0, 3.0, -3.0, 3.0, -3.0, 3.14, 7, 2,
+    )
+
+
+def test_decimal_round_ceil_floor(session):
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "d",
+        [("v", T.decimal(10, 2))],
+        [(Decimal("12.34"),), (Decimal("-12.56"),), (Decimal("2.50"),)],
+    )
+    got = s.execute(
+        "select v, round(v), round(v, 1), ceil(v), floor(v) from memory.t.d order by v"
+    ).rows
+    assert got == [
+        (Decimal("-12.56"), Decimal("-13.00"), Decimal("-12.60"), Decimal("-12.00"), Decimal("-13.00")),
+        (Decimal("2.50"), Decimal("3.00"), Decimal("2.50"), Decimal("3.00"), Decimal("2.00")),
+        (Decimal("12.34"), Decimal("12.00"), Decimal("12.30"), Decimal("13.00"), Decimal("12.00")),
+    ]
+
+
+def test_long_decimal_int128_arithmetic():
+    """Division scales the numerator up by 10^(rs-sa+sb) — far past int64
+    for long decimals — so the quotient must come through the int128 limb
+    path exactly (a naive int64 numerator silently wraps)."""
+    import decimal as pydec
+
+    s = Session()
+    a = Decimal("123456789012345.12")  # decimal(17,2): int 1.2e16
+    b = Decimal("1234.567890")  # decimal(12,6)
+    s.catalogs["memory"].create_table(
+        "t", "big",
+        [("a", T.decimal(17, 2)), ("b", T.decimal(12, 6))],
+        [(a, b)],
+    )
+    # numerator = a_int * 10^10 ~ 1.2e26 (wraps int64); quotient ~ 1.25e8
+    (row,) = s.execute("select a / b from memory.t.big").rows
+    with pydec.localcontext() as c:
+        c.prec = 50
+        c.rounding = pydec.ROUND_HALF_UP
+        want = (a / b).quantize(Decimal("0.000001"))
+    assert row[0] == want
+    # long-decimal product that fits at rest stays exact
+    (row,) = s.execute("select b * b from memory.t.big").rows
+    assert row[0] == (b * b).quantize(Decimal("0.000000000001"))
+
+
+def test_decimal_overflow_raises():
+    s = Session()
+    big = Decimal("9" * 18)  # 18 nines, scale 0
+    s.catalogs["memory"].create_table(
+        "t", "ovf", [("a", T.decimal(18, 0)), ("b", T.decimal(18, 0))], [(big, big)]
+    )
+    with pytest.raises(QueryError) as ei:
+        s.execute("select a * b from memory.t.ovf")
+    assert "overflow" in str(ei.value).lower()
+
+
+def test_greatest_least_null_propagation():
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "gl", [("a", T.BIGINT), ("b", T.BIGINT)], [(1, 2), (3, None)]
+    )
+    got = s.execute(
+        "select a, greatest(a, b), least(a, b) from memory.t.gl order by a"
+    ).rows
+    assert got == [(1, 2, 1), (3, None, None)]
+
+
+def test_variance_on_decimal_uses_magnitude():
+    s = Session()
+    vals = [Decimal("10.00"), Decimal("20.00"), Decimal("40.00")]
+    s.catalogs["memory"].create_table(
+        "t", "dv", [("v", T.decimal(10, 2))], [(v,) for v in vals]
+    )
+    (row,) = s.execute("select stddev_pop(v), var_pop(v) from memory.t.dv").rows
+    xs = [float(v) for v in vals]
+    assert row[0] == pytest.approx(statistics.pstdev(xs), rel=1e-12)
+    assert row[1] == pytest.approx(statistics.pvariance(xs), rel=1e-12)
+
+
+def test_log_two_arg_and_round_negative_digits():
+    s = Session()
+    s.catalogs["memory"].create_table("t", "one", [("x", T.BIGINT)], [(1,)])
+    (row,) = s.execute(
+        "select log(2.0e0, 64.0e0), round(1234, -2), round(-1250, -2) from memory.t.one"
+    ).rows
+    assert row == (6.0, 1200, -1300)
+
+
+def test_greatest_least_varchar_dictionaries():
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "sv",
+        [("a", T.VARCHAR), ("b", T.VARCHAR)],
+        [("apple", "zebra"), ("pear", "banana"), ("kiwi", "kiwi")],
+    )
+    got = s.execute(
+        "select a, greatest(a, b), least(a, b) from memory.t.sv order by a"
+    ).rows
+    assert got == [
+        ("apple", "zebra", "apple"),
+        ("kiwi", "kiwi", "kiwi"),
+        ("pear", "pear", "banana"),
+    ]

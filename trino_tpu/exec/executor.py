@@ -257,6 +257,12 @@ class Executor:
             return [agg_ops.agg_min(layout, arg, sel)]
         if call.function == "max":
             return [agg_ops.agg_max(layout, arg, sel)]
+        if call.function in P._VAR_FAMILY:
+            t = page.columns[call.arg_channel].type
+            s1, s2, cnt = agg_ops.var_states(
+                layout, arg, sel, t.scale if t.is_decimal else 0
+            )
+            return [(s1, None), (s2, None), (cnt, None)]
         raise NotImplementedError(call.function)
 
     def _combine_state(self, call: P.AggregateCall, states: List[Column], sel, layout) -> Column:
@@ -286,6 +292,12 @@ class Executor:
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         if call.function == "max":
             v, valid = agg_ops.agg_max(layout, as_arg(states[0]), sel)
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        if call.function in P._VAR_FAMILY:
+            s1, _ = agg_ops.agg_sum(layout, as_arg(states[0]), sel, np.dtype(np.float64))
+            s2, _ = agg_ops.agg_sum(layout, as_arg(states[1]), sel, np.dtype(np.float64))
+            cnt, _ = agg_ops.agg_sum(layout, as_arg(states[2]), sel, np.dtype(np.int64))
+            v, valid = agg_ops.finish_var(s1, s2, cnt, call.function)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         raise NotImplementedError(call.function)
 
@@ -386,8 +398,11 @@ class Executor:
 
     def _exec_aggregate(self, call: P.AggregateCall, page, sel, layout):
         if call.distinct:
-            if call.function != "count":
+            if call.function not in ("count", "approx_distinct"):
                 raise NotImplementedError(f"{call.function}(DISTINCT): round 2")
+            # approx_distinct is computed EXACTLY here (the reference uses
+            # HyperLogLog, spi/.../aggregation ApproximateCountDistinct;
+            # exact distinct is a strictly more accurate answer)
             arg = _col_to_lowered(page.columns[call.arg_channel])
             return agg_ops.agg_count_distinct(layout, arg, sel)
         if call.function == "count" and call.arg_channel is None:
@@ -410,6 +425,11 @@ class Executor:
             return agg_ops.agg_min(layout, arg, sel)
         if call.function == "max":
             return agg_ops.agg_max(layout, arg, sel)
+        if call.function in P._VAR_FAMILY:
+            t = page.columns[call.arg_channel].type
+            return agg_ops.agg_var(
+                layout, arg, sel, call.function, t.scale if t.is_decimal else 0
+            )
         raise NotImplementedError(call.function)
 
     # -------------------------------------------------------------- window

@@ -78,6 +78,42 @@ def agg_count_distinct(layout: GroupLayout, arg: Lowered, sel):
     return cnt, None
 
 
+def var_states(layout: GroupLayout, arg: Lowered, sel, scale: int):
+    """(sum, sum_sq, count) running state for the variance family, as
+    doubles. ``scale`` is the decimal scale of the argument (0 for
+    ints/floats) — values convert to their numeric magnitude first."""
+    vals, valid = arg
+    m = _live(sel, valid)
+    x = vals.astype(jnp.float64)
+    if scale:
+        x = x / (10.0 ** scale)
+    s1 = seg.seg_sum(layout, x, m, jnp.float64)
+    s2 = seg.seg_sum(layout, x * x, m, jnp.float64)
+    cnt = seg.seg_count(layout, m)
+    return s1, s2, cnt
+
+
+def agg_var(layout: GroupLayout, arg: Lowered, sel, kind: str, scale: int = 0):
+    """Variance/stddev family (reference: the VarianceState accumulators of
+    AggregationUtils); the finisher applies the pop/samp denominator/sqrt."""
+    s1, s2, cnt = var_states(layout, arg, sel, scale)
+    return finish_var(s1, s2, cnt, kind)
+
+
+def finish_var(s1, s2, cnt, kind: str):
+    """(value, valid) from (sum, sum_sq, count) running state."""
+    n = cnt.astype(jnp.float64)
+    safe_n = jnp.maximum(n, 1.0)
+    mean = s1 / safe_n
+    m2 = jnp.maximum(s2 - s1 * mean, 0.0)  # clamp fp negatives
+    pop = kind.endswith("_pop")
+    denom = safe_n if pop else jnp.maximum(n - 1.0, 1.0)
+    var = m2 / denom
+    out = jnp.sqrt(var) if kind.startswith("stddev") else var
+    valid = (cnt >= 1) if pop else (cnt >= 2)
+    return out, valid
+
+
 def agg_min(layout: GroupLayout, arg: Lowered, sel):
     return _agg_minmax(layout, arg, sel, is_min=True)
 

@@ -35,6 +35,7 @@ from trino_tpu.ops import datetime_ops as dt
 from trino_tpu.sql import ir
 
 DIVISION_BY_ZERO = "DIVISION_BY_ZERO"
+DECIMAL_OVERFLOW = "DECIMAL_OVERFLOW"
 NUMERIC_OVERFLOW = "NUMERIC_VALUE_OUT_OF_RANGE"
 
 
@@ -176,6 +177,22 @@ def _scale_of(t: T.Type) -> int:
     return t.scale if isinstance(t, T.DecimalType) else 0
 
 
+def _prec_of(t: T.Type) -> int:
+    if isinstance(t, T.DecimalType):
+        return t.precision
+    return {"tinyint": 3, "smallint": 5, "integer": 10}.get(t.name, 19)
+
+
+def _narrow128(ctx, out128, valid):
+    """int128 -> int64 storage; flags DECIMAL_OVERFLOW where it can't fit
+    (reference throws past p=38; long-decimal storage here is int64-wide,
+    see ops/int128.py)."""
+    from trino_tpu.ops import int128 as i128
+
+    ctx.add_error(DECIMAL_OVERFLOW, ~i128.fits_int64(out128), valid)
+    return i128.to_int64(out128)
+
+
 def _arith(name: str):
     def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
         a = lower(expr.args[0], ctx)
@@ -184,20 +201,54 @@ def _arith(name: str):
         valid = and_valid(a.valid, b.valid)
         av, bv = a.vals, b.vals
         if rt.is_decimal and not (at.is_floating or bt.is_floating):
+            from trino_tpu.ops import int128 as i128
+
             rs = _scale_of(rt)
             sa, sb = _scale_of(at), _scale_of(bt)
+            pa, pb = _prec_of(at), _prec_of(bt)
             if name in ("add", "sub"):
-                av = _rescale_decimal(av.astype(jnp.int64), sa, rs)
-                bv = _rescale_decimal(bv.astype(jnp.int64), sb, rs)
-                out = av + bv if name == "add" else av - bv
+                # int128 path when a rescaled operand or the result can
+                # exceed 18 digits (reference: Int128Math add/subtract)
+                if max(pa + (rs - sa), pb + (rs - sb)) > 18:
+                    a128, ova = i128.rescale_checked(i128.from_int64(av.astype(jnp.int64)), sa, rs)
+                    b128, ovb = i128.rescale_checked(i128.from_int64(bv.astype(jnp.int64)), sb, rs)
+                    ctx.add_error(DECIMAL_OVERFLOW, ova | ovb, valid)
+                    out128 = i128.add(a128, b128) if name == "add" else i128.sub(a128, b128)
+                    out = _narrow128(ctx, out128, valid)
+                else:
+                    av = _rescale_decimal(av.astype(jnp.int64), sa, rs)
+                    bv = _rescale_decimal(bv.astype(jnp.int64), sb, rs)
+                    out = av + bv if name == "add" else av - bv
             elif name == "mul":
-                out = _rescale_decimal(av.astype(jnp.int64) * bv.astype(jnp.int64), sa + sb, rs)
+                if pa + pb + 1 > 18:
+                    # full 128-bit product, rescale half-up, narrow + flag
+                    prod = i128.mul_int64(av.astype(jnp.int64), bv.astype(jnp.int64))
+                    out = _narrow128(ctx, i128.rescale(prod, sa + sb, rs), valid)
+                else:
+                    out = _rescale_decimal(av.astype(jnp.int64) * bv.astype(jnp.int64), sa + sb, rs)
             elif name == "div":
                 ctx.add_error(DIVISION_BY_ZERO, bv == 0, valid)
-                num = av.astype(jnp.int64) * (10 ** (rs - sa + sb))
-                den = jnp.where(bv == 0, 1, bv.astype(jnp.int64))
-                q = jnp.floor_divide(jnp.abs(num) + jnp.abs(den) // 2, jnp.abs(den))
-                out = jnp.sign(num) * jnp.sign(den) * q
+                shift = rs - sa + sb
+                den64 = jnp.where(bv == 0, 1, bv.astype(jnp.int64))
+                if pa + shift > 18:
+                    # 128-bit numerator / 64-bit divisor, half-up
+                    num128, ovn = i128.rescale_checked(
+                        i128.from_int64(av.astype(jnp.int64)), 0, shift
+                    )
+                    ctx.add_error(DECIMAL_OVERFLOW, ovn, valid)
+                    (nhi, nlo), nneg = i128.abs128(num128)
+                    dabs = jnp.abs(den64).astype(jnp.uint64)
+                    q, r = i128.divmod_u64_arr((nhi, nlo), dabs)
+                    up = r * 2 >= dabs
+                    q = i128.add(q, (jnp.zeros_like(q[0]), up.astype(jnp.int64)))
+                    negq = i128.neg(q)
+                    flip = nneg ^ (den64 < 0)
+                    out128 = (jnp.where(flip, negq[0], q[0]), jnp.where(flip, negq[1], q[1]))
+                    out = _narrow128(ctx, out128, valid)
+                else:
+                    num = av.astype(jnp.int64) * (10 ** shift)
+                    q = jnp.floor_divide(jnp.abs(num) + jnp.abs(den64) // 2, jnp.abs(den64))
+                    out = jnp.sign(num) * jnp.sign(den64) * q
             elif name == "mod":
                 s = max(sa, sb)
                 av = _rescale_decimal(av.astype(jnp.int64), sa, s)
@@ -470,6 +521,133 @@ def _lower_date_add_months(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     return LoweredVal(out, and_valid(a.valid, n.valid), None)
 
 
+def _arg_double(ctx: LowerCtx, arg: ir.Expr) -> LoweredVal:
+    a = lower(arg, ctx)
+    t = arg.type
+    v = a.vals.astype(jnp.float64)
+    if t.is_decimal:
+        v = v / (10.0 ** t.scale)
+    return LoweredVal(v, a.valid, None)
+
+
+def _lower_math1(op):
+    """Unary double math (sqrt/ln/exp/...): decimal args convert through
+    their scale; domain violations produce NaN/inf like the reference's
+    double semantics."""
+
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = _arg_double(ctx, expr.args[0])
+        return LoweredVal(op(a.vals), a.valid, None)
+
+    return fn
+
+
+def _lower_log_b(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """log(base, x) — reference MathFunctions.log(double, double)."""
+    b = _arg_double(ctx, expr.args[0])
+    x = _arg_double(ctx, expr.args[1])
+    return LoweredVal(
+        jnp.log(x.vals) / jnp.log(b.vals), and_valid(b.valid, x.valid), None
+    )
+
+
+def _lower_power(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = _arg_double(ctx, expr.args[0])
+    b = _arg_double(ctx, expr.args[1])
+    return LoweredVal(jnp.power(a.vals, b.vals), and_valid(a.valid, b.valid), None)
+
+
+def _lower_sign(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    t = expr.args[0].type
+    if t.is_floating:
+        return LoweredVal(jnp.sign(a.vals.astype(jnp.float64)), a.valid, None)
+    return LoweredVal(jnp.sign(a.vals).astype(jnp.int64), a.valid, None)
+
+
+def _round_half_away(x: jnp.ndarray, factor) -> jnp.ndarray:
+    """Round to ``d`` decimal places, half away from zero (reference:
+    MathFunctions.round double semantics)."""
+    scaled = x * factor
+    return jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5) / factor
+
+
+def _lower_round(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    t = expr.args[0].type
+    d = 0
+    if len(expr.args) > 1:
+        dc = expr.args[1]
+        if not isinstance(dc, ir.Constant):
+            raise NotImplementedError("round() digits must be a literal")
+        d = int(dc.value)
+    if t.is_floating:
+        return LoweredVal(_round_half_away(a.vals.astype(jnp.float64), 10.0 ** d), a.valid, None)
+    if t.is_decimal:
+        s_ = t.scale
+        if d >= s_:
+            return a
+        div = 10 ** (s_ - d)
+        v = a.vals.astype(jnp.int64)
+        q = jnp.sign(v) * jnp.floor_divide(jnp.abs(v) + div // 2, div)
+        return LoweredVal(q * div, a.valid, None)
+    if d >= 0:
+        return a  # integers: already whole
+    div = 10 ** (-d)  # round(1234, -2) = 1200, half away from zero
+    v = a.vals.astype(jnp.int64)
+    q = jnp.sign(v) * jnp.floor_divide(jnp.abs(v) + div // 2, div)
+    return LoweredVal((q * div).astype(a.vals.dtype), a.valid, None)
+
+
+def _lower_ceil_floor(is_ceil: bool):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = lower(expr.args[0], ctx)
+        t = expr.args[0].type
+        if t.is_floating:
+            op = jnp.ceil if is_ceil else jnp.floor
+            return LoweredVal(op(a.vals.astype(jnp.float64)), a.valid, None)
+        if t.is_decimal and t.scale > 0:
+            div = 10 ** t.scale
+            v = a.vals.astype(jnp.int64)
+            if is_ceil:
+                q = -jnp.floor_divide(-v, div)
+            else:
+                q = jnp.floor_divide(v, div)
+            return LoweredVal(q * div, a.valid, None)
+        return a
+
+    return fn
+
+
+def _lower_extremum(is_greatest: bool):
+    """greatest/least: NULL if ANY argument is NULL (reference semantics).
+    Varchar operands align onto one merged dictionary first (codes are
+    order-consistent because dictionaries are sorted, data/dictionary.py)."""
+
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        parts = [lower(a, ctx) for a in expr.args]
+        op = jnp.maximum if is_greatest else jnp.minimum
+        if expr.type.is_varchar:
+            acc = parts[0]
+            for p in parts[1:]:
+                av, bv = _align_varchar(acc, p)
+                merged = (
+                    acc.dictionary
+                    if acc.dictionary.values == p.dictionary.values
+                    else acc.dictionary.merge(p.dictionary)
+                )
+                acc = LoweredVal(op(av, bv), and_valid(acc.valid, p.valid), merged)
+            return acc
+        out = parts[0].vals
+        valid = parts[0].valid
+        for p in parts[1:]:
+            out = op(out, p.vals)
+            valid = and_valid(valid, p.valid)
+        return LoweredVal(out, valid, None)
+
+    return fn
+
+
 def _lower_negate(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     a = lower(expr.args[0], ctx)
     return LoweredVal(-a.vals, a.valid, None)
@@ -545,6 +723,8 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
     if tt == T.DATE and ft.is_varchar:
         raise NotImplementedError("cast(varchar as date) lowering: round 2")
     if tt.is_varchar:
+        if ft.is_varchar:  # varchar(n) <-> varchar: same codes/dictionary
+            return LoweredVal(a.vals, a.valid, a.dictionary)
         raise NotImplementedError("cast to varchar lowering: round 2")
     return LoweredVal(a.vals.astype(tt.np_dtype), a.valid, a.dictionary)
 
@@ -580,6 +760,21 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "rtrim": _lower_str_fn(str.rstrip),
     "length": _lower_length,
     "concat": _lower_concat,
+    "sqrt": _lower_math1(jnp.sqrt),
+    "cbrt": _lower_math1(jnp.cbrt),
+    "ln": _lower_math1(jnp.log),
+    "log_b": _lower_log_b,
+    "log2": _lower_math1(jnp.log2),
+    "log10": _lower_math1(jnp.log10),
+    "exp": _lower_math1(jnp.exp),
+    "power": _lower_power,
+    "sign": _lower_sign,
+    "round": _lower_round,
+    "ceil": _lower_ceil_floor(True),
+    "ceiling": _lower_ceil_floor(True),
+    "floor": _lower_ceil_floor(False),
+    "greatest": _lower_extremum(True),
+    "least": _lower_extremum(False),
     "extract_year": _lower_extract("year"),
     "extract_month": _lower_extract("month"),
     "extract_day": _lower_extract("day"),

@@ -16,7 +16,12 @@ from trino_tpu.sql import ir
 from trino_tpu.sql.analyzer.scope import AnalysisError, Scope
 from trino_tpu.sql.parser import ast
 
-AGGREGATE_FUNCTIONS = {"count", "sum", "avg", "min", "max"}
+AGGREGATE_FUNCTIONS = {
+    "count", "sum", "avg", "min", "max",
+    "stddev", "stddev_samp", "stddev_pop",
+    "variance", "var_samp", "var_pop",
+    "approx_distinct",
+}
 
 _MONTH_UNITS = {"year": 12, "month": 1}
 _DAY_UNITS = {"day": 1}
@@ -107,6 +112,12 @@ def aggregate_result_type(fn: str, arg: Optional[T.Type]) -> T.Type:
         return T.DOUBLE
     if fn in ("min", "max"):
         return arg
+    if fn in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
+        if not arg.is_numeric:
+            raise AnalysisError(f"{fn}() not defined for {arg}")
+        return T.DOUBLE
+    if fn == "approx_distinct":
+        return T.BIGINT
     raise AnalysisError(f"unknown aggregate {fn}")
 
 
@@ -286,8 +297,28 @@ class ExprAnalyzer:
             return ir.Call(T.BIGINT, "length", args)
         if name in ("round", "ceil", "ceiling", "floor"):
             return ir.Call(args[0].type if args[0].type.is_decimal else T.DOUBLE if args[0].type.is_floating else T.BIGINT, name, args)
-        if name in ("sqrt", "ln", "log", "exp", "power", "pow"):
+        if name in ("sqrt", "cbrt", "ln", "log2", "log10", "exp"):
+            if len(args) != 1:
+                raise AnalysisError(f"{name}() expects 1 argument")
             return ir.Call(T.DOUBLE, name, args)
+        if name == "log":
+            if len(args) != 2:
+                raise AnalysisError("log(base, x) expects 2 arguments")
+            return ir.Call(T.DOUBLE, "log_b", args)
+        if name in ("power", "pow"):
+            return ir.Call(T.DOUBLE, "power", args)
+        if name == "sign":
+            t = args[0].type
+            return ir.Call(T.DOUBLE if t.is_floating else T.BIGINT, "sign", args)
+        if name in ("greatest", "least"):
+            t = args[0].type
+            for a in args[1:]:
+                t2 = T.common_super_type(t, a.type)
+                if t2 is None:
+                    raise AnalysisError(f"{name} operands are incompatible")
+                t = t2
+            args = tuple(ir.Cast(t, a) if a.type != t else a for a in args)
+            return ir.Call(t, name, args)
         if name == "year":
             return ir.Call(T.BIGINT, "extract_year", args)
         if name == "month":

@@ -104,11 +104,18 @@ class ProjectNode(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class AggregateCall:
-    function: str  # count | count_star | sum | avg | min | max
+    function: str  # count | count_star | sum | avg | min | max | stddev* | var* | approx_distinct
     arg_channel: Optional[int]  # None for count(*)
     output_type: T.Type
     distinct: bool = False
     # count(*) counts rows; count(x) counts non-null x
+
+    def __post_init__(self):
+        # approx_distinct counts distinct non-null values: it shares the
+        # cannot-split-partial/final property of DISTINCT aggregates, so the
+        # flag is forced here (every construction site included)
+        if self.function == "approx_distinct" and not self.distinct:
+            object.__setattr__(self, "distinct", True)
 
 
 @dataclasses.dataclass
@@ -153,6 +160,10 @@ def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
         # running (sum, count)
         base = src_types[agg.arg_channel]
         out = [T.DOUBLE if base.is_floating else base, T.BIGINT]
+    elif agg.function in _VAR_FAMILY:
+        # running (sum, sum of squares, count) in double — the reference's
+        # VarianceState (mean/m2/count) reshaped for streaming combination
+        out = [T.DOUBLE, T.DOUBLE, T.BIGINT]
     elif agg.function in ("min", "max", "sum"):
         out = [agg.output_type if agg.function == "sum" else src_types[agg.arg_channel]]
     else:
@@ -161,8 +172,13 @@ def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
     return out
 
 
+_VAR_FAMILY = {"stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"}
+
+
 def _acc_state_count(agg: AggregateCall) -> int:
     """Number of accumulator state columns an aggregate ships partial->final."""
+    if agg.function in _VAR_FAMILY:
+        return 3
     return 2 if agg.function == "avg" else 1
 
 

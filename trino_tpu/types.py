@@ -10,9 +10,10 @@ to a fixed-width device representation (TPUs want fixed-width):
 - REAL/DOUBLE        -> float32/float64
 - DATE               -> int32 (days since 1970-01-01)
 - TIMESTAMP(6)       -> int64 (microseconds since epoch)
-- DECIMAL(p<=18, s)  -> int64 scaled by 10**s  (reference: short decimal;
-                        long decimal Int128 is emulated with 2x int64 limbs
-                        in ops/int128.py when p > 18)
+- DECIMAL(p, s)      -> int64 scaled by 10**s at rest; p > 18 arithmetic
+                        intermediates run on 2x int64 limbs (ops/int128.py,
+                        reference Int128Math.java) and overflow past int64
+                        raises DECIMAL_OVERFLOW (see decimal() below)
 - VARCHAR/CHAR       -> int32 dictionary codes; the dictionary (the actual
                         UTF-8 strings) lives host-side (data/dictionary.py).
                         TPUs excel at fixed width; strings are dictionary-first
@@ -88,9 +89,13 @@ class DecimalType(Type):
 def decimal(precision: int, scale: int) -> DecimalType:
     if not 1 <= precision <= 38:
         raise ValueError(f"decimal precision out of range: {precision}")
-    # p <= 18: scaled int64 ("short decimal"). p > 18: still int64 limbs here;
-    # full Int128 limb arithmetic (reference Int128Math.java) lives in
-    # ops/int128.py and is engaged by the expression lowering when needed.
+    # Storage is a scaled int64 for every precision. For p > 18 the
+    # expression lowering routes arithmetic whose INTERMEDIATES can exceed
+    # 64 bits (products, rescaled operands/numerators) through the int128
+    # limb kernels in ops/int128.py (reference: Int128Math.java), then
+    # narrows back; a long-decimal RESULT beyond int64 range raises the
+    # deferred DECIMAL_OVERFLOW error rather than wrapping. So the practical
+    # long-decimal value range at rest is |v| < 2^63 at the result scale.
     return DecimalType(
         name=f"decimal({precision},{scale})",
         np_dtype=np.dtype(np.int64),
