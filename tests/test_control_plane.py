@@ -1,0 +1,218 @@
+"""Control-plane tests: coordinator + workers over real HTTP.
+
+Mirrors the reference's DistributedQueryRunner pattern (SURVEY.md §4):
+multiple servers booted in one process with real HTTP between them; plus one
+true multi-process test (coordinator + 2 worker subprocesses) proving the
+process boundary (VERDICT.md round-1 item 7).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from trino_tpu.client.session import Session
+from trino_tpu.server.buffer import OutputBuffer
+from trino_tpu.server.coordinator import CoordinatorServer
+from trino_tpu.server.statemachine import StateMachine
+from trino_tpu.server.worker import WorkerServer
+
+
+# ---------------------------------------------------------------- unit tier
+def test_state_machine_terminal_latch():
+    sm = StateMachine("QUEUED", {"FINISHED", "FAILED"})
+    seen = []
+    sm.add_listener(seen.append)
+    assert sm.set("RUNNING")
+    assert sm.set("FINISHED")
+    assert not sm.set("FAILED")  # terminal latched
+    assert sm.get() == "FINISHED"
+    assert seen == ["QUEUED", "RUNNING", "FINISHED"]
+
+
+def test_output_buffer_token_protocol():
+    buf = OutputBuffer()
+    buf.enqueue(b"p0")
+    buf.enqueue(b"p1")
+    pages, nxt, complete, fail = buf.poll(0, timeout=0)
+    assert pages == [b"p0", b"p1"] and nxt == 2 and not complete
+    # re-read of un-acked token: at-least-once redelivery
+    pages2, _, _, _ = buf.poll(0, timeout=0)
+    assert pages2 == [b"p0", b"p1"]
+    buf.enqueue(b"p2")
+    buf.set_complete()
+    pages3, nxt3, complete3, _ = buf.poll(2, timeout=0)
+    assert pages3 == [b"p2"] and nxt3 == 3 and complete3
+    # ack of everything: delivered prefix dropped
+    _, _, complete4, _ = buf.poll(3, timeout=0)
+    assert complete4
+    with pytest.raises(ValueError):
+        buf.poll(1, timeout=0)  # already acknowledged
+
+
+def test_output_buffer_multi_consumer():
+    """Broadcast buffers: each consumer has its own ack watermark; pages
+    survive until EVERY declared consumer has acknowledged them."""
+    buf = OutputBuffer(consumer_count=2)
+    buf.enqueue(b"p0")
+    buf.enqueue(b"p1")
+    buf.set_complete()
+    pages_a, nxt_a, complete_a, _ = buf.poll(0, buffer_id=0, timeout=0)
+    assert pages_a == [b"p0", b"p1"] and complete_a  # stream ends here
+    _, _, done_a, _ = buf.poll(nxt_a, buffer_id=0, timeout=0)
+    assert done_a
+    # consumer 0 fully acked — consumer 1 must still see everything
+    pages_b, nxt_b, _, _ = buf.poll(0, buffer_id=1, timeout=0)
+    assert pages_b == [b"p0", b"p1"]
+    buf.destroy_consumer(1)
+    assert buf.buffered_bytes == 0  # all consumers done -> GC'd
+
+
+# --------------------------------------------- in-process multi-node tier
+@pytest.fixture(scope="module")
+def cluster():
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [
+        WorkerServer(coordinator_url=coord.base_url, node_id=f"w{i}")
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    assert coord.registry.wait_for_workers(2, timeout=15.0)
+    yield coord, workers
+    for w in workers:
+        w.stop()
+    coord.stop()
+
+
+def _run(coord, sql, props=None):
+    from trino_tpu.client.remote import StatementClient
+
+    client = StatementClient(coord.base_url, props or {"catalog": "tpch", "schema": "tiny"})
+    return client.execute(sql)
+
+
+def test_distributed_q1_matches_local(cluster):
+    coord, _ = cluster
+    sql = """
+        select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+               avg(l_extendedprice) as avg_price, count(*) as count_order
+        from lineitem
+        where l_shipdate <= date '1998-09-02'
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus
+    """
+    columns, rows = _run(coord, sql)
+    assert columns == ["l_returnflag", "l_linestatus", "sum_qty",
+                       "avg_price", "count_order"]
+    local = Session({"catalog": "tpch", "schema": "tiny"}).execute(sql)
+    local_rows = [[_json_round(v) for v in row] for row in local.rows]
+    assert [[_json_round(v) for v in row] for row in rows] == local_rows
+
+
+def test_distributed_join_broadcast(cluster):
+    coord, _ = cluster
+    sql = """
+        select n_name, count(*) as c
+        from customer, nation
+        where c_nationkey = n_nationkey
+        group by n_name
+        order by c desc, n_name limit 5
+    """
+    columns, rows = _run(coord, sql)
+    local = Session({"catalog": "tpch", "schema": "tiny"}).execute(sql)
+    assert [[_json_round(v) for v in r] for r in rows] == [
+        [_json_round(v) for v in r] for r in local.rows]
+
+
+def test_query_info_and_node_listing(cluster):
+    coord, workers = cluster
+    from trino_tpu.server import wire
+
+    nodes = wire.json_request("GET", f"{coord.base_url}/v1/node")
+    assert {n["nodeId"] for n in nodes} >= {"w0", "w1"}
+    _, _ = _run(coord, "select count(*) from region")
+    qid = sorted(coord.queries)[-1]
+    info = wire.json_request("GET", f"{coord.base_url}/v1/query/{qid}")
+    assert info["state"] == "FINISHED"
+    assert info["fragments"]  # at least one scheduled source fragment
+
+
+def test_failed_query_reports_error(cluster):
+    coord, _ = cluster
+    from trino_tpu.client.remote import RemoteQueryError
+
+    with pytest.raises(RemoteQueryError):
+        _run(coord, "select nonexistent_column from region")
+
+
+def test_worker_auth_rejects_unsigned(cluster):
+    _, workers = cluster
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{workers[0].base_url}/v1/task/forged", data=b"evil", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 401
+
+
+def _json_round(v):
+    """Rows crossing the JSON protocol stringify dates/decimals."""
+    import datetime
+    import decimal
+
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()
+    if isinstance(v, decimal.Decimal):
+        return str(v)
+    if isinstance(v, float):
+        return round(v, 9)
+    return v
+
+
+# ------------------------------------------------------ true process tier
+@pytest.mark.slow
+def test_two_process_cluster_runs_q1():
+    """Coordinator thread + 2 REAL worker subprocesses run Q1 split across
+    them (VERDICT.md: 'a test launches 2 processes and runs Q1 split across
+    them')."""
+    from trino_tpu.server import wire
+
+    coord = CoordinatorServer()
+    coord.start()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TRINO_TPU_INTERNAL_SECRET"] = wire.get_secret()
+    env.pop("XLA_FLAGS", None)
+    procs = []
+    try:
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "trino_tpu.server.worker",
+                 "--coordinator", coord.base_url, "--node-id", f"proc{i}"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        assert coord.registry.wait_for_workers(2, timeout=120.0), \
+            "worker subprocesses did not announce"
+        sql = ("select l_returnflag, count(*) as c, sum(l_quantity) as q "
+               "from lineitem group by l_returnflag order by l_returnflag")
+        columns, rows = _run(coord, sql)
+        local = Session({"catalog": "tpch", "schema": "tiny"}).execute(sql)
+        assert [[_json_round(v) for v in r] for r in rows] == [
+            [_json_round(v) for v in r] for r in local.rows]
+        # both workers actually executed tasks for the scan fragment
+        qid = sorted(coord.queries)[-1]
+        q = coord.queries[qid]
+        scheduled_workers = {
+            loc.base_url for locs in q.fragment_tasks.values() for loc in locs}
+        assert len(scheduled_workers) == 2
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+        coord.stop()

@@ -71,6 +71,40 @@ def test_variance_distributed(session):
         assert g[2] == pytest.approx(e[2], rel=1e-9)
 
 
+def test_variance_large_offset(session):
+    """Catastrophic-cancellation regression: values ~1e9 with unit spread.
+    The sum/sum-of-squares form loses all significant digits here; the
+    (count, mean, m2) state must not (reference: VarianceState)."""
+    rng = np.random.default_rng(7)
+    xs = [float(1e9 + v) for v in rng.normal(0.0, 1.0, 400)]
+    rows = [(i, i % 3, x) for i, (x) in enumerate(xs)]
+    session.catalogs["memory"].create_table(
+        "t", "bigoff", [("id", T.BIGINT), ("g", T.BIGINT), ("x", T.DOUBLE)], rows
+    )
+    got = session.execute(
+        "select g, stddev(x), var_samp(x) from memory.t.bigoff group by g order by g"
+    ).rows
+    by_g = {}
+    for _, g, x in rows:
+        by_g.setdefault(g, []).append(x)
+    for g, sd, var in got:
+        assert sd == pytest.approx(statistics.stdev(by_g[g]), rel=1e-6)
+        assert var == pytest.approx(statistics.variance(by_g[g]), rel=1e-6)
+
+    # and across the partial/final (distributed combine) path
+    import jax
+    from jax.sharding import Mesh
+
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.parallel.spmd import DistributedQuery
+
+    sql = "select g, stddev(x) from memory.t.bigoff group by g order by g"
+    mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+    dist = DistributedQuery.build(session, plan_sql(session, sql), mesh).run().to_pylist()
+    for g, sd in dist:
+        assert sd == pytest.approx(statistics.stdev(by_g[g]), rel=1e-6)
+
+
 def test_approx_distinct_exact(session):
     got = session.execute(
         "select g, approx_distinct(k) from memory.t.samples group by g order by g"

@@ -1,0 +1,114 @@
+"""Interactive SQL console.
+
+Reference: ``client/trino-cli`` (``Trino.java:41``, ``Console.java:86``) —
+a readline console with aligned output, running either against an embedded
+in-process session (default) or a remote coordinator over the REST protocol
+(``--server URL``).
+
+Usage:
+    python -m trino_tpu.client.cli [--server URL] [--catalog C] [--schema S]
+    python -m trino_tpu.client.cli --execute "select 1"
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Sequence
+
+
+def format_table(columns: Sequence[str], rows: Sequence[Sequence]) -> str:
+    """ALIGNED output format (the CLI default in the reference)."""
+    cells = [[_render(v) for v in row] for row in rows]
+    widths = [len(c) for c in columns]
+    for row in cells:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    sep = "-+-".join("-" * w for w in widths)
+    out: List[str] = []
+    out.append(" | ".join(c.ljust(w) for c, w in zip(columns, widths)))
+    out.append(sep)
+    for row in cells:
+        out.append(" | ".join(cell.ljust(w) for cell, w in zip(row, widths)))
+    return "\n".join(out)
+
+
+def _render(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+class Console:
+    def __init__(self, args):
+        self.args = args
+        if args.server:
+            from trino_tpu.client.remote import StatementClient
+
+            props = {"catalog": args.catalog, "schema": args.schema}
+            self._client = StatementClient(args.server, props)
+            self._session = None
+        else:
+            from trino_tpu.client.session import Session
+
+            self._client = None
+            self._session = Session({"catalog": args.catalog, "schema": args.schema})
+
+    def run_statement(self, sql: str) -> int:
+        t0 = time.monotonic()
+        try:
+            if self._client is not None:
+                columns, rows = self._client.execute(sql)
+            else:
+                result = self._session.execute(sql)
+                columns, rows = result.column_names, result.rows
+        except Exception as e:  # noqa: BLE001 — console surface
+            print(f"Query failed: {e}", file=sys.stderr)
+            return 1
+        print(format_table(columns, rows))
+        dt = time.monotonic() - t0
+        print(f"({len(rows)} row{'s' if len(rows) != 1 else ''} in {dt:.2f}s)")
+        return 0
+
+    def repl(self) -> int:
+        try:
+            import readline  # noqa: F401 — line editing side effect
+        except ImportError:
+            pass
+        print("trino-tpu console — end statements with ';', quit/exit to leave")
+        buf: List[str] = []
+        while True:
+            try:
+                prompt = "trino> " if not buf else "    -> "
+                line = input(prompt)
+            except (EOFError, KeyboardInterrupt):
+                print()
+                return 0
+            if not buf and line.strip().lower() in ("quit", "exit"):
+                return 0
+            buf.append(line)
+            text = "\n".join(buf)
+            if text.rstrip().endswith(";"):
+                buf = []
+                sql = text.rstrip().rstrip(";").strip()
+                if sql:
+                    self.run_statement(sql)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="trino-tpu")
+    ap.add_argument("--server", default=None, help="coordinator URL (default: embedded)")
+    ap.add_argument("--catalog", default="tpch")
+    ap.add_argument("--schema", default="tiny")
+    ap.add_argument("--execute", "-e", default=None, help="run one statement and exit")
+    args = ap.parse_args()
+    console = Console(args)
+    if args.execute:
+        return console.run_statement(args.execute)
+    return console.repl()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Remote statement client: the REST protocol consumer.
+
+Reference: ``client/trino-client/.../StatementClientV1.java:70`` — submit
+with ``POST /v1/statement``, then ``advance()`` (:350-362) follows
+``nextUri`` until the query reaches a terminal state, accumulating result
+pages.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from trino_tpu.server import wire
+
+
+class RemoteQueryError(RuntimeError):
+    pass
+
+
+class StatementClient:
+    """Submit one statement and iterate its results."""
+
+    def __init__(self, coordinator_url: str, session_properties: Optional[Dict[str, str]] = None):
+        self.coordinator_url = coordinator_url.rstrip("/")
+        self.session_properties = dict(session_properties or {})
+
+    def execute(self, sql: str, timeout: float = 600.0) -> Tuple[List[str], List[list]]:
+        """Returns (column_names, rows)."""
+        headers = {
+            f"X-Trino-Session-{k}": str(v) for k, v in self.session_properties.items()
+        }
+        status, body, _ = wire.http_request(
+            "POST", f"{self.coordinator_url}/v1/statement",
+            sql.encode(), "text/plain", headers=headers)
+        if status >= 400:
+            raise RemoteQueryError(f"submit failed: {body[:500].decode(errors='replace')}")
+        import json
+
+        payload = json.loads(body)
+        columns: List[str] = []
+        rows: List[list] = []
+        deadline = time.monotonic() + timeout
+        while True:
+            if "error" in payload:
+                raise RemoteQueryError(payload["error"]["message"])
+            if "columns" in payload:
+                columns = [c["name"] for c in payload["columns"]]
+            rows.extend(payload.get("data", []))
+            next_uri = payload.get("nextUri")
+            if next_uri is None:
+                return columns, rows
+            if time.monotonic() > deadline:
+                raise RemoteQueryError("client timeout")
+            status, body, _ = wire.http_request("GET", next_uri, timeout=60.0)
+            if status >= 400:
+                raise RemoteQueryError(f"poll failed: {body[:500].decode(errors='replace')}")
+            payload = json.loads(body)

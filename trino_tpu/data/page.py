@@ -174,6 +174,26 @@ class Page:
         sb = b.sel if b.sel is not None else jnp.ones((b.num_rows,), bool)
         return Page(cols, jnp.concatenate([sa, sb]), a.replicated and b.replicated)
 
+    def compact(self) -> "Page":
+        """Drop dead rows (host-side gather). Used at wire boundaries: the
+        serde (data/serde.py) carries no selection mask, so pages compact
+        once before serialization — the DCN tier's analog of the reference
+        compacting pages into the PartitionedOutputBuffer."""
+        if self.sel is None:
+            return self
+        live = np.asarray(self.sel)
+        idx = np.nonzero(live)[0]
+        cols = [
+            Column(
+                c.type,
+                jnp.asarray(np.asarray(c.values)[idx]),
+                jnp.asarray(np.asarray(c.nulls)[idx]) if c.nulls is not None else None,
+                c.dictionary,
+            )
+            for c in self.columns
+        ]
+        return Page(cols, None, self.replicated)
+
     def live_count(self) -> int:
         if self.sel is None:
             return self.num_rows

@@ -259,10 +259,10 @@ class Executor:
             return [agg_ops.agg_max(layout, arg, sel)]
         if call.function in P._VAR_FAMILY:
             t = page.columns[call.arg_channel].type
-            s1, s2, cnt = agg_ops.var_states(
+            cnt, mean, m2 = agg_ops.var_states(
                 layout, arg, sel, t.scale if t.is_decimal else 0
             )
-            return [(s1, None), (s2, None), (cnt, None)]
+            return [(cnt, None), (mean, None), (m2, None)]
         raise NotImplementedError(call.function)
 
     def _combine_state(self, call: P.AggregateCall, states: List[Column], sel, layout) -> Column:
@@ -294,10 +294,13 @@ class Executor:
             v, valid = agg_ops.agg_max(layout, as_arg(states[0]), sel)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         if call.function in P._VAR_FAMILY:
-            s1, _ = agg_ops.agg_sum(layout, as_arg(states[0]), sel, np.dtype(np.float64))
-            s2, _ = agg_ops.agg_sum(layout, as_arg(states[1]), sel, np.dtype(np.float64))
-            cnt, _ = agg_ops.agg_sum(layout, as_arg(states[2]), sel, np.dtype(np.int64))
-            v, valid = agg_ops.finish_var(s1, s2, cnt, call.function)
+            cnt_i, m = as_arg(states[0])
+            if sel is not None:
+                m = sel if m is None else (m & sel)
+            cnt, mean, m2 = agg_ops.combine_var_states(
+                layout, cnt_i, states[1].values, states[2].values, m
+            )
+            v, valid = agg_ops.finish_var(cnt, mean, m2, call.function)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         raise NotImplementedError(call.function)
 

@@ -79,33 +79,56 @@ def agg_count_distinct(layout: GroupLayout, arg: Lowered, sel):
 
 
 def var_states(layout: GroupLayout, arg: Lowered, sel, scale: int):
-    """(sum, sum_sq, count) running state for the variance family, as
-    doubles. ``scale`` is the decimal scale of the argument (0 for
-    ints/floats) — values convert to their numeric magnitude first."""
+    """(count, mean, m2) running state for the variance family — the
+    reference's VarianceState (count/mean/m2) layout, not the cancellative
+    sum/sum-of-squares form: m2 = Σ(x − mean_group)² is computed two-pass
+    (segment-sum the mean, then segment-sum centered squares), which stays
+    well-conditioned when |mean| ≫ stddev. ``scale`` is the decimal scale of
+    the argument (0 for ints/floats)."""
     vals, valid = arg
     m = _live(sel, valid)
     x = vals.astype(jnp.float64)
     if scale:
         x = x / (10.0 ** scale)
-    s1 = seg.seg_sum(layout, x, m, jnp.float64)
-    s2 = seg.seg_sum(layout, x * x, m, jnp.float64)
     cnt = seg.seg_count(layout, m)
-    return s1, s2, cnt
+    s1 = seg.seg_sum(layout, x, m, jnp.float64)
+    safe_n = jnp.maximum(cnt.astype(jnp.float64), 1.0)
+    mean = s1 / safe_n
+    gids = jnp.clip(layout.gids_orig(), 0, layout.capacity - 1)
+    centered = x - mean[gids]
+    m2 = seg.seg_sum(layout, centered * centered, m, jnp.float64)
+    return cnt, mean, m2
+
+
+def combine_var_states(layout: GroupLayout, cnt_i, mean_i, m2_i, m):
+    """Merge per-shard (count, mean, m2) states per output slot — the exact
+    multi-way Chan decomposition: N = Σnᵢ, mean = Σnᵢmeanᵢ/N,
+    M2 = ΣM2ᵢ + Σnᵢ(meanᵢ − mean)² (within-SS + between-SS)."""
+    n_i = cnt_i.astype(jnp.float64)
+    if m is not None:
+        n_i = jnp.where(m, n_i, 0.0)
+    cnt = seg.seg_sum(layout, cnt_i, m, jnp.int64)
+    s1 = seg.seg_sum(layout, n_i * mean_i, None, jnp.float64)
+    safe_n = jnp.maximum(cnt.astype(jnp.float64), 1.0)
+    mean = s1 / safe_n
+    gids = jnp.clip(layout.gids_orig(), 0, layout.capacity - 1)
+    d = mean_i - mean[gids]
+    m2 = seg.seg_sum(layout, m2_i + n_i * d * d, m, jnp.float64)
+    return cnt, mean, m2
 
 
 def agg_var(layout: GroupLayout, arg: Lowered, sel, kind: str, scale: int = 0):
     """Variance/stddev family (reference: the VarianceState accumulators of
     AggregationUtils); the finisher applies the pop/samp denominator/sqrt."""
-    s1, s2, cnt = var_states(layout, arg, sel, scale)
-    return finish_var(s1, s2, cnt, kind)
+    cnt, mean, m2 = var_states(layout, arg, sel, scale)
+    return finish_var(cnt, mean, m2, kind)
 
 
-def finish_var(s1, s2, cnt, kind: str):
-    """(value, valid) from (sum, sum_sq, count) running state."""
+def finish_var(cnt, mean, m2, kind: str):
+    """(value, valid) from (count, mean, m2) running state."""
     n = cnt.astype(jnp.float64)
     safe_n = jnp.maximum(n, 1.0)
-    mean = s1 / safe_n
-    m2 = jnp.maximum(s2 - s1 * mean, 0.0)  # clamp fp negatives
+    m2 = jnp.maximum(m2, 0.0)  # clamp fp negatives
     pop = kind.endswith("_pop")
     denom = safe_n if pop else jnp.maximum(n - 1.0, 1.0)
     var = m2 / denom

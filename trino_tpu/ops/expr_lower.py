@@ -476,8 +476,17 @@ def _lower_concat(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     """concat where at most one argument is a column (vocab transform);
     general column||column needs a pairwise dictionary product: round 2."""
     col_args = [a for a in expr.args if not isinstance(a, ir.Constant)]
+    # SQL semantics: concat with a NULL argument yields NULL for every row
+    # (reference: operator/scalar/ConcatFunction).
+    if any(isinstance(a, ir.Constant) and a.value is None for a in expr.args):
+        d = Dictionary([""])
+        return LoweredVal(
+            _const_array(ctx, np.int32, 0),
+            jnp.zeros((ctx.num_rows,), dtype=bool),
+            d,
+        )
     if not col_args:
-        s = "".join(str(a.value) for a in expr.args)
+        s = "".join(_concat_text(a) for a in expr.args)
         d = Dictionary([s])
         return LoweredVal(_const_array(ctx, np.int32, 0), None, d)
     if len(col_args) > 1:
@@ -485,12 +494,22 @@ def _lower_concat(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     (col_e,) = col_args
     x = lower(col_e, ctx)
     pre = "".join(
-        str(a.value) for a in expr.args[: expr.args.index(col_e)]
+        _concat_text(a) for a in expr.args[: expr.args.index(col_e)]
     )
     post = "".join(
-        str(a.value) for a in expr.args[expr.args.index(col_e) + 1 :]
+        _concat_text(a) for a in expr.args[expr.args.index(col_e) + 1 :]
     )
     return _vocab_transform(ctx, x, lambda v: pre + v + post)
+
+
+def _concat_text(a: ir.Constant) -> str:
+    """Render a constant concat argument as SQL text (varchar verbatim; other
+    types via an explicit cast, not Python repr)."""
+    if isinstance(a.value, str):
+        return a.value
+    if isinstance(a.value, bool):
+        return "true" if a.value else "false"
+    return str(a.value)
 
 
 def _lower_coalesce(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:

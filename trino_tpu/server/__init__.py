@@ -1,0 +1,15 @@
+"""Distributed control plane: coordinator + worker processes over HTTP.
+
+Reference: the coordinator⇄worker tier of the reference engine —
+``dispatcher/QueuedStatementResource.java:103`` (client protocol),
+``server/remotetask/HttpRemoteTask.java:132`` (task CRUD),
+``execution/SqlTaskManager.java:109`` (worker task engine),
+``operator/DirectExchangeClient.java:56`` (streaming page pull).
+
+TPU-first split (SURVEY.md §2.6): the *intra-slice* data plane never touches
+this package — repartition/broadcast exchanges compile into the query program
+as ICI collectives (parallel/spmd.py). This package is the *DCN tier*: the
+host-side control plane (dispatch, task lifecycle, discovery, failure
+detection) and the cross-host streaming page shuffle with the columnar wire
+serde (data/serde.py).
+"""

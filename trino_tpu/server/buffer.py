@@ -1,0 +1,101 @@
+"""Task output buffers: token-addressed page streams with at-least-once pull.
+
+Reference: the producer side of the pipelined shuffle —
+``execution/buffer/PartitionedOutputBuffer.java`` /
+``BroadcastOutputBuffer.java`` + the token protocol of
+``server/TaskResource.java:333-336`` (SURVEY.md §A.4): a consumer GETs
+``/results/{buffer}/{token}``, the response carries pages starting at that
+sequence id, and requesting token T+k implicitly acknowledges [T, T+k).
+At-least-once delivery with client-side de-dup by sequence id makes retries
+safe (the FTE determinism contract).
+
+Like the reference's OutputBuffers, the consumer set is declared up front
+(``consumer_count``): broadcast exchanges give every downstream task its own
+buffer id, and a page is garbage-collected only once EVERY consumer has
+acknowledged past it.
+
+Pages are stored serialized (data/serde.py) — the buffer is a wire-format
+queue, not a device-array holder; workers compact+serialize once, every
+consumer pull is a byte copy.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+
+class OutputBuffer:
+    """An ordered page stream read by ``consumer_count`` independent
+    consumers, each addressing its own buffer id ∈ [0, consumer_count)."""
+
+    def __init__(self, consumer_count: int = 1):
+        assert consumer_count >= 1
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pages: List[bytes] = []
+        self._base = 0  # sequence id of _pages[0]
+        self._acked = [0] * consumer_count  # per-consumer ack watermark
+        self._complete = False
+        self._aborted: Optional[str] = None
+
+    def enqueue(self, page_bytes: bytes) -> None:
+        with self._cond:
+            assert not self._complete, "enqueue after set_complete"
+            self._pages.append(page_bytes)
+            self._cond.notify_all()
+
+    def set_complete(self) -> None:
+        with self._cond:
+            self._complete = True
+            self._cond.notify_all()
+
+    def abort(self, reason: str) -> None:
+        with self._cond:
+            self._aborted = reason
+            self._complete = True
+            self._cond.notify_all()
+
+    def _gc_locked(self) -> None:
+        """Drop the prefix acknowledged by EVERY consumer."""
+        drop = min(min(self._acked) - self._base, len(self._pages))
+        if drop > 0:
+            del self._pages[:drop]
+            self._base += drop
+
+    def poll(
+        self, token: int, buffer_id: int = 0, max_pages: int = 16, timeout: float = 1.0
+    ) -> Tuple[List[bytes], int, bool, Optional[str]]:
+        """Return (pages, next_token, complete, failure) for one consumer
+        from sequence id ``token``; long-polls up to ``timeout`` when no data
+        is ready. Requesting token T acknowledges this consumer's [0, T)."""
+        with self._cond:
+            if not 0 <= buffer_id < len(self._acked):
+                raise ValueError(f"buffer id {buffer_id} out of range")
+            self._acked[buffer_id] = max(self._acked[buffer_id], token)
+            self._gc_locked()
+            self._cond.wait_for(
+                lambda: self._aborted or self._complete or self._base + len(self._pages) > token,
+                timeout,
+            )
+            if self._aborted:
+                return [], token, True, self._aborted
+            start = token - self._base
+            if start < 0:
+                raise ValueError(f"token {token} already garbage-collected (base {self._base})")
+            pages = self._pages[start : start + max_pages]
+            next_token = token + len(pages)
+            complete = self._complete and next_token == self._base + len(self._pages)
+            return list(pages), next_token, complete, None
+
+    def destroy_consumer(self, buffer_id: int) -> None:
+        """Final ack: this consumer is done with the whole stream."""
+        with self._cond:
+            if 0 <= buffer_id < len(self._acked):
+                self._acked[buffer_id] = self._base + len(self._pages)
+                self._gc_locked()
+                self._cond.notify_all()
+
+    @property
+    def buffered_bytes(self) -> int:
+        with self._lock:
+            return sum(len(p) for p in self._pages)

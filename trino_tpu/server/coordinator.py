@@ -1,0 +1,406 @@
+"""Coordinator: dispatch, discovery, scheduling, and the client protocol.
+
+Reference: ``dispatcher/QueuedStatementResource.java:103`` +
+``dispatcher/DispatchManager.java:173`` (statement submission),
+``execution/SqlQueryExecution.java:393`` (analyze→plan→schedule),
+``metadata/DiscoveryNodeManager.java:68`` +
+``failuredetector/HeartbeatFailureDetector.java:76`` (membership/liveness),
+``server/remotetask/HttpRemoteTask.java:132`` (task CRUD client),
+``server/protocol/ExecutingStatementResource.java:69`` (paged results with
+``nextUri`` chaining).
+
+Scheduling model (walking skeleton of PipelinedQueryScheduler): every
+*source* fragment gets one task per alive worker with splits round-robin
+assigned (UniformNodeSelector analog); all stages are scheduled at once and
+stream through long-polled output buffers (phased scheduling is a later
+refinement); the root *single* fragment executes on the coordinator itself,
+pulling upstream pages with the exchange client.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import re
+import threading
+import time
+import traceback
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from trino_tpu.server import wire
+from trino_tpu.server.exchange_client import ExchangeClient, TaskLocation
+from trino_tpu.server.statemachine import StateMachine, query_state_machine
+from trino_tpu.server.task import TaskRequest
+from trino_tpu.sql.planner import plan as P
+from trino_tpu.sql.planner.fragmenter import RemoteSourceNode, fragment_plan
+
+_ANNOUNCE_RE = re.compile(r"^/v1/announce/([^/]+)$")
+_RESULT_RE = re.compile(r"^/v1/statement/executing/([^/]+)/(\d+)$")
+_QUERY_RE = re.compile(r"^/v1/query/([^/]+)$")
+
+RESULT_PAGE_ROWS = 10_000
+
+
+class NodeRegistry:
+    """Worker membership with announce-age liveness (discovery + failure
+    detection collapsed: an entry not re-announced within ``max_age`` is
+    dead — the push analog of heartbeat ping + decayed failure ratio)."""
+
+    def __init__(self, max_age: float = 10.0):
+        self._nodes: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self.max_age = max_age
+
+    def announce(self, node_id: str, url: str) -> None:
+        with self._lock:
+            self._nodes[node_id] = {"url": url, "last_seen": time.monotonic()}
+
+    def alive(self) -> List[dict]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {"nodeId": nid, **info}
+                for nid, info in sorted(self._nodes.items())
+                if now - info["last_seen"] <= self.max_age
+            ]
+
+    def wait_for_workers(self, count: int, timeout: float = 30.0) -> bool:
+        """ClusterSizeMonitor analog: block dispatch until enough workers."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.alive()) >= count:
+                return True
+            time.sleep(0.1)
+        return False
+
+
+class QueryExecution:
+    """One query's lifecycle on the coordinator."""
+
+    def __init__(self, query_id: str, sql: str, session_properties: dict,
+                 registry: NodeRegistry, session_factory):
+        self.query_id = query_id
+        self.sql = sql
+        self.session_properties = dict(session_properties)
+        self.state: StateMachine[str] = query_state_machine()
+        self.registry = registry
+        self.session_factory = session_factory
+        self.failure: Optional[str] = None
+        self.columns: List[str] = []
+        self.rows: List[tuple] = []
+        self.fragment_tasks: Dict[int, List[TaskLocation]] = {}
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def cancel(self) -> None:
+        self.state.set("CANCELED")
+
+    # ------------------------------------------------------------ lifecycle
+    def _run(self) -> None:
+        try:
+            self.state.set("PLANNING")
+            session = self.session_factory(self.session_properties)
+            from trino_tpu.exec.query import plan_sql, run_query
+            from trino_tpu.sql.parser import ast
+            from trino_tpu.sql.parser.parser import parse_statement
+
+            stmt = parse_statement(self.sql)
+            if not isinstance(stmt, ast.Query):
+                # metadata statements (SHOW …, EXPLAIN) run coordinator-local
+                result = run_query(session, self.sql)
+                self.columns, self.rows = result.column_names, result.rows
+                self.state.set("FINISHED")
+                return
+            root = plan_sql(session, self.sql)
+            fragments = fragment_plan(root, session)
+            self.state.set("STARTING")
+            workers = self.registry.alive()
+            if not workers:
+                raise RuntimeError("no alive workers")
+            self._schedule(session, fragments, workers)
+            self.state.set("RUNNING")
+            result_page = self._run_root_fragment(session, fragments)
+            self.state.set("FINISHING")
+            self.columns = fragments[-1].root.column_names
+            self.rows = result_page.to_pylist()
+            self.state.set("FINISHED")
+        except Exception as e:  # noqa: BLE001 — reported through query info
+            self.failure = f"{e}\n{traceback.format_exc()}"
+            self._cancel_tasks()
+            self.state.set("FAILED")
+
+    def _schedule(self, session, fragments, workers) -> None:
+        """Create one task per worker for each source fragment, splits
+        round-robin across workers (SOURCE_DISTRIBUTION placement)."""
+        # Declared consumer set per producing fragment (reference:
+        # OutputBuffers): a fragment consumed by a source fragment is pulled
+        # by every one of its tasks (broadcast — one buffer id per task); a
+        # fragment consumed by the root single fragment has one consumer
+        # (the coordinator's exchange client).
+        consumer_counts: Dict[int, int] = {}
+        for frag in fragments:
+            for node in P.walk_plan(frag.root):
+                if isinstance(node, RemoteSourceNode):
+                    consumer_counts[node.fragment_id] = (
+                        len(workers) if frag.partitioning == "source" else 1)
+        for frag in fragments:
+            if frag.partitioning != "source":
+                continue
+            locations: List[TaskLocation] = []
+            # enumerate splits per scan node, interleave across workers
+            per_worker_splits: List[Dict[int, list]] = [dict() for _ in workers]
+            for node in P.walk_plan(frag.root):
+                if not isinstance(node, P.TableScanNode):
+                    continue
+                conn = session.catalogs[node.catalog]
+                splits = conn.get_splits(node.schema, node.table,
+                                         max(len(workers), 1))
+                for i, split in enumerate(splits):
+                    w = i % len(workers)
+                    per_worker_splits[w].setdefault(node.id, []).append(split)
+            for wi, worker in enumerate(workers):
+                task_id = f"{self.query_id}.{frag.id}.{wi}"
+                req = TaskRequest(
+                    task_id=task_id,
+                    query_id=self.query_id,
+                    fragment_root=frag.root,
+                    splits=per_worker_splits[wi],
+                    upstream=self._upstream_for(frag.root, consumer_index=wi),
+                    session_properties=self.session_properties,
+                    consumer_count=consumer_counts.get(frag.id, 1),
+                )
+                body = req.to_bytes()
+                status, resp, _ = wire.http_request(
+                    "POST", f"{worker['url']}/v1/task/{task_id}", body)
+                if status >= 400:
+                    raise RuntimeError(
+                        f"task create failed on {worker['nodeId']}: "
+                        f"{resp[:300].decode(errors='replace')}")
+                locations.append(TaskLocation(worker["url"], task_id))
+            self.fragment_tasks[frag.id] = locations
+
+    def _upstream_for(self, root, consumer_index: int = 0) -> Dict[int, list]:
+        up: Dict[int, list] = {}
+        for node in P.walk_plan(root):
+            if isinstance(node, RemoteSourceNode):
+                locs = self.fragment_tasks.get(node.fragment_id, [])
+                up[node.fragment_id] = [
+                    (l.base_url, l.task_id, consumer_index) for l in locs]
+        return up
+
+    def _run_root_fragment(self, session, fragments):
+        from trino_tpu.server.task import FragmentExecutor
+
+        root_frag = fragments[-1]
+        assert root_frag.partitioning == "single"
+        remote_pages: Dict[int, list] = {}
+        for node in P.walk_plan(root_frag.root):
+            if isinstance(node, RemoteSourceNode):
+                client = ExchangeClient(self.fragment_tasks[node.fragment_id])
+                client.start()
+                remote_pages[node.fragment_id] = client.pages()
+        ex = FragmentExecutor(session, {}, remote_pages)
+        return ex.execute_checked(root_frag.root)
+
+    def _cancel_tasks(self) -> None:
+        for locations in self.fragment_tasks.values():
+            for loc in locations:
+                try:
+                    wire.http_request(
+                        "DELETE", f"{loc.base_url}/v1/task/{loc.task_id}",
+                        timeout=5.0)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def info(self) -> dict:
+        return {
+            "queryId": self.query_id,
+            "state": self.state.get(),
+            "query": self.sql,
+            "failure": (self.failure or "").split("\n")[0] or None,
+            "fragments": {
+                str(fid): [l.task_id for l in locs]
+                for fid, locs in self.fragment_tasks.items()
+            },
+        }
+
+
+class CoordinatorServer:
+    """The coordinator process: discovery registry + dispatch + protocol."""
+
+    def __init__(self, port: int = 0, session_factory=None):
+        from trino_tpu.server.worker import default_session_factory
+
+        self.registry = NodeRegistry()
+        self.session_factory = session_factory or default_session_factory
+        self.queries: Dict[str, QueryExecution] = {}
+        self._qlock = threading.Lock()
+        self._qid = itertools.count(1)
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.base_url = f"http://127.0.0.1:{self.port}"
+        self._serve_thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._serve_thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def submit(self, sql: str, properties: Optional[dict] = None) -> QueryExecution:
+        query_id = f"q{time.strftime('%Y%m%d')}_{next(self._qid):05d}_{uuid.uuid4().hex[:5]}"
+        execution = QueryExecution(
+            query_id, sql, properties or {}, self.registry, self.session_factory)
+        with self._qlock:
+            self.queries[query_id] = execution
+        execution.start()
+        return execution
+
+    def get_query(self, query_id: str) -> Optional[QueryExecution]:
+        with self._qlock:
+            return self.queries.get(query_id)
+
+
+def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) -> dict:
+    state = q.state.get()
+    payload: dict = {
+        "id": q.query_id,
+        "stats": {"state": state},
+    }
+    if state == "FAILED":
+        payload["error"] = {"message": q.failure or "query failed"}
+        return payload
+    if state != "FINISHED":
+        payload["nextUri"] = f"{server.base_url}/v1/statement/executing/{q.query_id}/{token}"
+        return payload
+    start = token * RESULT_PAGE_ROWS
+    chunk = q.rows[start : start + RESULT_PAGE_ROWS]
+    payload["columns"] = [{"name": c} for c in q.columns]
+    payload["data"] = [list(_jsonable(v) for v in row) for row in chunk]
+    if start + RESULT_PAGE_ROWS < len(q.rows):
+        payload["nextUri"] = f"{server.base_url}/v1/statement/executing/{q.query_id}/{token + 1}"
+    return payload
+
+
+def _jsonable(v):
+    import datetime
+    import decimal
+
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()
+    if isinstance(v, decimal.Decimal):
+        return str(v)
+    return v
+
+
+def _make_handler(server: CoordinatorServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, status: int, body: bytes = b"",
+                  content_type: str = "application/json"):
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", "0"))
+            return self.rfile.read(n)
+
+        def do_PUT(self):
+            m = _ANNOUNCE_RE.match(self.path)
+            if m:
+                body = self._read_body()
+                if not wire.verify(body, self.headers.get(wire.H_INTERNAL_AUTH)):
+                    self._send(401, b'{"error": "bad internal signature"}')
+                    return
+                info = json.loads(body)
+                server.registry.announce(m.group(1), info["url"])
+                self._send(200, b"{}")
+                return
+            self._send(404)
+
+        def do_POST(self):
+            if self.path == "/v1/statement":
+                sql = self._read_body().decode()
+                props = {}
+                for header, value in self.headers.items():
+                    if header.lower().startswith("x-trino-session-"):
+                        props[header[len("x-trino-session-"):].lower()] = value
+                q = server.submit(sql, props)
+                self._send(200, json.dumps(_result_payload(server, q, 0)).encode())
+                return
+            self._send(404)
+
+        def do_GET(self):
+            m = _RESULT_RE.match(self.path)
+            if m:
+                q = server.get_query(m.group(1))
+                if q is None:
+                    self._send(404, b'{"error": "no such query"}')
+                    return
+                # long-poll briefly so clients don't busy-spin
+                if not q.state.is_terminal():
+                    q.state.wait_for_terminal(0.5)
+                self._send(200, json.dumps(
+                    _result_payload(server, q, int(m.group(2)))).encode())
+                return
+            m = _QUERY_RE.match(self.path)
+            if m:
+                q = server.get_query(m.group(1))
+                if q is None:
+                    self._send(404, b'{"error": "no such query"}')
+                    return
+                self._send(200, json.dumps(q.info()).encode())
+                return
+            if self.path == "/v1/node":
+                self._send(200, json.dumps(server.registry.alive()).encode())
+                return
+            if self.path == "/v1/info":
+                self._send(200, json.dumps(
+                    {"coordinator": True, "state": "ACTIVE"}).encode())
+                return
+            self._send(404)
+
+        def do_DELETE(self):
+            m = _RESULT_RE.match(self.path)
+            if m:
+                q = server.get_query(m.group(1))
+                if q is not None:
+                    q.cancel()
+                self._send(204)
+                return
+            self._send(404)
+
+    return Handler
+
+
+def main() -> None:
+    """Entry point: ``python -m trino_tpu.server.coordinator --port N``."""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    c = CoordinatorServer(args.port)
+    c.start()
+    print(json.dumps({"url": c.base_url}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        c.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,201 @@
+"""Worker task engine: task lifecycle + fragment execution.
+
+Reference: ``execution/SqlTaskManager.java:109`` (owns all tasks on a
+worker), ``SqlTaskExecution.java:85`` (fragment → drivers), ``TaskState``
+FSM. The driver loop's role is filled by whole-fragment execution over the
+device (exec/executor.py) — one task = one fragment instance = one batch
+program, not a page-at-a-time operator chain (SURVEY.md §7.1).
+
+A ``TaskRequest`` ships the plan-fragment subtree (pickled — the analog of
+the reference's JSON-serialized ``PlanFragment``), the splits assigned to
+this task (``SOURCE_DISTRIBUTION`` placement, chosen by the coordinator),
+and upstream task locations per RemoteSourceNode fragment id.
+"""
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu.data.page import Column, Page
+from trino_tpu.data.serde import serialize_page
+from trino_tpu.exec.executor import Executor
+from trino_tpu.server.buffer import OutputBuffer
+from trino_tpu.server.statemachine import StateMachine, task_state_machine
+from trino_tpu.sql.planner import plan as P
+from trino_tpu.sql.planner.fragmenter import RemoteSourceNode
+
+
+@dataclasses.dataclass
+class TaskRequest:
+    """Everything a worker needs to run one task (pickle wire format;
+    reference: TaskUpdateRequest posted to POST /v1/task/{taskId})."""
+
+    task_id: str
+    query_id: str
+    fragment_root: P.PlanNode
+    splits: Dict[int, List]  # scan plan-node id -> [Split]
+    upstream: Dict[int, List]  # fragment id -> [(base_url, task_id, buffer_id)]
+    session_properties: Dict[str, object]
+    # how many downstream consumers will pull this task's output (reference:
+    # OutputBuffers — the consumer set is declared when the task is created)
+    consumer_count: int = 1
+
+    def to_bytes(self) -> bytes:
+        return pickle.dumps(self)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "TaskRequest":
+        return pickle.loads(data)
+
+
+class FragmentExecutor(Executor):
+    """Executes one plan fragment: scans read only the task's assigned
+    splits; RemoteSourceNodes read pages pulled from upstream tasks."""
+
+    def __init__(self, session, splits: Dict[int, List], remote_pages: Dict[int, List[Page]]):
+        super().__init__(session)
+        self._splits = splits
+        self._remote_pages = remote_pages
+
+    def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
+        conn = self.session.catalogs[node.catalog]
+        splits = self._splits.get(node.id, [])
+        datas = [conn.scan(s, node.column_names) for s in splits]
+        cols: List[Column] = []
+        for name, typ in zip(node.column_names, node.column_types):
+            parts = [d[name] for d in datas]
+            if not parts:
+                cols.append(Column(typ, jnp.zeros((1,), typ.np_dtype or np.dtype(np.int64)),
+                                   None, _empty_dict(typ)))
+                continue
+            vals = np.concatenate([np.asarray(p.values) for p in parts])
+            nulls = None
+            if any(p.nulls is not None for p in parts):
+                nulls = np.concatenate(
+                    [np.asarray(p.nulls) if p.nulls is not None
+                     else np.zeros(len(p.values), bool) for p in parts]
+                )
+            cols.append(Column(typ, jnp.asarray(vals),
+                               jnp.asarray(nulls) if nulls is not None else None,
+                               parts[0].dictionary))
+        if not datas:
+            return Page(cols, jnp.zeros((1,), bool))
+        if cols and cols[0].values.shape[0] == 0:
+            pad = [Column(c.type, jnp.zeros((1,) + c.values.shape[1:], c.values.dtype),
+                          None, c.dictionary) for c in cols]
+            return Page(pad, jnp.zeros((1,), bool))
+        return Page(cols)
+
+    def _exec_RemoteSourceNode(self, node: RemoteSourceNode) -> Page:
+        pages = self._remote_pages.get(node.fragment_id, [])
+        pages = [p for p in pages if p.num_rows > 0]
+        if not pages:
+            cols = [
+                Column(t, jnp.zeros((1,), t.np_dtype or np.dtype(np.int64)),
+                       None, _empty_dict(t))
+                for t in node.types
+            ]
+            return Page(cols, jnp.zeros((1,), bool))
+        page = pages[0]
+        for p in pages[1:]:
+            page = Page.concat_pages(page, p)
+        return page
+
+
+def _empty_dict(typ):
+    from trino_tpu.data.dictionary import Dictionary
+
+    return Dictionary([""]) if typ.is_varchar else None
+
+
+class SqlTask:
+    """One task: FSM + executor thread + output buffer.
+
+    State flow PLANNED→RUNNING→FLUSHING→FINISHED mirrors TaskState.java:21;
+    FLUSHING = body finished, buffer still draining to consumers.
+    """
+
+    def __init__(self, request: TaskRequest, session_factory):
+        self.request = request
+        self.state: StateMachine[str] = task_state_machine()
+        self.output = OutputBuffer(request.consumer_count)
+        self.failure: Optional[str] = None
+        self._session_factory = session_factory
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        if self.state.compare_and_set("PLANNED", "RUNNING"):
+            self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            req = self.request
+            # pull all upstream fragments first (fragment bodies are
+            # bulk-synchronous; the pull itself streams + backpressures)
+            remote_pages: Dict[int, List[Page]] = {}
+            for fid, locations in req.upstream.items():
+                from trino_tpu.server.exchange_client import ExchangeClient, TaskLocation
+
+                client = ExchangeClient([TaskLocation(u, t, b) for u, t, b in locations])
+                client.start()
+                remote_pages[fid] = client.pages()
+            session = self._session_factory(req.session_properties)
+            ex = FragmentExecutor(session, req.splits, remote_pages)
+            page = ex.execute_checked(req.fragment_root)
+            self.state.set("FLUSHING")
+            page = page.compact()
+            if page.num_rows:
+                self.output.enqueue(serialize_page(page))
+            self.output.set_complete()
+            self.state.set("FINISHED")
+        except Exception as e:  # noqa: BLE001 — reported through task status
+            self.failure = f"{e}\n{traceback.format_exc()}"
+            self.output.abort(str(e))
+            self.state.set("FAILED")
+
+    def info(self) -> dict:
+        return {
+            "taskId": self.request.task_id,
+            "state": self.state.get(),
+            "failure": self.failure,
+            "bufferedBytes": self.output.buffered_bytes,
+        }
+
+
+class TaskManager:
+    """All tasks on this worker (reference: SqlTaskManager.java:109)."""
+
+    def __init__(self, session_factory):
+        self._tasks: Dict[str, SqlTask] = {}
+        self._lock = threading.Lock()
+        self._session_factory = session_factory
+
+    def create_task(self, request: TaskRequest) -> SqlTask:
+        with self._lock:
+            task = self._tasks.get(request.task_id)
+            if task is None:
+                task = SqlTask(request, self._session_factory)
+                self._tasks[request.task_id] = task
+        task.start()
+        return task
+
+    def get(self, task_id: str) -> Optional[SqlTask]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def cancel(self, task_id: str) -> None:
+        with self._lock:
+            task = self._tasks.pop(task_id, None)
+        if task is not None:
+            task.output.abort("canceled")
+            task.state.set("CANCELED")
+
+    def list_info(self) -> List[dict]:
+        with self._lock:
+            return [t.info() for t in self._tasks.values()]

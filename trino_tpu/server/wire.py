@@ -1,0 +1,97 @@
+"""Wire helpers for the DCN tier: framed page bodies + internal auth.
+
+Reference: ``application/X-trino-pages`` bodies (concatenated serialized
+pages) with sequence-id headers (``server/InternalHeaders.java:21-25``,
+SURVEY.md §A.4), and HMAC-style internal authentication
+(``server/InternalAuthenticationManager.java`` — JWT there, keyed digest
+here; same role: workers only accept control-plane calls from the cluster).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import struct
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+MEDIA_PAGES = "application/x-trino-tpu-pages"
+
+H_PAGE_TOKEN = "X-Page-Token"
+H_NEXT_TOKEN = "X-Page-Next-Token"
+H_BUFFER_COMPLETE = "X-Buffer-Complete"
+H_TASK_FAILED = "X-Task-Failed"
+H_INTERNAL_AUTH = "X-Internal-Auth"
+
+# Cluster-internal shared secret (reference: the
+# internal-communication.shared-secret config). There is NO well-known
+# default: task bodies are pickled plans, so accepting a guessable
+# signature would be remote code execution. Unset, each process generates
+# a random secret — a coordinator must export its secret to its workers
+# (get_secret() → TRINO_TPU_INTERNAL_SECRET in the worker environment).
+_env_secret = os.environ.get("TRINO_TPU_INTERNAL_SECRET")
+if _env_secret is None:
+    import secrets as _secrets
+
+    _env_secret = _secrets.token_hex(32)
+_SECRET = _env_secret.encode()
+
+
+def get_secret() -> str:
+    """This process's cluster secret (pass to spawned workers' env)."""
+    return _SECRET.decode()
+
+
+def sign(body: bytes) -> str:
+    return hmac.new(_SECRET, body, hashlib.sha256).hexdigest()
+
+
+def verify(body: bytes, signature: Optional[str]) -> bool:
+    return signature is not None and hmac.compare_digest(sign(body), signature)
+
+
+def frame_pages(pages: List[bytes]) -> bytes:
+    """Length-prefix each serialized page so one body carries a batch."""
+    return b"".join(struct.pack("<I", len(p)) + p for p in pages)
+
+
+def unframe_pages(body: bytes) -> List[bytes]:
+    pages = []
+    off = 0
+    while off < len(body):
+        (n,) = struct.unpack_from("<I", body, off)
+        off += 4
+        pages.append(body[off : off + n])
+        off += n
+    return pages
+
+
+def http_request(
+    method: str,
+    url: str,
+    body: bytes = b"",
+    content_type: str = "application/octet-stream",
+    timeout: float = 30.0,
+    headers: Optional[dict] = None,
+) -> Tuple[int, bytes, dict]:
+    """Minimal signed HTTP call. Returns (status, body, headers)."""
+    req = urllib.request.Request(url, data=body if method in ("POST", "PUT") else None, method=method)
+    req.add_header("Content-Type", content_type)
+    req.add_header(H_INTERNAL_AUTH, sign(body))
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def json_request(method: str, url: str, payload=None, timeout: float = 30.0):
+    body = json.dumps(payload).encode() if payload is not None else b""
+    status, data, _ = http_request(method, url, body, "application/json", timeout)
+    if status >= 400:
+        raise RuntimeError(f"{method} {url} -> {status}: {data[:500].decode(errors='replace')}")
+    return json.loads(data) if data else None

@@ -1,0 +1,187 @@
+"""Worker server: task CRUD + result streaming over HTTP.
+
+Reference: ``server/TaskResource.java`` —
+``POST /v1/task/{taskId}`` creates/updates a task (:140-145),
+``GET /v1/task/{taskId}/results/{bufferId}/{token}`` streams pages
+(:333-336), ``DELETE`` destroys; plus the worker side of discovery
+(announce loop → coordinator, reference: airlift discovery announcer).
+
+Built on the stdlib threading HTTP server — the control plane is
+latency-bound, not throughput-bound (SURVEY.md §7.1 "control plane stays
+host-side"); the data plane bodies are the serde's compressed columnar
+pages.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from trino_tpu.server import wire
+from trino_tpu.server.task import TaskManager, TaskRequest
+
+_RESULTS_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)/(\d+)$")
+_TASK_RE = re.compile(r"^/v1/task/([^/]+)$")
+_STATUS_RE = re.compile(r"^/v1/task/([^/]+)/status$")
+
+
+def default_session_factory(properties):
+    from trino_tpu.client.session import Session
+
+    return Session(properties)
+
+
+class WorkerServer:
+    """One worker process: task manager + HTTP endpoint + announcer."""
+
+    def __init__(self, port: int = 0, coordinator_url: Optional[str] = None,
+                 node_id: Optional[str] = None, session_factory=default_session_factory):
+        self.tasks = TaskManager(session_factory)
+        self.node_id = node_id or f"worker-{time.time_ns() & 0xFFFFFF:x}"
+        self.coordinator_url = coordinator_url
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.base_url = f"http://127.0.0.1:{self.port}"
+        self._serve_thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._announce_thread = threading.Thread(target=self._announce_loop, daemon=True)
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        self._serve_thread.start()
+        if self.coordinator_url:
+            self._announce_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def _announce_loop(self) -> None:
+        """Periodic announce = discovery + liveness in one (reference:
+        DiscoveryNodeManager polls announcements; HeartbeatFailureDetector
+        pings — here the worker pushes, the coordinator ages entries out)."""
+        while not self._stop.is_set():
+            try:
+                wire.json_request(
+                    "PUT",
+                    f"{self.coordinator_url}/v1/announce/{self.node_id}",
+                    {"url": self.base_url, "tasks": len(self.tasks.list_info())},
+                    timeout=5.0,
+                )
+            except Exception:  # noqa: BLE001 — coordinator may not be up yet
+                pass
+            self._stop.wait(1.0)
+
+
+def _make_handler(server: WorkerServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _send(self, status: int, body: bytes = b"",
+                  content_type: str = "application/json", headers: Optional[dict] = None):
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", "0"))
+            return self.rfile.read(n)
+
+        def do_POST(self):
+            m = _TASK_RE.match(self.path)
+            if m:
+                body = self._read_body()
+                if not wire.verify(body, self.headers.get(wire.H_INTERNAL_AUTH)):
+                    self._send(401, b'{"error": "bad internal signature"}')
+                    return
+                request = TaskRequest.from_bytes(body)
+                task = server.tasks.create_task(request)
+                self._send(200, json.dumps(task.info()).encode())
+                return
+            self._send(404)
+
+        def do_GET(self):
+            m = _RESULTS_RE.match(self.path)
+            if m:
+                task = server.tasks.get(m.group(1))
+                if task is None:
+                    self._send(404, b'{"error": "no such task"}')
+                    return
+                pages, next_token, complete, failure = task.output.poll(
+                    int(m.group(3)), buffer_id=int(m.group(2)))
+                headers = {
+                    wire.H_PAGE_TOKEN: m.group(3),
+                    wire.H_NEXT_TOKEN: str(next_token),
+                    wire.H_BUFFER_COMPLETE: "true" if complete else "false",
+                }
+                if failure:
+                    headers[wire.H_TASK_FAILED] = failure.replace("\n", " ")[:900]
+                self._send(200, wire.frame_pages(pages), wire.MEDIA_PAGES, headers)
+                return
+            m = _STATUS_RE.match(self.path)
+            if m:
+                task = server.tasks.get(m.group(1))
+                if task is None:
+                    self._send(404, b'{"error": "no such task"}')
+                    return
+                self._send(200, json.dumps(task.info()).encode())
+                return
+            if self.path == "/v1/info":
+                self._send(200, json.dumps(
+                    {"nodeId": server.node_id, "state": "ACTIVE",
+                     "tasks": server.tasks.list_info()}).encode())
+                return
+            self._send(404)
+
+        def do_DELETE(self):
+            m = _RESULTS_RE.match(self.path)
+            if m:
+                # final ack: this consumer is done with the buffer
+                task = server.tasks.get(m.group(1))
+                if task is not None:
+                    task.output.destroy_consumer(int(m.group(2)))
+                self._send(204)
+                return
+            m = _TASK_RE.match(self.path)
+            if m:
+                server.tasks.cancel(m.group(1))
+                self._send(204)
+                return
+            self._send(404)
+
+    return Handler
+
+
+def main() -> None:
+    """Entry point: ``python -m trino_tpu.server.worker --port N
+    --coordinator URL``."""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--node-id", default=None)
+    args = ap.parse_args()
+    w = WorkerServer(args.port, args.coordinator, args.node_id)
+    w.start()
+    print(json.dumps({"nodeId": w.node_id, "url": w.base_url}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        w.stop()
+
+
+if __name__ == "__main__":
+    main()

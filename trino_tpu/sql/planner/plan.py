@@ -161,9 +161,10 @@ def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
         base = src_types[agg.arg_channel]
         out = [T.DOUBLE if base.is_floating else base, T.BIGINT]
     elif agg.function in _VAR_FAMILY:
-        # running (sum, sum of squares, count) in double — the reference's
-        # VarianceState (mean/m2/count) reshaped for streaming combination
-        out = [T.DOUBLE, T.DOUBLE, T.BIGINT]
+        # running (count, mean, m2) — the reference's VarianceState layout;
+        # merged with the exact multi-way Chan decomposition
+        # (ops/aggregate.py combine_var_states)
+        out = [T.BIGINT, T.DOUBLE, T.DOUBLE]
     elif agg.function in ("min", "max", "sum"):
         out = [agg.output_type if agg.function == "sum" else src_types[agg.arg_channel]]
     else:
