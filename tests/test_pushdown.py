@@ -1,0 +1,165 @@
+"""Scan pushdown (TupleDomain) + dynamic filtering tests.
+
+Reference behaviors matched: ConnectorMetadata.applyFilter/TupleDomain
+(static pushdown), DynamicFilterService (runtime build-side narrowing).
+VERDICT round-1 item 9: "Q3/Q18 scan fewer rows with pushdown on (assert
+via scan stats)".
+"""
+import pytest
+
+from trino_tpu.client.session import Session
+from trino_tpu.connector.predicate import Domain, TupleDomain
+from trino_tpu.exec.executor import Executor
+from trino_tpu.exec.query import plan_sql
+from trino_tpu.sql.planner import plan as P
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session({"catalog": "tpch", "schema": "tiny"})
+
+
+# ----------------------------------------------------------- domain algebra
+def test_domain_intersect_ranges():
+    a = Domain.range(low=10, high=100)
+    b = Domain.range(low=50, high=200, high_inclusive=False)
+    c = a.intersect(b)
+    assert (c.low, c.high) == (50, 100)
+    assert c.contains(50) and c.contains(100) and not c.contains(101)
+    assert not c.null_allowed
+
+
+def test_domain_intersect_set_with_range():
+    a = Domain.from_values([1, 5, 9, 42])
+    b = Domain.range(low=4, high=40)
+    c = a.intersect(b)
+    assert c.values == frozenset({5, 9})
+    assert Domain.from_values([1]).intersect(Domain.from_values([2])).is_none()
+
+
+def test_tuple_domain_intersect():
+    td = TupleDomain({"x": Domain.range(low=0)}).intersect(
+        TupleDomain({"x": Domain.range(high=10), "y": Domain.from_values([1])}))
+    assert td.domain("x").low == 0 and td.domain("x").high == 10
+    assert td.domain("y").values == frozenset({1})
+    assert td.domain("z").is_all()
+
+
+# ------------------------------------------------------- static pushdown
+def _scan_nodes(root):
+    return [n for n in P.walk_plan(root) if isinstance(n, P.TableScanNode)]
+
+
+def test_optimizer_derives_scan_constraint(session):
+    root = plan_sql(
+        session,
+        "select count(*) from orders where o_orderkey between 100 and 200")
+    (scan,) = _scan_nodes(root)
+    assert scan.constraint is not None
+    dom = scan.constraint.domain("o_orderkey")
+    assert (dom.low, dom.high) == (100, 200)
+
+
+def test_static_pushdown_narrows_scan(session):
+    ex = Executor(session)
+    root = plan_sql(
+        session,
+        "select count(*) from orders where o_orderkey between 100 and 200")
+    rows = ex.execute_checked(root).to_pylist()
+    assert rows == [(101,)]
+    (scan,) = _scan_nodes(root)
+    # 15000 orders in tiny; the connector materialized only the key range
+    assert ex.scan_stats[scan.id] == 101
+
+
+def test_static_pushdown_correctness_vs_full_scan(session):
+    sql = ("select o_orderkey, o_totalprice from orders "
+           "where o_orderkey in (7, 3856, 12001) order by o_orderkey")
+    rows = session.execute(sql).rows
+    assert [r[0] for r in rows] == [7, 3856, 12001]
+
+
+# ------------------------------------------------------- dynamic filtering
+def test_dynamic_filter_planned_on_probe_scan(session):
+    root = plan_sql(session, """
+        select l_orderkey, l_quantity from lineitem, orders
+        where l_orderkey = o_orderkey and o_orderkey between 500 and 520
+    """)
+    scans = _scan_nodes(root)
+    lineitem = next(s for s in scans if s.table == "lineitem")
+    assert lineitem.dynamic_filters, "probe scan not annotated"
+    (join_id, key_idx, column) = lineitem.dynamic_filters[0]
+    assert column == "l_orderkey"
+
+
+def test_dynamic_filter_narrows_probe_scan(session):
+    ex = Executor(session)
+    root = plan_sql(session, """
+        select count(*), sum(l_quantity) from lineitem, orders
+        where l_orderkey = o_orderkey and o_orderkey between 500 and 520
+    """)
+    got = ex.execute_checked(root).to_pylist()
+    scans = _scan_nodes(root)
+    lineitem = next(s for s in scans if s.table == "lineitem")
+    orders = next(s for s in scans if s.table == "orders")
+    # build (orders) narrowed statically; probe (lineitem) narrowed by the
+    # runtime in-set of build keys — far below the 60k full lineitem scan
+    assert ex.scan_stats[orders.id] == 21
+    assert ex.scan_stats[lineitem.id] < 200
+    # correctness: same result with dynamic filtering disabled
+    ex2 = Executor(session)
+    ex2.enable_dynamic_filtering = False
+    root2 = plan_sql(session, """
+        select count(*), sum(l_quantity) from lineitem, orders
+        where l_orderkey = o_orderkey and o_orderkey between 500 and 520
+    """)
+    assert ex2.execute_checked(root2).to_pylist() == got
+    lineitem2 = next(s for s in _scan_nodes(root2) if s.table == "lineitem")
+    assert ex2.scan_stats[lineitem2.id] > ex.scan_stats[lineitem.id]
+
+
+def test_dynamic_filter_q18_shape(session):
+    """Q18 shape: the semi-join build (high-quantity orderkeys) dynamically
+    narrows the orders scan and the outer lineitem scan."""
+    sql = """
+        select o_orderkey, sum(l_quantity)
+        from orders, lineitem
+        where o_orderkey = l_orderkey
+          and o_orderkey in (
+            select l_orderkey from lineitem
+            group by l_orderkey having sum(l_quantity) > 300)
+        group by o_orderkey
+        order by o_orderkey
+    """
+    ex = Executor(session)
+    root = plan_sql(session, sql)
+    got = ex.execute_checked(root).to_pylist()
+    baseline = Session({"catalog": "tpch", "schema": "tiny"})
+    ex0 = Executor(baseline.__class__({"catalog": "tpch", "schema": "tiny"}))
+    ex0.enable_dynamic_filtering = False
+    root0 = plan_sql(session, sql)
+    want = ex0.execute_checked(root0).to_pylist()
+    assert got == want
+    # at least one scan read fewer rows with DF on
+    def total_scanned(e, r):
+        return sum(e.scan_stats.get(s.id, 0) for s in _scan_nodes(r))
+
+    assert total_scanned(ex, root) < total_scanned(ex0, root0)
+
+
+def test_empty_build_side_empties_probe(session):
+    ex = Executor(session)
+    root = plan_sql(session, """
+        select count(*) from lineitem, orders
+        where l_orderkey = o_orderkey and o_orderkey between 2 and 3
+    """)
+    # orderkeys 2..3: orders exist; use an impossible range instead
+    root2 = plan_sql(session, """
+        select count(*) from lineitem, orders
+        where l_orderkey = o_orderkey and o_orderkey > 100000000
+    """)
+    ex2 = Executor(session)
+    assert ex2.execute_checked(root2).to_pylist() == [(0,)]
+    scans = _scan_nodes(root2)
+    lineitem = next(s for s in scans if s.table == "lineitem")
+    assert ex2.scan_stats[lineitem.id] == 0

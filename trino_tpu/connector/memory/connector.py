@@ -58,7 +58,7 @@ class MemoryConnector(spi.Connector):
         first = next(iter(cols.values()), None)
         return 0 if first is None else len(first.values)
 
-    def get_splits(self, schema: str, table: str, target_splits: int) -> List[spi.Split]:
+    def get_splits(self, schema: str, table: str, target_splits: int, constraint=None) -> List[spi.Split]:
         n = self.table_row_count(schema, table) or 0
         target_splits = max(1, min(target_splits, max(n, 1)))
         bounds = [n * i // target_splits for i in range(target_splits + 1)]
@@ -68,7 +68,7 @@ class MemoryConnector(spi.Connector):
             if bounds[i] < bounds[i + 1] or n == 0
         ] or [spi.Split(table, schema, 0, 0)]
 
-    def scan(self, split: spi.Split, columns: List[str]) -> Dict[str, spi.ColumnData]:
+    def scan(self, split: spi.Split, columns: List[str], constraint=None) -> Dict[str, spi.ColumnData]:
         _, cols = self._tables[(split.schema, split.table)]
         out = {}
         for c in columns:

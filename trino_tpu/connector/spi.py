@@ -62,6 +62,43 @@ class ColumnData:
     dictionary: Optional[Dictionary] = None
 
 
+def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
+    """Host-side row-wise concat of scanned column parts, merging varchar
+    dictionaries when parts disagree (range-dependent vocabularies). The
+    single shared implementation for engine scan assembly and connectors."""
+    assert cols
+    if len(cols) == 1:
+        return cols[0]
+    d = cols[0].dictionary
+    if d is not None:
+        for cd in cols[1:]:
+            if cd.dictionary.values != d.values:
+                d = d.merge(cd.dictionary)
+        vals = np.concatenate([
+            np.where(
+                np.asarray(cd.values) >= 0,
+                np.asarray(cd.dictionary.recode_table(d))[
+                    np.clip(np.asarray(cd.values), 0, None)],
+                -1,
+            ).astype(np.int32)
+            if cd.dictionary.values != d.values
+            else np.asarray(cd.values)
+            for cd in cols
+        ])
+    else:
+        vals = np.concatenate([np.asarray(cd.values) for cd in cols])
+    nulls = (
+        np.concatenate([
+            np.asarray(cd.nulls) if cd.nulls is not None
+            else np.zeros(len(cd.values), bool)
+            for cd in cols
+        ])
+        if any(cd.nulls is not None for cd in cols)
+        else None
+    )
+    return ColumnData(cols[0].type, vals, nulls, d)
+
+
 class Connector:
     """Reference: spi/Plugin.java -> ConnectorFactory -> Connector."""
 
@@ -88,9 +125,16 @@ class Connector:
         return None
 
     # --- splits (ConnectorSplitManager) ---
-    def get_splits(self, schema: str, table: str, target_splits: int) -> List[Split]:
+    def get_splits(
+        self, schema: str, table: str, target_splits: int, constraint=None
+    ) -> List[Split]:
+        """``constraint`` is an ADVISORY TupleDomain (connector/predicate.py;
+        reference: ConnectorMetadata.applyFilter + the DynamicFilter the
+        split manager receives): a connector may use it to skip splits but
+        the engine keeps the enforcing filter, so ignoring it is correct."""
         raise NotImplementedError
 
     # --- data (ConnectorPageSource) ---
-    def scan(self, split: Split, columns: List[str]) -> Dict[str, ColumnData]:
+    def scan(self, split: Split, columns: List[str], constraint=None) -> Dict[str, ColumnData]:
+        """``constraint`` as in get_splits — advisory row-reduction only."""
         raise NotImplementedError

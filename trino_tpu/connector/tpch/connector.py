@@ -68,21 +68,117 @@ class TpchConnector(spi.Connector):
     def primary_key(self, schema: str, table: str):
         return self._PRIMARY_KEYS.get(table)
 
-    def get_splits(self, schema: str, table: str, target_splits: int) -> List[spi.Split]:
+    # Columns monotone in the generator's row index (key = row + 1; lineitem
+    # rows are indexed by ORDER row; partsupp rows are 4 per part). A range
+    # or in-set constraint on these maps directly to row-range narrowing —
+    # the generator analog of Parquet row-group pruning by min/max stats.
+    _MONOTONE = {
+        "region": ("r_regionkey", 0, 1),  # (column, key_offset, rows_per_key)
+        "nation": ("n_nationkey", 0, 1),
+        "supplier": ("s_suppkey", 1, 1),
+        "customer": ("c_custkey", 1, 1),
+        "part": ("p_partkey", 1, 1),
+        "partsupp": ("ps_partkey", 1, 4),
+        "orders": ("o_orderkey", 1, 1),
+        "lineitem": ("l_orderkey", 1, 1),  # row index = order row
+    }
+
+    # in-set domains split into at most this many range runs (split overhead
+    # cap, like max-splits-per-request in the reference split managers)
+    MAX_PUSHDOWN_RUNS = 256
+
+    def _key_ranges(self, table: str, n: int, constraint) -> List:
+        """[(lo, hi)) generator row ranges covered by the constraint's domain
+        on the monotone key column; [(0, n)] when nothing applies."""
+        if constraint is None or table not in self._MONOTONE:
+            return [(0, n)]
+        column, off, per_key = self._MONOTONE[table]
+        dom = constraint.domain(column)
+        if dom.is_all():
+            return [(0, n)]
+
+        def key_to_rows(k):
+            base = (int(k) - off) * per_key
+            return base, base + per_key
+
+        if dom.values is not None:
+            keys = sorted(int(v) for v in dom.values if isinstance(v, (int,)) or
+                          (isinstance(v, float) and v == int(v)))
+            if not keys:
+                return []
+            runs: List = []
+            for k in keys:
+                lo, hi = key_to_rows(k)
+                if runs and lo <= runs[-1][1]:
+                    runs[-1] = (runs[-1][0], hi)
+                else:
+                    runs.append((lo, hi))
+            while len(runs) > self.MAX_PUSHDOWN_RUNS:
+                # coalesce the closest-gap neighbors to cap split count
+                gaps = sorted(range(1, len(runs)), key=lambda i: runs[i][0] - runs[i - 1][1])
+                keep = set(gaps[len(runs) - self.MAX_PUSHDOWN_RUNS:])
+                merged = [runs[0]]
+                for i in range(1, len(runs)):
+                    if i in keep:
+                        merged.append(runs[i])
+                    else:
+                        merged[-1] = (merged[-1][0], runs[i][1])
+                runs = merged
+            return [(max(0, lo), min(n, hi)) for lo, hi in runs if lo < n and hi > 0]
+        low, high = dom.value_bounds()
+        lo = 0 if low is None else max(0, key_to_rows(low)[0])
+        hi = n if high is None else min(n, key_to_rows(high)[1])
+        return [(lo, hi)] if lo < hi else []
+
+    def get_splits(
+        self, schema: str, table: str, target_splits: int, constraint=None
+    ) -> List[spi.Split]:
+        """Never returns more than ``target_splits`` splits (callers shard
+        them 1:1 onto devices/workers). When the constraint's key runs
+        outnumber the budget, runs are grouped into contiguous covers and
+        ``scan`` re-narrows each cover to the exact runs."""
         sf = schema_scale_factor(schema)
         if table == "lineitem":
             n = gen.table_row_count("orders", sf)  # order-range splits
         else:
             n = gen.table_row_count(table, sf)
-        target_splits = max(1, min(target_splits, n))
-        bounds = [n * i // target_splits for i in range(target_splits + 1)]
-        return [
-            spi.Split(table, schema, bounds[i], bounds[i + 1])
-            for i in range(target_splits)
-            if bounds[i] < bounds[i + 1]
-        ]
+        target_splits = max(target_splits, 1)
+        ranges = self._key_ranges(table, n, constraint)
+        if not ranges:
+            return []
+        if len(ranges) == 1:
+            lo0, hi0 = ranges[0]
+            rows = hi0 - lo0
+            k = max(1, min(target_splits, rows))
+            bounds = [lo0 + rows * i // k for i in range(k + 1)]
+            return [
+                spi.Split(table, schema, bounds[i], bounds[i + 1])
+                for i in range(k)
+                if bounds[i] < bounds[i + 1]
+            ]
+        if len(ranges) > target_splits:
+            # group into target_splits covers, balanced by run count
+            grouped: List = []
+            per = (len(ranges) + target_splits - 1) // target_splits
+            for i in range(0, len(ranges), per):
+                chunk = ranges[i : i + per]
+                grouped.append((chunk[0][0], chunk[-1][1]))
+            ranges = grouped
+        return [spi.Split(table, schema, lo, hi) for lo, hi in ranges]
 
-    def scan(self, split: spi.Split, columns: List[str]) -> Dict[str, spi.ColumnData]:
+    def scan(self, split: spi.Split, columns: List[str], constraint=None) -> Dict[str, spi.ColumnData]:
         sf = schema_scale_factor(split.schema)
-        data = gen.generate(split.table, sf, split.lo, split.hi, columns)
-        return {c: data[c] for c in columns}
+        ranges = [
+            (max(split.lo, lo), min(split.hi, hi))
+            for lo, hi in self._key_ranges(split.table, split.hi, constraint)
+        ]
+        ranges = [(lo, hi) for lo, hi in ranges if lo < hi]
+        parts = [gen.generate(split.table, sf, lo, hi, columns) for lo, hi in ranges]
+        if len(parts) == 1:
+            return {c: parts[0][c] for c in columns}
+        if not parts:
+            empty = gen.generate(split.table, sf, 0, 0, columns)
+            return {c: empty[c] for c in columns}
+        # merge part dictionaries where they differ (nation/region name
+        # vocabs are range-dependent) — shared helper with the engine
+        return {c: spi.concat_column_data([p[c] for p in parts]) for c in columns}

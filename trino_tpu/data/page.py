@@ -174,6 +174,22 @@ class Page:
         sb = b.sel if b.sel is not None else jnp.ones((b.num_rows,), bool)
         return Page(cols, jnp.concatenate([sa, sb]), a.replicated and b.replicated)
 
+    @staticmethod
+    def all_dead(types: Sequence[T.Type]) -> "Page":
+        """One all-dead row of the given types — the canonical empty page
+        (zero-length arrays break downstream gathers: joins index
+        counts[p], build.rows, etc., so 'empty' is 1 row with sel=False)."""
+        cols = [
+            Column(
+                t,
+                jnp.zeros((1,), t.np_dtype or np.dtype(np.int64)),
+                None,
+                Dictionary([""]) if t.is_varchar else None,
+            )
+            for t in types
+        ]
+        return Page(cols, jnp.zeros((1,), bool))
+
     def compact(self) -> "Page":
         """Drop dead rows (host-side gather). Used at wire boundaries: the
         serde (data/serde.py) carries no selection mask, so pages compact

@@ -27,6 +27,8 @@ class PreloadedExecutor(Executor):
     """Executor that reads table scans from pre-staged pages (the traced
     inputs) instead of calling the connector."""
 
+    enable_dynamic_filtering = False  # scans pre-staged before tracing
+
     def __init__(self, session, staged: Dict[int, Page], capacity_hints=None):
         super().__init__(session, capacity_hints)
         self.staged = staged

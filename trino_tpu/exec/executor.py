@@ -60,10 +60,37 @@ def _col_to_lowered(c: Column) -> join_ops.Lowered:
     return (c.values, None if c.nulls is None else ~c.nulls)
 
 
+def assemble_scan_page(column_names, column_types, datas) -> Page:
+    """Build a device Page from per-split connector scan results: concat
+    parts per column (merging varchar dictionaries via
+    spi.concat_column_data), pad empty scans to the canonical one-dead-row
+    page. Shared by the eager executor and the worker fragment executor."""
+    from trino_tpu.connector.spi import concat_column_data
+
+    if not datas:
+        return Page.all_dead(column_types)
+    cols: List[Column] = []
+    for name, typ in zip(column_names, column_types):
+        cd = concat_column_data([d[name] for d in datas])
+        cols.append(
+            Column(
+                typ,
+                jnp.asarray(cd.values),
+                jnp.asarray(cd.nulls) if cd.nulls is not None else None,
+                cd.dictionary,
+            )
+        )
+    if cols and cols[0].values.shape[0] == 0:
+        return Page.all_dead(column_types)
+    return Page(cols)
+
+
 class Executor:
     """Traceable plan interpreter. ``execute_checked`` runs eagerly and
     raises deferred errors; the recursion itself (``execute``) is pure and
     jit-safe."""
+
+    enable_dynamic_filtering = True  # traced subclasses override to False
 
     def __init__(self, session, capacity_hints: Optional[Dict[str, int]] = None):
         self.session = session
@@ -73,6 +100,15 @@ class Executor:
         # traced runs (compiled/SPMD) require the hint to pre-exist — the
         # bucketed-recompile strategy of SURVEY.md §7.3 (dynamic shapes).
         self.capacity_hints: Dict[str, int] = capacity_hints if capacity_hints is not None else {}
+        # Dynamic filtering (reference: DynamicFilterService): build-side key
+        # domains by (join_id, key_index), produced when joins execute their
+        # build side, consumed by probe-side scans. Eager execution only —
+        # traced subclasses (PreloadedExecutor/SpmdExecutor) stage scans
+        # before tracing and override the class flag (Tracers have no
+        # concrete min/max).
+        self.dyn_domains: Dict[Tuple[int, int], object] = {}
+        # rows materialized per scan plan-node id (EXPLAIN/pushdown tests)
+        self.scan_stats: Dict[int, int] = {}
 
     # ------------------------------------------------------------------ api
     def execute_checked(self, node: P.PlanNode) -> Page:
@@ -97,45 +133,29 @@ class Executor:
         return out
 
     # ----------------------------------------------------------------- scan
+    def scan_constraint(self, node: P.TableScanNode):
+        """Effective TupleDomain for a scan: static pushdown ∩ available
+        dynamic-filter domains (reference: DynamicFilter.getCurrentPredicate)."""
+        from trino_tpu.connector.predicate import TupleDomain
+
+        td = node.constraint
+        for join_id, key_idx, column in node.dynamic_filters or ():
+            dom = self.dyn_domains.get((join_id, key_idx))
+            if dom is None:
+                continue
+            extra = TupleDomain({column: dom})
+            td = extra if td is None else td.intersect(extra)
+        return td
+
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
         conn = self.session.catalogs[node.catalog]
-        splits = conn.get_splits(node.schema, node.table, 1)
-        datas = [conn.scan(s, node.column_names) for s in splits]
-        cols: List[Column] = []
-        for name, typ in zip(node.column_names, node.column_types):
-            parts = [d[name] for d in datas]
-            vals = np.concatenate([p.values for p in parts]) if len(parts) > 1 else parts[0].values
-            nulls = None
-            if any(p.nulls is not None for p in parts):
-                nulls = np.concatenate(
-                    [
-                        p.nulls if p.nulls is not None else np.zeros(len(p.values), bool)
-                        for p in parts
-                    ]
-                )
-            dictionary = parts[0].dictionary
-            cols.append(
-                Column(
-                    typ,
-                    jnp.asarray(vals),
-                    jnp.asarray(nulls) if nulls is not None else None,
-                    dictionary,
-                )
-            )
-        if cols and cols[0].values.shape[0] == 0:
-            # empty table: pad to one all-dead row — zero-length arrays break
-            # downstream gathers (joins index counts[p], build.rows, etc.)
-            pad_cols = [
-                Column(
-                    c.type,
-                    jnp.zeros((1,) + c.values.shape[1:], c.values.dtype),
-                    None,
-                    c.dictionary,
-                )
-                for c in cols
-            ]
-            return Page(pad_cols, jnp.zeros((1,), bool))
-        return Page(cols)
+        constraint = self.scan_constraint(node)
+        splits = conn.get_splits(node.schema, node.table, 1, constraint=constraint)
+        datas = [conn.scan(s, node.column_names, constraint=constraint) for s in splits]
+        self.scan_stats[node.id] = sum(
+            len(next(iter(d.values())).values) if d else 0 for d in datas
+        )
+        return assemble_scan_page(node.column_names, node.column_types, datas)
 
     def _exec_ValuesNode(self, node: P.ValuesNode) -> Page:
         cols = [
@@ -497,8 +517,12 @@ class Executor:
 
     # -------------------------------------------------------------- joins
     def _exec_JoinNode(self, node: P.JoinNode) -> Page:
-        left = self.execute(node.left)
+        # Build side FIRST (the reference's phased build-before-probe
+        # ordering) so its key domains can dynamically narrow probe scans.
         right = self.execute(node.right)
+        if self.enable_dynamic_filtering and node.dyn_filter_keys:
+            self._collect_dynamic_filters(node, right)
+        left = self.execute(node.left)
         if node.join_type in ("semi", "anti"):
             if node.filter is not None:
                 return self.semi_join_filtered(node, left, right)
@@ -510,6 +534,36 @@ class Executor:
         if node.right_unique:
             return self.lookup_join(node, left, right)
         return self.expand_join(node, left, right)
+
+    DYNAMIC_FILTER_MAX_SET = 1024  # in-set domain cap (reference: the
+    # small/large domain-compaction thresholds of DynamicFilterConfig)
+
+    def _collect_dynamic_filters(self, node: P.JoinNode, build: Page) -> None:
+        """Extract build-side key domains host-side (one device sync per
+        key) for probe scans annotated by the optimizer."""
+        from trino_tpu.connector.predicate import Domain
+
+        for i in node.dyn_filter_keys:
+            ch = node.right_keys[i]
+            col = build.columns[ch]
+            if col.type.is_varchar:
+                continue  # dictionary codes are page-local, not portable
+            vals = np.asarray(col.values)
+            live = (
+                np.ones(len(vals), bool)
+                if build.sel is None
+                else np.asarray(build.sel).copy()
+            )
+            if col.nulls is not None:
+                live &= ~np.asarray(col.nulls)
+            lv = vals[live]
+            if len(lv) == 0:
+                dom = Domain(values=frozenset())  # provably empty probe
+            elif len(lv) <= self.DYNAMIC_FILTER_MAX_SET:
+                dom = Domain.from_values(np.unique(lv).tolist())
+            else:
+                dom = Domain.range(low=lv.min().item(), high=lv.max().item())
+            self.dyn_domains[(node.id, i)] = dom
 
     def hint_capacity(self, key: str, emit_counts) -> int:
         """Static output capacity for an expansion join or exchange, by hint

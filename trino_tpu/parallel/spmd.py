@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as PSpec
 
 from trino_tpu import types as T
+from trino_tpu.connector import spi as spi_mod
 from trino_tpu.data.page import Column, Page
 from trino_tpu.exec.executor import Executor, QueryError
 from trino_tpu.exec.page_tree import PageSpec, flatten_page, unflatten_page
@@ -81,6 +82,8 @@ class SpmdExecutor(Executor):
     parallel/exchange.py) when stats say the data is too big to replicate —
     the same predicates (sql/planner/stats.py) drive build-time capacity
     hints, so the trace always finds its hints."""
+
+    enable_dynamic_filtering = False  # scans pre-staged before tracing
 
     def __init__(self, session, staged: Dict[int, Page], capacity_hints=None, n_devices: int = 1):
         super().__init__(session, capacity_hints)
@@ -194,13 +197,20 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
         if not isinstance(node, P.TableScanNode):
             continue
         conn = session.catalogs[node.catalog]
-        splits = conn.get_splits(node.schema, node.table, n_devices)
+        # static constraint pushdown only: staging happens before the traced
+        # program (and its build sides) runs, so dynamic filters cannot
+        # narrow here — the reference's split-time DynamicFilter blocking
+        # maps to a host-side two-phase execution (later round)
+        splits = conn.get_splits(
+            node.schema, node.table, n_devices, constraint=node.constraint)
         shard_pages = []
         for di in range(n_devices):
             if di < len(splits):
-                data = conn.scan(splits[di], node.column_names)
+                data = conn.scan(splits[di], node.column_names, constraint=node.constraint)
             else:
-                data = conn.scan(dataclasses.replace(splits[0], lo=0, hi=0), node.column_names)
+                empty = dataclasses.replace(
+                    (splits or [spi_mod.Split(node.table, node.schema, 0, 0)])[0], lo=0, hi=0)
+                data = conn.scan(empty, node.column_names)
             cols = []
             for name, typ in zip(node.column_names, node.column_types):
                 cd = data[name]

@@ -96,7 +96,8 @@ class QueryExecution:
         self._thread.start()
 
     def cancel(self) -> None:
-        self.state.set("CANCELED")
+        if self.state.set("CANCELED"):
+            self._cancel_tasks()
 
     # ------------------------------------------------------------ lifecycle
     def _run(self) -> None:
@@ -157,7 +158,8 @@ class QueryExecution:
                     continue
                 conn = session.catalogs[node.catalog]
                 splits = conn.get_splits(node.schema, node.table,
-                                         max(len(workers), 1))
+                                         max(len(workers), 1),
+                                         constraint=node.constraint)
                 for i, split in enumerate(splits):
                     w = i % len(workers)
                     per_worker_splits[w].setdefault(node.id, []).append(split)
@@ -252,11 +254,18 @@ class CoordinatorServer:
         self.httpd.shutdown()
         self.httpd.server_close()
 
+    # retained terminal queries (history for /v1/query) — oldest evicted
+    # with their materialized result rows (reference: query.max-history)
+    MAX_QUERY_HISTORY = 100
+
     def submit(self, sql: str, properties: Optional[dict] = None) -> QueryExecution:
         query_id = f"q{time.strftime('%Y%m%d')}_{next(self._qid):05d}_{uuid.uuid4().hex[:5]}"
         execution = QueryExecution(
             query_id, sql, properties or {}, self.registry, self.session_factory)
         with self._qlock:
+            terminal = [qid for qid, q in self.queries.items() if q.state.is_terminal()]
+            for qid in terminal[: max(0, len(terminal) - self.MAX_QUERY_HISTORY)]:
+                del self.queries[qid]
             self.queries[query_id] = execution
         execution.start()
         return execution
@@ -274,6 +283,9 @@ def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) ->
     }
     if state == "FAILED":
         payload["error"] = {"message": q.failure or "query failed"}
+        return payload
+    if state == "CANCELED":
+        payload["error"] = {"message": "query was canceled"}
         return payload
     if state != "FINISHED":
         payload["nextUri"] = f"{server.base_url}/v1/statement/executing/{q.query_id}/{token}"

@@ -19,10 +19,7 @@ import threading
 import traceback
 from typing import Dict, List, Optional
 
-import jax.numpy as jnp
-import numpy as np
-
-from trino_tpu.data.page import Column, Page
+from trino_tpu.data.page import Page
 from trino_tpu.data.serde import serialize_page
 from trino_tpu.exec.executor import Executor
 from trino_tpu.server.buffer import OutputBuffer
@@ -64,54 +61,29 @@ class FragmentExecutor(Executor):
         self._remote_pages = remote_pages
 
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
+        from trino_tpu.exec.executor import assemble_scan_page
+
         conn = self.session.catalogs[node.catalog]
         splits = self._splits.get(node.id, [])
-        datas = [conn.scan(s, node.column_names) for s in splits]
-        cols: List[Column] = []
-        for name, typ in zip(node.column_names, node.column_types):
-            parts = [d[name] for d in datas]
-            if not parts:
-                cols.append(Column(typ, jnp.zeros((1,), typ.np_dtype or np.dtype(np.int64)),
-                                   None, _empty_dict(typ)))
-                continue
-            vals = np.concatenate([np.asarray(p.values) for p in parts])
-            nulls = None
-            if any(p.nulls is not None for p in parts):
-                nulls = np.concatenate(
-                    [np.asarray(p.nulls) if p.nulls is not None
-                     else np.zeros(len(p.values), bool) for p in parts]
-                )
-            cols.append(Column(typ, jnp.asarray(vals),
-                               jnp.asarray(nulls) if nulls is not None else None,
-                               parts[0].dictionary))
-        if not datas:
-            return Page(cols, jnp.zeros((1,), bool))
-        if cols and cols[0].values.shape[0] == 0:
-            pad = [Column(c.type, jnp.zeros((1,) + c.values.shape[1:], c.values.dtype),
-                          None, c.dictionary) for c in cols]
-            return Page(pad, jnp.zeros((1,), bool))
-        return Page(cols)
+        # splits were assigned by the coordinator (static constraint already
+        # applied); dynamic-filter domains collected in THIS fragment still
+        # narrow the per-split scan
+        constraint = self.scan_constraint(node)
+        datas = [conn.scan(s, node.column_names, constraint=constraint) for s in splits]
+        self.scan_stats[node.id] = sum(
+            len(next(iter(d.values())).values) if d else 0 for d in datas
+        )
+        return assemble_scan_page(node.column_names, node.column_types, datas)
 
     def _exec_RemoteSourceNode(self, node: RemoteSourceNode) -> Page:
         pages = self._remote_pages.get(node.fragment_id, [])
         pages = [p for p in pages if p.num_rows > 0]
         if not pages:
-            cols = [
-                Column(t, jnp.zeros((1,), t.np_dtype or np.dtype(np.int64)),
-                       None, _empty_dict(t))
-                for t in node.types
-            ]
-            return Page(cols, jnp.zeros((1,), bool))
+            return Page.all_dead(node.types)
         page = pages[0]
         for p in pages[1:]:
             page = Page.concat_pages(page, p)
         return page
-
-
-def _empty_dict(typ):
-    from trino_tpu.data.dictionary import Dictionary
-
-    return Dictionary([""]) if typ.is_varchar else None
 
 
 class SqlTask:
@@ -171,6 +143,10 @@ class SqlTask:
 class TaskManager:
     """All tasks on this worker (reference: SqlTaskManager.java:109)."""
 
+    # retained terminal tasks (status queries/late acks) — oldest evicted
+    # (reference: SqlTaskManager's task info cache expiry)
+    MAX_TASK_HISTORY = 200
+
     def __init__(self, session_factory):
         self._tasks: Dict[str, SqlTask] = {}
         self._lock = threading.Lock()
@@ -178,6 +154,9 @@ class TaskManager:
 
     def create_task(self, request: TaskRequest) -> SqlTask:
         with self._lock:
+            terminal = [tid for tid, t in self._tasks.items() if t.state.is_terminal()]
+            for tid in terminal[: max(0, len(terminal) - self.MAX_TASK_HISTORY)]:
+                del self._tasks[tid]
             task = self._tasks.get(request.task_id)
             if task is None:
                 task = SqlTask(request, self._session_factory)

@@ -31,7 +31,131 @@ def optimize(root: P.OutputNode, session=None) -> P.OutputNode:
     node = orient_joins(node, session)
     node, _ = prune_channels(node, set(range(len(node.output_types))))
     node = merge_identity_projects(node)
+    derive_scan_constraints(node)
+    plan_dynamic_filters(node)
     return P.OutputNode(node, root.column_names)
+
+
+# ------------------------------------------- scan constraint pushdown
+
+
+def derive_scan_constraints(node: P.PlanNode) -> None:
+    """Attach a TupleDomain to every scan under a filter (reference:
+    PushPredicateIntoTableScan + ConnectorMetadata.applyFilter). The
+    constraint is advisory: the enforcing FilterNode is KEPT, so connectors
+    may ignore or over-approximate it."""
+    from trino_tpu.connector.predicate import TupleDomain
+
+    for child in node.sources:
+        derive_scan_constraints(child)
+    if isinstance(node, P.FilterNode) and isinstance(node.source, P.TableScanNode):
+        scan = node.source
+        td = TupleDomain.all()
+        for conj in ir_conjuncts(node.predicate):
+            d = _conjunct_domain(conj, scan)
+            if d is not None:
+                td = td.intersect(d)
+        if not td.is_all():
+            scan.constraint = td if scan.constraint is None else scan.constraint.intersect(td)
+
+
+def _conjunct_domain(e: ir.Expr, scan: P.TableScanNode):
+    """Single-column comparison conjunct -> TupleDomain, else None."""
+    from trino_tpu.connector.predicate import Domain, TupleDomain
+
+    if not isinstance(e, ir.Call):
+        return None
+
+    def col_const(args):
+        a, b = args
+        if isinstance(a, ir.ColumnRef) and isinstance(b, ir.Constant) and b.value is not None:
+            return a, b.value, False
+        if isinstance(b, ir.ColumnRef) and isinstance(a, ir.Constant) and a.value is not None:
+            return b, a.value, True
+        return None, None, False
+
+    name = e.name
+    if name in ("eq", "lt", "le", "gt", "ge") and len(e.args) == 2:
+        col, v, flipped = col_const(e.args)
+        if col is None:
+            return None
+        if flipped:  # const OP col  ==  col FLIP(OP) const
+            name = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}[name]
+        dom = {
+            "eq": lambda: Domain.from_values([v]),
+            "lt": lambda: Domain.range(high=v, high_inclusive=False),
+            "le": lambda: Domain.range(high=v),
+            "gt": lambda: Domain.range(low=v, low_inclusive=False),
+            "ge": lambda: Domain.range(low=v),
+        }[name]()
+        return TupleDomain({scan.column_names[col.index]: dom})
+    if name == "between" and len(e.args) == 3:
+        col, lo, hi = e.args
+        if (isinstance(col, ir.ColumnRef) and isinstance(lo, ir.Constant)
+                and isinstance(hi, ir.Constant)
+                and lo.value is not None and hi.value is not None):
+            return TupleDomain(
+                {scan.column_names[col.index]: Domain.range(low=lo.value, high=hi.value)})
+        return None
+    if name == "in_list":
+        col = e.args[0]
+        rest = e.args[1:]
+        if isinstance(col, ir.ColumnRef) and all(
+                isinstance(a, ir.Constant) and a.value is not None for a in rest):
+            return TupleDomain(
+                {scan.column_names[col.index]: Domain.from_values([a.value for a in rest])})
+    return None
+
+
+# ------------------------------------------------- dynamic filter planning
+
+
+def plan_dynamic_filters(node: P.PlanNode) -> None:
+    """Annotate probe-side scans of inner/semi joins with the joins whose
+    build-side key domains can narrow them at runtime (reference:
+    DynamicFilterService.java:105 + LocalDynamicFilterConsumer): the
+    executor runs build sides first, extracts key min/max (or small
+    in-sets), and hands the domain to the scan's connector."""
+    for child in node.sources:
+        plan_dynamic_filters(child)
+    if not isinstance(node, P.JoinNode):
+        return
+    if node.join_type not in ("inner", "semi") or node.singleton:
+        return
+    for i, probe_ch in enumerate(node.left_keys or []):
+        target = _trace_to_scan(node.left, probe_ch)
+        if target is None:
+            continue
+        scan, column = target
+        if scan.dynamic_filters is None:
+            scan.dynamic_filters = []
+        scan.dynamic_filters.append((node.id, i, column))
+        if node.dyn_filter_keys is None:
+            node.dyn_filter_keys = []
+        node.dyn_filter_keys.append(i)
+
+
+def _trace_to_scan(node: P.PlanNode, channel: int):
+    """Follow ``channel`` down through row-preserving/identity mappings to
+    the originating scan column, or None."""
+    if isinstance(node, P.TableScanNode):
+        return node, node.column_names[channel]
+    if isinstance(node, P.FilterNode):
+        # row-preserving in the required direction: pruned scan rows could
+        # only be rows the join drops anyway. LIMIT is NOT traceable — which
+        # rows a limit admits depends on what the scan materialized, so
+        # pruning would change results.
+        return _trace_to_scan(node.source, channel)
+    if isinstance(node, P.ProjectNode):
+        e = node.expressions[channel]
+        if isinstance(e, ir.ColumnRef):
+            return _trace_to_scan(node.source, e.index)
+        return None
+    if isinstance(node, P.JoinNode):
+        if node.join_type in ("semi", "anti") or channel < len(node.left.output_types):
+            return _trace_to_scan(node.left, channel)
+        return _trace_to_scan(node.right, channel - len(node.left.output_types))
+    return None
 
 
 def merge_identity_projects(node: P.PlanNode) -> P.PlanNode:

@@ -47,6 +47,13 @@ class TableScanNode(PlanNode):
     column_names: List[str]
     column_types: List[T.Type]
     table_handle: object = None  # connector-provided
+    # Static pushdown (reference: applyFilter/TupleDomain): advisory
+    # constraint derived from filter conjuncts; the filter is kept.
+    constraint: object = None  # Optional[TupleDomain]
+    # Runtime narrowing (reference: DynamicFilterService/DynamicFilter):
+    # [(join_node_id, key_index, column_name)] — at execution the scan
+    # waits for the named join's build-side key domain.
+    dynamic_filters: List = None
 
     @property
     def output_types(self):
@@ -198,6 +205,10 @@ class JoinNode(PlanNode):
     distribution: Optional[str] = None  # 'partitioned' | 'broadcast'
     right_unique: bool = False  # build side keys unique (N:1 lookup join)
     singleton: bool = False  # right side is a scalar subquery (exactly 1 row)
+    # key indices whose build-side domain some probe scan consumes as a
+    # dynamic filter (set by optimizer.plan_dynamic_filters) — the executor
+    # extracts domains only for these
+    dyn_filter_keys: List[int] = None
 
     @property
     def sources(self):
