@@ -142,6 +142,23 @@ def test_query_info_and_node_listing(cluster):
     assert info["fragments"]  # at least one scheduled source fragment
 
 
+def test_set_session_round_trips_through_protocol(cluster):
+    """SET SESSION is stateless on the coordinator: the payload carries the
+    property back and the client applies it to subsequent statements
+    (reference: X-Trino-Set-Session)."""
+    coord, _ = cluster
+    from trino_tpu.client.remote import StatementClient
+
+    client = StatementClient(coord.base_url, {"catalog": "tpch", "schema": "tiny"})
+    client.execute("set session dynamic_filtering_enabled = false")
+    assert client.session_properties["dynamic_filtering_enabled"] is False
+    # subsequent query still works with the applied property
+    _, rows = client.execute("select count(*) from region")
+    assert rows == [[5]]
+    client.execute("reset session dynamic_filtering_enabled")
+    assert "dynamic_filtering_enabled" not in client.session_properties
+
+
 def test_failed_query_reports_error(cluster):
     coord, _ = cluster
     from trino_tpu.client.remote import RemoteQueryError

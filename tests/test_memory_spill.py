@@ -1,0 +1,117 @@
+"""Memory accounting + host-offload spill tests (VERDICT round-1 item 8).
+
+Reference behaviors matched: lib/trino-memory-context accounting,
+HashBuilderOperator spill FSM / SpillableHashAggregationBuilder — here
+realized as hash-partitioned multi-pass execution with host RAM as the
+spill tier (exec/memory.py).
+"""
+import numpy as np
+import pytest
+
+from trino_tpu.client.session import Session
+from trino_tpu.exec.executor import Executor
+from trino_tpu.exec.memory import MemoryContext, page_bytes, partition_page_host
+from trino_tpu.exec.query import plan_sql
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session({"catalog": "tpch", "schema": "tiny"})
+
+
+def _run(session, sql, budget=None):
+    props = {"catalog": "tpch", "schema": "tiny"}
+    if budget is not None:
+        props["query_max_device_memory"] = budget
+    s = Session(props)
+    ex = Executor(s)
+    root = plan_sql(s, sql)
+    return ex, sorted(ex.execute_checked(root).to_pylist())
+
+
+def test_memory_context_partition_choice():
+    mc = MemoryContext(1000)
+    assert mc.spill_partitions(900) == 1
+    assert mc.spill_partitions(1500) == 2
+    assert mc.spill_partitions(7000) == 8
+    assert mc.peak == 7000
+    assert MemoryContext(None).spill_partitions(10**12) == 1  # no budget
+
+
+def test_partition_page_host_exact_cover(session):
+    ex = Executor(session)
+    root = plan_sql(session, "select o_orderkey, o_custkey from orders")
+    page = ex.execute_checked(root)
+    parts = partition_page_host(page, [0], 4)
+    keys = sorted(
+        int(k) for p in parts for k, live in
+        zip(np.asarray(p.columns[0].values),
+            np.ones(p.num_rows, bool) if p.sel is None else np.asarray(p.sel))
+        if live
+    )
+    assert keys == sorted(int(v) for v in np.asarray(page.columns[0].values))
+    # equal keys co-locate: each partition's key set is disjoint
+    sets = [
+        {int(k) for k, live in zip(np.asarray(p.columns[0].values),
+                                   np.ones(p.num_rows, bool) if p.sel is None
+                                   else np.asarray(p.sel)) if live}
+        for p in parts
+    ]
+    for i in range(len(sets)):
+        for j in range(i + 1, len(sets)):
+            assert not (sets[i] & sets[j])
+
+
+JOIN_SQL = """
+    select c_custkey, c_name, o_orderkey, o_totalprice
+    from customer, orders
+    where c_custkey = o_custkey and o_orderdate < date '1992-06-01'
+"""
+
+
+def test_join_spills_and_matches(session):
+    ex_ref, want = _run(session, JOIN_SQL)
+    assert not ex_ref.memory.spills
+    ex_sp, got = _run(session, JOIN_SQL, budget=200_000)
+    assert got == want
+    joins = [s for s in ex_sp.memory.spills if s.kind == "join"]
+    assert joins and joins[0].partitions >= 2
+    assert ex_sp.memory.peak > 200_000  # projected bytes were observed
+
+
+AGG_SQL = """
+    select l_orderkey, count(*), sum(l_quantity)
+    from lineitem group by l_orderkey
+"""
+
+
+def test_aggregation_spills_and_matches(session):
+    _, want = _run(session, AGG_SQL)
+    ex_sp, got = _run(session, AGG_SQL, budget=300_000)
+    assert got == want
+    aggs = [s for s in ex_sp.memory.spills if s.kind == "aggregation"]
+    assert aggs and aggs[0].partitions >= 2
+
+
+def test_left_outer_join_spill_preserves_unmatched(session):
+    sql = """
+        select c_custkey, o_orderkey
+        from customer left join orders
+          on c_custkey = o_custkey and o_totalprice > 500000.00
+    """
+    _, want = _run(session, sql)
+    ex_sp, got = _run(session, sql, budget=150_000)
+    assert got == want
+    assert any(s.kind == "join" for s in ex_sp.memory.spills)
+    # unmatched customers survive with NULL build side
+    assert any(r[1] is None for r in got)
+
+
+def test_semi_join_spill(session):
+    sql = """
+        select count(*) from customer
+        where c_custkey in (select o_custkey from orders where o_totalprice > 300000.00)
+    """
+    _, want = _run(session, sql)
+    ex_sp, got = _run(session, sql, budget=100_000)
+    assert got == want

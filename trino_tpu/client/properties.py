@@ -1,0 +1,108 @@
+"""Typed session properties.
+
+Reference: ``SystemSessionProperties.java`` (1,985 lines, ~200 typed
+properties) + ``SessionPropertyManager`` — every knob is declared with a
+type, default, and description; setting an unknown property or a
+badly-typed value is an error at set time, not a silent no-op at use time.
+
+The registry here covers the knobs the engine actually reads; add an entry
+when a new subsystem grows a switch.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertyMetadata:
+    name: str
+    description: str
+    py_type: type
+    default: Any
+    validate: Optional[Callable[[Any], Optional[str]]] = None  # -> error | None
+
+
+def _positive(v) -> Optional[str]:
+    return None if v > 0 else "must be positive"
+
+
+SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
+    p.name: p
+    for p in [
+        PropertyMetadata("catalog", "default catalog", str, "tpch"),
+        PropertyMetadata("schema", "default schema", str, "tiny"),
+        PropertyMetadata(
+            "query_max_device_memory",
+            "per-query device working-set budget in bytes; exceeding it "
+            "spills joins/aggregations to host-partitioned passes "
+            "(reference: query.max-memory-per-node)",
+            int, None, lambda v: _positive(v) if v is not None else None,
+        ),
+        PropertyMetadata(
+            "dynamic_filtering_enabled",
+            "collect build-side join key domains at runtime to narrow probe "
+            "scans (reference: enable_dynamic_filtering)",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "spill_enabled",
+            "allow over-budget joins/aggregations to run as host-partitioned "
+            "passes instead of failing (reference: spill_enabled)",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "target_result_page_rows",
+            "rows per result page on the client protocol",
+            int, 10_000, _positive,
+        ),
+    ]
+}
+
+
+def validate_property(name: str, value: Any) -> Any:
+    """Coerce + validate one property; raises ValueError with the known-name
+    list on unknown properties (the reference's 'Session property X does not
+    exist' error)."""
+    meta = SYSTEM_SESSION_PROPERTIES.get(name)
+    if meta is None:
+        known = ", ".join(sorted(SYSTEM_SESSION_PROPERTIES))
+        raise ValueError(f"session property '{name}' does not exist (known: {known})")
+    if value is None:
+        if meta.default is None:
+            return None
+        raise ValueError(f"session property '{name}' cannot be null")
+    if meta.py_type is bool and isinstance(value, str):
+        if value.lower() in ("true", "1"):
+            value = True
+        elif value.lower() in ("false", "0"):
+            value = False
+        else:
+            raise ValueError(f"session property '{name}': expected boolean, got {value!r}")
+    elif meta.py_type is int and isinstance(value, str):
+        try:
+            value = int(value)
+        except ValueError:
+            raise ValueError(f"session property '{name}': expected integer, got {value!r}")
+    if not isinstance(value, meta.py_type):
+        raise ValueError(
+            f"session property '{name}': expected {meta.py_type.__name__},"
+            f" got {type(value).__name__}"
+        )
+    if meta.validate is not None:
+        err = meta.validate(value)
+        if err:
+            raise ValueError(f"session property '{name}': {err}")
+    return value
+
+
+def defaulted(properties: Dict[str, Any]) -> Dict[str, Any]:
+    """Validated property map with registry defaults filled in."""
+    out = {
+        name: meta.default
+        for name, meta in SYSTEM_SESSION_PROPERTIES.items()
+        if meta.default is not None
+    }
+    for k, v in properties.items():
+        out[k] = validate_property(k, v)
+    return out

@@ -43,6 +43,12 @@ class StatementClient:
         while True:
             if "error" in payload:
                 raise RemoteQueryError(payload["error"]["message"])
+            # SET/RESET SESSION round-trip: apply to subsequent statements
+            # (reference: StatementClientV1 processes X-Trino-Set-Session)
+            for k, v in payload.get("setSessionProperties", {}).items():
+                self.session_properties[k] = v
+            for k in payload.get("resetSessionProperties", []):
+                self.session_properties.pop(k, None)
             if "columns" in payload:
                 columns = [c["name"] for c in payload["columns"]]
             rows.extend(payload.get("data", []))

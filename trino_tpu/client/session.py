@@ -14,11 +14,19 @@ class Session:
     """A query session: catalogs, session properties, and an executor."""
 
     def __init__(self, properties: Optional[Dict[str, Any]] = None, num_partitions: int = 1):
+        from trino_tpu.client.properties import defaulted
         from trino_tpu.connector.registry import default_catalogs
 
         self.catalogs = default_catalogs()
-        self.properties: Dict[str, Any] = dict(properties or {})
+        self.properties: Dict[str, Any] = defaulted(dict(properties or {}))
         self.num_partitions = num_partitions
+
+    def set_property(self, name: str, value: Any) -> None:
+        """SET SESSION analog: typed/validated (client/properties.py;
+        reference: SystemSessionProperties + SessionPropertyManager)."""
+        from trino_tpu.client.properties import validate_property
+
+        self.properties[name] = validate_property(name, value)
 
     def execute(self, sql: str):
         """Run a query; returns a QueryResult (column names + Python rows)."""

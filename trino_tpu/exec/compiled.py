@@ -27,7 +27,9 @@ class PreloadedExecutor(Executor):
     """Executor that reads table scans from pre-staged pages (the traced
     inputs) instead of calling the connector."""
 
+    eager_tier = False  # runs under jax tracing: no host-side syncs
     enable_dynamic_filtering = False  # scans pre-staged before tracing
+    collect_stats = False  # tracing once; per-call timing is meaningless
 
     def __init__(self, session, staged: Dict[int, Page], capacity_hints=None):
         super().__init__(session, capacity_hints)

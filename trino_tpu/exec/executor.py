@@ -17,6 +17,7 @@ deferred-error contract of ops/expr_lower.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -90,7 +91,13 @@ class Executor:
     raises deferred errors; the recursion itself (``execute``) is pure and
     jit-safe."""
 
-    enable_dynamic_filtering = True  # traced subclasses override to False
+    # Eager tier: host-side recursion over concrete arrays (the local path
+    # and worker fragments). Traced subclasses (PreloadedExecutor,
+    # SpmdExecutor) run under jax tracing where host-side syncs (stats,
+    # dynamic-filter domains, spill partitioning) are impossible.
+    eager_tier = True
+    enable_dynamic_filtering = True  # AND-ed with the session property
+    collect_stats = True  # per-operator wall/rows (traced subclasses: False)
 
     def __init__(self, session, capacity_hints: Optional[Dict[str, int]] = None):
         self.session = session
@@ -109,6 +116,22 @@ class Executor:
         self.dyn_domains: Dict[Tuple[int, int], object] = {}
         # rows materialized per scan plan-node id (EXPLAIN/pushdown tests)
         self.scan_stats: Dict[int, int] = {}
+        # per-operator stats by plan-node id (EXPLAIN ANALYZE)
+        self.node_stats: Dict[int, dict] = {}
+        # device-memory budget + spill decisions (exec/memory.py; reference:
+        # lib/trino-memory-context + the spill FSMs). Property name mirrors
+        # the reference's query_max_memory_per_node.
+        from trino_tpu.exec.memory import MemoryContext
+
+        props = (
+            session.properties
+            if session is not None and hasattr(session, "properties")
+            else {}
+        ) or {}
+        self.memory = MemoryContext(props.get("query_max_device_memory"))
+        if not props.get("dynamic_filtering_enabled", True):
+            self.enable_dynamic_filtering = False
+        self.spill_enabled = bool(props.get("spill_enabled", True))
 
     # ------------------------------------------------------------------ api
     def execute_checked(self, node: P.PlanNode) -> Page:
@@ -123,7 +146,19 @@ class Executor:
         method = getattr(self, f"_exec_{type(node).__name__}", None)
         if method is None:
             raise NotImplementedError(f"executor: {type(node).__name__}")
-        return method(node)
+        if not self.collect_stats:
+            return method(node)
+        # per-operator profiling, always on in the eager tier (reference:
+        # OperatorContext/OperatorStats via OperationTimer — SURVEY.md §5.1)
+        t0 = time.perf_counter()
+        page = method(node)
+        wall = time.perf_counter() - t0
+        st = self.node_stats.setdefault(
+            node.id, {"name": type(node).__name__.replace("Node", ""), "wall_s": 0.0}
+        )
+        st["wall_s"] += wall
+        st["output_rows"] = page.live_count()  # live rows, not padded slots
+        return page
 
     def _lower(self, e: ir.Expr, page: Page) -> L.LoweredVal:
         ctx = L.LowerCtx(page.columns, page.num_rows, page.sel)
@@ -385,6 +420,10 @@ class Executor:
         """Group and aggregate; output has `capacity` rows, sel marking live
         groups (prefix for the sort path, occupancy mask for the direct
         path — both in group-key order)."""
+        if node.group_channels and self.eager_tier:
+            spilled = self._maybe_spill_aggregation(node, page)
+            if spilled is not None:
+                return spilled
         n = page.num_rows
         sel = page.sel
         if n == 0:
@@ -418,6 +457,32 @@ class Executor:
                 )
             )
         return Page(out_cols, out_sel, page.replicated)
+
+    _in_spill_pass = False  # reentrancy guard for partitioned passes
+
+    def _maybe_spill_aggregation(self, node: P.AggregationNode, page: Page):
+        """Over-budget group-by: hash-partition rows by group key host-side,
+        aggregate each partition fully on device, concatenate. Partitions
+        hold disjoint group-key sets, so per-partition results are exact
+        (reference: SpillableHashAggregationBuilder, host RAM as the tier)."""
+        from trino_tpu.exec import memory as mem
+
+        if self._in_spill_pass or not self.spill_enabled:
+            return None
+        projected = mem.page_bytes(page)
+        parts = self.memory.spill_partitions(projected)
+        if parts <= 1:
+            return None
+        self.memory.record_spill(node.id, "aggregation", parts, projected)
+        out = None
+        self._in_spill_pass = True
+        try:
+            for part in mem.partition_page_host(page, node.group_channels, parts):
+                res = self.aggregate_page(node, part).compact()
+                out = res if out is None else Page.concat_pages(out, res)
+        finally:
+            self._in_spill_pass = False
+        return out
 
     def _exec_aggregate(self, call: P.AggregateCall, page, sel, layout):
         if call.distinct:
@@ -523,6 +588,49 @@ class Executor:
         if self.enable_dynamic_filtering and node.dyn_filter_keys:
             self._collect_dynamic_filters(node, right)
         left = self.execute(node.left)
+        return self._dispatch_join(node, left, right)
+
+    def _dispatch_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        if node.left_keys and self.eager_tier:
+            # eager tier: spill-partition when the working set exceeds the
+            # device budget (traced tiers bound memory via capacity hints)
+            spilled = self._maybe_spill_join(node, left, right)
+            if spilled is not None:
+                return spilled
+        return self._run_join_kernel(node, left, right)
+
+    def _maybe_spill_join(self, node: P.JoinNode, left: Page, right: Page):
+        """Host-offload spill (exec/memory.py): when probe+build exceed the
+        device budget, hash-partition BOTH sides by join key host-side and
+        run the join as P independent on-device passes (equal keys
+        co-locate, so the union of pass outputs is the exact join). The
+        reference's partitioned-spill design (HashBuilderOperator FSM +
+        GenericPartitioningSpiller) with host RAM as the spill tier."""
+        from trino_tpu.exec import memory as mem
+
+        if not self.spill_enabled:
+            return None
+        projected = mem.page_bytes(left) + mem.page_bytes(right)
+        parts = self.memory.spill_partitions(projected)
+        if parts <= 1:
+            return None
+        self.memory.record_spill(node.id, "join", parts, projected)
+        lparts = mem.partition_page_host(left, node.left_keys, parts)
+        rparts = mem.partition_page_host(right, node.right_keys, parts)
+        out = None
+        hint_key = f"join:{node.id}"
+        for lp, rp in zip(lparts, rparts):
+            # per-pass expansion capacity: each pass sizes its own bucket
+            self.capacity_hints.pop(hint_key, None)
+            res = self._run_join_kernel(node, lp, rp)
+            res = res.compact()  # spill the pass result to host-sized rows
+            out = res if out is None else Page.concat_pages(out, res)
+        self.capacity_hints.pop(hint_key, None)
+        return out
+
+    def _run_join_kernel(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        """The single join-kernel dispatch, shared by the direct path and
+        the spilled per-partition passes."""
         if node.join_type in ("semi", "anti"):
             if node.filter is not None:
                 return self.semi_join_filtered(node, left, right)

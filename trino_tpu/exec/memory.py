@@ -1,0 +1,154 @@
+"""Device-memory accounting and the spill decision.
+
+Reference: ``lib/trino-memory-context`` (``AggregatedMemoryContext.java:30``,
+``LocalMemoryContext.java:31``) + ``memory/QueryContext.java:58`` — operator
+reservations roll up to a per-query pool; exceeding revocable memory
+triggers spill (``HashBuilderOperator.java:162-177`` FSM,
+``SpillableHashAggregationBuilder``).
+
+TPU-first redesign (SURVEY.md §7.2 step 9): page shapes are static, so
+"reservation" is exact arithmetic on array bytes — no JVM-style object
+walking. The spill tier is HOST RAM, not disk: an over-budget join or
+aggregation hash-partitions its inputs host-side into P passes and runs
+each pass on device (the partitioned-spill design of
+``GenericPartitioningSpiller`` collapsed into a loop over compiled kernels).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+def page_bytes(page) -> int:
+    """Exact device bytes of a Page (static shapes make this precise)."""
+    total = 0
+    for c in page.columns:
+        total += c.values.size * c.values.dtype.itemsize
+        if c.nulls is not None:
+            total += c.nulls.size  # bool = 1 byte
+    if page.sel is not None:
+        total += page.sel.size
+    return total
+
+
+@dataclasses.dataclass
+class SpillEvent:
+    node_id: int
+    kind: str  # 'join' | 'aggregation'
+    partitions: int
+    projected_bytes: int
+
+
+class MemoryContext:
+    """Per-query device-memory budget + peak tracking + spill log."""
+
+    MAX_SPILL_PARTITIONS = 64
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self.budget = int(budget_bytes) if budget_bytes else None
+        self.peak = 0
+        self.spills: List[SpillEvent] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget is not None
+
+    def observe(self, nbytes: int) -> None:
+        if nbytes > self.peak:
+            self.peak = nbytes
+
+    def spill_partitions(self, projected_bytes: int) -> int:
+        """1 = fits in budget; else the number of hash partitions (power of
+        two) whose per-pass working set fits."""
+        self.observe(projected_bytes)
+        if self.budget is None or projected_bytes <= self.budget:
+            return 1
+        parts = 1
+        while parts < self.MAX_SPILL_PARTITIONS and projected_bytes // parts > self.budget:
+            parts *= 2
+        return parts
+
+    def record_spill(self, node_id: int, kind: str, partitions: int, projected: int) -> None:
+        self.spills.append(SpillEvent(node_id, kind, partitions, projected))
+
+
+# ------------------------------------------------- host-side partitioning
+
+_M1 = 0xBF58476D1CE4E5B9
+_M2 = 0x94D049BB133111EB
+_NULL_HASH = 0x9E3779B97F4A7C15
+_MASK = (1 << 64) - 1
+
+
+def _mix64_np(x):
+    import numpy as np
+
+    x = x.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(_M1)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(_M2)
+        return x ^ (x >> np.uint64(31))
+
+
+def partition_page_host(page, key_channels, parts: int):
+    """Split a page into ``parts`` hash partitions by key columns, host-side
+    (numpy) — the spill write path. Equal keys co-locate (same splitmix64
+    combine as the device exchange, parallel/exchange.py, so a spilled join
+    and an exchanged join agree on placement); dead rows are dropped.
+
+    Returns a list of ``parts`` compacted Pages (1-row all-dead when empty).
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trino_tpu.data.page import Column, Page
+
+    n = page.num_rows
+    live = np.ones(n, bool) if page.sel is None else np.asarray(page.sel)
+    h = np.zeros(n, np.uint64)
+    for ch in key_channels:
+        col = page.columns[ch]
+        k = _mix64_np(np.asarray(col.values).astype(np.int64))
+        if col.nulls is not None:
+            k = np.where(np.asarray(col.nulls), np.uint64(_NULL_HASH), k)
+        h = _mix64_np(h ^ k)
+    pid = (h % np.uint64(parts)).astype(np.int64)
+    host_cols = [
+        (np.asarray(c.values), None if c.nulls is None else np.asarray(c.nulls))
+        for c in page.columns
+    ]
+    out = []
+    for p in range(parts):
+        idx = np.nonzero(live & (pid == p))[0]
+        if len(idx) == 0:
+            out.append(_pad_like(page))
+            continue
+        cols = [
+            Column(
+                c.type,
+                jnp.asarray(vals[idx]),
+                jnp.asarray(nulls[idx]) if nulls is not None else None,
+                c.dictionary,
+            )
+            for c, (vals, nulls) in zip(page.columns, host_cols)
+        ]
+        out.append(Page(cols, None, page.replicated))
+    return out
+
+
+def _pad_like(page):
+    """1-row all-dead page with the same column dtypes/dictionaries."""
+    import jax.numpy as jnp
+
+    from trino_tpu.data.page import Column, Page
+
+    cols = [
+        Column(
+            c.type,
+            jnp.zeros((1,) + c.values.shape[1:], c.values.dtype),
+            None,
+            c.dictionary,
+        )
+        for c in page.columns
+    ]
+    return Page(cols, jnp.zeros((1,), bool), page.replicated)

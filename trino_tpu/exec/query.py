@@ -27,8 +27,34 @@ def plan_sql(session, sql: str):
 def run_query(session, sql: str) -> QueryResult:
     stmt = parse_statement(sql)
     if isinstance(stmt, ast.Explain):
-        text = explain_query(session, None, stmt.mode, stmt=stmt.statement)
+        if stmt.analyze:
+            text = explain_analyze(session, stmt.statement)
+        else:
+            text = explain_query(session, None, stmt.mode, stmt=stmt.statement)
         return QueryResult(["Query Plan"], [], [(line,) for line in text.split("\n")])
+    if isinstance(stmt, ast.SetSession):
+        session.set_property(stmt.name, stmt.value)
+        return QueryResult(["result"], [], [("SET SESSION",)])
+    if isinstance(stmt, ast.ResetSession):
+        from trino_tpu.client.properties import SYSTEM_SESSION_PROPERTIES
+
+        meta = SYSTEM_SESSION_PROPERTIES.get(stmt.name)
+        if meta is None:
+            raise ValueError(f"session property '{stmt.name}' does not exist")
+        if meta.default is None:
+            session.properties.pop(stmt.name, None)
+        else:
+            session.properties[stmt.name] = meta.default
+        return QueryResult(["result"], [], [("RESET SESSION",)])
+    if isinstance(stmt, ast.ShowSession):
+        from trino_tpu.client.properties import SYSTEM_SESSION_PROPERTIES
+
+        rows = [
+            (name, str(session.properties.get(name, meta.default)),
+             str(meta.default), meta.py_type.__name__, meta.description)
+            for name, meta in sorted(SYSTEM_SESSION_PROPERTIES.items())
+        ]
+        return QueryResult(["Name", "Value", "Default", "Type", "Description"], [], rows)
     if isinstance(stmt, ast.ShowTables):
         return _show_tables(session, stmt)
     if isinstance(stmt, ast.ShowSchemas):
@@ -56,6 +82,30 @@ def explain_query(session, sql, mode: str = "logical", stmt=None) -> str:
 
         return format_fragments(fragment_plan(root, session))
     return format_plan(root)
+
+
+def explain_analyze(session, stmt) -> str:
+    """EXPLAIN ANALYZE: execute, then print the plan annotated with the
+    executor's per-operator stats (reference: ExplainAnalyzeOperator +
+    PlanPrinter.java:183 with OperatorStats injected)."""
+    import time as _time
+
+    root = Planner(session).plan(stmt)
+    root = optimize(root, session)
+    ex = Executor(session)
+    t0 = _time.perf_counter()
+    ex.execute_checked(root)
+    wall = _time.perf_counter() - t0
+    header = [f"Query wall time: {wall * 1e3:.1f}ms"]
+    if ex.memory.budget is not None:
+        header.append(
+            f"Device memory budget: {ex.memory.budget // 1024}KiB,"
+            f" peak working set: {ex.memory.peak // 1024}KiB,"
+            f" spills: {len(ex.memory.spills)}"
+        )
+    else:
+        header.append(f"Peak working set: {ex.memory.peak // 1024}KiB (no budget)")
+    return "\n".join(header) + "\n" + format_plan(root, executor=ex)
 
 
 def _show_tables(session, stmt):

@@ -83,7 +83,9 @@ class SpmdExecutor(Executor):
     the same predicates (sql/planner/stats.py) drive build-time capacity
     hints, so the trace always finds its hints."""
 
+    eager_tier = False  # runs under jax tracing: no host-side syncs
     enable_dynamic_filtering = False  # scans pre-staged before tracing
+    collect_stats = False  # tracing once; per-call timing is meaningless
 
     def __init__(self, session, staged: Dict[int, Page], capacity_hints=None, n_devices: int = 1):
         super().__init__(session, capacity_hints)

@@ -89,6 +89,12 @@ class QueryExecution:
         self.failure: Optional[str] = None
         self.columns: List[str] = []
         self.rows: List[tuple] = []
+        # SET/RESET SESSION results: the protocol carries them back to the
+        # client, which applies them to its subsequent requests (reference:
+        # the X-Trino-Set-Session / X-Trino-Clear-Session headers) — the
+        # coordinator itself is stateless per query.
+        self.set_session: Dict[str, object] = {}
+        self.reset_session: List[str] = []
         self.fragment_tasks: Dict[int, List[TaskLocation]] = {}
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -113,6 +119,11 @@ class QueryExecution:
                 # metadata statements (SHOW …, EXPLAIN) run coordinator-local
                 result = run_query(session, self.sql)
                 self.columns, self.rows = result.column_names, result.rows
+                if isinstance(stmt, ast.SetSession):
+                    # run_query validated+coerced it on the throwaway session
+                    self.set_session[stmt.name] = session.properties[stmt.name]
+                elif isinstance(stmt, ast.ResetSession):
+                    self.reset_session.append(stmt.name)
                 self.state.set("FINISHED")
                 return
             root = plan_sql(session, self.sql)
@@ -290,6 +301,10 @@ def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) ->
     if state != "FINISHED":
         payload["nextUri"] = f"{server.base_url}/v1/statement/executing/{q.query_id}/{token}"
         return payload
+    if q.set_session:
+        payload["setSessionProperties"] = {k: v for k, v in q.set_session.items()}
+    if q.reset_session:
+        payload["resetSessionProperties"] = list(q.reset_session)
     start = token * RESULT_PAGE_ROWS
     chunk = q.rows[start : start + RESULT_PAGE_ROWS]
     payload["columns"] = [{"name": c} for c in q.columns]

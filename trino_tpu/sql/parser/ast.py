@@ -276,6 +276,24 @@ class Explain(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class SetSession(Statement):
+    """SET SESSION name = value (reference: sql/tree/SetSession.java)."""
+
+    name: str
+    value: object
+
+
+@dataclasses.dataclass(frozen=True)
+class ResetSession(Statement):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSession(Statement):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowTables(Statement):
     schema: Optional[Tuple[str, ...]] = None
 

@@ -20,7 +20,8 @@ KEYWORDS = {
     "intersect", "except", "all", "distinct", "with", "asc", "desc",
     "nulls", "first", "last", "explain", "analyze", "show", "tables",
     "schemas", "columns", "describe", "values", "substring", "for", "year",
-    "month", "day", "hour", "minute", "second", "quarter",
+    "month", "day", "hour", "minute", "second", "quarter", "set", "reset",
+    "session",
 }
 
 

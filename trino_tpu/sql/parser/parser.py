@@ -135,7 +135,17 @@ class Parser:
                         break
                 self.expect_op(")")
             return ast.Explain(self.statement(), analyze=analyze, mode=mode, fmt=fmt)
+        if self.accept_kw("set"):
+            self.expect_kw("session")
+            name = self.identifier()
+            self.expect_op("=")
+            return ast.SetSession(name, self._property_value())
+        if self.accept_kw("reset"):
+            self.expect_kw("session")
+            return ast.ResetSession(self.identifier())
         if self.accept_kw("show"):
+            if self.accept_kw("session"):
+                return ast.ShowSession()
             if self.accept_kw("tables"):
                 schema = None
                 if self.accept_kw("from", "in"):
@@ -153,6 +163,18 @@ class Parser:
         if self.accept_kw("describe"):
             return ast.ShowColumns(tuple(self.qualified_name()))
         return self.query()
+
+    def _property_value(self):
+        """Literal value of SET SESSION: string | number | boolean."""
+        t = self.peek()
+        if t.kind == "string":
+            return self.advance().text
+        if t.kind == "number":
+            text = self.advance().text
+            return float(text) if "." in text or "e" in text.lower() else int(text)
+        if t.kind == "kw" and t.lower in ("true", "false"):
+            return self.advance().lower == "true"
+        raise ParseError(f"expected literal session value at {t.pos}")
 
     # --- queries ---
     def query(self) -> ast.Query:

@@ -399,13 +399,20 @@ def uses_expansion_kernel(n: JoinNode) -> bool:
     return not n.right_unique and not n.singleton
 
 
-def format_plan(node: PlanNode, indent: int = 0) -> str:
-    """Text plan printer (reference: sql/planner/planprinter/PlanPrinter.java)."""
+def format_plan(node: PlanNode, indent: int = 0, executor=None) -> str:
+    """Text plan printer (reference: sql/planner/planprinter/PlanPrinter.java).
+    With ``executor`` (a finished eager Executor), renders EXPLAIN ANALYZE:
+    per-operator wall time / output rows / scan+spill detail from its stats
+    (the role of PlanPrinter's stats injection from OperatorStats)."""
     pad = "  " * indent
     label = type(node).__name__.replace("Node", "")
     detail = ""
     if isinstance(node, TableScanNode):
         detail = f" {node.catalog}.{node.schema}.{node.table} -> {node.column_names}"
+        if node.constraint is not None:
+            detail += f" constraint={node.constraint!r}"
+        if node.dynamic_filters:
+            detail += f" dynamic_filters={[c for _, _, c in node.dynamic_filters]}"
     elif isinstance(node, FilterNode):
         detail = f" {node.predicate!r}"
     elif isinstance(node, ProjectNode):
@@ -428,9 +435,21 @@ def format_plan(node: PlanNode, indent: int = 0) -> str:
         detail = f" [{node.scope}/{node.partitioning}] keys={node.partition_channels}"
     elif isinstance(node, OutputNode):
         detail = f" {node.column_names}"
+    if executor is not None:
+        st = executor.node_stats.get(node.id)
+        if st is not None:
+            detail += f"  [wall={st['wall_s'] * 1e3:.1f}ms rows={st.get('output_rows', '?')}]"
+        if isinstance(node, TableScanNode) and node.id in executor.scan_stats:
+            detail += f" [scanned={executor.scan_stats[node.id]}]"
+        for sp in executor.memory.spills:
+            if sp.node_id == node.id:
+                detail += (
+                    f" [spilled: {sp.partitions} passes,"
+                    f" {sp.projected_bytes // 1024}KiB projected]"
+                )
     lines = [f"{pad}- {label}{detail}"]
     for s in node.sources:
-        lines.append(format_plan(s, indent + 1))
+        lines.append(format_plan(s, indent + 1, executor))
     return "\n".join(lines)
 
 
