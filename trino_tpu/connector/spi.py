@@ -5,9 +5,9 @@ Reference: ``core/trino-spi/src/main/java/io/trino/spi/connector/`` —
 ``ConnectorPageSource.java:24``. Round-1 surface: metadata (schemas, tables,
 columns, row-count stats), split enumeration (for distributed scans), and a
 page source that materializes a projected column subset of a split as numpy
-arrays (the engine moves them to device). Pushdown negotiation
-(applyFilter/TupleDomain) is a later round; the planner prunes projections
-already (``columns`` argument).
+arrays (the engine moves them to device). Pushdown: the planner prunes
+projections (``columns`` argument) and passes advisory TupleDomain
+constraints (connector/predicate.py) to ``get_splits``/``scan``.
 """
 from __future__ import annotations
 

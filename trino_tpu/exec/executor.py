@@ -487,7 +487,7 @@ class Executor:
     def _exec_aggregate(self, call: P.AggregateCall, page, sel, layout):
         if call.distinct:
             if call.function not in ("count", "approx_distinct"):
-                raise NotImplementedError(f"{call.function}(DISTINCT): round 2")
+                raise NotImplementedError(f"{call.function}(DISTINCT): not yet supported")
             # approx_distinct is computed EXACTLY here (the reference uses
             # HyperLogLog, spi/.../aggregation ApproximateCountDistinct;
             # exact distinct is a strictly more accurate answer)

@@ -474,7 +474,7 @@ def _lower_length(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
 
 def _lower_concat(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     """concat where at most one argument is a column (vocab transform);
-    general column||column needs a pairwise dictionary product: round 2."""
+    general column||column needs a pairwise dictionary product (not yet implemented)."""
     col_args = [a for a in expr.args if not isinstance(a, ir.Constant)]
     # SQL semantics: concat with a NULL argument yields NULL for every row
     # (reference: operator/scalar/ConcatFunction).
@@ -699,7 +699,7 @@ def _lower_case(expr: ir.Case, ctx: LowerCtx) -> LoweredVal:
         v = lower(val_e, ctx)
         if v.dictionary is not None:
             if dictionary is not None and dictionary.values != v.dictionary.values:
-                # Mixed-dictionary CASE branches need a recode pass: round 2.
+                # Mixed-dictionary CASE branches need a recode pass (not yet implemented).
                 raise NotImplementedError("varchar CASE over distinct dictionaries")
             dictionary = v.dictionary
         vals = jnp.where(take, v.vals.astype(dtype), vals)
@@ -740,11 +740,11 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
             v = a.vals
         return LoweredVal(v.astype(tt.np_dtype), a.valid, None)
     if tt == T.DATE and ft.is_varchar:
-        raise NotImplementedError("cast(varchar as date) lowering: round 2")
+        raise NotImplementedError("cast(varchar as date) lowering: not yet supported")
     if tt.is_varchar:
         if ft.is_varchar:  # varchar(n) <-> varchar: same codes/dictionary
             return LoweredVal(a.vals, a.valid, a.dictionary)
-        raise NotImplementedError("cast to varchar lowering: round 2")
+        raise NotImplementedError("cast to varchar lowering: not yet supported")
     return LoweredVal(a.vals.astype(tt.np_dtype), a.valid, a.dictionary)
 
 

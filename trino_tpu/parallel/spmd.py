@@ -192,7 +192,8 @@ class SpmdExecutor(Executor):
 def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
     """Enumerate splits per scan, load per-device shards, pad to a common
     per-device shape, stack [ndev, rows]. This is the SOURCE_DISTRIBUTION
-    split assignment done statically (scheduler integration: later round)."""
+    split assignment done statically; the dynamic split-to-worker scheduler
+    lives in the DCN tier (server/coordinator.py _schedule)."""
     staged: Dict[int, List] = {}
     specs: Dict[int, PageSpec] = {}
     for node in P.walk_plan(root):

@@ -256,7 +256,7 @@ class Parser:
             self.expect_op(")")
             return q
         if self.at_kw("values"):
-            raise ParseError("VALUES relation: round 2")
+            raise ParseError("VALUES relation: not yet supported")
         return self.query_spec()
 
     def query_spec(self) -> ast.QuerySpec:

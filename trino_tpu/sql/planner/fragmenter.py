@@ -15,9 +15,9 @@ SPMD executor maps the query onto the mesh (parallel/spmd.py):
 
 Unlike the reference, a fragment boundary is not a process/wire boundary on
 the intra-slice path — every exchange compiles to a collective inside one
-program. The fragment tree is still the scheduling unit for the multi-host
-tier (DCN streaming / spooled exchange — later round) and drives
-EXPLAIN (TYPE DISTRIBUTED).
+program. The fragment tree IS the scheduling unit for the multi-host DCN
+tier (trino_tpu/server: coordinator schedules source fragments onto
+workers, pages stream over HTTP) and drives EXPLAIN (TYPE DISTRIBUTED).
 """
 from __future__ import annotations
 

@@ -196,7 +196,7 @@ class JoinNode(PlanNode):
     (probe then build). ``distribution``: None until the optimizer picks
     partitioned vs broadcast (AddExchanges analog)."""
 
-    join_type: str = "inner"  # inner | left | semi | anti (right/full: round 2)
+    join_type: str = "inner"  # inner | left | semi | anti (right/full: not yet supported)
     left: PlanNode = None
     right: PlanNode = None
     left_keys: List[int] = None

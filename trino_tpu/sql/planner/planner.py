@@ -110,7 +110,7 @@ class Planner:
             ctes[wq.name.lower()] = wq
         body = query.body
         if isinstance(body, ast.SetOperation):
-            raise PlanningError("set operations: round 2")
+            raise PlanningError("set operations: not yet supported")
         if isinstance(body, ast.Query):
             inner = self.plan_query(body, outer_scope, ctes)
             body_plan = inner
@@ -120,7 +120,7 @@ class Planner:
         # parenthesized query: apply outer ORDER BY/LIMIT
         node = body_plan.node
         if query.order_by:
-            raise PlanningError("ORDER BY on parenthesized query: round 2")
+            raise PlanningError("ORDER BY on parenthesized query: not yet supported")
         if query.limit is not None:
             node = P.LimitNode(node, query.limit)
         return RelationPlan(node, body_plan.scope)
@@ -208,7 +208,7 @@ class Planner:
                 conj.append(
                     ast.Comparison("=", ast.Identifier((c,)), ast.Identifier((c,)))
                 )
-            raise PlanningError("JOIN USING: round 2")
+            raise PlanningError("JOIN USING: not yet supported")
 
         analyzer = ExprAnalyzer(joint_scope)
         predicate = analyzer.analyze(rel.on) if rel.on is not None else None
@@ -220,7 +220,7 @@ class Planner:
                 filter=combine_conjuncts(residual),
             )
             return RelationPlan(node, joint_scope)
-        raise PlanningError(f"{rel.join_type} join: round 2")
+        raise PlanningError(f"{rel.join_type} join: not yet supported")
 
     @staticmethod
     def _extract_equi_keys(
@@ -670,7 +670,7 @@ class Planner:
             if len(sub.scope.fields) != 1:
                 raise PlanningError("IN subquery must return one column")
             if not isinstance(value_ir, ir.ColumnRef):
-                raise PlanningError("IN subquery over expressions: round 2")
+                raise PlanningError("IN subquery over expressions: not yet supported")
             jt = "anti" if conj.negated else "semi"
             new_node = P.JoinNode(
                 join_type=jt, left=node, right=sub.node,
@@ -695,7 +695,7 @@ class Planner:
         (reference: TransformExistsApplyToCorrelatedJoin + decorrelation)."""
         q = ex.query
         if q.with_queries or not isinstance(q.body, ast.QuerySpec):
-            raise PlanningError("complex EXISTS subquery: round 2")
+            raise PlanningError("complex EXISTS subquery: not yet supported")
         spec = q.body
         inner_rp = self.plan_relation(spec.from_, scope, ctes) if spec.from_ else None
         if inner_rp is None:
@@ -724,7 +724,7 @@ class Planner:
                 continue
             residual.append(decorrelate_to_joint(e, nleft))
         if not corr_outer:
-            raise PlanningError("uncorrelated EXISTS: round 2")
+            raise PlanningError("uncorrelated EXISTS: not yet supported")
         if inner_filters:
             inner_node = P.FilterNode(inner_node, combine_conjuncts(inner_filters))
         jt = "anti" if negated else "semi"
