@@ -104,31 +104,51 @@ def _force(out_arrays):
         np.asarray(a.ravel()[0] if a.ndim else a)
 
 
-def run_suite(emit_audit=False):
+def run_suite(emit_audit=False, queries=None):
     """Returns {name: {"rows": n, "seconds": best, "rows_per_sec": v}}."""
-    import numpy as np
-
     from trino_tpu import Session
-    from trino_tpu.exec.compiled import CompiledQuery
-    from trino_tpu.exec.query import plan_sql
 
     session = Session(properties={"schema": SCHEMA})
     results = {}
-    for name, sql in QUERIES.items():
-        # one retry per query: the remote-compile tunnel occasionally drops
-        # a connection mid-run ("Unexpected EOF"); a failed query must not
-        # zero out the whole suite
+    for name in queries or QUERIES:
+        sql = QUERIES[name]
         for attempt in (1, 2):
             try:
                 results[name] = _bench_query(session, name, sql, emit_audit)
                 break
             except Exception as e:
+                import traceback
+
                 print(f"[{name}] attempt {attempt} failed: {e}", file=sys.stderr)
+                traceback.print_exc(file=sys.stderr)
                 if attempt == 2:
                     results[name] = {"error": str(e)[:300]}
                 else:
                     time.sleep(10)
     return results
+
+
+def _run_query_subprocess(platform: str, name: str):
+    """One query in a FRESH subprocess: its own tunnel session, device
+    buffers, and compile caches. Queries are isolated because the TPU
+    tunnel has shown cross-query state poisoning (a prior query's loaded
+    program makes the next query's input transfer fail with
+    INVALID_ARGUMENT); per-process isolation sidesteps it and matches how
+    the reference's benchto drives one query at a time."""
+    env = dict(os.environ, _BENCH_CHILD=f"{platform}:{name}")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=1800, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "subprocess timeout (1800s)"}
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCH_CHILD_RESULT "):
+            return json.loads(line[len("BENCH_CHILD_RESULT "):])
+    tail = proc.stderr[-1000:].replace("\n", " | ")
+    print(f"[{platform}:{name}] child produced no result: {tail}", file=sys.stderr)
+    return {"error": f"child failed: {tail[:300]}"}
 
 
 def _bench_query(session, name, sql, emit_audit):
@@ -195,10 +215,25 @@ def _scan_rows(cq) -> int:
 
 
 def main():
-    if os.environ.get("_BENCH_CHILD") == "cpu":
-        # CPU anchor subprocess: run the same suite on host CPU
-        res = run_suite()
-        print("BENCH_CHILD_RESULT " + json.dumps(res))
+    child = os.environ.get("_BENCH_CHILD")
+    if child:
+        # child mode "<platform>:<query>": one query on one backend. The
+        # image's sitecustomize force-registers the TPU tunnel via the
+        # jax_platforms CONFIG (env vars don't win) — override the config
+        # before any backend initializes, like tests/conftest.py does.
+        platform, name = child.split(":", 1)
+        import jax
+
+        if platform == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+            if jax.devices()[0].platform != "cpu":
+                print("BENCH_CHILD_RESULT " + json.dumps(
+                    {"error": f"anchor not on cpu: {jax.devices()[0].platform}"}))
+                return
+        else:
+            _init_backend_with_retry()
+        res = run_suite(emit_audit=(platform != "cpu"), queries=[name])
+        print("BENCH_CHILD_RESULT " + json.dumps(res[name]))
         return
 
     _init_backend_with_retry()
@@ -207,23 +242,14 @@ def main():
     dev = jax.devices()[0]
     if dev.platform != "tpu":
         print(f"WARNING: benchmarking on {dev.platform}, not TPU", file=sys.stderr)
-    results = run_suite(emit_audit=True)
-
-    # measured CPU anchor (same engine, same queries, host CPU backend)
-    cpu = None
-    try:
-        env = dict(os.environ, _BENCH_CHILD="cpu", JAX_PLATFORMS="cpu")
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            capture_output=True, text=True, timeout=1800, env=env,
-        )
-        for line in proc.stdout.splitlines():
-            if line.startswith("BENCH_CHILD_RESULT "):
-                cpu = json.loads(line[len("BENCH_CHILD_RESULT "):])
-        if cpu is None:
-            print(f"CPU anchor failed:\n{proc.stderr[-2000:]}", file=sys.stderr)
-    except Exception as e:  # anchor is best-effort; TPU number still reported
-        print(f"CPU anchor failed: {e}", file=sys.stderr)
+    results = {}
+    cpu = {}
+    for name in QUERIES:
+        results[name] = _run_query_subprocess("tpu", name)
+        print(f"[tpu:{name}] {results[name]}", file=sys.stderr)
+    for name in QUERIES:
+        cpu[name] = _run_query_subprocess("cpu", name)
+        print(f"[cpu:{name}] {cpu[name]}", file=sys.stderr)
 
     headline = results.get("q1", {}).get("rows_per_sec", 0)
     cpu_q1 = (cpu or {}).get("q1", {}).get("rows_per_sec")

@@ -1,0 +1,136 @@
+"""DDL/DML: CREATE TABLE (AS) / INSERT / DROP + VALUES bodies + blackhole.
+
+Reference behaviors matched: CreateTableTask/Insert + ConnectorPageSink
+(trino-memory), sql/tree/Values, plugin/trino-blackhole.
+"""
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu.client.session import Session
+
+
+@pytest.fixture()
+def session():
+    return Session({"catalog": "memory", "schema": "default"})
+
+
+def test_values_query(session):
+    rows = session.execute("values (1, 'a'), (2, 'b'), (3, 'c')").rows
+    assert rows == [(1, "a"), (2, "b"), (3, "c")]
+
+
+def test_values_as_relation(session):
+    rows = session.execute("""
+        select t.name, t.qty * 2 as dbl
+        from (values ('x', 10), ('y', 20)) as t(name, qty)
+        order by dbl desc
+    """).rows
+    assert rows == [("y", 40), ("x", 20)]
+
+
+def test_values_type_unification(session):
+    rows = session.execute("values (1), (2.5), (-3)").rows
+    assert rows == [(Decimal("1.0"), ), (Decimal("2.5"),), (Decimal("-3.0"),)]
+
+
+def test_create_insert_select_drop(session):
+    session.execute("create table t1 (id bigint, name varchar, price decimal(10,2))")
+    assert session.execute("show tables from default").rows == [("t1",)]
+    r = session.execute(
+        "insert into t1 values (1, 'widget', 9.99), (2, 'gadget', 19.50)")
+    assert r.rows == [(2,)]
+    r = session.execute("insert into t1 (name, id) values ('gizmo', 3)")
+    assert r.rows == [(1,)]
+    rows = session.execute(
+        "select id, name, price from t1 order by id").rows
+    assert rows == [
+        (1, "widget", Decimal("9.99")),
+        (2, "gadget", Decimal("19.50")),
+        (3, "gizmo", None),
+    ]
+    session.execute("drop table t1")
+    assert session.execute("show tables from default").rows == []
+    with pytest.raises(ValueError, match="not found"):
+        session.execute("drop table t1")
+    session.execute("drop table if exists t1")  # no error
+
+
+def test_create_table_as_select():
+    s = Session({"catalog": "memory", "schema": "default"})
+    r = s.execute("""
+        create table top_orders as
+        select o_orderkey, o_totalprice from tpch.tiny.orders
+        where o_totalprice > 400000.00
+    """)
+    (n,) = r.rows[0]
+    assert n > 0
+    rows = s.execute("select count(*), min(o_totalprice) from top_orders").rows
+    assert rows[0][0] == n
+    assert rows[0][1] > Decimal("400000.00")
+
+
+def test_create_if_not_exists(session):
+    session.execute("create table t2 (x bigint)")
+    session.execute("create table if not exists t2 (x bigint)")  # no error
+    with pytest.raises(ValueError, match="already exists"):
+        session.execute("create table t2 (x bigint)")
+
+
+def test_insert_select_roundtrip(session):
+    session.execute("create table src (g bigint, v bigint)")
+    session.execute("insert into src values (1, 10), (1, 20), (2, 30)")
+    session.execute("create table agg as select g, sum(v) as s from src group by g")
+    assert session.execute("select g, s from agg order by g").rows == [(1, 30), (2, 30)]
+
+
+def test_blackhole_swallows(session):
+    session.execute("create table blackhole.default.sink (x bigint, y varchar)")
+    r = session.execute(
+        "insert into blackhole.default.sink values (1, 'a'), (2, 'b')")
+    assert r.rows == [(2,)]
+    assert session.catalogs["blackhole"].rows_swallowed == 2
+    rows = session.execute("select count(*) from blackhole.default.sink").rows
+    assert rows == [(0,)]
+
+
+def test_insert_width_mismatch(session):
+    session.execute("create table t3 (a bigint, b bigint)")
+    with pytest.raises(ValueError, match="columns"):
+        session.execute("insert into t3 values (1)")
+
+
+def test_insert_column_validation(session):
+    session.execute("create table t4 (a bigint, b bigint)")
+    with pytest.raises(ValueError, match="does not exist"):
+        session.execute("insert into t4 (bogus) values (42)")
+    with pytest.raises(ValueError, match="duplicates"):
+        session.execute("insert into t4 (a, a) values (7, 8)")
+
+
+def test_insert_contextual_keyword_column(session):
+    """A column named with a contextual keyword works in both CREATE and
+    INSERT column lists."""
+    session.execute("create table t5 (year bigint, v bigint)")
+    session.execute("insert into t5 (year, v) values (2026, 1)")
+    assert session.execute("select year, v from t5").rows == [(2026, 1)]
+
+
+def test_values_cast_narrowing_rounds(session):
+    """CAST narrowing a decimal's scale rounds half away from zero
+    (reference: DecimalOperators rescale), not truncates."""
+    rows = session.execute("values (cast(1.25 as decimal(3,1)))").rows
+    assert rows == [(__import__("decimal").Decimal("1.3"),)]
+    rows = session.execute("values (cast(-1.25 as decimal(3,1)))").rows
+    assert rows == [(__import__("decimal").Decimal("-1.3"),)]
+
+
+def test_order_by_expr_after_star():
+    s = Session({"catalog": "memory", "schema": "default"})
+    s.catalogs["memory"].create_table(
+        "default", "ob", [("a", __import__("trino_tpu.types", fromlist=["BIGINT"]).BIGINT),
+                          ("b", __import__("trino_tpu.types", fromlist=["BIGINT"]).BIGINT)],
+        [(10, 1), (1, 2), (5, 3)],
+    )
+    rows = s.execute("select *, a + b as s from ob order by a + b").rows
+    assert rows == [(1, 2, 3), (5, 3, 8), (10, 1, 11)]

@@ -1,0 +1,102 @@
+"""Scalar function breadth: string/math/date additions (VERDICT item 7).
+
+Oracles: Python math/str/datetime over the same inputs.
+"""
+import datetime
+import math
+
+import pytest
+
+from trino_tpu.client.session import Session
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session({"catalog": "tpch", "schema": "tiny"})
+
+
+def _one(session, expr):
+    return session.execute(f"select {expr} from tpch.tiny.region limit 1").rows[0][0]
+
+
+def test_trig_and_constants(session):
+    assert _one(session, "sin(1.0)") == pytest.approx(math.sin(1.0))
+    assert _one(session, "cos(0.5)") == pytest.approx(math.cos(0.5))
+    assert _one(session, "atan2(1.0, 2.0)") == pytest.approx(math.atan2(1.0, 2.0))
+    assert _one(session, "tanh(0.3)") == pytest.approx(math.tanh(0.3))
+    assert _one(session, "degrees(pi())") == pytest.approx(180.0)
+    assert _one(session, "radians(180.0)") == pytest.approx(math.pi)
+    assert _one(session, "pi()") == pytest.approx(math.pi)
+    assert _one(session, "e()") == pytest.approx(math.e)
+    assert _one(session, "mod(17, 5)") == 2
+    assert _one(session, "truncate(-2.7)") == pytest.approx(-2.0)
+    import decimal
+
+    assert _one(session, "truncate(12.345, 1)") == decimal.Decimal("12.300")
+
+
+def test_truncate_decimal_scale(session):
+    import decimal
+
+    rows = session.execute("""
+        select l_extendedprice, truncate(l_extendedprice, 1)
+        from lineitem order by l_orderkey, l_linenumber limit 10
+    """).rows
+    for full, trunc in rows:
+        want = full.quantize(decimal.Decimal("0.1"), rounding=decimal.ROUND_DOWN)
+        assert trunc == want.quantize(decimal.Decimal("0.01"))  # scale kept
+
+
+def test_string_functions(session):
+    rows = session.execute("""
+        select n_name, replace(n_name, 'A', '_'), reverse(n_name),
+               strpos(n_name, 'AN'), starts_with(n_name, 'UNITED')
+        from nation order by n_nationkey limit 4
+    """).rows
+    for name, repl, rev, pos, sw in rows:
+        assert repl == name.replace("A", "_")
+        assert rev == name[::-1]
+        assert pos == name.find("AN") + 1
+        assert sw == name.startswith("UNITED")
+
+
+def test_date_functions(session):
+    rows = session.execute("""
+        select o_orderdate, day_of_week(o_orderdate), day_of_year(o_orderdate),
+               week(o_orderdate),
+               date_trunc('month', o_orderdate), date_trunc('year', o_orderdate),
+               date_trunc('week', o_orderdate), date_trunc('quarter', o_orderdate)
+        from orders order by o_orderkey limit 25
+    """).rows
+    for d, dow, doy, wk, tm, ty, tw, tq in rows:
+        assert dow == d.isoweekday()
+        assert doy == d.timetuple().tm_yday
+        assert wk == d.isocalendar()[1]
+        assert tm == d.replace(day=1)
+        assert ty == d.replace(month=1, day=1)
+        assert tw == d - datetime.timedelta(days=d.isoweekday() - 1)
+        q_month = (d.month - 1) // 3 * 3 + 1
+        assert tq == d.replace(month=q_month, day=1)
+
+
+def test_strings_in_where(session):
+    rows = session.execute("""
+        select count(*) from nation where starts_with(n_name, 'I')
+    """).rows
+    assert rows == [(4,)]  # INDIA, INDONESIA, IRAN, IRAQ
+    rows = session.execute(
+        "select n_name from nation where starts_with(n_name, 'I') order by n_name").rows
+    assert [r[0] for r in rows] == ["INDIA", "INDONESIA", "IRAN", "IRAQ"]
+
+
+def test_order_by_hidden_source_column(session):
+    """ORDER BY a column that is not in the SELECT list (pre-projection of
+    ordering symbols, reference: QueryPlanner)."""
+    rows = session.execute(
+        "select n_name from nation order by n_nationkey desc limit 3").rows
+    assert [r[0] for r in rows] == ["UNITED STATES", "UNITED KINGDOM", "RUSSIA"]
+    rows = session.execute(
+        "select o_orderkey from orders order by o_totalprice desc limit 2").rows
+    full = session.execute(
+        "select o_orderkey, o_totalprice from orders order by o_totalprice desc limit 2").rows
+    assert [r[0] for r in rows] == [r[0] for r in full]

@@ -1,0 +1,3 @@
+from trino_tpu.connector.blackhole.connector import BlackHoleConnector
+
+__all__ = ["BlackHoleConnector"]

@@ -40,6 +40,32 @@ class MemoryConnector(spi.Connector):
         )
         self._tables[(schema, name)] = (meta, cols)
 
+    def insert_rows(self, schema: str, table: str, rows: List[tuple]) -> int:
+        """Append rows (reference: memory connector's page sink). New data
+        is columnized independently and concatenated with dictionary merge."""
+        entry = self._tables.get((schema, table))
+        if entry is None:
+            raise KeyError(f"memory.{schema}.{table} does not exist")
+        meta, cols = entry
+        if not rows:
+            return 0
+        from trino_tpu.data.page import Column
+
+        for i, cm in enumerate(meta.columns):
+            pycol = [r[i] for r in rows]
+            col = Column.from_python(cm.type, pycol)
+            new = spi.ColumnData(
+                cm.type,
+                np.asarray(col.values),
+                np.asarray(col.nulls) if col.nulls is not None else None,
+                col.dictionary,
+            )
+            cols[cm.name] = spi.concat_column_data([cols[cm.name], new])
+        return len(rows)
+
+    def drop_table(self, schema: str, table: str) -> None:
+        self._tables.pop((schema, table), None)
+
     def list_schemas(self) -> List[str]:
         return sorted({s for s, _ in self._tables} | {"default"})
 

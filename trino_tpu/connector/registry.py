@@ -12,7 +12,12 @@ from trino_tpu.connector.spi import Connector
 
 
 def default_catalogs() -> Dict[str, Connector]:
+    from trino_tpu.connector.blackhole.connector import BlackHoleConnector
     from trino_tpu.connector.memory.connector import MemoryConnector
     from trino_tpu.connector.tpch import TpchConnector
 
-    return {"tpch": TpchConnector(), "memory": MemoryConnector()}
+    return {
+        "tpch": TpchConnector(),
+        "memory": MemoryConnector(),
+        "blackhole": BlackHoleConnector(),
+    }

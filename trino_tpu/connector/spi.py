@@ -138,3 +138,18 @@ class Connector:
     def scan(self, split: Split, columns: List[str], constraint=None) -> Dict[str, ColumnData]:
         """``constraint`` as in get_splits — advisory row-reduction only."""
         raise NotImplementedError
+
+    # --- writes (ConnectorMetadata DDL + ConnectorPageSink) ---
+    def create_table(self, schema: str, name: str, schema_def, rows) -> None:
+        """CREATE TABLE [AS]: register a table with the given columns and
+        initial rows (reference: ConnectorMetadata.createTable /
+        beginCreateTable + ConnectorPageSink)."""
+        raise NotImplementedError(f"{self.name}: connector does not support CREATE TABLE")
+
+    def insert_rows(self, schema: str, table: str, rows) -> int:
+        """INSERT: append Python-value rows in table column order; returns
+        the row count (reference: beginInsert/finishInsert + page sink)."""
+        raise NotImplementedError(f"{self.name}: connector does not support INSERT")
+
+    def drop_table(self, schema: str, table: str) -> None:
+        raise NotImplementedError(f"{self.name}: connector does not support DROP TABLE")

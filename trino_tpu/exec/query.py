@@ -32,6 +32,14 @@ def run_query(session, sql: str) -> QueryResult:
         else:
             text = explain_query(session, None, stmt.mode, stmt=stmt.statement)
         return QueryResult(["Query Plan"], [], [(line,) for line in text.split("\n")])
+    if isinstance(stmt, ast.CreateTable):
+        return _create_table(session, stmt)
+    if isinstance(stmt, ast.CreateTableAs):
+        return _create_table_as(session, stmt)
+    if isinstance(stmt, ast.Insert):
+        return _insert(session, stmt)
+    if isinstance(stmt, ast.DropTable):
+        return _drop_table(session, stmt)
     if isinstance(stmt, ast.SetSession):
         session.set_property(stmt.name, stmt.value)
         return QueryResult(["result"], [], [("SET SESSION",)])
@@ -82,6 +90,97 @@ def explain_query(session, sql, mode: str = "logical", stmt=None) -> str:
 
         return format_fragments(fragment_plan(root, session))
     return format_plan(root)
+
+
+def _resolve_table_name(session, parts):
+    parts = [p.lower() for p in parts]
+    catalog = session.properties.get("catalog", "tpch")
+    schema = session.properties.get("schema", "tiny")
+    if len(parts) == 3:
+        catalog, schema, table = parts
+    elif len(parts) == 2:
+        schema, table = parts
+    else:
+        (table,) = parts
+    if catalog not in session.catalogs:
+        raise ValueError(f"catalog not found: {catalog}")
+    return session.catalogs[catalog], schema, table
+
+
+def _create_table(session, stmt):
+    """CREATE TABLE (reference: execution/CreateTableTask.java)."""
+    from trino_tpu import types as T
+
+    conn, schema, table = _resolve_table_name(session, stmt.name)
+    if conn.get_table(schema, table) is not None:
+        if stmt.not_exists:
+            return QueryResult(["result"], [], [("CREATE TABLE",)])
+        raise ValueError(f"table already exists: {schema}.{table}")
+    schema_def = [(n.lower(), T.parse_type(t)) for n, t in stmt.columns]
+    conn.create_table(schema, table, schema_def, [])
+    return QueryResult(["result"], [], [("CREATE TABLE",)])
+
+
+def _create_table_as(session, stmt):
+    """CTAS (reference: the TableWriterOperator/TableFinishOperator pair,
+    collapsed: the source query runs eagerly, rows sink via the connector
+    write SPI — distributed scaled writers are the SPMD tier's upgrade)."""
+    conn, schema, table = _resolve_table_name(session, stmt.name)
+    if conn.get_table(schema, table) is not None:
+        if stmt.not_exists:
+            return QueryResult(["rows"], [], [(0,)])
+        raise ValueError(f"table already exists: {schema}.{table}")
+    root = Planner(session).plan(stmt.query)
+    root = optimize(root, session)
+    page = Executor(session).execute_checked(root)
+    rows = page.to_pylist()
+    schema_def = list(zip([n.lower() for n in root.column_names], root.source.output_types))
+    conn.create_table(schema, table, schema_def, rows)
+    return QueryResult(["rows"], [], [(len(rows),)])
+
+
+def _insert(session, stmt):
+    """INSERT INTO (reference: execution/InsertTask + page sink)."""
+    conn, schema, table = _resolve_table_name(session, stmt.name)
+    meta = conn.get_table(schema, table)
+    if meta is None:
+        raise ValueError(f"table not found: {schema}.{table}")
+    root = Planner(session).plan(stmt.query)
+    root = optimize(root, session)
+    page = Executor(session).execute_checked(root)
+    rows = page.to_pylist()
+    table_cols = [c.name for c in meta.columns]
+    src_width = len(root.column_names)
+    if stmt.columns:
+        named = [c.lower() for c in stmt.columns]
+        if len(named) != src_width:
+            raise ValueError("INSERT column list does not match query width")
+        if len(set(named)) != len(named):
+            raise ValueError("INSERT column list contains duplicates")
+        for c in named:
+            if c not in table_cols:
+                raise ValueError(f"insert column does not exist: {c}")
+        pos = {c: i for i, c in enumerate(named)}
+        # unmentioned columns get NULL (reference Insert semantics)
+        rows = [
+            tuple(r[pos[c]] if c in pos else None for c in table_cols)
+            for r in rows
+        ]
+    elif src_width != len(table_cols):
+        raise ValueError(
+            f"INSERT has {src_width} expressions but table has {len(table_cols)} columns")
+    n = conn.insert_rows(schema, table, rows)
+    return QueryResult(["rows"], [], [(n,)])
+
+
+def _drop_table(session, stmt):
+    conn, schema, table = _resolve_table_name(session, stmt.name)
+    if conn.get_table(schema, table) is None:
+        if stmt.if_exists:
+            return QueryResult(["result"], [], [("DROP TABLE",)])
+        raise ValueError(f"table not found: {schema}.{table}")
+    conn.drop_table(schema, table)
+    return QueryResult(["result"], [], [("DROP TABLE",)])
 
 
 def explain_analyze(session, stmt) -> str:

@@ -63,6 +63,48 @@ def add_months(days, n):
     return days_from_civil(y2, m2, d2)
 
 
+def extract_dow(days):
+    """ISO day-of-week, Monday=1..Sunday=7 (reference:
+    DateTimeFunctions.dayOfWeekFromDate). 1970-01-01 was a Thursday."""
+    return jnp.mod(days.astype(jnp.int64) + 3, 7) + 1
+
+
+def extract_doy(days):
+    """Day of year, 1-based."""
+    y, _, _ = civil_from_days(days)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return days.astype(jnp.int64) - jan1 + 1
+
+
+def extract_week(days):
+    """ISO-8601 week number (reference: DateTimeFunctions.weekFromDate):
+    week 1 contains the year's first Thursday."""
+    d = days.astype(jnp.int64)
+    thursday = d - extract_dow(d) + 4  # Thursday of this ISO week
+    y, _, _ = civil_from_days(thursday)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return (thursday - jan1) // 7 + 1
+
+
+def trunc_date(days, unit: str):
+    """date_trunc(unit, date) -> epoch days (reference:
+    DateTimeFunctions.truncateDate)."""
+    d = days.astype(jnp.int64)
+    if unit == "day":
+        return d
+    if unit == "week":  # ISO week start (Monday)
+        return d - (extract_dow(d) - 1)
+    y, m, _dd = civil_from_days(d)
+    one = jnp.ones_like(y)
+    if unit == "month":
+        return days_from_civil(y, m, one)
+    if unit == "quarter":
+        return days_from_civil(y, ((m - 1) // 3) * 3 + 1, one)
+    if unit == "year":
+        return days_from_civil(y, one, one)
+    raise NotImplementedError(f"date_trunc unit: {unit}")
+
+
 def days_in_month(y, m):
     lengths = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31], dtype=jnp.int64)
     base = lengths[m - 1]

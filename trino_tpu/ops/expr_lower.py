@@ -463,13 +463,56 @@ def _lower_str_fn(pyfn) -> Callable:
     return fn
 
 
+def _const_str_args(expr: ir.Call, start: int) -> List[str]:
+    out = []
+    for a in expr.args[start:]:
+        assert isinstance(a, ir.Constant) and isinstance(a.value, str), (
+            f"{expr.name}: pattern arguments must be varchar literals")
+        out.append(a.value)
+    return out
+
+
+def _lower_replace(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    frm, to = (_const_str_args(expr, 1) + [""])[:2] if len(expr.args) == 2 \
+        else _const_str_args(expr, 1)
+    return _vocab_transform(ctx, x, lambda v: v.replace(frm, to))
+
+
+def _lower_reverse(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    return _vocab_transform(ctx, x, lambda v: v[::-1])
+
+
+def _vocab_lut(ctx: LowerCtx, x: LoweredVal, pyfn, np_dtype) -> LoweredVal:
+    """varchar -> scalar via a per-vocab-entry lookup table (the
+    dictionary-first analog of per-row scalar evaluation)."""
+    assert x.dictionary is not None
+    lut = np.array([pyfn(v) for v in x.dictionary.values], dtype=np_dtype)
+    lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros((1,), dtype=np_dtype)
+    out = jnp.where(
+        x.vals >= 0,
+        lut_dev[jnp.clip(x.vals, 0, max(len(lut) - 1, 0))],
+        jnp.zeros((), np_dtype),
+    )
+    return LoweredVal(out, x.valid, None)
+
+
+def _lower_strpos(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    (sub,) = _const_str_args(expr, 1)
+    return _vocab_lut(ctx, x, lambda v: v.find(sub) + 1, np.int64)
+
+
+def _lower_starts_with(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    (prefix,) = _const_str_args(expr, 1)
+    return _vocab_lut(ctx, x, lambda v: v.startswith(prefix), np.bool_)
+
+
 def _lower_length(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     x = lower(expr.args[0], ctx)
-    assert x.dictionary is not None
-    lut = np.array([len(v) for v in x.dictionary.values], dtype=np.int64)
-    lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros((1,), dtype=np.int64)
-    out = jnp.where(x.vals >= 0, lut_dev[jnp.clip(x.vals, 0, max(len(lut) - 1, 0))], 0)
-    return LoweredVal(out, x.valid, None)
+    return _vocab_lut(ctx, x, len, np.int64)
 
 
 def _lower_concat(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
@@ -538,6 +581,42 @@ def _lower_date_add_months(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     n = lower(expr.args[1], ctx)
     out = dt.add_months(a.vals, n.vals).astype(jnp.int32)
     return LoweredVal(out, and_valid(a.valid, n.valid), None)
+
+
+def _lower_date_trunc(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    unit_e = expr.args[0]
+    assert isinstance(unit_e, ir.Constant) and isinstance(unit_e.value, str), (
+        "date_trunc unit must be a varchar literal")
+    a = lower(expr.args[1], ctx)
+    out = dt.trunc_date(a.vals, unit_e.value.lower()).astype(jnp.int32)
+    return LoweredVal(out, a.valid, None)
+
+
+def _lower_atan2(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = _arg_double(ctx, expr.args[0])
+    b = _arg_double(ctx, expr.args[1])
+    return LoweredVal(jnp.arctan2(a.vals, b.vals), and_valid(a.valid, b.valid), None)
+
+
+def _lower_truncate(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """truncate(x[, d]): drop digits past d decimal places, toward zero
+    (reference: MathFunctions.truncate both arities)."""
+    a = lower(expr.args[0], ctx)
+    t = expr.args[0].type
+    d = 0
+    if len(expr.args) == 2:
+        d_e = expr.args[1]
+        assert isinstance(d_e, ir.Constant)
+        d = int(d_e.value)
+    if t.is_floating:
+        p = 10.0 ** d
+        return LoweredVal(jnp.trunc(a.vals * p) / p, a.valid, None)
+    if t.is_decimal:
+        keep = max(t.scale - d, 0)
+        p = 10 ** keep
+        v = a.vals
+        return LoweredVal(jnp.where(v >= 0, v // p, -((-v) // p)) * p, a.valid, None)
+    return LoweredVal(a.vals, a.valid, None)
 
 
 def _arg_double(ctx: LowerCtx, arg: ir.Expr) -> LoweredVal:
@@ -798,5 +877,26 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "extract_month": _lower_extract("month"),
     "extract_day": _lower_extract("day"),
     "extract_quarter": _lower_extract("quarter"),
+    "extract_dow": _lower_extract("dow"),
+    "extract_doy": _lower_extract("doy"),
+    "extract_week": _lower_extract("week"),
     "date_add_months": _lower_date_add_months,
+    "date_trunc": _lower_date_trunc,
+    "replace": _lower_replace,
+    "reverse": _lower_reverse,
+    "strpos": _lower_strpos,
+    "starts_with": _lower_starts_with,
+    "sin": _lower_math1(jnp.sin),
+    "cos": _lower_math1(jnp.cos),
+    "tan": _lower_math1(jnp.tan),
+    "asin": _lower_math1(jnp.arcsin),
+    "acos": _lower_math1(jnp.arccos),
+    "atan": _lower_math1(jnp.arctan),
+    "sinh": _lower_math1(jnp.sinh),
+    "cosh": _lower_math1(jnp.cosh),
+    "tanh": _lower_math1(jnp.tanh),
+    "degrees": _lower_math1(jnp.degrees),
+    "radians": _lower_math1(jnp.radians),
+    "atan2": _lower_atan2,
+    "truncate": _lower_truncate,
 }

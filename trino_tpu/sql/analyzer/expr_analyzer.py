@@ -325,6 +325,56 @@ class ExprAnalyzer:
             return ir.Call(T.BIGINT, "extract_month", args)
         if name == "day":
             return ir.Call(T.BIGINT, "extract_day", args)
+        if name in ("day_of_week", "dow"):
+            return ir.Call(T.BIGINT, "extract_dow", args)
+        if name in ("day_of_year", "doy"):
+            return ir.Call(T.BIGINT, "extract_doy", args)
+        if name == "week":
+            return ir.Call(T.BIGINT, "extract_week", args)
+        if name == "date_trunc":
+            if len(args) != 2 or args[1].type != T.DATE:
+                raise AnalysisError("date_trunc(unit, date) expects a date")
+            return ir.Call(T.DATE, "date_trunc", args)
+        if name == "replace":
+            if len(args) not in (2, 3):
+                raise AnalysisError("replace(string, search[, replace])")
+            return ir.Call(T.varchar(), "replace", args)
+        if name == "reverse":
+            return ir.Call(T.varchar(), "reverse", args)
+        if name in ("strpos", "position"):
+            return ir.Call(T.BIGINT, "strpos", args)
+        if name == "starts_with":
+            return ir.Call(T.BOOLEAN, "starts_with", args)
+        if name in ("sin", "cos", "tan", "asin", "acos", "atan",
+                    "sinh", "cosh", "tanh", "degrees", "radians"):
+            if len(args) != 1:
+                raise AnalysisError(f"{name}() expects 1 argument")
+            return ir.Call(T.DOUBLE, name, args)
+        if name == "atan2":
+            if len(args) != 2:
+                raise AnalysisError("atan2(y, x) expects 2 arguments")
+            return ir.Call(T.DOUBLE, "atan2", args)
+        if name == "pi":
+            import math
+
+            return ir.Constant(T.DOUBLE, math.pi)
+        if name == "e":
+            import math
+
+            return ir.Constant(T.DOUBLE, math.e)
+        if name == "truncate":
+            if len(args) not in (1, 2):
+                raise AnalysisError("truncate(x[, decimal_places])")
+            if len(args) == 2 and not isinstance(args[1], ir.Constant):
+                raise AnalysisError("truncate scale must be a literal")
+            t = args[0].type
+            return ir.Call(t if t.is_decimal or t.is_floating else T.BIGINT,
+                           "truncate", args)
+        if name == "mod":
+            if len(args) != 2:
+                raise AnalysisError("mod(a, b) expects 2 arguments")
+            return ir.Call(
+                arithmetic_result_type("%", args[0].type, args[1].type), "mod", args)
         raise AnalysisError(f"unknown function: {name}")
 
     @staticmethod

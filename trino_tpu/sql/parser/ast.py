@@ -249,7 +249,14 @@ class SetOperation(Node):
     right: "QueryBody"
 
 
-QueryBody = object  # QuerySpec | SetOperation | Query (parenthesized)
+@dataclasses.dataclass(frozen=True)
+class Values(Node):
+    """VALUES (e, ...), ... as a query body (reference: sql/tree/Values)."""
+
+    rows: Tuple[Tuple[Expression, ...], ...]
+
+
+QueryBody = object  # QuerySpec | SetOperation | Values | Query (parenthesized)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -273,6 +280,40 @@ class Explain(Statement):
     analyze: bool = False
     mode: str = "logical"  # logical | distributed
     fmt: str = "text"
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable(Statement):
+    """CREATE TABLE name (col type, ...) (reference: sql/tree/CreateTable)."""
+
+    name: tuple  # qualified name parts
+    columns: tuple  # ((name, type_text), ...)
+    not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTableAs(Statement):
+    """CREATE TABLE name AS query (reference: sql/tree/CreateTableAsSelect)."""
+
+    name: tuple
+    query: "Query" = None
+    not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Insert(Statement):
+    """INSERT INTO name [(cols)] query (VALUES arrives as a Values query
+    body; reference: sql/tree/Insert)."""
+
+    name: tuple
+    columns: tuple  # () = table order
+    query: "Query" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Statement):
+    name: tuple
+    if_exists: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

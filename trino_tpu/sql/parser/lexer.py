@@ -21,7 +21,7 @@ KEYWORDS = {
     "nulls", "first", "last", "explain", "analyze", "show", "tables",
     "schemas", "columns", "describe", "values", "substring", "for", "year",
     "month", "day", "hour", "minute", "second", "quarter", "set", "reset",
-    "session",
+    "session", "create", "insert", "into", "drop", "if", "table",
 }
 
 

@@ -135,6 +135,47 @@ class Parser:
                         break
                 self.expect_op(")")
             return ast.Explain(self.statement(), analyze=analyze, mode=mode, fmt=fmt)
+        if self.accept_kw("create"):
+            self.expect_kw("table")
+            not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                not_exists = True
+            name = tuple(self.qualified_name())
+            if self.accept_kw("as"):
+                return ast.CreateTableAs(name, self.query(), not_exists)
+            self.expect_op("(")
+            columns = [self._column_def()]
+            while self.accept_op(","):
+                columns.append(self._column_def())
+            self.expect_op(")")
+            return ast.CreateTable(name, tuple(columns), not_exists)
+        if self.accept_kw("insert"):
+            self.expect_kw("into")
+            name = tuple(self.qualified_name())
+            columns = ()
+            # a '(' here is a column list only if NOT opening a query body
+            # (a query must start with SELECT/WITH/VALUES or '('); contextual
+            # keywords remain usable as column names, matching CREATE TABLE
+            if self.at_op("(") and not (
+                self.at_kw("select", "with", "values", ahead=1)
+                or (self.peek(1).kind == "op" and self.peek(1).text == "(")
+            ):
+                self.advance()
+                cols = [self.identifier()]
+                while self.accept_op(","):
+                    cols.append(self.identifier())
+                self.expect_op(")")
+                columns = tuple(cols)
+            return ast.Insert(name, columns, self.query())
+        if self.accept_kw("drop"):
+            self.expect_kw("table")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropTable(tuple(self.qualified_name()), if_exists)
         if self.accept_kw("set"):
             self.expect_kw("session")
             name = self.identifier()
@@ -163,6 +204,21 @@ class Parser:
         if self.accept_kw("describe"):
             return ast.ShowColumns(tuple(self.qualified_name()))
         return self.query()
+
+    def _column_def(self):
+        """name type — type text is ident plus optional (n[,m]) suffix."""
+        name = self.identifier()
+        t = self.peek()
+        if t.kind not in ("ident", "kw"):
+            raise ParseError(f"expected column type at {t.pos}")
+        type_text = self.advance().text
+        if self.accept_op("("):
+            args = [self.advance().text]
+            while self.accept_op(","):
+                args.append(self.advance().text)
+            self.expect_op(")")
+            type_text += "(" + ",".join(args) + ")"
+        return (name, type_text)
 
     def _property_value(self):
         """Literal value of SET SESSION: string | number | boolean."""
@@ -255,9 +311,21 @@ class Parser:
             q = self.query()
             self.expect_op(")")
             return q
-        if self.at_kw("values"):
-            raise ParseError("VALUES relation: not yet supported")
+        if self.accept_kw("values"):
+            rows = [self._values_row()]
+            while self.accept_op(","):
+                rows.append(self._values_row())
+            return ast.Values(tuple(rows))
         return self.query_spec()
+
+    def _values_row(self):
+        if self.accept_op("("):
+            row = [self.expr()]
+            while self.accept_op(","):
+                row.append(self.expr())
+            self.expect_op(")")
+            return tuple(row)
+        return (self.expr(),)  # single-column row without parens
 
     def query_spec(self) -> ast.QuerySpec:
         self.expect_kw("select")
@@ -345,7 +413,7 @@ class Parser:
 
     def table_primary(self) -> ast.Relation:
         if self.accept_op("("):
-            if self.at_kw("select", "with"):
+            if self.at_kw("select", "with", "values"):
                 q = self.query()
                 self.expect_op(")")
                 rel: ast.Relation = ast.SubqueryRelation(q)

@@ -109,6 +109,14 @@ class Planner:
         for wq in query.with_queries:
             ctes[wq.name.lower()] = wq
         body = query.body
+        if isinstance(body, ast.Values):
+            vp = self._plan_values(body, outer_scope)
+            node = vp.node
+            if query.order_by:
+                raise PlanningError("ORDER BY on VALUES: not yet supported")
+            if query.limit is not None:
+                node = P.LimitNode(node, query.limit)
+            return RelationPlan(node, vp.scope)
         if isinstance(body, ast.SetOperation):
             raise PlanningError("set operations: not yet supported")
         if isinstance(body, ast.Query):
@@ -124,6 +132,50 @@ class Planner:
         if query.limit is not None:
             node = P.LimitNode(node, query.limit)
         return RelationPlan(node, body_plan.scope)
+
+    def _plan_values(self, body: ast.Values, outer_scope: Optional[Scope]) -> RelationPlan:
+        """VALUES rows -> ValuesNode (reference: sql/tree/Values +
+        QueryPlanner.planValues). Rows are constant-folded; per-column types
+        unify to the common super type."""
+        from trino_tpu.data.page import _from_repr
+        from trino_tpu.sql.analyzer.expr_analyzer import ExprAnalyzer
+
+        analyzer = ExprAnalyzer(Scope([], outer_scope))
+        ir_rows = []
+        width = None
+        for row in body.rows:
+            if width is None:
+                width = len(row)
+            elif len(row) != width:
+                raise PlanningError("VALUES rows have mismatched column counts")
+            ir_rows.append([analyzer.analyze(e) for e in row])
+        types = []
+        for ci in range(width or 0):
+            t = T.UNKNOWN
+            for r in ir_rows:
+                t2 = T.common_super_type(t, r[ci].type)
+                if t2 is None:
+                    raise PlanningError(
+                        f"VALUES column {ci}: incompatible types {t} and {r[ci].type}")
+                t = t2
+            types.append(t if t != T.UNKNOWN else T.BIGINT)
+        py_rows = []
+        for r in ir_rows:
+            vals = []
+            for ci, e in enumerate(r):
+                c = _fold_constant(e)
+                if c is None:
+                    raise PlanningError("VALUES expressions must be constants")
+                if c.value is None:
+                    vals.append(None)
+                elif types[ci].is_varchar or types[ci] == T.BOOLEAN:
+                    vals.append(c.value)  # repr == Python value
+                else:
+                    vals.append(_from_repr(types[ci], _rescale(c, types[ci])))
+            py_rows.append(tuple(vals))
+        names = [f"_col{i}" for i in range(width or 0)]
+        node = P.ValuesNode(types, names, py_rows)
+        return RelationPlan(node, Scope([Field(n, t, None) for n, t in zip(names, types)], outer_scope))
 
     def plan_relation(
         self, rel: ast.Relation, outer_scope: Optional[Scope], ctes: Dict[str, ast.WithQuery]
@@ -296,6 +348,9 @@ class Planner:
         extra_ast_to_ch = self._append_order_by_windows(
             query, spec, select_irs, names, replacements
         )
+        self._append_order_by_hidden(
+            query, spec, select_irs, names, scope, replacements, extra_ast_to_ch
+        )
         node_proj = P.ProjectNode(node, select_irs, names)
         out_fields = [
             Field(n, e.type, None)
@@ -310,9 +365,23 @@ class Planner:
                 node, list(range(len(select_irs))), [], step="single", names=names
             )
         if query.order_by:
+            # select-item index -> first output channel (Star items expand)
+            item_channels = []
+            ch = 0
+            for si in spec.select_items:
+                item_channels.append(ch)
+                if isinstance(si.expr, ast.Star):
+                    ch += len(
+                        scope.channels_of_alias(si.expr.qualifier[0])
+                        if si.expr.qualifier
+                        else scope.fields
+                    )
+                else:
+                    ch += 1
             node = self._plan_order_by(
                 query, node, out_scope, replacements=replacements,
                 select_asts=spec.select_items, extra_ast_to_ch=extra_ast_to_ch,
+                item_channels=item_channels,
             )
         if query.limit is not None:
             if query.order_by and isinstance(node, P.SortNode):
@@ -465,6 +534,46 @@ class Planner:
                     select_irs.append(replacements[w])
                     names.append(f"$ob_win{len(extra)}")
         return extra
+
+    def _append_order_by_hidden(
+        self, query, spec, select_irs, names, scope, replacements, extra
+    ):
+        """ORDER BY over source columns/expressions that are not in the
+        SELECT list (reference: QueryPlanner's pre-projection of ordering
+        symbols): analyze against the PRE-projection scope and append a
+        hidden channel, pruned after the sort by _drop_hidden."""
+        if spec.distinct:
+            # invalid SQL to order by a non-output column under DISTINCT
+            # (reference error: "ORDER BY expressions must appear in select
+            # list"); leave resolution to _plan_order_by's error path
+            return
+        select_asts = [
+            si.expr for si in spec.select_items if not isinstance(si.expr, ast.Star)
+        ]
+        aliases = {
+            si.alias.lower()
+            for si in spec.select_items
+            if isinstance(si, ast.SelectItem) and si.alias
+        }
+        for s in query.order_by:
+            e = s.expr
+            if e in extra or e in select_asts:
+                continue
+            if isinstance(e, ast.Identifier) and len(e.parts) == 1 and e.parts[0].lower() in aliases:
+                continue
+            if isinstance(e, ast.Literal) and e.kind == "number":
+                continue  # ordinal
+            # does it already name a visible output column?
+            star = any(isinstance(si.expr, ast.Star) for si in spec.select_items)
+            if star and isinstance(e, ast.Identifier):
+                continue  # SELECT * exposes every source column
+            try:
+                analyzed = ExprAnalyzer(scope, replacements).analyze(e)
+            except AnalysisError:
+                continue  # let _plan_order_by report the failure
+            extra[e] = len(select_irs)
+            select_irs.append(analyzed)
+            names.append(f"$ob{len(extra)}")
 
     @staticmethod
     def _drop_hidden(node, names, n_visible):
@@ -623,20 +732,23 @@ class Planner:
 
     def _plan_order_by(
         self, query, node, out_scope, replacements, select_asts,
-        inner_scope=None, extra_ast_to_ch=None,
+        inner_scope=None, extra_ast_to_ch=None, item_channels=None,
     ):
         """ORDER BY resolves against select aliases/ordinals first, then the
         select expressions themselves (by structure). ``extra_ast_to_ch``
-        maps hidden projection channels (windows only in ORDER BY)."""
+        maps hidden projection channels (windows only in ORDER BY);
+        ``item_channels`` maps select-item index -> first output channel
+        (they diverge when a Star item expands to several channels)."""
         sort_channels = []
         alias_to_ch = {}
         ast_to_ch = dict(extra_ast_to_ch or {})
         for i, si in enumerate(select_asts):
+            pos = item_channels[i] if item_channels is not None else i
             if isinstance(si, ast.SelectItem):
                 if si.alias:
-                    alias_to_ch[si.alias.lower()] = i
+                    alias_to_ch[si.alias.lower()] = pos
                 if not isinstance(si.expr, ast.Star):
-                    ast_to_ch[si.expr] = i
+                    ast_to_ch[si.expr] = pos
         for s in query.order_by:
             ch = None
             if isinstance(s.expr, ast.Identifier) and len(s.expr.parts) == 1:
@@ -903,3 +1015,44 @@ def _derive_name(e: ast.Expression) -> Optional[str]:
     if isinstance(e, ast.FunctionCall):
         return e.name
     return None
+
+
+def _fold_constant(e: ir.Expr) -> Optional[ir.Constant]:
+    """Constant-fold the VALUES-expression subset: literals, unary negate,
+    and casts of literals (reference: IrExpressionOptimizer, minimally)."""
+    if isinstance(e, ir.Constant):
+        return e
+    if isinstance(e, ir.Call) and e.name == "negate" and len(e.args) == 1:
+        inner = _fold_constant(e.args[0])
+        if inner is not None and inner.value is not None:
+            return ir.Constant(e.type, -inner.value)
+        return inner
+    if isinstance(e, ir.Cast):
+        inner = _fold_constant(e.value)
+        if inner is not None:
+            return ir.Constant(inner.type, inner.value)  # repr kept; _rescale converts
+        return None
+    return None
+
+
+def _rescale(c: ir.Constant, target: T.Type):
+    """Convert a constant's storage repr to the target column type's repr
+    (int -> scaled decimal, decimal scale change, int -> float)."""
+    v = c.value
+    if v is None:
+        return None
+    if target.is_decimal:
+        src_scale = c.type.scale if c.type.is_decimal else 0
+        if target.scale >= src_scale:
+            return int(v) * (10 ** (target.scale - src_scale))
+        # narrowing: round half away from zero, the reference's CAST
+        # semantics (Int128Math.rescale / DecimalOperators)
+        p = 10 ** (src_scale - target.scale)
+        iv = int(v)
+        q, r = divmod(abs(iv), p)
+        q += 1 if 2 * r >= p else 0
+        return q if iv >= 0 else -q
+    if target.is_floating and not isinstance(v, float):
+        scale = c.type.scale if c.type.is_decimal else 0
+        return float(v) / (10 ** scale)
+    return v
