@@ -125,6 +125,34 @@ def test_values_cast_narrowing_rounds(session):
     assert rows == [(__import__("decimal").Decimal("-1.3"),)]
 
 
+def test_insert_type_mismatch_rejected(session):
+    session.execute("create table u1 (x bigint)")
+    with pytest.raises(ValueError, match="mismatched types"):
+        session.execute("insert into u1 values (1.5)")
+    # widening coercions are fine: bigint literal -> decimal column
+    session.execute("create table u2 (d decimal(10,2))")
+    session.execute("insert into u2 values (3)")
+    assert session.execute("select d from u2").rows == [
+        (__import__("decimal").Decimal("3.00"),)]
+
+
+def test_values_negated_cast(session):
+    """Folded CASTs keep their rescaled repr (regression: relabeling the
+    type without rescaling shifted values by powers of ten)."""
+    import decimal
+
+    rows = session.execute("values (-cast(1.25 as decimal(3,1)))").rows
+    assert rows == [(decimal.Decimal("-1.3"),)]
+    rows = session.execute("values (cast(1.25 as decimal(3,1))), (1.22)").rows
+    assert rows == [(decimal.Decimal("1.30"),), (decimal.Decimal("1.22"),)]
+
+
+def test_if_as_identifier(session):
+    session.execute("create table branches (if bigint, session bigint)")
+    session.execute("insert into branches (if, session) values (1, 2)")
+    assert session.execute("select if, session from branches").rows == [(1, 2)]
+
+
 def test_order_by_expr_after_star():
     s = Session({"catalog": "memory", "schema": "default"})
     s.catalogs["memory"].create_table(

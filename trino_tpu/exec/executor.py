@@ -206,6 +206,48 @@ class Executor:
             )
         return Page(cols, replicated=True)
 
+    # -------------------------------------------------------------- set ops
+    def _exec_UnionNode(self, node: P.UnionNode) -> Page:
+        """UNION ALL: row-wise page concatenation (static shapes: total =
+        sum of branch capacities; dead rows stay dead)."""
+        pages = [self.execute(s) for s in node.sources_]
+        out = pages[0]
+        for p in pages[1:]:
+            out = Page.concat_pages(out, p)
+        return out
+
+    def _exec_SetOpNode(self, node: P.SetOpNode) -> Page:
+        left = self.execute(node.left)
+        right = self.execute(node.right)
+        return self.set_op_pages(node, left, right)
+
+    def set_op_pages(self, node: P.SetOpNode, left: Page, right: Page) -> Page:
+        """INTERSECT/EXCEPT DISTINCT via the grouping machinery: concat both
+        sides with a side tag, group by ALL columns (grouping equality makes
+        NULLs compare equal — the set-operation semantics), then keep groups
+        by per-side presence counts. Reference: SetOperationNodeTranslator's
+        aggregation-based lowering."""
+        both = Page.concat_pages(left, right)
+        n_l, n = left.num_rows, both.num_rows
+        side_right = jnp.arange(n) >= n_l
+        layout, out_sel = self.group_structure(list(range(both.channel_count)), both)
+        live = both.sel if both.sel is not None else jnp.ones((n,), bool)
+        l_cnt = seg.seg_sum(layout, (~side_right).astype(jnp.int64), live, jnp.int64)
+        r_cnt = seg.seg_sum(layout, side_right.astype(jnp.int64), live, jnp.int64)
+        if node.op == "intersect":
+            keep = (l_cnt > 0) & (r_cnt > 0)
+        else:  # except
+            keep = (l_cnt > 0) & (r_cnt == 0)
+        keys = [_col_to_lowered(both.columns[c]) for c in range(both.channel_count)]
+        key_cols = gb.gather_group_keys(keys, layout.rep)
+        out_cols = [
+            Column(both.columns[i].type, v,
+                   None if valid is None else ~valid,
+                   both.columns[i].dictionary)
+            for i, (v, valid) in enumerate(key_cols)
+        ]
+        return Page(out_cols, out_sel & keep, left.replicated and right.replicated)
+
     # --------------------------------------------------------------- filter
     def _exec_FilterNode(self, node: P.FilterNode) -> Page:
         page = self.execute(node.source)

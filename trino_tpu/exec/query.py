@@ -169,8 +169,44 @@ def _insert(session, stmt):
     elif src_width != len(table_cols):
         raise ValueError(
             f"INSERT has {src_width} expressions but table has {len(table_cols)} columns")
+    _check_insert_types(meta, stmt.columns, root.source.output_types)
     n = conn.insert_rows(schema, table, rows)
     return QueryResult(["rows"], [], [(n,)])
+
+
+def _check_insert_types(meta, named_columns, src_types):
+    """Reject sources that cannot widen into the target column type
+    (reference: Trino's 'Insert query has mismatched column types'). A
+    source type is accepted when it IS the target or implicitly coerces to
+    it (common super type == target): bigint -> decimal is fine, decimal ->
+    bigint is a silent-truncation hazard and is rejected."""
+    from trino_tpu import types as T
+
+    if named_columns:
+        targets = [
+            meta.columns[meta.column_index(c.lower())].type for c in named_columns
+        ]
+    else:
+        targets = [c.type for c in meta.columns]
+    for i, (src, tgt) in enumerate(zip(src_types, targets)):
+        if src == tgt or src == T.UNKNOWN:
+            continue
+        if T.common_super_type(src, tgt) is None:
+            raise ValueError(
+                f"insert column {i}: mismatched types — query produces {src}, "
+                f"table expects {tgt}")
+        # comparable but information-losing narrowing is rejected; exact
+        # widening (int -> bigint/decimal/double, lower -> higher scale) is
+        # coerced at write time
+        losing = (
+            (src.is_floating and not tgt.is_floating)
+            or (src.is_decimal and not (tgt.is_decimal or tgt.is_floating))
+            or (src.is_decimal and tgt.is_decimal and tgt.scale < src.scale)
+        )
+        if losing:
+            raise ValueError(
+                f"insert column {i}: mismatched types — query produces {src}, "
+                f"table expects {tgt}")
 
 
 def _drop_table(session, stmt):

@@ -179,6 +179,24 @@ class SpmdExecutor(Executor):
             return Executor.semi_join_filtered(self, node, *rp)
         return super().semi_join_filtered(node, left, gather_page(right))
 
+    # ----------------------------------------------------------- set ops
+    def _exec_UnionNode(self, node) -> Page:
+        """UNION ALL of shards is the union of per-shard concatenations —
+        unless replication statuses differ, where local concat would
+        multiply the replicated side; gather everything then."""
+        pages = [self.execute(s) for s in node.sources_]
+        if len({p.replicated for p in pages}) > 1:
+            pages = [gather_page(p) for p in pages]
+        out = pages[0]
+        for p in pages[1:]:
+            out = Page.concat_pages(out, p)
+        return out
+
+    def set_op_pages(self, node, left: Page, right: Page) -> Page:
+        # whole-row membership needs co-located rows: gather both sides
+        # (repartition-by-row-hash is the scalable upgrade)
+        return super().set_op_pages(node, gather_page(left), gather_page(right))
+
     # ---------------------------------------------- ordering on gathered
     def sorted_page(self, page: Page, sort_channels, limit=None) -> Page:
         return super().sorted_page(gather_page(page), sort_channels, limit)

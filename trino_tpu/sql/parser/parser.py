@@ -115,7 +115,7 @@ class Parser:
         if t.kind == "ident":
             return self.advance().text
         # contextual keywords usable as identifiers (e.g. a column named "year")
-        if t.kind == "kw" and t.lower in ("year", "month", "day", "date", "first", "last", "tables", "schemas", "columns", "values", "quarter", "hour", "minute", "second"):
+        if t.kind == "kw" and t.lower in ("year", "month", "day", "date", "first", "last", "tables", "schemas", "columns", "values", "quarter", "hour", "minute", "second", "if", "session", "set", "reset"):
             return self.advance().text
         raise ParseError(f"expected identifier but got {t.text!r} at {t.pos}")
 
@@ -626,6 +626,7 @@ class Parser:
             return e
         if t.kind == "ident" or (t.kind == "kw" and t.lower in (
             "year", "month", "day", "date", "first", "last", "quarter", "values",
+            "if", "session", "set", "reset",
         )):
             # function call or (qualified) identifier
             if self.peek(1).kind == "op" and self.peek(1).text == "(":

@@ -136,6 +136,27 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
                 )
             node.source = src
             return node, True
+        if isinstance(node, (P.UnionNode, P.SetOpNode)):
+            # each non-replicated operand becomes a gathered source fragment
+            kids = list(node.sources)
+            new_kids = []
+            for kid in kids:
+                src, rep = cut(kid, fragments)
+                if not rep:
+                    fid = next(_frag_ids)
+                    fragments.append(PlanFragment(fid, "source", src))
+                    src = RemoteSourceNode(
+                        fragment_id=fid,
+                        types=src.output_types,
+                        names=src.output_names,
+                        exchange_type="gather",
+                    )
+                new_kids.append(src)
+            if isinstance(node, P.UnionNode):
+                node.sources_ = new_kids
+            else:
+                node.left, node.right = new_kids
+            return node, True
         if isinstance(node, P.ValuesNode):
             return node, True
         raise NotImplementedError(f"fragmenter: {type(node).__name__}")

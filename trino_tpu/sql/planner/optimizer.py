@@ -333,12 +333,18 @@ def push_predicates(node: P.PlanNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
         return P.ProjectNode(src, node.expressions, node.names)
     if isinstance(node, P.JoinNode):
         return _push_into_join(node, conjuncts)
+    if isinstance(node, P.UnionNode):
+        # predicates distribute over UNION ALL branches (channel-aligned)
+        new_sources = [push_predicates(s, list(conjuncts)) for s in node.sources]
+        return _replace_sources(node, new_sources)
     if isinstance(
         node,
-        (P.LimitNode, P.TopNNode, P.SortNode, P.AggregationNode, P.ExchangeNode, P.WindowNode),
+        (P.LimitNode, P.TopNNode, P.SortNode, P.AggregationNode, P.ExchangeNode,
+         P.WindowNode, P.SetOpNode),
     ):
         # not safe/supported to push through — recurse with nothing
-        # (predicates over window outputs change which rows a window sees)
+        # (predicates over window outputs change which rows a window sees;
+        # set-op membership is over whole rows)
         new_sources = [push_predicates(s, []) for s in node.sources]
         node = _replace_sources(node, new_sources)
         return _wrap_filter(node, conjuncts)
@@ -354,6 +360,10 @@ def _wrap_filter(node: P.PlanNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
 def _replace_sources(node: P.PlanNode, sources: List[P.PlanNode]) -> P.PlanNode:
     if isinstance(node, P.JoinNode):
         node.left, node.right = sources
+    elif isinstance(node, P.SetOpNode):
+        node.left, node.right = sources
+    elif isinstance(node, P.UnionNode):
+        node.sources_ = list(sources)
     elif sources:
         node.source = sources[0]
     return node
@@ -626,4 +636,37 @@ def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict
         for j, i in enumerate(keep_calls):
             mapping[w + i] = new_w + j
         return node, mapping
+    if isinstance(node, P.UnionNode):
+        keep = sorted(needed)
+        mapping = {old: i for i, old in enumerate(keep)}
+        new_sources = []
+        for s in node.sources_:
+            src, src_map = prune_channels(s, set(keep))
+            # branches must stay channel-aligned: re-project when a source
+            # pruned differently than requested
+            if [src_map.get(c) for c in keep] != list(range(len(keep))):
+                tys = src.output_types
+                src = P.ProjectNode(
+                    src,
+                    [ir.ColumnRef(tys[src_map[c]], src_map[c]) for c in keep],
+                    [node.names[c] for c in keep],
+                )
+            new_sources.append(src)
+        return P.UnionNode(sources_=new_sources, names=[node.names[c] for c in keep]), mapping
+    if isinstance(node, P.SetOpNode):
+        # set membership is whole-row: every channel stays
+        width = len(node.output_types)
+        keep = list(range(width))
+        names = node.output_names
+        for attr in ("left", "right"):
+            src, src_map = prune_channels(getattr(node, attr), set(keep))
+            if [src_map.get(c) for c in keep] != keep:
+                tys = src.output_types
+                src = P.ProjectNode(
+                    src,
+                    [ir.ColumnRef(tys[src_map[c]], src_map[c]) for c in keep],
+                    list(names),
+                )
+            setattr(node, attr, src)
+        return node, {i: i for i in keep}
     raise NotImplementedError(f"prune_channels: {type(node).__name__}")

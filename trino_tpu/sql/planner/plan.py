@@ -360,6 +360,52 @@ class ValuesNode(PlanNode):
 
 
 @dataclasses.dataclass
+class UnionNode(PlanNode):
+    """UNION ALL: positional concatenation of same-width sources
+    (reference: plan/UnionNode.java; distinct UNION plans as UnionNode +
+    grouping AggregationNode, the reference's SetOperationNodeTranslator)."""
+
+    sources_: List[PlanNode] = None
+    names: List[str] = None
+
+    @property
+    def sources(self):
+        return tuple(self.sources_)
+
+    @property
+    def output_types(self):
+        return self.sources_[0].output_types
+
+    @property
+    def output_names(self):
+        return list(self.names)
+
+
+@dataclasses.dataclass
+class SetOpNode(PlanNode):
+    """INTERSECT/EXCEPT (DISTINCT): whole-row set membership with SQL
+    set-operation NULL semantics (NULLs compare equal — the grouping
+    equality, not the join equality; reference:
+    SetOperationNodeTranslator + distinct aggregations)."""
+
+    op: str = "intersect"  # intersect | except
+    left: PlanNode = None
+    right: PlanNode = None
+
+    @property
+    def sources(self):
+        return (self.left, self.right)
+
+    @property
+    def output_types(self):
+        return self.left.output_types
+
+    @property
+    def output_names(self):
+        return self.left.output_names
+
+
+@dataclasses.dataclass
 class ExchangeNode(PlanNode):
     """Reference: plan/ExchangeNode.java — the fragmenter cuts plans here
     (PlanFragmenter.java:94). partitioning: 'single' (gather),

@@ -118,7 +118,18 @@ class Planner:
                 node = P.LimitNode(node, query.limit)
             return RelationPlan(node, vp.scope)
         if isinstance(body, ast.SetOperation):
-            raise PlanningError("set operations: not yet supported")
+            sp = self._plan_set_operation(body, outer_scope, ctes)
+            node = sp.node
+            if query.order_by:
+                node = self._plan_order_by(
+                    query, node, sp.scope, replacements={}, select_asts=[],
+                )
+            if query.limit is not None:
+                if isinstance(node, P.SortNode):
+                    node = P.TopNNode(node.source, query.limit, node.sort_channels)
+                else:
+                    node = P.LimitNode(node, query.limit)
+            return RelationPlan(node, sp.scope)
         if isinstance(body, ast.Query):
             inner = self.plan_query(body, outer_scope, ctes)
             body_plan = inner
@@ -132,6 +143,57 @@ class Planner:
         if query.limit is not None:
             node = P.LimitNode(node, query.limit)
         return RelationPlan(node, body_plan.scope)
+
+    def _plan_set_operation(
+        self, body: ast.SetOperation, outer_scope, ctes
+    ) -> RelationPlan:
+        """UNION [ALL] / INTERSECT / EXCEPT (reference:
+        SetOperationNodeTranslator): sides unify per-column to the common
+        super type (cast projections inserted); UNION distinct = UnionNode +
+        grouping aggregation; INTERSECT/EXCEPT = whole-row SetOpNode."""
+        left = self._plan_body(body.left, outer_scope, ctes)
+        right = self._plan_body(body.right, outer_scope, ctes)
+        lf, rf = left.scope.fields, right.scope.fields
+        if len(lf) != len(rf):
+            raise PlanningError(
+                f"set operation column counts differ: {len(lf)} vs {len(rf)}")
+        types = []
+        for i, (a, b) in enumerate(zip(lf, rf)):
+            t = T.common_super_type(a.type, b.type)
+            if t is None:
+                raise PlanningError(
+                    f"set operation column {i}: incompatible types {a.type} / {b.type}")
+            types.append(t)
+        names = [f.name or f"_col{i}" for i, f in enumerate(lf)]
+        lnode = _cast_to(left.node, types, names)
+        rnode = _cast_to(right.node, types, names)
+        if body.op == "union":
+            node: P.PlanNode = P.UnionNode(sources_=[lnode, rnode], names=names)
+            if not body.all:
+                node = P.AggregationNode(
+                    node, list(range(len(types))), [], step="single", names=names)
+        else:
+            if body.all:
+                raise PlanningError(f"{body.op.upper()} ALL: not yet supported")
+            node = P.SetOpNode(op=body.op, left=lnode, right=rnode)
+        fields = [Field(n, t, None) for n, t in zip(names, types)]
+        return RelationPlan(node, Scope(fields, outer_scope))
+
+    def _plan_body(self, body, outer_scope, ctes) -> RelationPlan:
+        """Plan one side of a set operation (QuerySpec / nested set op /
+        Values / parenthesized Query)."""
+        if isinstance(body, ast.SetOperation):
+            return self._plan_set_operation(body, outer_scope, ctes)
+        if isinstance(body, ast.Values):
+            return self._plan_values(body, outer_scope)
+        if isinstance(body, ast.Query):
+            return self.plan_query(body, outer_scope, ctes)
+        if isinstance(body, ast.QuerySpec):
+            return self.plan_query_spec(
+                body, outer_scope, ctes,
+                ast.Query(body=body, with_queries=(), order_by=(), limit=None),
+            )
+        raise PlanningError(f"unsupported set operation operand: {type(body).__name__}")
 
     def _plan_values(self, body: ast.Values, outer_scope: Optional[Scope]) -> RelationPlan:
         """VALUES rows -> ValuesNode (reference: sql/tree/Values +
@@ -1017,6 +1079,18 @@ def _derive_name(e: ast.Expression) -> Optional[str]:
     return None
 
 
+def _cast_to(node: P.PlanNode, types: List[T.Type], names: List[str]) -> P.PlanNode:
+    """Project ``node`` onto exactly ``types`` (identity when it matches)."""
+    src_types = node.output_types
+    if list(src_types) == list(types):
+        return node
+    exprs = [
+        ir.ColumnRef(st, i) if st == t else ir.Cast(t, ir.ColumnRef(st, i))
+        for i, (st, t) in enumerate(zip(src_types, types))
+    ]
+    return P.ProjectNode(node, exprs, list(names))
+
+
 def _fold_constant(e: ir.Expr) -> Optional[ir.Constant]:
     """Constant-fold the VALUES-expression subset: literals, unary negate,
     and casts of literals (reference: IrExpressionOptimizer, minimally)."""
@@ -1029,9 +1103,12 @@ def _fold_constant(e: ir.Expr) -> Optional[ir.Constant]:
         return inner
     if isinstance(e, ir.Cast):
         inner = _fold_constant(e.value)
-        if inner is not None:
-            return ir.Constant(inner.type, inner.value)  # repr kept; _rescale converts
-        return None
+        if inner is None or inner.value is None:
+            return inner
+        # apply the cast NOW (rescale to the target type's repr) so the
+        # constant's type tag matches its repr — relabeling without
+        # rescaling shifts values by powers of ten
+        return ir.Constant(e.type, _rescale(inner, e.type))
     return None
 
 
