@@ -129,11 +129,28 @@ def test_insert_type_mismatch_rejected(session):
     session.execute("create table u1 (x bigint)")
     with pytest.raises(ValueError, match="mismatched types"):
         session.execute("insert into u1 values (1.5)")
-    # widening coercions are fine: bigint literal -> decimal column
-    session.execute("create table u2 (d decimal(10,2))")
+    # bigint into integer: silent-overflow hazard, rejected like the
+    # reference's canCoerce
+    session.execute("create table u3 (x integer)")
+    with pytest.raises(ValueError, match="mismatched types"):
+        session.execute("insert into u3 values (5000000000)")
+    # integer into a decimal wide enough for all 10 digits is fine
+    session.execute("create table u2 (d decimal(12,2))")
     session.execute("insert into u2 values (3)")
     assert session.execute("select d from u2").rows == [
         (__import__("decimal").Decimal("3.00"),)]
+    # ...but not into a decimal that cannot hold every integer value
+    session.execute("create table u4 (d decimal(10,2))")
+    with pytest.raises(ValueError, match="mismatched types"):
+        session.execute("insert into u4 values (3)")
+
+
+def test_values_cast_decimal_to_integer(session):
+    """Folded decimal->integer casts unscale with rounding (regression:
+    the scaled repr leaked through as e.g. 1275 for cast(12.75 as integer))."""
+    assert session.execute("values (cast(12.75 as integer))").rows == [(13,)]
+    assert session.execute("values (cast(1.5 as bigint))").rows == [(2,)]
+    assert session.execute("values (cast(-12.75 as integer))").rows == [(-13,)]
 
 
 def test_values_negated_cast(session):

@@ -1,0 +1,3 @@
+from trino_tpu.connector.filesystem.connector import FileSystemConnector
+
+__all__ = ["FileSystemConnector"]

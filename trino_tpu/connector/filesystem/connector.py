@@ -1,0 +1,307 @@
+"""Filesystem connector: Parquet tables on local disk.
+
+Reference roles collapsed into one connector: ``lib/trino-parquet``
+(``ParquetReader.java:85`` — column readers, row-group pruning by min/max
+statistics), the lakehouse connectors' table layout (``plugin/trino-hive``:
+a table is a directory of files), and the write path
+(``ConnectorPageSink`` → parquet files).
+
+TPU-first notes: columns decode straight to the engine's storage reprs —
+strings dictionary-encode (pyarrow dictionary arrays pass through without
+materializing Python strings when possible), dates to epoch-day int32,
+decimals to scaled int64 — so a scanned page is device-transfer-ready.
+Splits are row groups; a TupleDomain constraint prunes row groups whose
+min/max statistics can't match (the Parquet predicate-pushdown behavior of
+``applyFilter`` + row-group filtering).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector import spi
+from trino_tpu.data.dictionary import Dictionary
+
+
+def _pa():
+    import pyarrow  # noqa: PLC0415 — optional heavy dep, loaded on use
+
+    return pyarrow
+
+
+def _pq():
+    import pyarrow.parquet  # noqa: PLC0415
+
+    return pyarrow.parquet
+
+
+def _type_from_arrow(at) -> T.Type:
+    pa = _pa()
+    if pa.types.is_boolean(at):
+        return T.BOOLEAN
+    if pa.types.is_int8(at) or pa.types.is_int16(at) or pa.types.is_int32(at):
+        return T.INTEGER
+    if pa.types.is_integer(at):
+        return T.BIGINT
+    if pa.types.is_floating(at):
+        return T.DOUBLE
+    if pa.types.is_date(at):
+        return T.DATE
+    if pa.types.is_decimal(at):
+        return T.decimal(at.precision, at.scale)
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.varchar()
+    if pa.types.is_dictionary(at):
+        return _type_from_arrow(at.value_type)
+    raise NotImplementedError(f"unsupported parquet/arrow type: {at}")
+
+
+def _arrow_from_type(t: T.Type):
+    pa = _pa()
+    if t == T.BOOLEAN:
+        return pa.bool_()
+    if t == T.INTEGER:
+        return pa.int32()
+    if t == T.BIGINT:
+        return pa.int64()
+    if t == T.DOUBLE:
+        return pa.float64()
+    if t == T.DATE:
+        return pa.date32()
+    if t.is_decimal:
+        return pa.decimal128(t.precision, t.scale)
+    if t.is_varchar:
+        return pa.string()
+    raise NotImplementedError(f"unsupported type for parquet write: {t}")
+
+
+class FileSystemConnector(spi.Connector):
+    name = "filesystem"
+
+    # rows per row group on write: the scan-parallelism granule (a split =
+    # a run of row groups), like the reference's parquet writer block size
+    ROW_GROUP_SIZE = 4096
+
+    def __init__(self, root: Optional[str] = None):
+        # schema = subdirectory of root, table = <name>.parquet inside it
+        self.root = root or os.path.join(os.getcwd(), "fs_catalog")
+
+    # ------------------------------------------------------------- layout
+    def _table_path(self, schema: str, table: str) -> str:
+        return os.path.join(self.root, schema, f"{table}.parquet")
+
+    def list_schemas(self) -> List[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d))
+        )
+
+    def list_tables(self, schema: str) -> List[str]:
+        d = os.path.join(self.root, schema)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            f[: -len(".parquet")] for f in os.listdir(d) if f.endswith(".parquet")
+        )
+
+    def get_table(self, schema: str, table: str) -> Optional[spi.TableMetadata]:
+        path = self._table_path(schema, table)
+        if not os.path.exists(path):
+            return None
+        arrow_schema = _pq().read_schema(path)
+        cols = [
+            spi.ColumnMetadata(f.name, _type_from_arrow(f.type))
+            for f in arrow_schema
+        ]
+        return spi.TableMetadata(schema, table, cols)
+
+    def table_row_count(self, schema: str, table: str) -> Optional[int]:
+        path = self._table_path(schema, table)
+        if not os.path.exists(path):
+            return None
+        return _pq().ParquetFile(path).metadata.num_rows
+
+    # ------------------------------------------------------------- splits
+    def get_splits(
+        self, schema: str, table: str, target_splits: int, constraint=None
+    ) -> List[spi.Split]:
+        """One split per row-group run; row groups whose min/max statistics
+        contradict the constraint are pruned (ParquetReader's predicate
+        evaluation on column-chunk statistics)."""
+        path = self._table_path(schema, table)
+        pf = _pq().ParquetFile(path)
+        md = pf.metadata
+        keep = [
+            rg for rg in range(md.num_row_groups)
+            if constraint is None or self._row_group_matches(md, rg, constraint)
+        ]
+        if not keep:
+            return []
+        # distribute kept row groups over at most target_splits splits
+        per = max(1, (len(keep) + max(target_splits, 1) - 1) // max(target_splits, 1))
+        return [
+            spi.Split(table, schema, 0, 0, info=tuple(keep[i : i + per]))
+            for i in range(0, len(keep), per)
+        ]
+
+    def _row_group_matches(self, md, rg: int, constraint) -> bool:
+        rgm = md.row_group(rg)
+        name_to_idx = {rgm.column(i).path_in_schema: i for i in range(rgm.num_columns)}
+        for column, dom in constraint.domains.items():
+            ci = name_to_idx.get(column)
+            if ci is None:
+                continue
+            stats = rgm.column(ci).statistics
+            if stats is None or not stats.has_min_max:
+                continue
+            lo, hi = _stat_repr(stats.min), _stat_repr(stats.max)
+            dlo, dhi = dom.value_bounds()
+            try:
+                if dlo is not None and hi is not None and hi < dlo:
+                    return False
+                if dhi is not None and lo is not None and lo > dhi:
+                    return False
+            except TypeError:
+                continue  # incomparable statistic/domain value kinds
+        return True
+
+    # --------------------------------------------------------------- scan
+    def scan(self, split: spi.Split, columns: List[str], constraint=None) -> Dict[str, spi.ColumnData]:
+        path = self._table_path(split.schema, split.table)
+        pf = _pq().ParquetFile(path)
+        if split.info is not None:
+            row_groups = list(split.info)
+        else:
+            row_groups = list(range(pf.metadata.num_row_groups))
+        if not row_groups:  # empty pad split (SPMD over-provisioned devices)
+            tbl = pf.schema_arrow.empty_table().select(list(columns))
+        else:
+            tbl = pf.read_row_groups(row_groups, columns=list(columns))
+        out: Dict[str, spi.ColumnData] = {}
+        for name in columns:
+            out[name] = _column_data(tbl.column(name))
+        return out
+
+    # -------------------------------------------------------------- write
+    def create_table(self, schema: str, name: str, schema_def, rows) -> None:
+        pa = _pa()
+        path = self._table_path(schema, name)
+        if os.path.exists(path):
+            raise ValueError(f"table already exists: {schema}.{name}")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        arrays = []
+        fields = []
+        for i, (cname, ctype) in enumerate(schema_def):
+            at = _arrow_from_type(ctype)
+            pycol = [_coerce_py(ctype, r[i]) for r in rows]
+            arrays.append(pa.array(pycol, type=at))
+            fields.append(pa.field(cname, at))
+        _pq().write_table(
+            pa.table(arrays, schema=pa.schema(fields)), path,
+            row_group_size=self.ROW_GROUP_SIZE,
+        )
+
+    def insert_rows(self, schema: str, table: str, rows) -> int:
+        """Append by rewrite (single-file tables; the multi-file append is
+        the lakehouse upgrade)."""
+        pa = _pa()
+        meta = self.get_table(schema, table)
+        if meta is None:
+            raise KeyError(f"{self.name}.{schema}.{table} does not exist")
+        path = self._table_path(schema, table)
+        old = _pq().read_table(path)
+        arrays = []
+        for i, cm in enumerate(meta.columns):
+            at = _arrow_from_type(cm.type)
+            new = pa.array([_coerce_py(cm.type, r[i]) for r in rows], type=at)
+            arrays.append(pa.concat_arrays([old.column(i).combine_chunks(), new]))
+        _pq().write_table(
+            pa.table(arrays, names=[c.name for c in meta.columns]), path,
+            row_group_size=self.ROW_GROUP_SIZE,
+        )
+        return len(rows)
+
+    def drop_table(self, schema: str, table: str) -> None:
+        path = self._table_path(schema, table)
+        if os.path.exists(path):
+            os.remove(path)
+
+
+def _coerce_py(t: T.Type, v):
+    """Python value -> the arrow type's expected Python kind (the engine's
+    implicit widening: int/Decimal into double, int into decimal, ...)."""
+    import decimal
+
+    if v is None:
+        return None
+    if t == T.DOUBLE:
+        return float(v)
+    if t.is_decimal and not isinstance(v, decimal.Decimal):
+        return decimal.Decimal(v)
+    if t in (T.BIGINT, T.INTEGER) and not isinstance(v, bool):
+        return int(v)
+    return v
+
+
+def _stat_repr(v):
+    """Parquet statistic value -> engine storage repr."""
+    import datetime
+    import decimal
+
+    if isinstance(v, datetime.date):
+        return (v - datetime.date(1970, 1, 1)).days
+    if isinstance(v, decimal.Decimal):
+        exp = -v.as_tuple().exponent
+        return int(v.scaleb(exp))
+    return v
+
+
+def _column_data(chunked) -> spi.ColumnData:
+    """Arrow column -> engine ColumnData (storage reprs, dictionary-first
+    strings)."""
+    pa = _pa()
+    arr = chunked.combine_chunks() if hasattr(chunked, "combine_chunks") else chunked
+    at = arr.type
+    t = _type_from_arrow(at)
+    n = len(arr)
+    nulls = None
+    if arr.null_count:
+        nulls = np.asarray(arr.is_null())
+    if t.is_varchar:
+        # dictionary-encode through arrow (C++-side) — no per-row Python
+        dict_arr = arr.dictionary_encode() if not pa.types.is_dictionary(at) else arr
+        vocab = dict_arr.dictionary.to_pylist()
+        codes = np.asarray(dict_arr.indices.fill_null(-1)).astype(np.int32)
+        # engine dictionaries are sorted + order-preserving: recode
+        d = Dictionary.build([v for v in vocab if v is not None])
+        remap = np.array(
+            [d.code_of(v) if v is not None else -1 for v in vocab], dtype=np.int32
+        )
+        vals = np.where(codes >= 0, remap[np.clip(codes, 0, None)], -1).astype(np.int32)
+        return spi.ColumnData(t, vals, nulls, d)
+    if t == T.DATE:
+        vals = np.asarray(arr.cast(pa.int32())).astype(np.int32)
+        return spi.ColumnData(t, vals, nulls)
+    if t.is_decimal:
+        # decimal128's storage IS the scaled integer: read the 16-byte
+        # little-endian values straight from the validity+data buffers
+        # (casting through arrow would round to the integral VALUE).
+        if t.precision > 18:
+            raise NotImplementedError(
+                "parquet decimal precision > 18: int128 staging not wired yet")
+        if arr.offset:
+            arr = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+            arr = arr.slice(0)  # normalize; buffers() below honors offset via copy
+            arr = pa.concat_arrays([arr])
+        data = np.frombuffer(arr.buffers()[1], dtype=np.int64)
+        vals = np.ascontiguousarray(
+            data[2 * arr.offset : 2 * (arr.offset + n) : 2]
+        )  # low limb = full value for p <= 18
+        return spi.ColumnData(t, vals, nulls)
+    vals = np.asarray(arr.fill_null(0) if arr.null_count else arr)
+    return spi.ColumnData(t, np.asarray(vals, dtype=t.np_dtype), nulls)

@@ -12,7 +12,10 @@ from trino_tpu.connector.spi import Connector
 
 
 def default_catalogs() -> Dict[str, Connector]:
+    import os
+
     from trino_tpu.connector.blackhole.connector import BlackHoleConnector
+    from trino_tpu.connector.filesystem.connector import FileSystemConnector
     from trino_tpu.connector.memory.connector import MemoryConnector
     from trino_tpu.connector.tpch import TpchConnector
 
@@ -20,4 +23,6 @@ def default_catalogs() -> Dict[str, Connector]:
         "tpch": TpchConnector(),
         "memory": MemoryConnector(),
         "blackhole": BlackHoleConnector(),
+        # parquet-on-disk catalog; root via env (etc/catalog/*.properties role)
+        "filesystem": FileSystemConnector(os.environ.get("TRINO_TPU_FS_ROOT")),
     }

@@ -191,22 +191,18 @@ def _check_insert_types(meta, named_columns, src_types):
     for i, (src, tgt) in enumerate(zip(src_types, targets)):
         if src == tgt or src == T.UNKNOWN:
             continue
-        if T.common_super_type(src, tgt) is None:
-            raise ValueError(
-                f"insert column {i}: mismatched types — query produces {src}, "
-                f"table expects {tgt}")
-        # comparable but information-losing narrowing is rejected; exact
-        # widening (int -> bigint/decimal/double, lower -> higher scale) is
-        # coerced at write time
-        losing = (
-            (src.is_floating and not tgt.is_floating)
-            or (src.is_decimal and not (tgt.is_decimal or tgt.is_floating))
-            or (src.is_decimal and tgt.is_decimal and tgt.scale < src.scale)
-        )
-        if losing:
-            raise ValueError(
-                f"insert column {i}: mismatched types — query produces {src}, "
-                f"table expects {tgt}")
+        # the reference's implicit-coercion rule (TypeCoercion.canCoerce):
+        # src must widen EXACTLY into tgt — common super type IS the target,
+        # or an integer fits the decimal's integral digits
+        if T.common_super_type(src, tgt) == tgt:
+            continue
+        int_digits = {T.INTEGER: 10, T.BIGINT: 19}.get(src)
+        if (int_digits is not None and tgt.is_decimal
+                and tgt.precision - tgt.scale >= int_digits):
+            continue
+        raise ValueError(
+            f"insert column {i}: mismatched types — query produces {src}, "
+            f"table expects {tgt}")
 
 
 def _drop_table(session, stmt):

@@ -229,8 +229,12 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
             if di < len(splits):
                 data = conn.scan(splits[di], node.column_names, constraint=node.constraint)
             else:
+                # devices beyond the split count scan NOTHING: lo=hi and an
+                # empty info both mark emptiness (row-group connectors use
+                # info, range connectors use lo/hi)
                 empty = dataclasses.replace(
-                    (splits or [spi_mod.Split(node.table, node.schema, 0, 0)])[0], lo=0, hi=0)
+                    (splits or [spi_mod.Split(node.table, node.schema, 0, 0)])[0],
+                    lo=0, hi=0, info=())
                 data = conn.scan(empty, node.column_names)
             cols = []
             for name, typ in zip(node.column_names, node.column_types):

@@ -1132,4 +1132,12 @@ def _rescale(c: ir.Constant, target: T.Type):
     if target.is_floating and not isinstance(v, float):
         scale = c.type.scale if c.type.is_decimal else 0
         return float(v) / (10 ** scale)
+    if c.type.is_decimal and not target.is_decimal:
+        # integer target: unscale with half-away-from-zero rounding
+        # (reference: DecimalCasts round, not truncate)
+        p = 10 ** c.type.scale
+        iv = int(v)
+        q, r = divmod(abs(iv), p)
+        q += 1 if 2 * r >= p else 0
+        return q if iv >= 0 else -q
     return v

@@ -54,6 +54,10 @@ def estimate_rows(session, node: P.PlanNode) -> int:
         # group count <= input rows; the sort-based kernel's capacity is the
         # input row count anyway
         return estimate_rows(session, node.source)
+    if isinstance(node, P.UnionNode):
+        # UNION ALL output = SUM of branches (the generic max fallback
+        # would under-allocate capacity hints by the branch count)
+        return sum(estimate_rows(session, s) for s in node.sources_)
     srcs = node.sources
     if not srcs:
         return MIN_CAPACITY
