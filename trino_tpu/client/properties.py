@@ -56,6 +56,21 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "rows per result page on the client protocol",
             int, 10_000, _positive,
         ),
+        PropertyMetadata(
+            "retry_policy",
+            "NONE = pipelined all-at-once scheduling; TASK = fault-tolerant "
+            "stage-by-stage execution with per-task retries over spooled "
+            "outputs (reference: retry-policy / RetryPolicy.java)",
+            str, "NONE",
+            lambda v: None if v.upper() in ("NONE", "TASK") else "must be NONE or TASK",
+        ),
+        PropertyMetadata(
+            "failure_injection",
+            "inject a task failure when this substring matches a task id, "
+            "e.g. '.<fragment>.<worker>.a<attempt>' (reference: "
+            "FailureInjector.java:41-69; test-only)",
+            str, "",
+        ),
     ]
 }
 

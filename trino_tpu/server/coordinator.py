@@ -95,6 +95,9 @@ class QueryExecution:
         # coordinator itself is stateless per query.
         self.set_session: Dict[str, object] = {}
         self.reset_session: List[str] = []
+        # FTE bookkeeping: successful attempt index per task + retried ids
+        self.task_attempts: Dict[str, int] = {}
+        self.retried_tasks: List[str] = []
         self.fragment_tasks: Dict[int, List[TaskLocation]] = {}
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -143,6 +146,25 @@ class QueryExecution:
             self.failure = f"{e}\n{traceback.format_exc()}"
             self._cancel_tasks()
             self.state.set("FAILED")
+        finally:
+            self._cleanup_spool()
+
+    def _cleanup_spool(self) -> None:
+        """Drop this query's spooled task outputs (reference: exchange
+        lifecycle — sink files are deleted when the query completes)."""
+        import glob
+        import os
+
+        from trino_tpu.server.task import spool_directory
+
+        spool_dir = spool_directory()
+        if not spool_dir:
+            return
+        for path in glob.glob(os.path.join(spool_dir, f"{self.query_id}.*.pages")):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     def _schedule(self, session, fragments, workers) -> None:
         """Create one task per worker for each source fragment, splits
@@ -158,10 +180,19 @@ class QueryExecution:
                 if isinstance(node, RemoteSourceNode):
                     consumer_counts[node.fragment_id] = (
                         len(workers) if frag.partitioning == "source" else 1)
+        fte = str(self.session_properties.get("retry_policy", "NONE")).upper() == "TASK"
+        if fte:
+            from trino_tpu.server.task import spool_directory
+
+            if spool_directory() is None:
+                # the retry contract needs durable outputs (reference: TASK
+                # retry requires a configured exchange manager)
+                raise RuntimeError(
+                    "retry_policy=TASK requires the spooled exchange: set "
+                    "TRINO_TPU_SPOOL_DIR to a cluster-shared directory")
         for frag in fragments:
             if frag.partitioning != "source":
                 continue
-            locations: List[TaskLocation] = []
             # enumerate splits per scan node, interleave across workers
             per_worker_splits: List[Dict[int, list]] = [dict() for _ in workers]
             for node in P.walk_plan(frag.root):
@@ -174,26 +205,133 @@ class QueryExecution:
                 for i, split in enumerate(splits):
                     w = i % len(workers)
                     per_worker_splits[w].setdefault(node.id, []).append(split)
-            for wi, worker in enumerate(workers):
-                task_id = f"{self.query_id}.{frag.id}.{wi}"
-                req = TaskRequest(
-                    task_id=task_id,
-                    query_id=self.query_id,
-                    fragment_root=frag.root,
-                    splits=per_worker_splits[wi],
-                    upstream=self._upstream_for(frag.root, consumer_index=wi),
-                    session_properties=self.session_properties,
-                    consumer_count=consumer_counts.get(frag.id, 1),
-                )
-                body = req.to_bytes()
-                status, resp, _ = wire.http_request(
-                    "POST", f"{worker['url']}/v1/task/{task_id}", body)
-                if status >= 400:
+            if fte:
+                self.fragment_tasks[frag.id] = self._run_fragment_fte(
+                    frag, per_worker_splits, workers, consumer_counts)
+            else:
+                self.fragment_tasks[frag.id] = [
+                    self._create_task(
+                        frag, wi, 0, per_worker_splits[wi], workers[wi],
+                        consumer_counts)
+                    for wi in range(len(workers))
+                ]
+
+    MAX_TASK_ATTEMPTS = 3
+
+    def _create_task(self, frag, wi: int, attempt: int, splits, worker,
+                     consumer_counts) -> TaskLocation:
+        task_id = f"{self.query_id}.{frag.id}.{wi}.a{attempt}"
+        req = TaskRequest(
+            task_id=task_id,
+            query_id=self.query_id,
+            fragment_root=frag.root,
+            splits=splits,
+            upstream=self._upstream_for(frag.root, consumer_index=wi),
+            session_properties=self.session_properties,
+            consumer_count=consumer_counts.get(frag.id, 1),
+        )
+        status, resp, _ = wire.http_request(
+            "POST", f"{worker['url']}/v1/task/{task_id}", req.to_bytes())
+        if status >= 400:
+            raise RuntimeError(
+                f"task create failed on {worker['nodeId']}: "
+                f"{resp[:300].decode(errors='replace')}")
+        return TaskLocation(worker["url"], task_id)
+
+    TASK_ATTEMPT_TIMEOUT = 600.0
+
+    def _run_fragment_fte(self, frag, per_worker_splits, workers,
+                          consumer_counts) -> List[TaskLocation]:
+        """Fault-tolerant stage execution (reference:
+        EventDrivenFaultTolerantQueryScheduler.java:201): all of a stage's
+        tasks run CONCURRENTLY; the stage barrier is that every task must
+        FINISH (output spooled) before consumers schedule. A failed/
+        unreachable/timed-out attempt is canceled (best effort) and retried
+        on the next worker — upstreams are never recomputed because their
+        outputs persist in the spool."""
+        n = len(workers)
+        locations: List[Optional[TaskLocation]] = [None] * n
+        # per slot: (attempt, location-or-None, attempt deadline)
+        slots: Dict[int, tuple] = {}
+        for wi in range(n):
+            slots[wi] = self._start_attempt(
+                frag, wi, 0, per_worker_splits, workers, consumer_counts)
+        while slots:
+            if self.state.get() == "CANCELED":
+                for _, loc, _dl in slots.values():
+                    self._cancel_attempt(loc)
+                raise RuntimeError("query was canceled")
+            for wi in list(slots):
+                attempt, loc, deadline = slots[wi]
+                state, failure = self._poll_task(loc, deadline)
+                if state is None:
+                    continue  # still running
+                if state == "FINISHED":
+                    locations[wi] = loc
+                    self.task_attempts[loc.task_id] = attempt
+                    del slots[wi]
+                    continue
+                # failed / unreachable / timed out / canceled remotely
+                self._cancel_attempt(loc)
+                if loc is not None:
+                    self.retried_tasks.append(loc.task_id)
+                if attempt + 1 >= self.MAX_TASK_ATTEMPTS:
+                    for _, other, _dl in slots.values():
+                        self._cancel_attempt(other)
                     raise RuntimeError(
-                        f"task create failed on {worker['nodeId']}: "
-                        f"{resp[:300].decode(errors='replace')}")
-                locations.append(TaskLocation(worker["url"], task_id))
-            self.fragment_tasks[frag.id] = locations
+                        f"task {frag.id}.{wi} failed after "
+                        f"{self.MAX_TASK_ATTEMPTS} attempts: {failure}")
+                slots[wi] = self._start_attempt(
+                    frag, wi, attempt + 1, per_worker_splits, workers,
+                    consumer_counts)
+            time.sleep(0.05)
+        return list(locations)
+
+    def _start_attempt(self, frag, wi, attempt, per_worker_splits, workers,
+                       consumer_counts):
+        """Create one attempt; creation failure (dead worker at POST) is a
+        normal retryable outcome, represented as a slot with loc=None."""
+        worker = workers[(wi + attempt) % len(workers)]
+        deadline = time.monotonic() + self.TASK_ATTEMPT_TIMEOUT
+        try:
+            loc = self._create_task(
+                frag, wi, attempt, per_worker_splits[wi], worker,
+                consumer_counts)
+        except Exception:  # noqa: BLE001 — retried like a task failure
+            loc = None
+        return (attempt, loc, deadline)
+
+    def _poll_task(self, loc: Optional[TaskLocation], deadline: float):
+        """One non-blocking status check: (None, None) while running, else
+        (terminal_state, failure)."""
+        if loc is None:
+            return "FAILED", "task creation failed (worker unreachable)"
+        if time.monotonic() > deadline:
+            return "FAILED", "task attempt timeout"
+        try:
+            status, body, _ = wire.http_request(
+                "GET", f"{loc.base_url}/v1/task/{loc.task_id}/status",
+                timeout=10.0)
+        except Exception as e:  # noqa: BLE001 — worker gone counts as failed
+            return "FAILED", f"status poll failed: {e}"
+        if status >= 400:
+            return "FAILED", f"status {status}"
+        info = json.loads(body)
+        if info["state"] in ("FINISHED", "FAILED", "CANCELED"):
+            return info["state"], info.get("failure")
+        return None, None
+
+    @staticmethod
+    def _cancel_attempt(loc: Optional[TaskLocation]) -> None:
+        """Best-effort cancel of a superseded/orphaned attempt so it stops
+        consuming worker resources alongside its replacement."""
+        if loc is None:
+            return
+        try:
+            wire.http_request(
+                "DELETE", f"{loc.base_url}/v1/task/{loc.task_id}", timeout=5.0)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _upstream_for(self, root, consumer_index: int = 0) -> Dict[int, list]:
         up: Dict[int, list] = {}
@@ -238,6 +376,7 @@ class QueryExecution:
                 str(fid): [l.task_id for l in locs]
                 for fid, locs in self.fragment_tasks.items()
             },
+            "retriedTasks": list(self.retried_tasks),
         }
 
 

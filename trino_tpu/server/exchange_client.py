@@ -86,9 +86,40 @@ class ExchangeClient:
             return body, headers
         raise RuntimeError(f"exchange pull {loc}: retries exhausted")
 
+    def _read_spool(self, loc: TaskLocation) -> bool:
+        """Fallback for an unreachable/failed producer: read its spooled
+        output from the shared spool directory (reference: FTE consumers
+        read ExchangeSource files, not live task buffers —
+        FileSystemExchange.java:70). Returns True when served from spool."""
+        import os
+
+        from trino_tpu.server.task import spool_directory
+
+        spool_dir = spool_directory()
+        if not spool_dir:
+            return False
+        path = os.path.join(spool_dir, f"{loc.task_id}.pages")
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            body = f.read()
+        pages = wire.unframe_pages(body)
+        for pb in pages:
+            self._queue.put(deserialize_page(pb))
+        # final ack to the live buffer (if the producer still exists) so it
+        # releases the in-memory copy — the spool is the durable one
+        try:
+            wire.http_request(
+                "DELETE", loc.results_url(len(pages)), timeout=5.0)
+        except Exception:  # noqa: BLE001 — producer may be gone; that's fine
+            pass
+        return True
+
     def _pull(self, loc: TaskLocation) -> None:
         token = 0
         try:
+            if self._read_spool(loc):
+                return
             while True:
                 body, headers = self._request_with_retry(loc, token)
                 failed = headers.get(wire.H_TASK_FAILED)

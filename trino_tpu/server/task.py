@@ -108,6 +108,11 @@ class SqlTask:
     def _run(self) -> None:
         try:
             req = self.request
+            # fault injection (reference: FailureInjector.java:41-69 —
+            # keyed by trace/stage/partition/attempt; here by task-id match)
+            inject = str(req.session_properties.get("failure_injection") or "")
+            if inject and inject in req.task_id:
+                raise RuntimeError(f"injected failure for {req.task_id}")
             # pull all upstream fragments first (fragment bodies are
             # bulk-synchronous; the pull itself streams + backpressures)
             remote_pages: Dict[int, List[Page]] = {}
@@ -122,14 +127,36 @@ class SqlTask:
             page = ex.execute_checked(req.fragment_root)
             self.state.set("FLUSHING")
             page = page.compact()
-            if page.num_rows:
-                self.output.enqueue(serialize_page(page))
+            page_frames = [serialize_page(page)] if page.num_rows else []
+            self._spool(page_frames)
+            for pb in page_frames:
+                self.output.enqueue(pb)
             self.output.set_complete()
             self.state.set("FINISHED")
         except Exception as e:  # noqa: BLE001 — reported through task status
             self.failure = f"{e}\n{traceback.format_exc()}"
             self.output.abort(str(e))
             self.state.set("FAILED")
+
+    def _spool(self, page_frames) -> None:
+        """Persist the task's output to the shared spool directory
+        (reference: the FTE tier's spooled exchange —
+        spi/exchange/ExchangeManager.java:39 + FileSystemExchange.java:70):
+        a finished task's pages survive the producing worker, so retried
+        consumers re-read them instead of recomputing the stage."""
+        spool_dir = spool_directory()
+        if not spool_dir:
+            return
+        import os
+
+        from trino_tpu.server import wire
+
+        os.makedirs(spool_dir, exist_ok=True)
+        path = os.path.join(spool_dir, f"{self.request.task_id}.pages")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(wire.frame_pages(page_frames))
+        os.replace(tmp, path)  # atomic publish: readers never see partials
 
     def info(self) -> dict:
         return {
@@ -138,6 +165,14 @@ class SqlTask:
             "failure": self.failure,
             "bufferedBytes": self.output.buffered_bytes,
         }
+
+
+def spool_directory() -> Optional[str]:
+    """Cluster-shared spool location ('object storage' of the walking
+    skeleton); unset disables spooling."""
+    import os
+
+    return os.environ.get("TRINO_TPU_SPOOL_DIR") or None
 
 
 class TaskManager:
