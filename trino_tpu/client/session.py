@@ -13,13 +13,17 @@ from typing import Any, Dict, List, Optional, Tuple
 class Session:
     """A query session: catalogs, session properties, and an executor."""
 
-    def __init__(self, properties: Optional[Dict[str, Any]] = None, num_partitions: int = 1):
+    def __init__(self, properties: Optional[Dict[str, Any]] = None, num_partitions: int = 1,
+                 identity=None, access_control=None):
         from trino_tpu.client.properties import defaulted
         from trino_tpu.connector.registry import default_catalogs
+        from trino_tpu.server.security import AccessControl, Identity
 
         self.catalogs = default_catalogs()
         self.properties: Dict[str, Any] = defaulted(dict(properties or {}))
         self.num_partitions = num_partitions
+        self.identity = identity or Identity()
+        self.access_control = access_control or AccessControl()
 
     def set_property(self, name: str, value: Any) -> None:
         """SET SESSION analog: typed/validated (client/properties.py;

@@ -92,7 +92,7 @@ def explain_query(session, sql, mode: str = "logical", stmt=None) -> str:
     return format_plan(root)
 
 
-def _resolve_table_name(session, parts):
+def _resolve_table_name(session, parts, write: bool = False):
     parts = [p.lower() for p in parts]
     catalog = session.properties.get("catalog", "tpch")
     schema = session.properties.get("schema", "tiny")
@@ -104,6 +104,10 @@ def _resolve_table_name(session, parts):
         (table,) = parts
     if catalog not in session.catalogs:
         raise ValueError(f"catalog not found: {catalog}")
+    if write:
+        ac = getattr(session, "access_control", None)
+        if ac is not None:
+            ac.check_can_write(session.identity, catalog, schema, table)
     return session.catalogs[catalog], schema, table
 
 
@@ -111,7 +115,7 @@ def _create_table(session, stmt):
     """CREATE TABLE (reference: execution/CreateTableTask.java)."""
     from trino_tpu import types as T
 
-    conn, schema, table = _resolve_table_name(session, stmt.name)
+    conn, schema, table = _resolve_table_name(session, stmt.name, write=True)
     if conn.get_table(schema, table) is not None:
         if stmt.not_exists:
             return QueryResult(["result"], [], [("CREATE TABLE",)])
@@ -125,7 +129,7 @@ def _create_table_as(session, stmt):
     """CTAS (reference: the TableWriterOperator/TableFinishOperator pair,
     collapsed: the source query runs eagerly, rows sink via the connector
     write SPI — distributed scaled writers are the SPMD tier's upgrade)."""
-    conn, schema, table = _resolve_table_name(session, stmt.name)
+    conn, schema, table = _resolve_table_name(session, stmt.name, write=True)
     if conn.get_table(schema, table) is not None:
         if stmt.not_exists:
             return QueryResult(["rows"], [], [(0,)])
@@ -141,7 +145,7 @@ def _create_table_as(session, stmt):
 
 def _insert(session, stmt):
     """INSERT INTO (reference: execution/InsertTask + page sink)."""
-    conn, schema, table = _resolve_table_name(session, stmt.name)
+    conn, schema, table = _resolve_table_name(session, stmt.name, write=True)
     meta = conn.get_table(schema, table)
     if meta is None:
         raise ValueError(f"table not found: {schema}.{table}")
@@ -206,7 +210,7 @@ def _check_insert_types(meta, named_columns, src_types):
 
 
 def _drop_table(session, stmt):
-    conn, schema, table = _resolve_table_name(session, stmt.name)
+    conn, schema, table = _resolve_table_name(session, stmt.name, write=True)
     if conn.get_table(schema, table) is None:
         if stmt.if_exists:
             return QueryResult(["result"], [], [("DROP TABLE",)])

@@ -79,9 +79,10 @@ class QueryExecution:
     """One query's lifecycle on the coordinator."""
 
     def __init__(self, query_id: str, sql: str, session_properties: dict,
-                 registry: NodeRegistry, session_factory):
+                 registry: NodeRegistry, session_factory, user: str = "anonymous"):
         self.query_id = query_id
         self.sql = sql
+        self.user = user
         self.session_properties = dict(session_properties)
         self.state: StateMachine[str] = query_state_machine()
         self.registry = registry
@@ -113,6 +114,9 @@ class QueryExecution:
         try:
             self.state.set("PLANNING")
             session = self.session_factory(self.session_properties)
+            from trino_tpu.server.security import Identity
+
+            session.identity = Identity(self.user)
             from trino_tpu.exec.query import plan_sql, run_query
             from trino_tpu.sql.parser import ast
             from trino_tpu.sql.parser.parser import parse_statement
@@ -370,6 +374,7 @@ class QueryExecution:
         return {
             "queryId": self.query_id,
             "state": self.state.get(),
+            "user": self.user,
             "query": self.sql,
             "failure": (self.failure or "").split("\n")[0] or None,
             "fragments": {
@@ -383,7 +388,8 @@ class QueryExecution:
 class CoordinatorServer:
     """The coordinator process: discovery registry + dispatch + protocol."""
 
-    def __init__(self, port: int = 0, session_factory=None):
+    def __init__(self, port: int = 0, session_factory=None, resource_group=None):
+        from trino_tpu.server.resource_groups import ResourceGroup
         from trino_tpu.server.worker import default_session_factory
 
         self.registry = NodeRegistry()
@@ -391,6 +397,9 @@ class CoordinatorServer:
         self.queries: Dict[str, QueryExecution] = {}
         self._qlock = threading.Lock()
         self._qid = itertools.count(1)
+        # admission control (reference: resource groups / DispatchManager's
+        # resource-group submission)
+        self.resource_group = resource_group or ResourceGroup()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -408,16 +417,35 @@ class CoordinatorServer:
     # with their materialized result rows (reference: query.max-history)
     MAX_QUERY_HISTORY = 100
 
-    def submit(self, sql: str, properties: Optional[dict] = None) -> QueryExecution:
+    def submit(self, sql: str, properties: Optional[dict] = None,
+               user: str = "anonymous") -> QueryExecution:
         query_id = f"q{time.strftime('%Y%m%d')}_{next(self._qid):05d}_{uuid.uuid4().hex[:5]}"
         execution = QueryExecution(
-            query_id, sql, properties or {}, self.registry, self.session_factory)
+            query_id, sql, properties or {}, self.registry, self.session_factory,
+            user=user)
         with self._qlock:
             terminal = [qid for qid, q in self.queries.items() if q.state.is_terminal()]
             for qid in terminal[: max(0, len(terminal) - self.MAX_QUERY_HISTORY)]:
                 del self.queries[qid]
             self.queries[query_id] = execution
-        execution.start()
+        # admission is ASYNC: the submit POST returns a QUEUED payload
+        # immediately and the client polls nextUri; the query starts when
+        # its group grants a slot (reference: QueuedStatementResource's
+        # queued/executing split + ResourceGroupManager.submit)
+        def admit_and_start():
+            if not self.resource_group.submit(timeout=600.0):
+                execution.failure = "Query queue is full (resource group limit)"
+                execution.state.set("FAILED")
+                return
+            if execution.state.is_terminal():  # canceled while queued
+                self.resource_group.finish()
+                return
+            execution.state.add_listener(
+                lambda s: self.resource_group.finish()
+                if s in ("FINISHED", "FAILED", "CANCELED") else None)
+            execution.start()
+
+        threading.Thread(target=admit_and_start, daemon=True).start()
         return execution
 
     def get_query(self, query_id: str) -> Optional[QueryExecution]:
@@ -464,6 +492,42 @@ def _jsonable(v):
     return v
 
 
+def _render_ui(server: CoordinatorServer) -> str:
+    """Minimal cluster status page (reference role: core/trino-web-ui's
+    query list + worker view, server-rendered instead of a React SPA)."""
+    import html
+
+    rows = []
+    with server._qlock:
+        queries = sorted(server.queries.items(), reverse=True)
+    for qid, q in queries[:50]:
+        state = q.state.get()
+        rows.append(
+            f"<tr><td>{html.escape(qid)}</td><td class='s {state}'>{state}</td>"
+            f"<td>{html.escape(q.user)}</td>"
+            f"<td><code>{html.escape(q.sql.strip()[:120])}</code></td>"
+            f"<td>{len(q.retried_tasks)}</td></tr>")
+    nodes = "".join(
+        f"<tr><td>{html.escape(n['nodeId'])}</td>"
+        f"<td>{html.escape(n['url'])}</td></tr>"
+        for n in server.registry.alive())
+    rg = server.resource_group.info()
+    return f"""<!doctype html><html><head><meta http-equiv="refresh" content="3">
+<title>trino-tpu</title><style>
+body{{font-family:monospace;margin:2em;background:#111;color:#ddd}}
+table{{border-collapse:collapse;margin:1em 0;width:100%}}
+td,th{{border:1px solid #333;padding:4px 10px;text-align:left}}
+.s.FINISHED{{color:#6c6}}.s.FAILED{{color:#e66}}.s.RUNNING{{color:#6ae}}
+h1,h2{{color:#fff}}</style></head><body>
+<h1>trino-tpu coordinator</h1>
+<p>resource group "{rg['name']}": {rg['running']} running, {rg['queued']} queued
+(limit {rg['hardConcurrencyLimit']})</p>
+<h2>workers</h2><table><tr><th>node</th><th>url</th></tr>{nodes}</table>
+<h2>queries</h2><table>
+<tr><th>query id</th><th>state</th><th>user</th><th>query</th><th>retries</th></tr>
+{''.join(rows)}</table></body></html>"""
+
+
 def _make_handler(server: CoordinatorServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -503,7 +567,8 @@ def _make_handler(server: CoordinatorServer):
                 for header, value in self.headers.items():
                     if header.lower().startswith("x-trino-session-"):
                         props[header[len("x-trino-session-"):].lower()] = value
-                q = server.submit(sql, props)
+                user = self.headers.get("X-Trino-User", "anonymous")
+                q = server.submit(sql, props, user=user)
                 self._send(200, json.dumps(_result_payload(server, q, 0)).encode())
                 return
             self._send(404)
@@ -535,6 +600,9 @@ def _make_handler(server: CoordinatorServer):
             if self.path == "/v1/info":
                 self._send(200, json.dumps(
                     {"coordinator": True, "state": "ACTIVE"}).encode())
+                return
+            if self.path in ("/ui", "/ui/"):
+                self._send(200, _render_ui(server).encode(), "text/html")
                 return
             self._send(404)
 

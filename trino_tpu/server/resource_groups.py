@@ -1,0 +1,64 @@
+"""Resource groups: admission control for query dispatch.
+
+Reference: ``execution/resourcegroups/InternalResourceGroup.java:75`` + the
+resource-group manager SPI — a tree of groups with concurrency/queue
+limits; queries QUEUE when their group is at its hard concurrency limit and
+dispatch as running queries finish. This is the flat single-group core of
+that design (per-user subgroup trees are configuration, not mechanism).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Deque, Optional
+
+
+class ResourceGroup:
+    """Bounded-concurrency admission gate with a FIFO queue."""
+
+    def __init__(self, name: str = "global", hard_concurrency_limit: int = 16,
+                 max_queued: int = 200):
+        self.name = name
+        self.hard_concurrency_limit = hard_concurrency_limit
+        self.max_queued = max_queued
+        self._lock = threading.Lock()
+        self._running = 0
+        self._queue: Deque[threading.Event] = collections.deque()
+
+    def submit(self, timeout: Optional[float] = None) -> bool:
+        """Block until admitted (True) or rejected/timed out (False).
+        Rejection happens immediately when the queue is full (the
+        reference's QUERY_QUEUE_FULL error)."""
+        with self._lock:
+            if self._running < self.hard_concurrency_limit and not self._queue:
+                self._running += 1
+                return True
+            if len(self._queue) >= self.max_queued:
+                return False
+            gate = threading.Event()
+            self._queue.append(gate)
+        if not gate.wait(timeout):
+            with self._lock:
+                try:
+                    self._queue.remove(gate)
+                except ValueError:
+                    return True  # raced with finish(): already admitted
+            return False
+        return True
+
+    def finish(self) -> None:
+        with self._lock:
+            if self._queue:
+                gate = self._queue.popleft()
+                gate.set()  # hand the slot over; _running unchanged
+            else:
+                self._running = max(0, self._running - 1)
+
+    def info(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "running": self._running,
+                "queued": len(self._queue),
+                "hardConcurrencyLimit": self.hard_concurrency_limit,
+            }

@@ -290,6 +290,11 @@ class Planner:
         meta = conn.get_table(schema, table)
         if meta is None:
             raise PlanningError(f"table not found: {catalog}.{schema}.{table}")
+        # authorization seam (reference: AccessControl.checkCanSelectFromColumns
+        # called from StatementAnalyzer)
+        ac = getattr(self.session, "access_control", None)
+        if ac is not None:
+            ac.check_can_select(self.session.identity, catalog, schema, table)
         node = P.TableScanNode(
             catalog=catalog,
             schema=schema,
