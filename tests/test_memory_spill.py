@@ -72,7 +72,7 @@ JOIN_SQL = """
 def test_join_spills_and_matches(session):
     ex_ref, want = _run(session, JOIN_SQL)
     assert not ex_ref.memory.spills
-    ex_sp, got = _run(session, JOIN_SQL, budget=200_000)
+    ex_sp, got = _run(session, JOIN_SQL, budget=100_000)
     assert got == want
     joins = [s for s in ex_sp.memory.spills if s.kind == "join"]
     assert joins and joins[0].partitions >= 2
@@ -87,7 +87,7 @@ AGG_SQL = """
 
 def test_aggregation_spills_and_matches(session):
     _, want = _run(session, AGG_SQL)
-    ex_sp, got = _run(session, AGG_SQL, budget=300_000)
+    ex_sp, got = _run(session, AGG_SQL, budget=150_000)
     assert got == want
     aggs = [s for s in ex_sp.memory.spills if s.kind == "aggregation"]
     assert aggs and aggs[0].partitions >= 2
@@ -100,7 +100,7 @@ def test_left_outer_join_spill_preserves_unmatched(session):
           on c_custkey = o_custkey and o_totalprice > 500000.00
     """
     _, want = _run(session, sql)
-    ex_sp, got = _run(session, sql, budget=150_000)
+    ex_sp, got = _run(session, sql, budget=75_000)
     assert got == want
     assert any(s.kind == "join" for s in ex_sp.memory.spills)
     # unmatched customers survive with NULL build side
@@ -113,5 +113,5 @@ def test_semi_join_spill(session):
         where c_custkey in (select o_custkey from orders where o_totalprice > 300000.00)
     """
     _, want = _run(session, sql)
-    ex_sp, got = _run(session, sql, budget=100_000)
+    ex_sp, got = _run(session, sql, budget=50_000)
     assert got == want

@@ -28,7 +28,7 @@ def test_explain_analyze_reports_stats(session):
 
 
 def test_explain_analyze_shows_spill_and_budget(session):
-    session.set_property("query_max_device_memory", 200_000)
+    session.set_property("query_max_device_memory", 100_000)
     out = session.execute("""
         explain analyze
         select c_custkey, count(o_orderkey) from customer, orders
@@ -95,7 +95,7 @@ def test_spill_works_with_dynamic_filtering_off(session):
     from trino_tpu.exec.query import plan_sql
 
     session.set_property("dynamic_filtering_enabled", False)
-    session.set_property("query_max_device_memory", 300_000)
+    session.set_property("query_max_device_memory", 150_000)
     ex = Executor(session)
     root = plan_sql(session, "select l_orderkey, count(*) from lineitem group by l_orderkey")
     ex.execute_checked(root)
@@ -112,7 +112,7 @@ def test_explain_analyze_live_row_counts(session):
 
 
 def test_spill_disabled_runs_unpartitioned(session):
-    session.set_property("query_max_device_memory", 100_000)
+    session.set_property("query_max_device_memory", 50_000)
     session.set_property("spill_enabled", False)
     from trino_tpu.exec.executor import Executor
     from trino_tpu.exec.query import plan_sql

@@ -40,6 +40,24 @@ class TableMetadata:
 
 
 @dataclasses.dataclass(frozen=True)
+class ColumnStats:
+    """CBO column statistics (reference: spi/statistics/ColumnStatistics).
+    ``low``/``high`` are storage-repr bounds (scaled ints for decimals,
+    epoch days for dates); ``ndv`` estimates distinct values."""
+
+    low: Optional[int] = None
+    high: Optional[int] = None
+    ndv: Optional[int] = None
+    null_fraction: float = 0.0
+
+    @property
+    def vrange(self) -> Optional[tuple]:
+        if self.low is None or self.high is None:
+            return None
+        return (self.low, self.high)
+
+
+@dataclasses.dataclass(frozen=True)
 class Split:
     """A unit of scan parallelism (reference: spi/connector/ConnectorSplit).
     ``lo``/``hi`` are connector-interpreted bounds (e.g. row or key range)."""
@@ -54,12 +72,19 @@ class Split:
 @dataclasses.dataclass
 class ColumnData:
     """One scanned column: numpy values (+nulls) host-side; the executor
-    transfers to device. Varchar carries the dictionary."""
+    transfers to device. Varchar carries the dictionary.
+
+    ``vrange`` is an optional TABLE-WIDE static (min, max) bound on the
+    column's storage values (reference: spi/statistics ColumnStatistics
+    min/max). Table-wide — not per-split — so every split of a table
+    narrows to the same physical dtype (data/page.py Column.vrange) and
+    pages stay dtype-compatible across workers."""
 
     type: T.Type
     values: np.ndarray
     nulls: Optional[np.ndarray] = None
     dictionary: Optional[Dictionary] = None
+    vrange: Optional[tuple] = None
 
 
 def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
@@ -69,6 +94,11 @@ def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
     assert cols
     if len(cols) == 1:
         return cols[0]
+    from trino_tpu.data.page import merge_vrange
+
+    vrange = cols[0].vrange
+    for cd in cols[1:]:
+        vrange = merge_vrange(vrange, cd.vrange)
     d = cols[0].dictionary
     if d is not None:
         for cd in cols[1:]:
@@ -96,7 +126,7 @@ def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
         if any(cd.nulls is not None for cd in cols)
         else None
     )
-    return ColumnData(cols[0].type, vals, nulls, d)
+    return ColumnData(cols[0].type, vals, nulls, d, vrange)
 
 
 class Connector:
@@ -116,6 +146,12 @@ class Connector:
 
     def table_row_count(self, schema: str, table: str) -> Optional[int]:
         """Stats for the cost-based optimizer (reference: spi/statistics/)."""
+        return None
+
+    def column_stats(self, schema: str, table: str, column: str) -> Optional["ColumnStats"]:
+        """Per-column statistics for the cost-based optimizer: storage-repr
+        (min, max) and distinct-value estimate (reference:
+        spi/statistics/ColumnStatistics — low/high value + NDV)."""
         return None
 
     def primary_key(self, schema: str, table: str) -> Optional[List[str]]:

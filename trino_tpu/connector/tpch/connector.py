@@ -54,6 +54,15 @@ class TpchConnector(spi.Connector):
     def table_row_count(self, schema: str, table: str) -> Optional[int]:
         return gen.table_row_count(table, schema_scale_factor(schema))
 
+    def column_stats(self, schema: str, table: str, column: str) -> Optional[spi.ColumnStats]:
+        sf = schema_scale_factor(schema)
+        vr = gen.column_vrange(table, column, sf)
+        ndv = gen.column_ndv(table, column, sf)
+        if vr is None and ndv is None:
+            return None
+        low, high = vr if vr is not None else (None, None)
+        return spi.ColumnStats(low=low, high=high, ndv=ndv)
+
     _PRIMARY_KEYS = {
         "region": ["r_regionkey"],
         "nation": ["n_nationkey"],

@@ -280,6 +280,14 @@ def generate(table: str, sf: float, lo: int, hi: int, columns=None) -> Dict[str,
     scan projects — the generator-side analog of connector projection
     pushdown, reference ConnectorMetadata.applyProjection)."""
     need = set(columns) if columns is not None else {n for n, _ in SCHEMAS[table]}
+    out = _generate(table, sf, lo, hi, need)
+    for name, cd in out.items():
+        if cd.vrange is None:
+            cd.vrange = column_vrange(table, name, sf)
+    return out
+
+
+def _generate(table: str, sf: float, lo: int, hi: int, need) -> Dict[str, ColumnData]:
     if table == "orders":
         return _generate_orders(sf, lo, hi, need)
     if table == "lineitem":
@@ -544,3 +552,86 @@ def _generate_lineitem(sf: float, order_lo: int, order_hi: int, need) -> Dict[st
     if "l_comment" in need:
         out["l_comment"] = _pool_comment_col(_generic_comment_pool(), 618, lk)
     return out
+
+
+# --- column statistics (CBO + physical narrowing) ---------------------------
+# Storage-repr (min, max) bounds derived from the generation formulas above.
+# Table-wide (not per-split), so every split narrows to the same physical
+# dtype. Reference: spi/statistics/ColumnStatistics low/high + NDV.
+
+_EPRICE_MAX = 50 * 209900  # max qty * max retailprice (scaled)
+_LINE_TOTAL_MAX = (_EPRICE_MAX * 100 * 108) // 10000
+_ACCTBAL = (-99999, 999999)
+
+
+def column_vrange(table: str, column: str, sf: float):
+    """Static (min, max) of the column's storage values, or None."""
+    n_supp = table_row_count("supplier", sf)
+    n_cust = table_row_count("customer", sf)
+    n_part = table_row_count("part", sf)
+    n_ord = table_row_count("orders", sf)
+    ranges = {
+        ("region", "r_regionkey"): (0, 4),
+        ("nation", "n_nationkey"): (0, 24),
+        ("nation", "n_regionkey"): (0, 4),
+        ("supplier", "s_suppkey"): (1, n_supp),
+        ("supplier", "s_nationkey"): (0, 24),
+        ("supplier", "s_acctbal"): _ACCTBAL,
+        ("customer", "c_custkey"): (1, n_cust),
+        ("customer", "c_nationkey"): (0, 24),
+        ("customer", "c_acctbal"): _ACCTBAL,
+        ("part", "p_partkey"): (1, n_part),
+        ("part", "p_size"): (1, 50),
+        ("part", "p_retailprice"): (90000, 209900),
+        ("partsupp", "ps_partkey"): (1, n_part),
+        ("partsupp", "ps_suppkey"): (1, n_supp),
+        ("partsupp", "ps_availqty"): (1, 9999),
+        ("partsupp", "ps_supplycost"): (100, 100000),
+        ("orders", "o_orderkey"): (1, n_ord),
+        ("orders", "o_custkey"): (1, n_cust),
+        ("orders", "o_totalprice"): (81000, 7 * _LINE_TOTAL_MAX),
+        ("orders", "o_orderdate"): (START_DATE, END_DATE - 151),
+        ("orders", "o_shippriority"): (0, 0),
+        ("lineitem", "l_orderkey"): (1, n_ord),
+        ("lineitem", "l_partkey"): (1, n_part),
+        ("lineitem", "l_suppkey"): (1, n_supp),
+        ("lineitem", "l_linenumber"): (1, 7),
+        ("lineitem", "l_quantity"): (100, 5000),
+        ("lineitem", "l_extendedprice"): (90000, _EPRICE_MAX),
+        ("lineitem", "l_discount"): (0, 10),
+        ("lineitem", "l_tax"): (0, 8),
+        ("lineitem", "l_shipdate"): (START_DATE + 1, END_DATE - 151 + 121),
+        ("lineitem", "l_commitdate"): (START_DATE + 30, END_DATE - 151 + 90),
+        ("lineitem", "l_receiptdate"): (START_DATE + 2, END_DATE - 151 + 151),
+    }
+    return ranges.get((table, column))
+
+
+def column_ndv(table: str, column: str, sf: float):
+    """Distinct-value estimate, or None when unknown."""
+    vr = column_vrange(table, column, sf)
+    rows = table_row_count(table, sf)
+    # unique keys
+    unique = {
+        ("region", "r_regionkey"), ("nation", "n_nationkey"),
+        ("supplier", "s_suppkey"), ("customer", "c_custkey"),
+        ("part", "p_partkey"), ("orders", "o_orderkey"),
+    }
+    if (table, column) in unique:
+        return rows
+    if column == "l_orderkey":
+        return table_row_count("orders", sf)
+    if column == "o_custkey":
+        return max(1, (table_row_count("customer", sf) * 2) // 3)
+    # bounded-domain columns: min(span, rows)
+    if vr is not None:
+        return min(vr[1] - vr[0] + 1, rows)
+    vocab_sizes = {
+        "c_mktsegment": 5, "o_orderpriority": 5, "o_orderstatus": 3,
+        "l_returnflag": 3, "l_linestatus": 2, "l_shipinstruct": 4,
+        "l_shipmode": 7, "p_brand": 25, "p_mfgr": 5, "p_type": 150,
+        "p_container": 40, "n_name": 25, "r_name": 5,
+    }
+    if column in vocab_sizes:
+        return vocab_sizes[column]
+    return None

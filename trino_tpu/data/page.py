@@ -26,10 +26,25 @@ from trino_tpu.data.dictionary import NULL_CODE, Dictionary
 
 @dataclasses.dataclass
 class Column:
+    """``values.dtype`` is the column's PHYSICAL dtype and may be narrower
+    than ``type.np_dtype`` (the logical width) for integer-kind, date, and
+    decimal columns whose value range provably fits — the TPU analog of the
+    reference's type-specialized codegen (``FlatHashStrategyCompiler``):
+    int64 is emulated 2x int32 on TPU, so keys/dates that fit int32 sort,
+    join, and group ~2x faster and cost half the HBM traffic. Arithmetic
+    re-widens explicitly (ops/expr_lower casts operands to the result
+    type's compute dtype), so narrowing never changes results.
+
+    ``vrange`` is an optional static (min, max) bound on the stored values
+    (storage repr — scaled ints for decimals, epoch days for dates), from
+    connector stats. It licenses narrowing and lets the expression lowering
+    skip int128 paths when interval arithmetic proves an int64 fit."""
+
     type: T.Type
     values: jnp.ndarray  # device array; int32 codes when type.is_varchar
     nulls: Optional[jnp.ndarray] = None  # bool[n], True where NULL; None = no nulls
     dictionary: Optional[Dictionary] = None  # required when type.is_varchar
+    vrange: Optional[tuple] = None  # static (min, max) of values, Python ints
 
     def __post_init__(self):
         if self.type.is_varchar and self.dictionary is None:
@@ -74,6 +89,23 @@ class Column:
         if nulls is not None:
             out = [None if isnull else v for v, isnull in zip(out, nulls)]
         return out
+
+
+def fits_int32(vrange) -> bool:
+    """True when a (min, max) range can ride int32 physically. The bounds
+    are strict: the dtype max stays free for join sentinels and the min
+    stays negation-safe for descending sort keys."""
+    if vrange is None:
+        return False
+    lo, hi = vrange
+    return -(2**31) < lo and hi < 2**31 - 1
+
+
+def merge_vrange(a, b):
+    """Union of two optional (min, max) ranges; None dominates (unknown)."""
+    if a is None or b is None:
+        return None
+    return (min(a[0], b[0]), max(a[1], b[1]))
 
 
 def _to_repr(typ: T.Type, v):
@@ -154,6 +186,9 @@ class Page:
         cols: List[Column] = []
         for ca, cb in zip(a.columns, b.columns):
             va, vb = ca.values, cb.values
+            if va.dtype != vb.dtype:  # mixed physical widths: promote
+                dt = jnp.promote_types(va.dtype, vb.dtype)
+                va, vb = va.astype(dt), vb.astype(dt)
             d = ca.dictionary
             if ca.dictionary is not None and cb.dictionary is not None:
                 if ca.dictionary is not cb.dictionary and ca.dictionary.values != cb.dictionary.values:
@@ -169,7 +204,7 @@ class Page:
                 na = ca.nulls if ca.nulls is not None else jnp.zeros((len(ca),), bool)
                 nb = cb.nulls if cb.nulls is not None else jnp.zeros((len(cb),), bool)
                 nulls = jnp.concatenate([na, nb])
-            cols.append(Column(ca.type, vals, nulls, d))
+            cols.append(Column(ca.type, vals, nulls, d, merge_vrange(ca.vrange, cb.vrange)))
         sa = a.sel if a.sel is not None else jnp.ones((a.num_rows,), bool)
         sb = b.sel if b.sel is not None else jnp.ones((b.num_rows,), bool)
         return Page(cols, jnp.concatenate([sa, sb]), a.replicated and b.replicated)
@@ -205,6 +240,7 @@ class Page:
                 jnp.asarray(np.asarray(c.values)[idx]),
                 jnp.asarray(np.asarray(c.nulls)[idx]) if c.nulls is not None else None,
                 c.dictionary,
+                c.vrange,
             )
             for c in self.columns
         ]

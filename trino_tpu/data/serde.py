@@ -14,7 +14,8 @@ Format (little-endian):
   then per column (inside the compressed body):
     type_name: u16 len + utf8
     has_nulls: u8; if 1: packed bitmap ceil(n/8) bytes
-    values: dtype from type, n * itemsize bytes
+    dtype_code: u8 (PHYSICAL dtype — may be narrower than the logical type)
+    values: n * itemsize bytes
     if varchar: dict_len u32, then dict_len strings (u32 len + utf8)
 """
 from __future__ import annotations
@@ -34,6 +35,15 @@ MAGIC = 0x7E51_00D5
 CODEC_NONE = 0
 CODEC_ZLIB = 1
 
+# Physical dtype tags: a column may ride a narrower dtype than its logical
+# type's (data/page.py Column), so the wire format carries the actual one.
+_DTYPE_CODES = {
+    np.dtype(np.bool_): 0, np.dtype(np.int8): 1, np.dtype(np.int16): 2,
+    np.dtype(np.int32): 3, np.dtype(np.int64): 4,
+    np.dtype(np.float32): 5, np.dtype(np.float64): 6,
+}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+
 
 def serialize_page(page: Page, codec: int = CODEC_ZLIB) -> bytes:
     parts: List[bytes] = []
@@ -47,7 +57,9 @@ def serialize_page(page: Page, codec: int = CODEC_ZLIB) -> bytes:
             parts.append(np.packbits(np.asarray(col.nulls)).tobytes())
         else:
             parts.append(b"\x00")
-        parts.append(np.ascontiguousarray(np.asarray(col.values)).tobytes())
+        vals_np = np.ascontiguousarray(np.asarray(col.values))
+        parts.append(struct.pack("<B", _DTYPE_CODES[vals_np.dtype]))
+        parts.append(vals_np.tobytes())
         if col.type.is_varchar:
             assert col.dictionary is not None
             vocab = col.dictionary.values
@@ -59,7 +71,7 @@ def serialize_page(page: Page, codec: int = CODEC_ZLIB) -> bytes:
     body = b"".join(parts)
     if codec == CODEC_ZLIB:
         body = zlib.compress(body, level=1)
-    header = struct.pack("<IBBHI", MAGIC, 1, codec, page.channel_count, n)
+    header = struct.pack("<IBBHI", MAGIC, 2, codec, page.channel_count, n)
     return header + body
 
 
@@ -67,6 +79,8 @@ def deserialize_page(data: bytes) -> Page:
     magic, version, codec, ncols, nrows = struct.unpack_from("<IBBHI", data, 0)
     if magic != MAGIC:
         raise ValueError("bad page magic")
+    if version != 2:
+        raise ValueError(f"unsupported page format version {version} (expected 2)")
     body = data[12:]
     if codec == CODEC_ZLIB:
         body = zlib.decompress(body)
@@ -87,8 +101,8 @@ def deserialize_page(data: bytes) -> Page:
             )[:nrows].astype(np.bool_)
             nulls = jnp.asarray(bits)
             off += nbytes
-        dt = typ.np_dtype
-        assert dt is not None
+        dt = _CODE_DTYPES[body[off]]
+        off += 1
         vals = np.frombuffer(body, dtype=dt, count=nrows, offset=off)
         off += nrows * dt.itemsize
         dictionary = None

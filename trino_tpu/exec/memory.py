@@ -129,6 +129,7 @@ def partition_page_host(page, key_channels, parts: int):
                 jnp.asarray(vals[idx]),
                 jnp.asarray(nulls[idx]) if nulls is not None else None,
                 c.dictionary,
+                c.vrange,
             )
             for c, (vals, nulls) in zip(page.columns, host_cols)
         ]
@@ -148,6 +149,7 @@ def _pad_like(page):
             jnp.zeros((1,) + c.values.shape[1:], c.values.dtype),
             None,
             c.dictionary,
+            c.vrange,
         )
         for c in page.columns
     ]

@@ -44,6 +44,13 @@ class LoweredVal:
     vals: jnp.ndarray
     valid: Optional[jnp.ndarray]  # bool array; None = all valid
     dictionary: Optional[Dictionary] = None
+    # Static bound on |stored value| (Python int; None = unknown), from
+    # connector column stats (data/page.py Column.vrange) propagated by
+    # interval arithmetic. Lets decimal ops skip the int128 limb path when
+    # the range proves every intermediate fits int64 — the value-range
+    # analog of the reference's precision-based short/long decimal split
+    # (Int128Math vs long arithmetic).
+    bound: Optional[int] = None
 
 
 class LowerCtx:
@@ -79,7 +86,10 @@ def lower(expr: ir.Expr, ctx: LowerCtx) -> LoweredVal:
     if isinstance(expr, ir.ColumnRef):
         col = ctx.columns[expr.index]
         valid = None if col.nulls is None else ~col.nulls
-        return LoweredVal(col.values, valid, col.dictionary)
+        bound = None
+        if col.vrange is not None and not jnp.issubdtype(col.values.dtype, jnp.floating):
+            bound = max(abs(int(col.vrange[0])), abs(int(col.vrange[1])))
+        return LoweredVal(col.values, valid, col.dictionary, bound)
     if isinstance(expr, ir.Constant):
         return _lower_constant(expr, ctx)
     if isinstance(expr, ir.Cast):
@@ -108,7 +118,10 @@ def _lower_constant(expr: ir.Constant, ctx: LowerCtx) -> LoweredVal:
     if t.is_varchar:
         d = Dictionary([expr.value])
         return LoweredVal(_const_array(ctx, np.int32, 0), None, d)
-    return LoweredVal(_const_array(ctx, t.np_dtype, expr.value), None, None)
+    bound = None
+    if not (t.is_floating or t == T.BOOLEAN):
+        bound = abs(int(expr.value))
+    return LoweredVal(_const_array(ctx, t.np_dtype, expr.value), None, None, bound)
 
 
 # ---------------------------------------------------------------------------
@@ -193,6 +206,16 @@ def _narrow128(ctx, out128, valid):
     return i128.to_int64(out128)
 
 
+def _rescaled_bound(bound: int, from_scale: int, to_scale: int) -> int:
+    """Bound on |v| after rescaling from from_scale to to_scale."""
+    if to_scale >= from_scale:
+        return bound * 10 ** (to_scale - from_scale)
+    return bound // 10 ** (from_scale - to_scale) + 1
+
+
+_INT64_SAFE = 2**62  # int128-skip threshold: proven intermediates below this
+
+
 def _arith(name: str):
     def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
         a = lower(expr.args[0], ctx)
@@ -200,6 +223,9 @@ def _arith(name: str):
         at, bt, rt = expr.args[0].type, expr.args[1].type, expr.type
         valid = and_valid(a.valid, b.valid)
         av, bv = a.vals, b.vals
+        ba, bb = a.bound, b.bound
+        have_bounds = ba is not None and bb is not None
+        out_bound = None
         if rt.is_decimal and not (at.is_floating or bt.is_floating):
             from trino_tpu.ops import int128 as i128
 
@@ -208,8 +234,18 @@ def _arith(name: str):
             pa, pb = _prec_of(at), _prec_of(bt)
             if name in ("add", "sub"):
                 # int128 path when a rescaled operand or the result can
-                # exceed 18 digits (reference: Int128Math add/subtract)
-                if max(pa + (rs - sa), pb + (rs - sb)) > 18:
+                # exceed 18 digits (reference: Int128Math add/subtract) —
+                # UNLESS static bounds prove an int64 fit (the value-range
+                # analog of the short/long decimal split)
+                need128 = max(pa + (rs - sa), pb + (rs - sb)) > 18
+                if need128 and have_bounds:
+                    s = _rescaled_bound(ba, sa, rs) + _rescaled_bound(bb, sb, rs)
+                    if s < _INT64_SAFE:
+                        need128 = False
+                        out_bound = s
+                elif not need128 and have_bounds:
+                    out_bound = _rescaled_bound(ba, sa, rs) + _rescaled_bound(bb, sb, rs)
+                if need128:
                     a128, ova = i128.rescale_checked(i128.from_int64(av.astype(jnp.int64)), sa, rs)
                     b128, ovb = i128.rescale_checked(i128.from_int64(bv.astype(jnp.int64)), sb, rs)
                     ctx.add_error(DECIMAL_OVERFLOW, ova | ovb, valid)
@@ -220,7 +256,14 @@ def _arith(name: str):
                     bv = _rescale_decimal(bv.astype(jnp.int64), sb, rs)
                     out = av + bv if name == "add" else av - bv
             elif name == "mul":
-                if pa + pb + 1 > 18:
+                need128 = pa + pb + 1 > 18
+                if have_bounds:
+                    prod_bound = ba * bb * (10 ** max(rs - sa - sb, 0))
+                    if need128 and prod_bound < _INT64_SAFE:
+                        need128 = False
+                    if prod_bound < _INT64_SAFE:
+                        out_bound = _rescaled_bound(ba * bb, sa + sb, rs)
+                if need128:
                     # full 128-bit product, rescale half-up, narrow + flag
                     prod = i128.mul_int64(av.astype(jnp.int64), bv.astype(jnp.int64))
                     out = _narrow128(ctx, i128.rescale(prod, sa + sb, rs), valid)
@@ -230,7 +273,11 @@ def _arith(name: str):
                 ctx.add_error(DIVISION_BY_ZERO, bv == 0, valid)
                 shift = rs - sa + sb
                 den64 = jnp.where(bv == 0, 1, bv.astype(jnp.int64))
-                if pa + shift > 18:
+                need128 = pa + shift > 18
+                if need128 and have_bounds and ba * 10 ** max(shift, 0) < _INT64_SAFE:
+                    need128 = False
+                    out_bound = ba * 10 ** max(shift, 0)
+                if need128:
                     # 128-bit numerator / 64-bit divisor, half-up
                     num128, ovn = i128.rescale_checked(
                         i128.from_int64(av.astype(jnp.int64)), 0, shift
@@ -257,9 +304,12 @@ def _arith(name: str):
                 bv = jnp.where(bv == 0, 1, bv)
                 out = jnp.sign(av) * jnp.mod(jnp.abs(av), jnp.abs(bv))
                 out = _rescale_decimal(out, s, rs)
+                if have_bounds:
+                    bound_s = min(_rescaled_bound(ba, sa, s), _rescaled_bound(bb, sb, s))
+                    out_bound = _rescaled_bound(bound_s, s, rs)
             else:
                 raise AssertionError(name)
-            return LoweredVal(out, valid, None)
+            return LoweredVal(out, valid, None, out_bound)
         if rt.is_floating:
             fa = av.astype(jnp.float64) / (10.0 ** _scale_of(at)) if at.is_decimal else av
             fb = bv.astype(jnp.float64) / (10.0 ** _scale_of(bt)) if bt.is_decimal else bv
@@ -283,21 +333,26 @@ def _arith(name: str):
         bv = bv.astype(rt.np_dtype)
         if name == "add":
             out = av + bv
+            out_bound = ba + bb if have_bounds else None
         elif name == "sub":
             out = av - bv
+            out_bound = ba + bb if have_bounds else None
         elif name == "mul":
             out = av * bv
+            out_bound = ba * bb if have_bounds else None
         elif name == "div":
             ctx.add_error(DIVISION_BY_ZERO, bv == 0, valid)
             den = jnp.where(bv == 0, 1, bv)
             out = jnp.sign(av) * jnp.sign(den) * jnp.floor_divide(jnp.abs(av), jnp.abs(den))
+            out_bound = ba if have_bounds else None
         elif name == "mod":
             ctx.add_error(DIVISION_BY_ZERO, bv == 0, valid)
             den = jnp.where(bv == 0, 1, bv)
             out = jnp.sign(av) * jnp.mod(jnp.abs(av), jnp.abs(den))
+            out_bound = min(ba, bb) if have_bounds else None
         else:
             raise AssertionError(name)
-        return LoweredVal(out, valid, None)
+        return LoweredVal(out, valid, None, out_bound)
 
     return fn
 
@@ -805,19 +860,25 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
         rs = _scale_of(tt)
         if ft.is_floating:
             v = jnp.round(a.vals.astype(jnp.float64) * (10.0**rs)).astype(jnp.int64)
+            bound = None
         elif ft.is_decimal:
             v = _rescale_decimal(a.vals.astype(jnp.int64), _scale_of(ft), rs)
+            bound = None if a.bound is None else _rescaled_bound(a.bound, _scale_of(ft), rs)
         else:
             v = a.vals.astype(jnp.int64) * (10**rs)
-        return LoweredVal(v, a.valid, None)
+            bound = None if a.bound is None else a.bound * 10**rs
+        return LoweredVal(v, a.valid, None, bound)
     if tt.is_integer_kind:
         if ft.is_decimal:
             v = _rescale_decimal(a.vals.astype(jnp.int64), _scale_of(ft), 0)
+            bound = None if a.bound is None else _rescaled_bound(a.bound, _scale_of(ft), 0)
         elif ft.is_floating:
             v = jnp.round(a.vals)
+            bound = None
         else:
             v = a.vals
-        return LoweredVal(v.astype(tt.np_dtype), a.valid, None)
+            bound = a.bound
+        return LoweredVal(v.astype(tt.np_dtype), a.valid, None, bound)
     if tt == T.DATE and ft.is_varchar:
         raise NotImplementedError("cast(varchar as date) lowering: not yet supported")
     if tt.is_varchar:

@@ -31,7 +31,60 @@ from trino_tpu.ops import ranks
 
 Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
 
-_DEAD_KEY = jnp.int64(2**63 - 1)  # sorts last; equality re-checked via live mask
+
+def _sentinel_max(dtype):
+    """Largest value of the key dtype — dead rows sort last under it. A live
+    key equal to the sentinel is re-guarded by the live mask at probe time
+    (probe_counts checks build.live at the range start)."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+
+
+_INT_WIDEN = {jnp.dtype(jnp.int8): jnp.int16, jnp.dtype(jnp.int16): jnp.int32,
+              jnp.dtype(jnp.int32): jnp.int64}
+
+
+def align_join_keys(
+    build_keys: List[Lowered],
+    probe_keys: List[Lowered],
+    build_vranges=None,
+    probe_vranges=None,
+) -> Tuple[List[Lowered], List[Lowered]]:
+    """Cast each (build, probe) key pair to its common PHYSICAL dtype so the
+    kernels below sort/compare at the narrowest width the data rides
+    (data/page.py Column: int32-narrowed keys sort ~2x faster than emulated
+    int64 on TPU). Bool keys promote to int8.
+
+    Single-key builds mask dead rows with the dtype's max value (sentinel),
+    so a live key equal to that max could collide with dead rows. When the
+    pair's value ranges don't PROVE the max is unreachable, integer keys
+    widen one step (int8->int16->...->int64; int64 keeps the legacy
+    2^63-1 edge). Multi-key builds use a dead-flag column instead of a
+    sentinel and never need this."""
+    n = len(build_keys)
+    single = n == 1
+    if build_vranges is None:
+        build_vranges = [None] * n
+    if probe_vranges is None:
+        probe_vranges = [None] * n
+    out_b, out_p = [], []
+    for (bv, bva), (pv, pva), bvr, pvr in zip(
+        build_keys, probe_keys, build_vranges, probe_vranges
+    ):
+        dt = jnp.promote_types(bv.dtype, pv.dtype)
+        if dt == jnp.bool_:
+            dt = jnp.int8
+        if single and jnp.issubdtype(dt, jnp.integer):
+            proven = (
+                bvr is not None and pvr is not None
+                and max(bvr[1], pvr[1]) < jnp.iinfo(dt).max
+            )
+            if not proven and jnp.dtype(dt) in _INT_WIDEN:
+                dt = _INT_WIDEN[jnp.dtype(dt)]
+        out_b.append((bv.astype(dt), bva))
+        out_p.append((pv.astype(dt), pva))
+    return out_b, out_p
 
 
 @dataclasses.dataclass
@@ -70,12 +123,18 @@ def build_side(keys: List[Lowered], sel: Optional[jnp.ndarray]) -> SortedBuild:
     never match (single-key: sentinel; multi-key: leading dead-flag column)."""
     live = _live_mask(keys, sel)
     if len(keys) == 1:
-        vals = keys[0][0].astype(jnp.int64)
-        k = jnp.where(live, vals, _DEAD_KEY)
+        vals = keys[0][0]
+        if vals.dtype == jnp.bool_:
+            vals = vals.astype(jnp.int8)
+        k = jnp.where(live, vals, _sentinel_max(vals.dtype))
         order = ranks.argsort32(k)
         return SortedBuild([k[order]], order, live[order], True)
     dead = (~live).astype(jnp.int8)
-    masked = [jnp.where(live, v.astype(jnp.int64), 0) for v, _ in keys]
+    masked = [
+        jnp.where(live, v.astype(jnp.int8) if v.dtype == jnp.bool_ else v,
+                  jnp.zeros((), jnp.int8 if v.dtype == jnp.bool_ else v.dtype))
+        for v, _ in keys
+    ]
     sort_keys = [dead] + masked
     order = ranks.lex_argsort32(sort_keys)
     return SortedBuild(
@@ -84,11 +143,15 @@ def build_side(keys: List[Lowered], sel: Optional[jnp.ndarray]) -> SortedBuild:
 
 
 def _probe_cols(build: SortedBuild, probe_keys: List[Lowered]) -> List[jnp.ndarray]:
-    """Probe-side search columns aligned with ``build.cols``."""
+    """Probe-side search columns aligned with ``build.cols`` (callers align
+    physical dtypes up front via align_join_keys)."""
+    def as_key(v):
+        return v.astype(jnp.int8) if v.dtype == jnp.bool_ else v
+
     if build.single:
-        return [probe_keys[0][0].astype(jnp.int64)]
+        return [as_key(probe_keys[0][0])]
     m = probe_keys[0][0].shape[0]
-    return [jnp.zeros((m,), jnp.int8)] + [v.astype(jnp.int64) for v, _ in probe_keys]
+    return [jnp.zeros((m,), jnp.int8)] + [as_key(v) for v, _ in probe_keys]
 
 
 def probe_valid(probe_keys: List[Lowered]) -> Optional[jnp.ndarray]:
@@ -157,11 +220,19 @@ def expand(
     offsets = jnp.cumsum(c64)  # inclusive
     total = offsets[n - 1]
     starts = offsets - c64
-    j = jnp.arange(capacity, dtype=jnp.int64)
+    # search in int32 when capacity fits: offsets past 2^31 only occur when
+    # total overflowed the capacity anyway (flagged, run discarded), so
+    # clipping them cannot change any slot j < capacity's result
+    if capacity < 2**31:
+        offs = jnp.clip(offsets, 0, 2**31 - 1).astype(jnp.int32)
+        j = jnp.arange(capacity, dtype=jnp.int32)
+    else:
+        offs = offsets
+        j = jnp.arange(capacity, dtype=jnp.int64)
     # both sides sorted -> merge ranks, not binary search
-    p = jnp.clip(ranks.ranks_sorted_queries(offsets, j, side="right"), 0, n - 1)
-    k = j - starts[p]
-    live = j < total
+    p = jnp.clip(ranks.ranks_sorted_queries(offs, j, side="right"), 0, n - 1)
+    k = j.astype(jnp.int64) - starts[p]
+    live = j < jnp.minimum(total, capacity).astype(j.dtype)
     return p, k, live, total
 
 

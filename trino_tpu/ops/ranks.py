@@ -78,7 +78,11 @@ def sorted_ranks(
     # combined STABLE sort with builds concatenated first: equal keys keep
     # builds before queries (no tag operand needed), payload = combined index
     operands = [
-        jnp.concatenate([b, q.astype(b.dtype)])
+        jnp.concatenate([b, q]) if b.dtype == q.dtype
+        else jnp.concatenate([
+            b.astype(jnp.promote_types(b.dtype, q.dtype)),
+            q.astype(jnp.promote_types(b.dtype, q.dtype)),
+        ])
         for b, q in zip(build_cols_sorted, query_cols)
     ]
     out = jax.lax.sort(

@@ -20,19 +20,23 @@ Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
 
 def _sort_key(vals, valid, ascending: bool, nulls_first: Optional[bool]):
     """Produce (null_rank_key, value_key) so NULLs land per SQL defaults:
-    NULLS LAST for ASC, NULLS FIRST for DESC, unless specified."""
+    NULLS LAST for ASC, NULLS FIRST for DESC, unless specified.
+
+    Keys keep their PHYSICAL dtype (data/page.py Column): int32-narrowed
+    keys sort ~2x faster than emulated int64 on TPU. Descending integers
+    reverse via bitwise NOT (~v = -v-1: order-reversing for the full dtype
+    range, no INT_MIN negation overflow)."""
     if nulls_first is None:
         nulls_first = not ascending
-    if jnp.issubdtype(vals.dtype, jnp.floating):
-        v = vals.astype(jnp.float64)
-    else:
-        v = vals.astype(jnp.int64)
+    v = vals
+    if v.dtype == jnp.bool_:
+        v = v.astype(jnp.int8)
     if not ascending:
-        v = -v
+        v = -v if jnp.issubdtype(v.dtype, jnp.floating) else ~v
     if valid is None:
         return [v]
-    null_rank = jnp.where(valid, 1, 0) if nulls_first else jnp.where(valid, 0, 1)
-    return [null_rank, jnp.where(valid, v, 0)]
+    null_rank = valid.astype(jnp.int8) if nulls_first else (~valid).astype(jnp.int8)
+    return [null_rank, jnp.where(valid, v, jnp.zeros((), v.dtype))]
 
 
 def sort_order(

@@ -101,6 +101,6 @@ def repartition_page(
     for c in page.columns:
         vals = xchg(c.values[rows])
         nulls = xchg(c.nulls[rows]) if c.nulls is not None else None
-        out_cols.append(Column(c.type, vals, nulls, c.dictionary))
+        out_cols.append(Column(c.type, vals, nulls, c.dictionary, c.vrange))
     sel = xchg(send_live)
     return Page(out_cols, sel, replicated=False), overflow
