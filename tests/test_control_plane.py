@@ -233,3 +233,33 @@ def test_two_process_cluster_runs_q1():
         for p in procs:
             p.wait(timeout=10)
         coord.stop()
+
+
+def test_remote_ddl_persists_across_statements(cluster):
+    """CREATE TABLE + INSERT + SELECT over the wire against the memory
+    catalog: the coordinator holds ONE catalog map at server scope, so
+    stateful-connector DDL is visible to later statements (reference:
+    server-scoped MetadataManager catalogs, not per-query)."""
+    coord, _ = cluster
+    props = {"catalog": "memory", "schema": "default"}
+    _run(coord, "create table memory.default.advice_t (x bigint, s varchar)", props)
+    _run(coord, "insert into memory.default.advice_t values (1, 'a'), (2, 'b')", props)
+    _cols, rows = _run(coord, "select x, s from memory.default.advice_t order by x", props)
+    assert [tuple(r) for r in rows] == [(1, "a"), (2, "b")]
+    _run(coord, "drop table memory.default.advice_t", props)
+
+
+def test_worker_task_routes_require_hmac(cluster):
+    """GET /v1/task status/results and DELETE (cancel) verify the internal
+    HMAC, not just task creation (wire.py's stated contract)."""
+    import urllib.request
+
+    _, workers = cluster
+    url = f"{workers[0].base_url}/v1/task/nonexistent/status"
+    req = urllib.request.Request(url, method="GET")
+    try:
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 401

@@ -130,3 +130,22 @@ def test_distributed_parquet_scan(session, tmp_path):
     mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
     dist = DistributedQuery.build(session, plan_sql(session, sql), mesh).run().to_pylist()
     assert dist == local
+
+
+def test_all_null_string_column_scans(session):
+    """An all-null parquet varchar column has an empty dictionary vocab —
+    the scan must return a null column, not crash on the empty remap."""
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    fs = session.catalogs["filesystem"]
+    os.makedirs(os.path.join(fs.root, "lake"), exist_ok=True)
+    table = pa.table({
+        "k": pa.array([1, 2, 3], pa.int64()),
+        "s": pa.array([None, None, None], pa.string()),
+    })
+    pq.write_table(table, os.path.join(fs.root, "lake", "allnull.parquet"))
+    out = session.execute("select k, s from lake.allnull order by k")
+    assert out.rows == [(1, None), (2, None), (3, None)]

@@ -100,3 +100,18 @@ def test_order_by_hidden_source_column(session):
     full = session.execute(
         "select o_orderkey, o_totalprice from orders order by o_totalprice desc limit 2").rows
     assert [r[0] for r in rows] == [r[0] for r in full]
+
+
+def test_concat_renders_typed_constants(session):
+    # non-varchar constants render as their cast-to-varchar text, not the
+    # storage repr (scaled ints / epoch days)
+    out = session.execute("select concat('x=', 1.25), concat('d=', date '1995-03-15')")
+    assert out.rows == [("x=1.25", "d=1995-03-15")]
+
+
+def test_cast_double_to_decimal_keeps_fraction(session):
+    out = session.execute(
+        "select cast(1.5e0 as decimal(3,1)), cast(-2.45e0 as decimal(3,1))")
+    from decimal import Decimal
+
+    assert out.rows == [(Decimal("1.5"), Decimal("-2.5"))]

@@ -14,12 +14,15 @@ class Session:
     """A query session: catalogs, session properties, and an executor."""
 
     def __init__(self, properties: Optional[Dict[str, Any]] = None, num_partitions: int = 1,
-                 identity=None, access_control=None):
+                 identity=None, access_control=None, catalogs=None):
         from trino_tpu.client.properties import defaulted
         from trino_tpu.connector.registry import default_catalogs
         from trino_tpu.server.security import AccessControl, Identity
 
-        self.catalogs = default_catalogs()
+        # ``catalogs``: share one connector-instance map across sessions
+        # (server mode) so DDL/DML against in-memory connectors persists
+        # between statements; default = fresh per-session catalogs.
+        self.catalogs = catalogs if catalogs is not None else default_catalogs()
         self.properties: Dict[str, Any] = defaulted(dict(properties or {}))
         self.num_partitions = num_partitions
         self.identity = identity or Identity()

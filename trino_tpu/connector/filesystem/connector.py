@@ -282,6 +282,11 @@ def _column_data(chunked) -> spi.ColumnData:
         remap = np.array(
             [d.code_of(v) if v is not None else -1 for v in vocab], dtype=np.int32
         )
+        if len(remap) == 0:
+            # all-null column: empty vocab would make the remap gather
+            # raise (np.where evaluates both branches); one -1 pad keeps
+            # the shape machinery happy and every row maps to NULL
+            remap = np.array([-1], dtype=np.int32)
         vals = np.where(codes >= 0, remap[np.clip(codes, 0, None)], -1).astype(np.int32)
         return spi.ColumnData(t, vals, nulls, d)
     if t == T.DATE:

@@ -17,6 +17,7 @@ from trino_tpu.data.dictionary import Dictionary
 
 class MemoryConnector(spi.Connector):
     name = "memory"
+    coordinator_only = True  # tables live in this process only
 
     def __init__(self):
         self._tables: Dict[Tuple[str, str], Tuple[spi.TableMetadata, Dict[str, spi.ColumnData]]] = {}

@@ -133,6 +133,10 @@ class Connector:
     """Reference: spi/Plugin.java -> ConnectorFactory -> Connector."""
 
     name: str = "connector"
+    # True when table state lives only in the creating process (e.g. the
+    # in-memory connector): the coordinator must not distribute scans to
+    # workers, whose catalog instances would be empty.
+    coordinator_only: bool = False
 
     # --- metadata (ConnectorMetadata) ---
     def list_schemas(self) -> List[str]:

@@ -252,10 +252,10 @@ class Page:
         return int(jnp.sum(self.sel))
 
     def to_pylist(self) -> List[tuple]:
-        """Materialize live rows as Python tuples (host side, test/CLI path)."""
-        cols = [c.to_python() for c in self.columns]
-        n = self.num_rows
-        if self.sel is not None:
-            live = np.asarray(self.sel)
-            return [tuple(col[i] for col in cols) for i in range(n) if live[i]]
-        return [tuple(col[i] for col in cols) for i in range(n)]
+        """Materialize live rows as Python tuples (host side, test/CLI path).
+        Compacts FIRST so per-row Python decode touches only live rows — a
+        TopN page carries its full input capacity with a tiny live prefix,
+        and decoding millions of dead slots would dwarf the query itself."""
+        page = self.compact() if self.sel is not None else self
+        cols = [c.to_python() for c in page.columns]
+        return [tuple(col[i] for col in cols) for i in range(page.num_rows)]

@@ -30,6 +30,7 @@ from trino_tpu.ops import aggregate as agg_ops
 from trino_tpu.ops import expr_lower as L
 from trino_tpu.ops import groupby as gb
 from trino_tpu.ops import join as join_ops
+from trino_tpu.ops import ranks as ranks_ops
 from trino_tpu.ops import segments as seg
 from trino_tpu.ops import sort as sort_ops
 from trino_tpu.sql import ir
@@ -239,10 +240,11 @@ class Executor:
         both = Page.concat_pages(left, right)
         n_l, n = left.num_rows, both.num_rows
         side_right = jnp.arange(n) >= n_l
-        layout, out_sel = self.group_structure(list(range(both.channel_count)), both)
-        live = both.sel if both.sel is not None else jnp.ones((n,), bool)
-        l_cnt = seg.seg_sum(layout, (~side_right).astype(jnp.int64), live, jnp.int64)
-        r_cnt = seg.seg_sum(layout, side_right.astype(jnp.int64), live, jnp.int64)
+        layout, out_sel, (side_right_l,), sel_l = self.group_structure(
+            list(range(both.channel_count)), both, [side_right]
+        )
+        l_cnt = seg.seg_sum(layout, (~side_right_l).astype(jnp.int64), sel_l, jnp.int64)
+        r_cnt = seg.seg_sum(layout, side_right_l.astype(jnp.int64), sel_l, jnp.int64)
         if node.op == "intersect":
             keep = (l_cnt > 0) & (r_cnt > 0)
         else:  # except
@@ -264,6 +266,45 @@ class Executor:
         passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
         sel = passed if page.sel is None else (page.sel & passed)
         return Page(page.columns, sel, page.replicated)
+
+    def _exec_CompactNode(self, node: P.CompactNode) -> Page:
+        """Squeeze live rows into a smaller static-capacity page: ONE stable
+        payload-carrying sort by the dead flag (live rows first, original
+        order kept), then a static truncation to the capacity hint. Skipped
+        when it cannot help (no selection mask, or capacity >= the page's
+        rows — e.g. an SPMD shard already smaller than the global
+        estimate). Overflow raises CAPACITY_EXCEEDED:cmp:<id> for the
+        recompile-growth loop."""
+        page = self.execute(node.source)
+        n = page.num_rows
+        if page.sel is None:
+            return page
+        live = page.sel
+        capacity = self.hint_capacity(f"cmp:{node.id}", live.astype(jnp.int32))
+        if capacity >= n:
+            return page
+        total = jnp.sum(live.astype(jnp.int32))
+        self.errors.append((f"CAPACITY_EXCEEDED:cmp:{node.id}", total > capacity))
+        payloads = []
+        for c in page.columns:
+            payloads.append(c.values)
+            if c.nulls is not None:
+                payloads.append(c.nulls)
+        out = jax.lax.sort(
+            (~live,) + tuple(payloads), num_keys=1, is_stable=True
+        )[1:]
+        cols = []
+        i = 0
+        for c in page.columns:
+            v = out[i][:capacity]
+            i += 1
+            nulls = None
+            if c.nulls is not None:
+                nulls = out[i][:capacity]
+                i += 1
+            cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange))
+        sel = jnp.arange(capacity, dtype=jnp.int32) < jnp.minimum(total, capacity)
+        return Page(cols, sel, page.replicated)
 
     def _exec_ProjectNode(self, node: P.ProjectNode) -> Page:
         page = self.execute(node.source)
@@ -289,7 +330,10 @@ class Executor:
         State column types follow plan._acc_types so the page can cross the
         wire (serde needs faithful dtypes)."""
         keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
-        layout, part_sel = self.group_structure(node.group_channels, page)
+        payload_arrays, slots = self._agg_payloads(node.aggregates, page.columns)
+        layout, part_sel, payloads_l, sel_l = self.group_structure(
+            node.group_channels, page, payload_arrays
+        )
         out_cols: List[Column] = []
         if node.group_channels:
             key_cols = gb.gather_group_keys(keys, layout.rep)
@@ -301,8 +345,10 @@ class Executor:
                            src.dictionary, src.vrange)
                 )
         src_types = node.source.output_types
-        for call in node.aggregates:
-            states = self._partial_states(call, page, layout)
+        for call, slot in zip(node.aggregates, slots):
+            states = self._partial_states(
+                call, page, layout, self._slot_arg(payloads_l, slot), sel_l
+            )
             state_types = P._acc_types(call, src_types)
             for (sv, valid), st in zip(states, state_types):
                 out_cols.append(
@@ -314,7 +360,19 @@ class Executor:
         """Final aggregation over gathered partial-state pages."""
         k = len(node.group_channels)
         keys = [_col_to_lowered(page.columns[c]) for c in range(k)]
-        layout, out_sel = self.group_structure(list(range(k)), page)
+        # state columns ride the grouping sort as payloads (layout space)
+        payload_arrays: List = []
+        state_slots: List = []
+        for c in page.columns[k:]:
+            vi = len(payload_arrays)
+            payload_arrays.append(c.values)
+            hv = c.nulls is not None
+            if hv:
+                payload_arrays.append(~c.nulls)
+            state_slots.append((vi, hv))
+        layout, out_sel, payloads_l, sel_l = self.group_structure(
+            list(range(k)), page, payload_arrays
+        )
         out_cols: List[Column] = []
         if k:
             key_cols = gb.gather_group_keys(keys, layout.rep)
@@ -325,28 +383,31 @@ class Executor:
                     Column(src.type, v, None if valid is None else ~valid,
                            src.dictionary, src.vrange)
                 )
-        ci = k
+        ci = 0
         for call in node.aggregates:
             # state layout must match what aggregate_partial emitted
             n_states = P._acc_state_count(call)
-            states = page.columns[ci : ci + n_states]
+            states = [
+                self._slot_arg(payloads_l, state_slots[ci + j]) for j in range(n_states)
+            ]
             ci += n_states
-            out_cols.append(self._combine_state(call, states, page.sel, layout))
+            out_cols.append(self._combine_state(call, states, sel_l, layout))
         return Page(out_cols, out_sel, page.replicated)
 
-    def _partial_states(self, call: P.AggregateCall, page, layout):
+    def _partial_states(self, call: P.AggregateCall, page, layout, arg_l, sel_l):
         """State arrays per aggregate: [(values, valid)], layout matching
-        plan._acc_types."""
+        plan._acc_types. ``arg_l``/``sel_l`` are in layout space
+        (group_structure payloads)."""
         if call.distinct:
             raise NotImplementedError(
                 "DISTINCT aggregates cannot be split partial/final (the "
                 "planner routes them through a gather exchange instead)"
             )
-        sel = page.sel
+        sel = sel_l
         if call.function == "count" and call.arg_channel is None:
             v, _ = agg_ops.agg_count_star(layout, sel)
             return [(v, None)]
-        arg = _col_to_lowered(page.columns[call.arg_channel])
+        arg = arg_l
         if call.function == "count":
             v, _ = agg_ops.agg_count(layout, arg, sel)
             return [(v, None)]
@@ -373,16 +434,15 @@ class Executor:
             return [(cnt, None), (mean, None), (m2, None)]
         raise NotImplementedError(call.function)
 
-    def _combine_state(self, call: P.AggregateCall, states: List[Column], sel, layout) -> Column:
-        def as_arg(col: Column):
-            return (col.values, None if col.nulls is None else ~col.nulls)
-
+    def _combine_state(self, call: P.AggregateCall, states, sel, layout) -> Column:
+        """``states``: per-state (values, valid) pairs in layout space; sel
+        likewise (see group_structure)."""
         if call.function == "count":
-            v, _ = agg_ops.agg_sum(layout, as_arg(states[0]), sel, np.dtype(np.int64))
+            v, _ = agg_ops.agg_sum(layout, states[0], sel, np.dtype(np.int64))
             return Column(T.BIGINT, v, None, None)
         if call.function == "sum":
             v, valid = agg_ops.agg_sum(
-                layout, as_arg(states[0]), sel, call.output_type.np_dtype
+                layout, states[0], sel, call.output_type.np_dtype
             )
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         if call.function == "avg":
@@ -391,29 +451,29 @@ class Executor:
                 if call.output_type.is_decimal
                 else np.dtype(np.float64)
             )
-            s, _sv = agg_ops.agg_sum(layout, as_arg(states[0]), sel, base)
-            cnt, _ = agg_ops.agg_sum(layout, as_arg(states[1]), sel, np.dtype(np.int64))
+            s, _sv = agg_ops.agg_sum(layout, states[0], sel, base)
+            cnt, _ = agg_ops.agg_sum(layout, states[1], sel, np.dtype(np.int64))
             v, valid = agg_ops.finish_avg(s, cnt, call.output_type)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         if call.function == "min":
-            v, valid = agg_ops.agg_min(layout, as_arg(states[0]), sel)
+            v, valid = agg_ops.agg_min(layout, states[0], sel)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         if call.function == "max":
-            v, valid = agg_ops.agg_max(layout, as_arg(states[0]), sel)
+            v, valid = agg_ops.agg_max(layout, states[0], sel)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         if call.function in P._VAR_FAMILY:
-            cnt_i, m = as_arg(states[0])
+            cnt_i, m = states[0]
             if sel is not None:
                 m = sel if m is None else (m & sel)
             cnt, mean, m2 = agg_ops.combine_var_states(
-                layout, cnt_i, states[1].values, states[2].values, m
+                layout, cnt_i, states[1][0], states[2][0], m
             )
             v, valid = agg_ops.finish_var(cnt, mean, m2, call.function)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         raise NotImplementedError(call.function)
 
-    def group_structure(self, group_channels: List[int], page: Page):
-        """(GroupLayout, out_sel): group assignment for a page.
+    def group_structure(self, group_channels: List[int], page: Page, payloads=()):
+        """(GroupLayout, out_sel, payloads_l, sel_l): group assignment.
 
         Two strategies (the FlatHash vs BigintGroupByHash specialization
         split in the reference, re-chosen for TPU — see ops/segments.py):
@@ -424,6 +484,12 @@ class Executor:
           order).
         - sort-based: exact comparison grouping for arbitrary keys
           (ops/groupby.py); capacity == input length, out_sel a prefix.
+
+        ``payloads`` (e.g. aggregate argument columns) come back in LAYOUT
+        SPACE: permuted group-contiguous by the sort for the sorted
+        strategy (free payload operands of the one fused lax.sort),
+        unchanged for direct layouts. ``sel_l`` is the page's selection in
+        that same space (a live-prefix mask after sorting dead rows last).
         """
         n = page.num_rows
         keys = [_col_to_lowered(page.columns[c]) for c in group_channels]
@@ -431,7 +497,7 @@ class Executor:
         if not group_channels:
             gids = jnp.zeros((n,), dtype=jnp.int32)
             layout = seg.direct_layout(gids, 1, sel)
-            return layout, jnp.arange(1) < 1
+            return layout, jnp.arange(1) < 1, list(payloads), sel
         direct = self._direct_strides(group_channels, page)
         if direct is not None:
             strides, capacity = direct
@@ -439,10 +505,42 @@ class Executor:
             for (vals, _), stride in zip(keys, strides):
                 gids = gids + vals.astype(jnp.int32) * stride
             layout = seg.direct_layout(gids, capacity, sel)
-            return layout, seg.occupancy(layout, sel)
-        order, gid_sorted, num_groups = gb.group_plan(keys, sel)
+            return layout, seg.occupancy(layout, sel), list(payloads), sel
+        order, gid_sorted, num_groups, payloads_l = gb.group_plan(keys, sel, payloads)
         layout = seg.sorted_layout(order, gid_sorted, num_groups)
-        return layout, jnp.arange(n) < num_groups
+        if sel is None:
+            sel_l = None
+        else:
+            n_live = jnp.sum(sel).astype(jnp.int32)
+            sel_l = jnp.arange(n, dtype=jnp.int32) < n_live
+        return layout, jnp.arange(n) < num_groups, payloads_l, sel_l
+
+    @staticmethod
+    def _agg_payloads(aggregates, columns):
+        """(payload_arrays, slots): flatten every non-distinct aggregate
+        argument (values + validity) into sort-payload operands; slots maps
+        each call to its (index, has_valid) or None (count(*)/DISTINCT)."""
+        payload_arrays: List = []
+        slots: List = []
+        for call in aggregates:
+            if call.arg_channel is None or call.distinct:
+                slots.append(None)
+                continue
+            col = columns[call.arg_channel]
+            vi = len(payload_arrays)
+            payload_arrays.append(col.values)
+            hv = col.nulls is not None
+            if hv:
+                payload_arrays.append(~col.nulls)
+            slots.append((vi, hv))
+        return payload_arrays, slots
+
+    @staticmethod
+    def _slot_arg(payloads_l, slot):
+        if slot is None:
+            return None
+        vi, hv = slot
+        return (payloads_l[vi], payloads_l[vi + 1] if hv else None)
 
     @staticmethod
     def _direct_strides(group_channels: List[int], page: Page):
@@ -490,7 +588,10 @@ class Executor:
             n = 1
             sel = page.sel
         keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
-        layout, out_sel = self.group_structure(node.group_channels, page)
+        payload_arrays, slots = self._agg_payloads(node.aggregates, page.columns)
+        layout, out_sel, payloads_l, sel_l = self.group_structure(
+            node.group_channels, page, payload_arrays
+        )
         out_cols: List[Column] = []
         if node.group_channels:
             key_cols = gb.gather_group_keys(keys, layout.rep)
@@ -499,8 +600,10 @@ class Executor:
                 v, valid = key_cols[i]
                 nulls = None if valid is None else ~valid
                 out_cols.append(Column(src.type, v, nulls, src.dictionary, src.vrange))
-        for call in node.aggregates:
-            vals, valid = self._exec_aggregate(call, page, sel, layout)
+        for call, slot in zip(node.aggregates, slots):
+            vals, valid = self._exec_aggregate(
+                call, page, sel, layout, self._slot_arg(payloads_l, slot), sel_l
+            )
             out_cols.append(
                 Column(
                     call.output_type,
@@ -537,7 +640,10 @@ class Executor:
             self._in_spill_pass = False
         return out
 
-    def _exec_aggregate(self, call: P.AggregateCall, page, sel, layout):
+    def _exec_aggregate(self, call: P.AggregateCall, page, sel, layout, arg_l, sel_l):
+        """``arg_l``/``sel_l`` are in layout space (group_structure
+        payloads); the DISTINCT path re-groups and takes the original-order
+        page column instead."""
         if call.distinct:
             if call.function not in ("count", "approx_distinct"):
                 raise NotImplementedError(f"{call.function}(DISTINCT): not yet supported")
@@ -546,9 +652,10 @@ class Executor:
             # exact distinct is a strictly more accurate answer)
             arg = _col_to_lowered(page.columns[call.arg_channel])
             return agg_ops.agg_count_distinct(layout, arg, sel)
+        sel = sel_l
         if call.function == "count" and call.arg_channel is None:
             return agg_ops.agg_count_star(layout, sel)
-        arg = _col_to_lowered(page.columns[call.arg_channel])
+        arg = arg_l
         if call.function == "count":
             return agg_ops.agg_count(layout, arg, sel)
         if call.function == "sum":
@@ -779,21 +886,33 @@ class Executor:
         capacity = self.hint_capacity(f"join:{node.id}", emit)
         p, k, live, total = join_ops.expand(emit, capacity)
         self.errors.append((f"CAPACITY_EXCEEDED:join:{node.id}", total > capacity))
-        matched = live & (k < counts[p])
-        b_idx = jnp.clip(lo[p] + k, 0, build.n - 1)
+        # ONE batched random gather at p for lo/counts and every left column
+        # (separate computed-index gathers don't fuse: ~40 ms each per 6M
+        # rows on v5e — see ranks.batched_gather)
+        left_arrays = [lo, counts]
+        for c in left.columns:
+            left_arrays.append(c.values)
+            if c.nulls is not None:
+                left_arrays.append(c.nulls)
+        g = ranks_ops.batched_gather(left_arrays, p)
+        lo_p, counts_p = g[0], g[1]
+        matched = live & (k < counts_p)
+        b_idx = jnp.clip(lo_p + k, 0, build.n - 1)
         rows = build.rows[b_idx]
-        out_cols = [
-            Column(
-                c.type,
-                c.values[p],
-                c.nulls[p] if c.nulls is not None else None,
-                c.dictionary,
-                c.vrange,
-            )
-            for c in left.columns
-        ]
-        for rc in right.columns:
-            v, valid = join_ops.gather_column(_col_to_lowered(rc), rows, matched)
+        out_cols = []
+        gi = 2
+        for c in left.columns:
+            v = g[gi]
+            gi += 1
+            nulls = None
+            if c.nulls is not None:
+                nulls = g[gi]
+                gi += 1
+            out_cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange))
+        right_lowered = join_ops.gather_columns(
+            [_col_to_lowered(rc) for rc in right.columns], rows, matched
+        )
+        for rc, (v, valid) in zip(right.columns, right_lowered):
             out_cols.append(
                 Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary, rc.vrange)
             )
@@ -839,20 +958,28 @@ class Executor:
         capacity = self.hint_capacity(f"join:{node.id}", counts)
         p, k, live, total = join_ops.expand(counts, capacity)
         self.errors.append((f"CAPACITY_EXCEEDED:join:{node.id}", total > capacity))
-        b_idx = jnp.clip(lo[p] + k, 0, build.n - 1)
+        left_arrays = [lo]
+        for c in left.columns:
+            left_arrays.append(c.values)
+            if c.nulls is not None:
+                left_arrays.append(c.nulls)
+        g = ranks_ops.batched_gather(left_arrays, p)
+        b_idx = jnp.clip(g[0] + k, 0, build.n - 1)
         rows = build.rows[b_idx]
-        exp_cols = [
-            Column(
-                c.type,
-                c.values[p],
-                c.nulls[p] if c.nulls is not None else None,
-                c.dictionary,
-                c.vrange,
-            )
-            for c in left.columns
-        ]
-        for rc in right.columns:
-            v, valid = join_ops.gather_column(_col_to_lowered(rc), rows, live)
+        exp_cols = []
+        gi = 1
+        for c in left.columns:
+            v = g[gi]
+            gi += 1
+            nulls = None
+            if c.nulls is not None:
+                nulls = g[gi]
+                gi += 1
+            exp_cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange))
+        right_lowered = join_ops.gather_columns(
+            [_col_to_lowered(rc) for rc in right.columns], rows, live
+        )
+        for rc, (v, valid) in zip(right.columns, right_lowered):
             exp_cols.append(
                 Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary, rc.vrange)
             )
@@ -877,8 +1004,10 @@ class Executor:
         build = join_ops.build_side(build_keys, right.sel)
         rows, matched = join_ops.probe_unique(build, probe_keys)
         out_cols = list(left.columns)
-        for rc in right.columns:
-            v, valid = join_ops.gather_column(_col_to_lowered(rc), rows, matched)
+        right_lowered = join_ops.gather_columns(
+            [_col_to_lowered(rc) for rc in right.columns], rows, matched
+        )
+        for rc, (v, valid) in zip(right.columns, right_lowered):
             out_cols.append(
                 Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary, rc.vrange)
             )
@@ -947,29 +1076,36 @@ class Executor:
         return self.sorted_page(page, node.sort_channels)
 
     def sorted_page(self, page: Page, sort_channels, limit: Optional[int] = None) -> Page:
-        """Gather rows into sort order (dead rows last); sel becomes a prefix
-        mask of the live (and limit-capped) rows."""
+        """Move rows into sort order (dead rows last); sel becomes a prefix
+        mask of the live (and limit-capped) rows. All columns ride the ONE
+        payload-carrying sort (sort_ops.sort_payloads) — never a computed-
+        permutation gather per column."""
         n = page.num_rows
         keys = [
             (_col_to_lowered(page.columns[c]), asc, nf) for c, asc, nf in sort_channels
         ]
-        order = sort_ops.sort_order(keys, page.sel, n)
+        payloads = []
+        for c in page.columns:
+            payloads.append(c.values)
+            if c.nulls is not None:
+                payloads.append(c.nulls)
+        sorted_arrays = sort_ops.sort_payloads(keys, page.sel, payloads)
         live = (
             jnp.asarray(n, dtype=jnp.int64) if page.sel is None else jnp.sum(page.sel)
         )
         if limit is not None:
             live = jnp.minimum(live, limit)
         sel = jnp.arange(n) < live
-        cols = [
-            Column(
-                c.type,
-                c.values[order],
-                c.nulls[order] if c.nulls is not None else None,
-                c.dictionary,
-                c.vrange,
-            )
-            for c in page.columns
-        ]
+        cols = []
+        i = 0
+        for c in page.columns:
+            v = sorted_arrays[i]
+            i += 1
+            nulls = None
+            if c.nulls is not None:
+                nulls = sorted_arrays[i]
+                i += 1
+            cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange))
         return Page(cols, sel, page.replicated)
 
     def _exec_TopNNode(self, node: P.TopNNode) -> Page:

@@ -22,6 +22,9 @@ class PageSpec:
     dictionaries: List[Optional[Dictionary]]
     has_nulls: List[bool]
     has_sel: bool
+    # static (min, max) bounds per column (data/page.py Column.vrange) —
+    # static metadata, so it crosses the jit boundary in the spec
+    vranges: Optional[List[Optional[tuple]]] = None
 
     def array_count(self) -> int:
         """How many flat arrays a page with this spec occupies."""
@@ -45,6 +48,7 @@ def flatten_page(page: Page) -> Tuple[List[jnp.ndarray], PageSpec]:
         [c.dictionary for c in page.columns],
         has_nulls,
         page.sel is not None,
+        [c.vrange for c in page.columns],
     )
     return arrays, spec
 
@@ -52,13 +56,14 @@ def flatten_page(page: Page) -> Tuple[List[jnp.ndarray], PageSpec]:
 def unflatten_page(spec: PageSpec, arrays: List[jnp.ndarray]) -> Page:
     cols: List[Column] = []
     i = 0
-    for t, d, hn in zip(spec.types, spec.dictionaries, spec.has_nulls):
+    vranges = spec.vranges or [None] * len(spec.types)
+    for t, d, hn, vr in zip(spec.types, spec.dictionaries, spec.has_nulls, vranges):
         vals = arrays[i]
         i += 1
         nulls = None
         if hn:
             nulls = arrays[i]
             i += 1
-        cols.append(Column(t, vals, nulls, d))
+        cols.append(Column(t, vals, nulls, d, vr))
     sel = arrays[i] if spec.has_sel else None
     return Page(cols, sel)

@@ -7,6 +7,11 @@ cumsum-diff / segmented scans for sorted layouts — never an integer
 scatter). NULL inputs are excluded per SQL semantics; count(*) counts live
 rows; avg carries (sum, count) state (the same intermediate state Trino's
 partial aggregation ships).
+
+Argument/mask arrays are in LAYOUT SPACE (segments.seg_sum): callers pass
+them as payload operands of the grouping sort instead of re-gathering by
+the permutation. ``agg_count_distinct`` is the exception — it re-groups and
+takes original-row-order arguments.
 """
 from __future__ import annotations
 
@@ -61,7 +66,7 @@ def agg_count_distinct(layout: GroupLayout, arg: Lowered, sel):
     n = vals.shape[0]
     live = _live(sel, valid)
     outer_gids = layout.gids_orig()
-    order, gid_sorted, num_inner = gb.group_plan(
+    order, gid_sorted, num_inner, _ = gb.group_plan(
         [(outer_gids, None), (vals, None)], live
     )
     inner = seg.sorted_layout(order, gid_sorted, num_inner)
@@ -94,7 +99,7 @@ def var_states(layout: GroupLayout, arg: Lowered, sel, scale: int):
     s1 = seg.seg_sum(layout, x, m, jnp.float64)
     safe_n = jnp.maximum(cnt.astype(jnp.float64), 1.0)
     mean = s1 / safe_n
-    gids = jnp.clip(layout.gids_orig(), 0, layout.capacity - 1)
+    gids = jnp.clip(layout.gids_layout(), 0, layout.capacity - 1)
     centered = x - mean[gids]
     m2 = seg.seg_sum(layout, centered * centered, m, jnp.float64)
     return cnt, mean, m2
@@ -111,7 +116,7 @@ def combine_var_states(layout: GroupLayout, cnt_i, mean_i, m2_i, m):
     s1 = seg.seg_sum(layout, n_i * mean_i, None, jnp.float64)
     safe_n = jnp.maximum(cnt.astype(jnp.float64), 1.0)
     mean = s1 / safe_n
-    gids = jnp.clip(layout.gids_orig(), 0, layout.capacity - 1)
+    gids = jnp.clip(layout.gids_layout(), 0, layout.capacity - 1)
     d = mean_i - mean[gids]
     m2 = seg.seg_sum(layout, m2_i + n_i * d * d, m, jnp.float64)
     return cnt, mean, m2

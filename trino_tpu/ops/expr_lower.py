@@ -601,12 +601,24 @@ def _lower_concat(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
 
 
 def _concat_text(a: ir.Constant) -> str:
-    """Render a constant concat argument as SQL text (varchar verbatim; other
-    types via an explicit cast, not Python repr)."""
+    """Render a constant concat argument as its cast-to-varchar text,
+    decoding the STORAGE repr by type: scaled ints print as decimals,
+    epoch days as ISO dates (reference: operator/scalar cast-to-varchar
+    semantics, not Python repr of the storage value)."""
     if isinstance(a.value, str):
         return a.value
     if isinstance(a.value, bool):
         return "true" if a.value else "false"
+    t = a.type
+    if t.is_decimal:
+        from decimal import Decimal
+
+        return str(Decimal(int(a.value)).scaleb(-t.scale))
+    if t == T.DATE:
+        import datetime
+
+        return (datetime.date(1970, 1, 1)
+                + datetime.timedelta(days=int(a.value))).isoformat()
     return str(a.value)
 
 
@@ -859,7 +871,10 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
     if tt.is_decimal:
         rs = _scale_of(tt)
         if ft.is_floating:
-            v = jnp.round(a.vals.astype(jnp.float64) * (10.0**rs)).astype(jnp.int64)
+            scaled = a.vals.astype(jnp.float64) * (10.0**rs)
+            # half away from zero (reference DecimalCasts), not jnp.round's
+            # half-to-even
+            v = (jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)).astype(jnp.int64)
             bound = None
         elif ft.is_decimal:
             v = _rescale_decimal(a.vals.astype(jnp.int64), _scale_of(ft), rs)
@@ -873,7 +888,7 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
             v = _rescale_decimal(a.vals.astype(jnp.int64), _scale_of(ft), 0)
             bound = None if a.bound is None else _rescaled_bound(a.bound, _scale_of(ft), 0)
         elif ft.is_floating:
-            v = jnp.round(a.vals)
+            v = jnp.sign(a.vals) * jnp.floor(jnp.abs(a.vals) + 0.5)
             bound = None
         else:
             v = a.vals

@@ -52,6 +52,40 @@ def lex_argsort32(sort_keys: List[jnp.ndarray]) -> jnp.ndarray:
     return out[-1]
 
 
+def batched_gather(arrays: List[jnp.ndarray], idx: jnp.ndarray) -> List[jnp.ndarray]:
+    """Gather many same-length arrays at the same indices in ONE random-HBM
+    pass per dtype group. Separate gathers do not fuse when the index is
+    computed (each costs ~40 ms per 6M rows on v5e); a [n, k] row-gather
+    moves k columns for about the price of one."""
+    if len(arrays) <= 1:
+        return [a[idx] for a in arrays]
+    groups: dict = {}
+    for i, a in enumerate(arrays):
+        groups.setdefault(a.dtype, []).append(i)
+    out: List = [None] * len(arrays)
+    for _, idxs in groups.items():
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = arrays[i][idx]
+        else:
+            m = jnp.stack([arrays[i] for i in idxs], axis=1)
+            g = m[idx]
+            for j, i in enumerate(idxs):
+                out[i] = g[:, j]
+    return out
+
+
+def apply_inverse(perm: jnp.ndarray, payloads: List[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Return each payload re-ordered so slot perm[i] moves to slot i —
+    i.e. payload[inverse_permutation(perm)] — via ONE payload-carrying sort
+    (sort by perm). Replaces an inverse-permutation sort plus one random
+    gather per payload."""
+    out = jax.lax.sort(
+        (perm.astype(jnp.int32),) + tuple(payloads), num_keys=1, is_stable=True
+    )
+    return list(out[1:])
+
+
 def inverse_permutation(perm: jnp.ndarray) -> jnp.ndarray:
     """inv[perm[i]] = i, scatter-free (one int32 sort)."""
     n = perm.shape[0]
@@ -105,11 +139,12 @@ def sorted_ranks(
     # does not compile at multi-million rows on v5e
     left_all = jax.lax.cummax(left_at_start)
     right_all = prefix_incl  # at query slots: builds <= query
-    # back to query order: query i sits at combined index nb + i
-    inv = inverse_permutation(idx_s)
-    q_slots = inv[nb:]
-    lo = left_all[q_slots]
-    counts = right_all[q_slots] - lo
+    # back to query order (query i sits at combined index nb + i): ONE
+    # payload-carrying sort by idx_s, instead of inverse_permutation plus
+    # two random gathers (~40 ms each per 6M rows on v5e)
+    left_o, right_o = apply_inverse(idx_s, [left_all, right_all])
+    lo = left_o[nb:]
+    counts = right_o[nb:] - lo
     return lo, counts
 
 

@@ -63,6 +63,11 @@ class GroupLayout:
     def is_direct(self) -> bool:
         return self.gids is not None
 
+    def gids_layout(self) -> jnp.ndarray:
+        """Per-row group ids in LAYOUT SPACE (original order for direct
+        layouts, sorted order for sorted ones)."""
+        return self.gids if self.gids is not None else self.gid_sorted
+
     def gids_orig(self) -> jnp.ndarray:
         """Per-row group ids in original row order (rarely needed: only
         nested regroupings like count(DISTINCT) ask for it)."""
@@ -91,12 +96,24 @@ def direct_layout(gids: jnp.ndarray, capacity: int, live: Optional[jnp.ndarray])
 def sorted_layout(
     order: jnp.ndarray, gid_sorted: jnp.ndarray, num_groups: jnp.ndarray
 ) -> GroupLayout:
-    """Layout from a group-contiguous permutation (ops/groupby.py). Slot
-    ranges come from merge ranks (one combined sort), not binary search."""
+    """Layout from a group-contiguous permutation (ops/groupby.py).
+
+    ``gid_sorted`` is DENSE and non-decreasing (run k has gid k), so slot
+    ranges need no rank search: compacting the run-boundary positions to
+    the front with one bool-key sort yields ``starts`` directly, and each
+    run ends where the next begins. One n-row 2-operand sort replaces the
+    2n-row combined rank sort plus its inverse-permutation sort."""
     n = order.shape[0]
-    slots = jnp.arange(n, dtype=gid_sorted.dtype)
-    starts, cnt = ranks.sorted_ranks([gid_sorted], [slots])
-    ends = starts + cnt
+    pos = jnp.arange(n, dtype=jnp.int32)
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), gid_sorted[1:] != gid_sorted[:-1]]
+    )
+    nb = jnp.sum(boundary.astype(jnp.int32))
+    _, starts_seq = jax.lax.sort((~boundary, pos), num_keys=1, is_stable=True)
+    nn = jnp.int32(n)
+    starts = jnp.where(pos < nb, starts_seq, nn)
+    next_start = jnp.concatenate([starts_seq[1:], jnp.full((1,), nn, jnp.int32)])
+    ends = jnp.where(pos < nb, jnp.where(pos + 1 < nb, next_start, nn), nn)
     rep = order[jnp.clip(starts, 0, n - 1)]
     return GroupLayout(
         n=n,
@@ -135,7 +152,13 @@ def _cumsum_diff(layout: GroupLayout, x_sorted: jnp.ndarray) -> jnp.ndarray:
 def seg_sum(
     layout: GroupLayout, vals: jnp.ndarray, m: Optional[jnp.ndarray], out_dtype
 ) -> jnp.ndarray:
-    """Per-slot sum of ``vals`` over rows where mask ``m`` holds."""
+    """Per-slot sum of ``vals`` over rows where mask ``m`` holds.
+
+    ``vals``/``m`` are in LAYOUT SPACE: original row order for direct
+    layouts, group-contiguous sorted order for sorted layouts. Callers get
+    sorted-space arrays for free as payload operands of the grouping sort
+    (Executor.group_structure) — a per-aggregate random re-gather by the
+    permutation would cost ~40 ms per 6M rows on v5e."""
     x = vals.astype(out_dtype)
     if m is not None:
         x = jnp.where(m, x, jnp.zeros((), out_dtype))
@@ -144,13 +167,14 @@ def seg_sum(
     if jnp.issubdtype(jnp.dtype(out_dtype), jnp.floating):
         # f32/f64 scatter-add is fast on TPU and avoids cumsum error growth
         return jax.ops.segment_sum(
-            x[layout.order], layout.gid_sorted, num_segments=layout.capacity
+            x, layout.gid_sorted, num_segments=layout.capacity
         )
-    return _cumsum_diff(layout, x[layout.order])
+    return _cumsum_diff(layout, x)
 
 
 def seg_count(layout: GroupLayout, m: Optional[jnp.ndarray]) -> jnp.ndarray:
-    """Per-slot count of rows where mask ``m`` holds (int64)."""
+    """Per-slot count of rows where mask ``m`` holds (int64). ``m`` is in
+    layout space (see seg_sum)."""
     ones = (
         jnp.ones((layout.n,), jnp.int64)
         if m is None
@@ -162,7 +186,7 @@ def seg_count(layout: GroupLayout, m: Optional[jnp.ndarray]) -> jnp.ndarray:
         )
     if m is None:
         return (layout.ends - layout.starts).astype(jnp.int64)
-    return _cumsum_diff(layout, ones[layout.order])
+    return _cumsum_diff(layout, ones)
 
 
 def seg_minmax(
@@ -170,6 +194,8 @@ def seg_minmax(
 ) -> jnp.ndarray:
     """Per-slot min/max of vals over rows where ``m`` holds (sentinel-filled
     for empty slots — pair with seg_count to derive validity).
+
+    ``vals``/``m`` are in layout space (see seg_sum).
 
     Sorted path: one fused sort by (gid, value) puts each group's min at its
     start and max at its end — two gathers finish the job. (A segmented
@@ -189,8 +215,7 @@ def seg_minmax(
         return jnp.stack(
             [red(jnp.where(layout.gids == g, x, sentinel)) for g in range(layout.capacity)]
         )
-    xs = x[layout.order]
-    _, x_by_group = jax.lax.sort((layout.gid_sorted, xs), num_keys=2)
+    _, x_by_group = jax.lax.sort((layout.gid_sorted, x), num_keys=2)
     n = layout.n
     pos = layout.starts if is_min else jnp.clip(layout.ends - 1, 0, n - 1)
     out = x_by_group[jnp.clip(pos, 0, n - 1)]

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as PSpec
 from trino_tpu import types as T
 from trino_tpu.connector import spi as spi_mod
 from trino_tpu.data.page import Column, Page
+from trino_tpu.data import page as page_mod
 from trino_tpu.exec.executor import Executor, QueryError
 from trino_tpu.exec.page_tree import PageSpec, flatten_page, unflatten_page
 from trino_tpu.ops import aggregate as agg_ops
@@ -62,6 +63,7 @@ def gather_page(page: Page) -> Page:
             _gather_flat(c.values),
             _gather_flat(c.nulls) if c.nulls is not None else None,
             c.dictionary,
+            c.vrange,
         )
         for c in page.columns
     ]
@@ -239,12 +241,18 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
             cols = []
             for name, typ in zip(node.column_names, node.column_types):
                 cd = data[name]
+                vals = np.asarray(cd.values)
+                # physical narrowing, same rule as assemble_scan_page:
+                # table-wide ranges keep every shard dtype-uniform
+                if vals.dtype == np.int64 and page_mod.fits_int32(cd.vrange):
+                    vals = vals.astype(np.int32)
                 cols.append(
                     Column(
                         typ,
-                        np.asarray(cd.values),
+                        vals,
                         np.asarray(cd.nulls) if cd.nulls is not None else None,
                         cd.dictionary,
+                        cd.vrange,
                     )
                 )
             shard_pages.append(cols)
@@ -305,6 +313,7 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
         types = []
         dicts = []
         has_nulls = []
+        vranges = [c.vrange for c in shard_pages[0]]
         for (vals, nulls, d), typ in zip(stacked_cols, node.column_types):
             arrays.append(vals)
             types.append(typ)
@@ -316,7 +325,7 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
                 has_nulls.append(False)
         arrays.append(sel)
         staged[node.id] = arrays
-        specs[node.id] = PageSpec(types, dicts, has_nulls, True)
+        specs[node.id] = PageSpec(types, dicts, has_nulls, True, vranges)
     return staged, specs
 
 

@@ -134,6 +134,18 @@ class QueryExecution:
                 self.state.set("FINISHED")
                 return
             root = plan_sql(session, self.sql)
+            if any(
+                isinstance(n, P.TableScanNode)
+                and session.catalogs[n.catalog].coordinator_only
+                for n in P.walk_plan(root)
+            ):
+                # scans over process-local catalogs (memory) cannot be
+                # shipped to workers — execute on the coordinator's own
+                # engine (its embedded worker role)
+                result = run_query(session, self.sql)
+                self.columns, self.rows = result.column_names, result.rows
+                self.state.set("FINISHED")
+                return
             fragments = fragment_plan(root, session)
             self.state.set("STARTING")
             workers = self.registry.alive()
@@ -390,10 +402,21 @@ class CoordinatorServer:
 
     def __init__(self, port: int = 0, session_factory=None, resource_group=None):
         from trino_tpu.server.resource_groups import ResourceGroup
-        from trino_tpu.server.worker import default_session_factory
+        from trino_tpu.connector.registry import default_catalogs
 
         self.registry = NodeRegistry()
-        self.session_factory = session_factory or default_session_factory
+        # one shared catalog map for every query this server runs: DDL/DML
+        # against stateful connectors (memory) must be visible to later
+        # statements (reference: MetadataManager's catalog handles living at
+        # server scope, not query scope)
+        self.catalogs = default_catalogs()
+
+        def _shared_catalog_session(properties):
+            from trino_tpu.client.session import Session
+
+            return Session(properties, catalogs=self.catalogs)
+
+        self.session_factory = session_factory or _shared_catalog_session
         self.queries: Dict[str, QueryExecution] = {}
         self._qlock = threading.Lock()
         self._qid = itertools.count(1)

@@ -34,12 +34,28 @@ def default_session_factory(properties):
     return Session(properties)
 
 
+def shared_catalog_session_factory():
+    """Session factory bound to ONE catalog map for the whole process, so
+    stateful-connector writes persist across tasks (see
+    CoordinatorServer)."""
+    from trino_tpu.connector.registry import default_catalogs
+
+    catalogs = default_catalogs()
+
+    def factory(properties):
+        from trino_tpu.client.session import Session
+
+        return Session(properties, catalogs=catalogs)
+
+    return factory
+
+
 class WorkerServer:
     """One worker process: task manager + HTTP endpoint + announcer."""
 
     def __init__(self, port: int = 0, coordinator_url: Optional[str] = None,
-                 node_id: Optional[str] = None, session_factory=default_session_factory):
-        self.tasks = TaskManager(session_factory)
+                 node_id: Optional[str] = None, session_factory=None):
+        self.tasks = TaskManager(session_factory or shared_catalog_session_factory())
         self.node_id = node_id or f"worker-{time.time_ns() & 0xFFFFFF:x}"
         self.coordinator_url = coordinator_url
         handler = _make_handler(self)
@@ -111,9 +127,20 @@ def _make_handler(server: WorkerServer):
                 return
             self._send(404)
 
+        def _authorized(self) -> bool:
+            """Every /v1/task route carries the cluster's HMAC (wire.sign of
+            the body — empty for GET/DELETE), not just task creation: result
+            pages and cancellation are control-plane surface too."""
+            if wire.verify(b"", self.headers.get(wire.H_INTERNAL_AUTH)):
+                return True
+            self._send(401, b'{"error": "bad internal signature"}')
+            return False
+
         def do_GET(self):
             m = _RESULTS_RE.match(self.path)
             if m:
+                if not self._authorized():
+                    return
                 task = server.tasks.get(m.group(1))
                 if task is None:
                     self._send(404, b'{"error": "no such task"}')
@@ -131,6 +158,8 @@ def _make_handler(server: WorkerServer):
                 return
             m = _STATUS_RE.match(self.path)
             if m:
+                if not self._authorized():
+                    return
                 task = server.tasks.get(m.group(1))
                 if task is None:
                     self._send(404, b'{"error": "no such task"}')
@@ -147,6 +176,8 @@ def _make_handler(server: WorkerServer):
         def do_DELETE(self):
             m = _RESULTS_RE.match(self.path)
             if m:
+                if not self._authorized():
+                    return
                 # final ack: this consumer is done with the buffer
                 task = server.tasks.get(m.group(1))
                 if task is not None:
@@ -155,6 +186,8 @@ def _make_handler(server: WorkerServer):
                 return
             m = _TASK_RE.match(self.path)
             if m:
+                if not self._authorized():
+                    return
                 server.tasks.cancel(m.group(1))
                 self._send(204)
                 return

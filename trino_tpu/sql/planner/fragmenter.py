@@ -66,7 +66,7 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
         """Returns (node-in-current-fragment, is_replicated)."""
         if isinstance(node, P.TableScanNode):
             return node, False
-        if isinstance(node, (P.FilterNode, P.ProjectNode, P.LimitNode)):
+        if isinstance(node, (P.FilterNode, P.ProjectNode, P.LimitNode, P.CompactNode)):
             src, rep = cut(node.source, fragments)
             node.source = src
             return node, rep

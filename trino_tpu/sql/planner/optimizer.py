@@ -33,7 +33,88 @@ def optimize(root: P.OutputNode, session=None) -> P.OutputNode:
     node = merge_identity_projects(node)
     derive_scan_constraints(node)
     plan_dynamic_filters(node)
+    if session is not None:
+        node = insert_compactions(node, session)
     return P.OutputNode(node, root.column_names)
+
+
+# ------------------------------------------------------- compaction pass
+
+# only consider squeezing inputs this large (the payload sort that performs
+# the compaction has to pay for itself downstream)
+COMPACT_MIN_SLOTS = 1 << 17
+COMPACT_MIN_RATIO = 2.0  # slots / estimated live rows
+
+
+def _slot_count(session, node: P.PlanNode) -> int:
+    """Physical row-slot count a node's output page carries (the static
+    shape downstream operators process, live or dead)."""
+    from trino_tpu.sql.planner import stats
+
+    if isinstance(node, P.TableScanNode):
+        conn = session.catalogs.get(node.catalog)
+        n = conn.table_row_count(node.schema, node.table) if conn else None
+        return int(n) if n else 1024
+    if isinstance(node, P.CompactNode):
+        from trino_tpu.sql.planner.stats import compact_capacity
+
+        return compact_capacity(session, node)
+    if isinstance(node, P.JoinNode):
+        if P.uses_expansion_kernel(node):
+            return stats._expansion_capacity(session, node)
+        left = _slot_count(session, node.left)
+        if node.join_type == "left" and node.filter is not None:
+            return 2 * left  # head + null-tail concat (expand_join)
+        return left
+    if isinstance(node, P.AggregationNode):
+        return _slot_count(session, node.source)  # sorted-path capacity == n
+    if isinstance(node, P.UnionNode):
+        return sum(_slot_count(session, s) for s in node.sources_)
+    if isinstance(node, P.SetOpNode):
+        return _slot_count(session, node.left) + _slot_count(session, node.right)
+    if isinstance(node, P.ValuesNode):
+        return max(1, len(node.rows or ()))
+    srcs = node.sources
+    if not srcs:
+        return 1024
+    return max(_slot_count(session, s) for s in srcs)
+
+
+def insert_compactions(node: P.PlanNode, session) -> P.PlanNode:
+    """Insert CompactNodes where cardinality estimates say the live rows
+    are a small fraction of the page's slots AND a downstream operator
+    (join / aggregation / window / set-op) would pay per-slot costs for the
+    dead ones. Sorts/TopN don't qualify: the compaction itself is one
+    payload sort, so compact-then-sort saves nothing over sorting.
+    Capacities are estimates; underestimates raise CAPACITY_EXCEEDED and
+    the bucketed recompile loop doubles them (CompiledQuery.run)."""
+    from trino_tpu.sql.planner import stats
+
+    def maybe_compact(child: P.PlanNode) -> P.PlanNode:
+        if isinstance(child, (P.CompactNode, P.ValuesNode, P.TableScanNode)):
+            return child
+        slots = _slot_count(session, child)
+        if slots < COMPACT_MIN_SLOTS:
+            return child
+        live = stats.estimate_live_rows(session, child)
+        if slots < COMPACT_MIN_RATIO * live * 1.3:
+            return child
+        return P.CompactNode(child, estimated_rows=live)
+
+    def walk(n: P.PlanNode) -> P.PlanNode:
+        srcs = [walk(s) for s in n.sources]
+        n = _replace_sources(n, srcs)
+        if isinstance(n, P.JoinNode):
+            n.left = maybe_compact(n.left)
+            n.right = maybe_compact(n.right)
+        elif isinstance(n, (P.AggregationNode, P.WindowNode)):
+            n.source = maybe_compact(n.source)
+        elif isinstance(n, P.SetOpNode):
+            n.left = maybe_compact(n.left)
+            n.right = maybe_compact(n.right)
+        return n
+
+    return walk(node)
 
 
 # ------------------------------------------- scan constraint pushdown

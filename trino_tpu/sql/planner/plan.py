@@ -83,6 +83,37 @@ class FilterNode(PlanNode):
 
 
 @dataclasses.dataclass
+class CompactNode(PlanNode):
+    """Squeeze live rows to the front of a smaller static-capacity page.
+
+    TPU-first: filters keep selection masks instead of compacting (static
+    shapes), so a selective pipeline drags dead slots through every
+    downstream sort/join. When the optimizer's cardinality estimate says
+    live rows are far below the slot count, this node pays one stable
+    payload-carrying sort (live rows first, original order kept) to shrink
+    the working set. Capacity comes from stats (hint key ``cmp:<id>``);
+    a too-small estimate raises CAPACITY_EXCEEDED and the bucketed
+    recompile loop doubles it. Reference role: the implicit compaction the
+    reference gets for free from page-at-a-time operators that drop
+    filtered rows (PageProcessor emitting compacted pages)."""
+
+    source: PlanNode = None
+    estimated_rows: int = 0  # live-row estimate the capacity hint derives from
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        return self.source.output_types
+
+    @property
+    def output_names(self):
+        return self.source.output_names
+
+
+@dataclasses.dataclass
 class ProjectNode(PlanNode):
     source: PlanNode = None
     expressions: List[ir.Expr] = None

@@ -1124,6 +1124,12 @@ def _rescale(c: ir.Constant, target: T.Type):
     if v is None:
         return None
     if target.is_decimal:
+        if c.type.is_floating or isinstance(v, float):
+            # scale BEFORE integer conversion, half away from zero
+            # (int(1.5) * 10**s would truncate the fraction entirely)
+            scaled = float(v) * (10 ** target.scale)
+            q = int(abs(scaled) + 0.5)
+            return q if scaled >= 0 else -q
         src_scale = c.type.scale if c.type.is_decimal else 0
         if target.scale >= src_scale:
             return int(v) * (10 ** (target.scale - src_scale))

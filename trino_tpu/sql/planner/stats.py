@@ -90,6 +90,8 @@ def estimate_capacity_hints(session, root: P.PlanNode) -> Dict[str, int]:
     for n in P.walk_plan(root):
         if isinstance(n, P.JoinNode) and P.uses_expansion_kernel(n):
             hints[f"join:{n.id}"] = _expansion_capacity(session, n)
+        elif isinstance(n, P.CompactNode):
+            hints[f"cmp:{n.id}"] = compact_capacity(session, n)
     return hints
 
 
@@ -181,3 +183,206 @@ def grow_overflowed_hints(hints: Dict[str, int], codes, flags) -> Dict[str, int]
             out = dict(hints) if out is None else out
             out[key] = out.get(key, MIN_CAPACITY) * 2
     return out
+
+
+# ------------------------------------------------- selectivity / live rows
+
+# Reference: FilterStatsCalculator.UNKNOWN_FILTER_COEFFICIENT — predicates
+# we can't estimate keep 90% of rows (biased high: capacities must survive
+# a non-selective filter without a recompile).
+UNKNOWN_FILTER_COEFFICIENT = 0.9
+
+
+def resolve_column_stats(session, node: P.PlanNode, channel: int):
+    """ColumnStats of the base-table column a channel traces to (through
+    pass-through projections, filters, joins, and group keys), or None."""
+    from trino_tpu.sql import ir
+
+    if isinstance(node, P.TableScanNode):
+        conn = session.catalogs.get(node.catalog)
+        if conn is None:
+            return None
+        return conn.column_stats(node.schema, node.table, node.column_names[channel])
+    if isinstance(node, P.ProjectNode):
+        e = node.expressions[channel]
+        if isinstance(e, ir.ColumnRef):
+            return resolve_column_stats(session, node.source, e.index)
+        return None
+    if isinstance(node, (P.FilterNode, P.CompactNode, P.LimitNode, P.SortNode,
+                         P.TopNNode, P.WindowNode)):
+        if isinstance(node, P.WindowNode) and channel >= len(node.source.output_types):
+            return None
+        return resolve_column_stats(session, node.source, channel)
+    if isinstance(node, P.JoinNode):
+        nl = len(node.left.output_types)
+        if node.join_type in ("semi", "anti") or channel < nl:
+            if channel < nl:
+                return resolve_column_stats(session, node.left, channel)
+            return None
+        return resolve_column_stats(session, node.right, channel - nl)
+    if isinstance(node, P.AggregationNode):
+        if channel < len(node.group_channels):
+            return resolve_column_stats(
+                session, node.source, node.group_channels[channel])
+        return None
+    return None
+
+
+def _scale_of_type(t) -> int:
+    return t.scale if getattr(t, "scale", None) is not None and t.is_decimal else 0
+
+
+def _cmp_selectivity(session, fn: str, col_expr, const_expr, source) -> float:
+    """Range-interpolated selectivity of ``col <op> const`` from column
+    min/max stats (reference: FilterStatsCalculator range arithmetic)."""
+    cs = resolve_column_stats(session, source, col_expr.index)
+    if cs is None or const_expr.value is None:
+        return UNKNOWN_FILTER_COEFFICIENT
+    if cs.low is None or cs.high is None:
+        # no range (e.g. varchar vocab) — NDV still prices equality
+        if cs.ndv and fn == "eq":
+            return 1.0 / cs.ndv
+        if cs.ndv and fn == "ne":
+            return 1.0 - 1.0 / cs.ndv
+        return UNKNOWN_FILTER_COEFFICIENT
+    lo, hi = cs.low, cs.high
+    try:
+        c = int(const_expr.value)
+    except (TypeError, ValueError):
+        return UNKNOWN_FILTER_COEFFICIENT
+    # align literal scale to the column's storage scale
+    ds = _scale_of_type(col_expr.type) - _scale_of_type(const_expr.type)
+    if ds > 0:
+        c *= 10 ** ds
+    elif ds < 0:
+        c //= 10 ** (-ds)
+    span = hi - lo + 1
+    if fn == "eq":
+        return 1.0 / max(cs.ndv or span, 1) if lo <= c <= hi else 0.0
+    if fn == "ne":
+        return 1.0 - (1.0 / max(cs.ndv or span, 1)) if lo <= c <= hi else 1.0
+    if fn in ("lt", "le"):
+        kept = c - lo + (1 if fn == "le" else 0)
+    elif fn in ("gt", "ge"):
+        kept = hi - c + (1 if fn == "ge" else 0)
+    else:
+        return UNKNOWN_FILTER_COEFFICIENT
+    return min(max(kept / span, 0.0), 1.0)
+
+
+def predicate_selectivity(session, pred, source) -> float:
+    """Estimated fraction of rows a predicate keeps."""
+    from trino_tpu.sql import ir
+
+    if isinstance(pred, ir.Call):
+        if pred.name == "and":
+            return predicate_selectivity(session, pred.args[0], source) * \
+                predicate_selectivity(session, pred.args[1], source)
+        if pred.name == "or":
+            a = predicate_selectivity(session, pred.args[0], source)
+            b = predicate_selectivity(session, pred.args[1], source)
+            return min(1.0, a + b - a * b)
+        if pred.name in ("eq", "ne", "lt", "le", "gt", "ge"):
+            a, b = pred.args
+            flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+            if isinstance(a, ir.ColumnRef) and isinstance(b, ir.Constant):
+                return _cmp_selectivity(session, pred.name, a, b, source)
+            if isinstance(b, ir.ColumnRef) and isinstance(a, ir.Constant):
+                return _cmp_selectivity(
+                    session, flip.get(pred.name, pred.name), b, a, source)
+        if pred.name == "between":
+            v, lo_e, hi_e = pred.args
+            if isinstance(v, ir.ColumnRef) and isinstance(lo_e, ir.Constant) \
+                    and isinstance(hi_e, ir.Constant):
+                return max(
+                    0.0,
+                    _cmp_selectivity(session, "ge", v, lo_e, source)
+                    + _cmp_selectivity(session, "le", v, hi_e, source) - 1.0,
+                )
+        if pred.name == "in_list":
+            v = pred.args[0]
+            if isinstance(v, ir.ColumnRef):
+                cs = resolve_column_stats(session, source, v.index)
+                if cs is not None and cs.ndv:
+                    return min(1.0, (len(pred.args) - 1) / cs.ndv)
+    return UNKNOWN_FILTER_COEFFICIENT
+
+
+def key_ndv(session, node: P.PlanNode, channels) -> int:
+    """Product of per-key NDVs (capped), or 0 when unknown."""
+    total = 1
+    for c in channels:
+        cs = resolve_column_stats(session, node, c)
+        if cs is None or not cs.ndv:
+            return 0
+        total *= cs.ndv
+        if total > 1 << 62:
+            break
+    return total
+
+
+def estimate_live_rows(session, node: P.PlanNode) -> int:
+    """Estimated LIVE output rows (as opposed to estimate_rows, which is
+    capacity-biased): drives compaction placement and capacities.
+    Reference role: StatsCalculator's outputRowCount."""
+    if isinstance(node, P.TableScanNode):
+        # NO constraint discount here: scan constraints are advisory and the
+        # enforcing FilterNode is always kept (optimizer.derive_scan_
+        # constraints), so the filter's predicate_selectivity already counts
+        # them — discounting both would square the selectivity.
+        conn = session.catalogs.get(node.catalog)
+        n = conn.table_row_count(node.schema, node.table) if conn else None
+        return int(n) if n else MIN_CAPACITY
+    if isinstance(node, P.FilterNode):
+        src = estimate_live_rows(session, node.source)
+        return max(1, int(src * predicate_selectivity(
+            session, node.predicate, node.source)))
+    if isinstance(node, (P.ProjectNode, P.CompactNode, P.WindowNode, P.SortNode)):
+        return estimate_live_rows(session, node.source)
+    if isinstance(node, (P.LimitNode, P.TopNNode)):
+        return min(node.count, estimate_live_rows(session, node.source))
+    if isinstance(node, P.ValuesNode):
+        return max(1, len(node.rows or ()))
+    if isinstance(node, P.UnionNode):
+        return sum(estimate_live_rows(session, s) for s in node.sources_)
+    if isinstance(node, P.JoinNode):
+        left = estimate_live_rows(session, node.left)
+        right = estimate_live_rows(session, node.right)
+        if node.singleton:
+            return left
+        if not node.left_keys:
+            return left * right
+        ndv = key_ndv(session, node.left, node.left_keys)
+        match = min(1.0, right / ndv) if ndv else 1.0
+        if node.join_type == "semi":
+            return max(1, int(left * match))
+        if node.join_type == "anti":
+            return max(1, int(left * (1.0 - match)) if ndv else left)
+        if node.right_unique:
+            out = int(left * match)
+        else:
+            ndv_r = key_ndv(session, node.right, node.right_keys)
+            fanout = max(right / ndv_r, 1.0) if ndv_r else JOIN_FANOUT
+            out = int(left * match * fanout)
+        if node.join_type == "left":
+            out = max(out, left)
+        return max(1, out)
+    if isinstance(node, P.AggregationNode):
+        src = estimate_live_rows(session, node.source)
+        if not node.group_channels:
+            return 1
+        ndv = key_ndv(session, node.source, node.group_channels)
+        return max(1, min(src, ndv) if ndv else src)
+    if isinstance(node, P.SetOpNode):
+        return estimate_live_rows(session, node.left)
+    srcs = node.sources
+    if not srcs:
+        return MIN_CAPACITY
+    return max(estimate_live_rows(session, s) for s in srcs)
+
+
+def compact_capacity(session, node: P.CompactNode) -> int:
+    """Static capacity for a CompactNode: estimated live rows + 30% slack,
+    next power of two (the recompile loop doubles on overflow)."""
+    est = node.estimated_rows or estimate_live_rows(session, node.source)
+    return _pow2(max(int(est * 1.3), MIN_CAPACITY))
