@@ -75,6 +75,10 @@ order by o_totalprice desc, o_orderdate limit 100
 }
 
 SCHEMA = "sf1"
+# q18's whole-body fori program is large enough that its TPU compile alone
+# can exceed any sane budget; measure it with the dispatch train on the
+# (smaller, also cacheable) plain program instead
+TRAIN_ONLY = {"q18"}
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "540"))
 CHILD_TIMEOUT_S = 500.0
 HBM_BYTES_PER_S = 819e9  # v5e HBM roofline
@@ -95,7 +99,13 @@ def _setup_jax(platform: str) -> None:
     import jax
 
     if platform == "cpu":
+        # CPU compiles are cheap; disable the compilation cache entirely (a
+        # stale entry — including environment-level AOT caches — has
+        # produced "supplied N buffers but expected M" execution failures
+        # on the CPU backend)
         jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_compilation_cache", False)
+        return
     jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
@@ -121,11 +131,15 @@ def _build(session, name: str):
 
 
 def _fori_harness(cq, scan_starts):
-    """jit(f)(flat, k): run the query body k times device-side. The body
-    perturbs element 0 of each scan's first column with an i-dependent
-    select whose branches differ (never taken, not foldable: defeats
-    loop-invariant hoisting) and folds every output into the carry
-    (defeats dead-code elimination of unconsumed operators)."""
+    """jit(f)(flat, k) -> (acc, flags): run the query body k times
+    device-side. The body perturbs element 0 of each scan's first column
+    with an i-dependent select whose branches differ (never taken, not
+    foldable: defeats loop-invariant hoisting) and folds every output into
+    the carry (defeats dead-code elimination of unconsumed operators).
+    Deferred error flags OR across iterations and return with the result,
+    so this ONE program also drives the capacity-growth loop — the tunnel
+    has shown cross-program state poisoning inside a process, so the child
+    must compile and dispatch exactly one program."""
     import jax
     import jax.numpy as jnp
 
@@ -133,43 +147,85 @@ def _fori_harness(cq, scan_starts):
 
     def repeated(flat, k):
         def step(i, carry):
-            acc, x = carry
+            acc, fbits, x = carry
             xi = [
                 a.at[0].set(jnp.where(i < 0, a[0] + 1, a[0]))
                 if j in scan_starts else a
                 for j, a in enumerate(x)
             ]
-            outs, _flags = body(xi)
+            outs, step_flags = body(xi)
             tot = jnp.float32(0)
             for o in outs:
                 tot = tot + jnp.sum(o, dtype=jnp.float32) if o.dtype != jnp.bool_ \
                     else tot + jnp.sum(o).astype(jnp.float32)
-            return acc + tot, x
+            # deferred error flags OR into an int64 BITMASK: the carry
+            # structure stays fixed no matter how many flags the body has
+            # (the count is only known while tracing this step), keeping
+            # the whole harness to ONE body instantiation — a second
+            # instantiation (or any jax.eval_shape of the body) has been
+            # observed to poison the tunnel backend, failing every
+            # subsequent dispatch with INVALID_ARGUMENT.
+            bits = jnp.int64(0)
+            for j, sf in enumerate(step_flags[:63]):
+                bits = bits | (jnp.any(sf).astype(jnp.int64) << j)
+            if len(step_flags) > 63:  # collapse the overflow conservatively
+                rest = jnp.zeros((), bool)
+                for sf in step_flags[63:]:
+                    rest = rest | jnp.any(sf)
+                bits = bits | (rest.astype(jnp.int64) << 63)
+            return acc + tot, fbits | bits, x
 
-        acc, _ = jax.lax.fori_loop(0, k, step, (jnp.float32(0), flat))
-        return acc
+        acc, fbits, _ = jax.lax.fori_loop(
+            0, k, step, (jnp.float32(0), jnp.int64(0), flat)
+        )
+        return acc, fbits
 
     return jax.jit(repeated)
 
 
 def _measure_fori(cq, scan_starts):
     """(seconds_per_run, mode) via the fori harness, or None on compile
-    failure (XLA scoped-vmem bug on some bodies)."""
+    failure (XLA scoped-vmem bug on some bodies). Runs the capacity-growth
+    loop through the harness itself (one program per process — see
+    _fori_harness)."""
     import numpy as np
 
-    f = _fori_harness(cq, scan_starts)
-    try:
-        t0 = time.time()
-        np.asarray(f(cq.input_arrays, 1))
-        _log(f"fori compile+first: {time.time() - t0:.1f}s")
-    except Exception as e:  # noqa: BLE001 — compiler bug fallback
-        _log(f"fori harness failed ({str(e)[:120]}); falling back to train")
-        return None
-    t0 = time.time(); np.asarray(f(cq.input_arrays, 1)); t1 = time.time() - t0
+    from trino_tpu.exec.executor import raise_query_errors
+    from trino_tpu.sql.planner import stats
+
+    grown = None
+    for _attempt in range(6):
+        f = _fori_harness(cq, scan_starts)
+        try:
+            t0 = time.time()
+            acc, fbits = f(cq.input_arrays, 1)
+            bits = int(np.asarray(fbits))
+            np.asarray(acc)
+            _log(f"fori compile+first: {time.time() - t0:.1f}s")
+        except Exception as e:  # noqa: BLE001 — compiler bug fallback
+            _log(f"fori harness failed ({str(e)[:120]}); falling back to train")
+            return None
+        codes = cq.error_codes_cell[0]
+        flags = [
+            np.asarray(bool(bits >> min(j, 63) & 1)) for j in range(len(codes))
+        ]
+        grown = stats.grow_overflowed_hints(cq.capacity_hints, codes, flags)
+        if grown is not None:
+            _log(f"capacity overflow; growing {grown} and recompiling")
+            cq.capacity_hints = grown
+            cq._jit()
+            continue
+        raise_query_errors(codes, flags)
+        break
+    else:
+        raise RuntimeError(
+            "capacity still exceeded after recompiles — refusing to time a "
+            "truncating program")
+    t0 = time.time(); r = f(cq.input_arrays, 1); np.asarray(r[0]); t1 = time.time() - t0
     # pick K so the loop dominates sync noise, then scale-check with 2K
     k = max(4, min(400, int(10.0 / max(t1, 0.01))))
-    t0 = time.time(); np.asarray(f(cq.input_arrays, k)); ta = time.time() - t0
-    t0 = time.time(); np.asarray(f(cq.input_arrays, 2 * k)); tb = time.time() - t0
+    t0 = time.time(); r = f(cq.input_arrays, k); np.asarray(r[0]); ta = time.time() - t0
+    t0 = time.time(); r = f(cq.input_arrays, 2 * k); np.asarray(r[0]); tb = time.time() - t0
     per = (tb - ta) / k
     if per <= 0:
         return None
@@ -202,16 +258,17 @@ def _bench_query(session, name: str):
     t0 = time.time()
     cq, rows, bytes_in, scan_starts = _build(session, name)
     _log(f"{name}: staged {rows} rows ({bytes_in // 1048576} MiB) "
-         f"in {time.time() - t0:.1f}s")
-    t0 = time.time()
-    page = cq.run()  # compile + first run + capacity-growth + error check
-    _ = page.to_pylist()
-    _log(f"{name}: first run+materialize {time.time() - t0:.1f}s "
-         f"hints={cq.capacity_hints}")
+         f"in {time.time() - t0:.1f}s hints={cq.capacity_hints}")
     res = None
-    if _remaining() > 120:
+    if name not in TRAIN_ONLY and _remaining() > 120:
         res = _measure_fori(cq, scan_starts)
     if res is None:
+        # fallback program: compile + first run + growth + error check,
+        # then a dispatch train on that same program
+        t0 = time.time()
+        cq.run()
+        _log(f"{name}: first run {time.time() - t0:.1f}s "
+             f"hints={cq.capacity_hints}")
         res = _measure_train(cq)
     per, mode = res
     implied = bytes_in / per
@@ -233,6 +290,13 @@ def _bench_query(session, name: str):
 
 def _run_child(spec: str) -> subprocess.Popen:
     env = dict(os.environ, _BENCH_CHILD=spec)
+    if spec.startswith("cpu"):
+        # JAX_PLATFORMS must be set BEFORE python starts so the tunnel
+        # plugin never engages: its chipless remote-compile path has
+        # served mismatched XLA:CPU executables ("supplied N buffers but
+        # expected M")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     return subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL
@@ -323,21 +387,42 @@ def main() -> None:
         _child_main(child)
         return
 
-    # CPU anchor runs concurrently — it costs no wall time unless the TPU
-    # side finishes first. TPU queries run one child each, sequentially:
-    # partial results survive any single query's crash or timeout.
-    cpu_proc = _run_child("cpu")
+    # CPU anchors run in a background thread, one child at a time (one
+    # query per process — two compiled queries in one CPU process has
+    # produced buffer-count mismatches; running all three at once would
+    # contend with each other and understate the anchor). TPU queries run
+    # one child each, sequentially: partial results survive any single
+    # query's crash or timeout.
+    import threading
+
+    cpu: dict = {}
+
+    def _cpu_anchor():
+        for name in QUERIES:
+            res = _collect_child(_run_child(f"cpu:{name}"), max(_remaining(), 60))
+            cpu[name] = res.get(name, res)
+
+    anchor_thread = threading.Thread(target=_cpu_anchor, daemon=True)
+    anchor_thread.start()
     tpu = {}
     for name in QUERIES:
-        if _remaining() < 90:
-            tpu[name] = {"error": "skipped: bench deadline"}
-            continue
-        res = _collect_child(
-            _run_child(f"tpu:{name}"), min(CHILD_TIMEOUT_S, _remaining()))
-        tpu[name] = res.get(name, res if "error" in res else
-                            {"error": "child result missing query"})
-        _log(f"tpu:{name} -> {tpu[name]}")
-    cpu = _collect_child(cpu_proc, max(_remaining(), 30))
+        for attempt in (1, 2):
+            if _remaining() < 90:
+                tpu[name] = {"error": "skipped: bench deadline"}
+                break
+            # give the first attempt most of the remaining budget (a cold
+            # compile is the dominant cost); keep a reserve for the rest
+            cap = max(CHILD_TIMEOUT_S, _remaining() * 0.6)
+            res = _collect_child(
+                _run_child(f"tpu:{name}"), min(cap, _remaining()))
+            tpu[name] = res.get(name, res if "error" in res else
+                                {"error": "child result missing query"})
+            _log(f"tpu:{name} (attempt {attempt}) -> {tpu[name]}")
+            if "error" not in tpu[name]:
+                break
+    anchor_thread.join(timeout=max(_remaining(), 60))
+    for name in QUERIES:
+        cpu.setdefault(name, {"error": "anchor did not finish"})
 
     headline = (tpu.get("q1") or {}).get("rows_per_sec") or 0
     cpu_q1 = (cpu.get("q1") or {}).get("rows_per_sec")

@@ -164,3 +164,56 @@ def test_error_ignores_filtered_rows(session):
         session, "select 10/(n_nationkey-3) from nation where n_nationkey > 5"
     ).rows
     assert len(rows) == 19
+
+
+# ---- repartitioned (never-gather) distributed operators ----
+# gather_max_rows_per_device=1 forces the exchange paths at tiny scale.
+
+
+@pytest.fixture()
+def xchg_session():
+    return Session({"gather_max_rows_per_device": 1})
+
+
+def _run_both(xchg_session, mesh, sql, expect_hint):
+    root = plan_sql(xchg_session, sql)
+    dq = DistributedQuery.build(xchg_session, root, mesh)
+    got = dq.run().to_pylist()
+    assert any(k.startswith(expect_hint) for k in dq.capacity_hints), (
+        f"expected a {expect_hint} exchange, hints={list(dq.capacity_hints)}")
+    want = run_query(Session(), sql).rows
+    return got, want
+
+
+def test_sharded_order_by_never_gathers_unsorted(xchg_session, mesh):
+    """Full ORDER BY range-partitions by sampled splitters and sorts
+    shards locally (hint xchgo: proves the range exchange compiled in);
+    results identical to the local engine."""
+    sql = """
+        select l_orderkey, l_extendedprice from lineitem
+        where l_orderkey < 600
+        order by l_extendedprice desc, l_orderkey
+    """
+    got, want = _run_both(xchg_session, mesh, sql, "xchgo:")
+    assert got == want
+
+
+def test_repartitioned_window(xchg_session, mesh):
+    sql = """
+        select o_custkey, o_orderkey,
+               rank() over (partition by o_custkey order by o_totalprice desc) r
+        from orders where o_orderkey < 800
+        order by o_custkey, r, o_orderkey
+    """
+    got, want = _run_both(xchg_session, mesh, sql, "xchgw:")
+    assert got == want
+
+
+def test_repartitioned_set_op(xchg_session, mesh):
+    sql = """
+        select o_custkey from orders where o_orderkey < 600
+        intersect
+        select c_custkey from customer
+    """
+    got, want = _run_both(xchg_session, mesh, sql, "xchgs:")
+    assert sorted(got) == sorted(want)

@@ -65,6 +65,14 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             lambda v: None if v.upper() in ("NONE", "TASK") else "must be NONE or TASK",
         ),
         PropertyMetadata(
+            "gather_max_rows_per_device",
+            "estimated rows per device above which distributed windows/"
+            "set-ops/sorts repartition (hash or range exchange) instead of "
+            "gathering the whole input to every device (reference role: "
+            "the AddExchanges distribution thresholds)",
+            int, 1 << 16, _positive,
+        ),
+        PropertyMetadata(
             "failure_injection",
             "inject a task failure when this substring matches a task id, "
             "e.g. '.<fragment>.<worker>.a<attempt>' (reference: "

@@ -17,10 +17,12 @@ def default_catalogs() -> Dict[str, Connector]:
     from trino_tpu.connector.blackhole.connector import BlackHoleConnector
     from trino_tpu.connector.filesystem.connector import FileSystemConnector
     from trino_tpu.connector.memory.connector import MemoryConnector
+    from trino_tpu.connector.tpcds import TpcdsConnector
     from trino_tpu.connector.tpch import TpchConnector
 
     return {
         "tpch": TpchConnector(),
+        "tpcds": TpcdsConnector(),
         "memory": MemoryConnector(),
         "blackhole": BlackHoleConnector(),
         # parquet-on-disk catalog; root via env (etc/catalog/*.properties role)

@@ -1,0 +1,1 @@
+from trino_tpu.connector.tpcds.connector import TpcdsConnector  # noqa: F401

@@ -238,8 +238,15 @@ class Executor:
         by per-side presence counts. Reference: SetOperationNodeTranslator's
         aggregation-based lowering."""
         both = Page.concat_pages(left, right)
-        n_l, n = left.num_rows, both.num_rows
-        side_right = jnp.arange(n) >= n_l
+        n_l = left.num_rows
+        side_right = jnp.arange(both.num_rows) >= n_l
+        return self._set_op_grouped(node, both, side_right)
+
+    def _set_op_grouped(self, node: P.SetOpNode, both: Page, side_right) -> Page:
+        """The grouping half of a set operation over a combined page with an
+        explicit per-row side tag — reused by the SPMD tier after a
+        whole-row hash exchange (where positional tagging is impossible)."""
+        n = both.num_rows
         layout, out_sel, (side_right_l,), sel_l = self.group_structure(
             list(range(both.channel_count)), both, [side_right]
         )
@@ -257,7 +264,7 @@ class Executor:
                    both.columns[i].dictionary)
             for i, (v, valid) in enumerate(key_cols)
         ]
-        return Page(out_cols, out_sel & keep, left.replicated and right.replicated)
+        return Page(out_cols, out_sel & keep, both.replicated)
 
     # --------------------------------------------------------------- filter
     def _exec_FilterNode(self, node: P.FilterNode) -> Page:

@@ -73,13 +73,28 @@ def repartition_page(
     Returns (received_page [n_devices*capacity rows, sharded], overflow_flag).
     Dead rows (sel False) are not sent; received pad slots carry sel False.
     """
-    n = page.num_rows
-    live = page.sel if page.sel is not None else jnp.ones((n,), bool)
     keys = [
         (page.columns[c].values, None if page.columns[c].nulls is None else ~page.columns[c].nulls)
         for c in key_channels
     ]
     pid = partition_ids(keys, n_devices)
+    return repartition_by_pid(page, pid, n_devices, capacity, axis)
+
+
+def repartition_by_pid(
+    page: Page,
+    pid: jnp.ndarray,
+    n_devices: int,
+    capacity: int,
+    axis: str,
+) -> Tuple[Page, jnp.ndarray]:
+    """Repartition by a PRECOMPUTED per-row partition id (int32 in
+    [0, n_devices)): the shared producer half of both the hash exchange
+    (FIXED_HASH_DISTRIBUTION) and the range exchange used by the sharded
+    distributed sort (the reference's range-partitioned MergeOperator
+    pipeline, redesigned as splitter-routed all_to_all)."""
+    n = page.num_rows
+    live = page.sel if page.sel is not None else jnp.ones((n,), bool)
     pid = jnp.where(live, pid, jnp.int32(n_devices))  # dead rows sort last
     order = ranks.argsort32(pid)
     pid_sorted = pid[order]

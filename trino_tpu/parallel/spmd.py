@@ -195,18 +195,149 @@ class SpmdExecutor(Executor):
         return out
 
     def set_op_pages(self, node, left: Page, right: Page) -> Page:
-        # whole-row membership needs co-located rows: gather both sides
-        # (repartition-by-row-hash is the scalable upgrade)
-        return super().set_op_pages(node, gather_page(left), gather_page(right))
+        """Whole-row membership needs equal rows co-located: big inputs
+        co-partition by row hash over ALL columns (NULLs hash to a constant
+        so set-semantics NULL equality survives the exchange); the combined
+        page carries an explicit side-tag column through the shuffle. Small
+        inputs gather (cheaper than an exchange)."""
+        from trino_tpu.sql.planner import stats
 
-    # ---------------------------------------------- ordering on gathered
+        if (left.replicated or right.replicated
+                or not stats.setop_repartitions(self.session, node, self.n_devices)):
+            return super().set_op_pages(node, gather_page(left), gather_page(right))
+        both = Page.concat_pages(left, right)
+        n_l = left.num_rows
+        side = jnp.arange(both.num_rows, dtype=jnp.int32) >= n_l
+        tagged = Page(
+            both.columns + [Column(T.BOOLEAN, side)], both.sel, both.replicated
+        )
+        recv = self._repartition(
+            tagged, list(range(both.channel_count)), f"xchgs:{node.id}"
+        )
+        body = Page(recv.columns[:-1], recv.sel, recv.replicated)
+        return self._set_op_grouped(node, body, recv.columns[-1].values)
+
+    # --------------------------------------------------- distributed sort
+    def _exec_TopNNode(self, node: P.TopNNode) -> Page:
+        """Distributed top-N: per-shard top-N (the global top-N is a subset
+        of the union of shard top-Ns), all_gather the N*D survivors (tiny),
+        final local sort. The reference's TopNOperator-per-task + single
+        merge consumer (MergeOperator), without gathering full shards."""
+        page = self.execute(node.source)
+        if page.replicated:
+            return Executor.sorted_page(self, page, node.sort_channels, node.count)
+        local = Executor.sorted_page(self, page, node.sort_channels, node.count)
+        gathered = gather_page(_take_prefix(local, node.count))
+        return Executor.sorted_page(self, gathered, node.sort_channels, node.count)
+
+    def _exec_LimitNode(self, node: P.LimitNode) -> Page:
+        """LIMIT without ordering: any N rows qualify — take N per shard,
+        gather only those."""
+        page = self.execute(node.source)
+        if page.replicated:
+            return Executor.sorted_page(self, page, [], node.count)
+        local = Executor.sorted_page(self, page, [], node.count)
+        gathered = gather_page(_take_prefix(local, node.count))
+        return Executor.sorted_page(self, gathered, [], node.count)
+
+    def _exec_SortNode(self, node: P.SortNode) -> Page:
+        """Full ORDER BY: big inputs range-partition by sampled splitters
+        and sort locally — the output stays SHARDED, globally ordered by
+        device index (the reference's range exchange + ordered-merge
+        consumer, redesigned: the 'merge' IS the mesh's device order,
+        realized as concatenation order when the root gathers). Small
+        inputs gather and sort locally."""
+        from trino_tpu.sql.planner import stats
+
+        page = self.execute(node.source)
+        if page.replicated or not stats.sort_repartitions(
+                self.session, node.source, self.n_devices):
+            return Executor.sorted_page(self, gather_page(page), node.sort_channels)
+        recv = self._range_exchange(page, node.sort_channels, f"xchgo:{node.id}")
+        return Executor.sorted_page(self, recv, node.sort_channels)
+
+    SORT_SAMPLES_PER_SHARD = 32
+
+    def _range_exchange(self, page: Page, sort_channels, hint_key: str) -> Page:
+        """Route rows to devices by lexicographic comparison against
+        sampled splitters, so device d receives exactly the d-th key range.
+        Splitters come from per-shard evenly spaced samples of the locally
+        sorted keys, all_gathered and re-sampled — the classic sample-sort
+        recipe; skew beyond the capacity hint doubles-and-recompiles."""
+        from trino_tpu.ops import sort as sort_ops
+        from trino_tpu.parallel import exchange
+
+        n = page.num_rows
+        live = page.sel if page.sel is not None else jnp.ones((n,), bool)
+        keys = [
+            ((page.columns[c].values,
+              None if page.columns[c].nulls is None else ~page.columns[c].nulls),
+             asc, nf)
+            for c, asc, nf in sort_channels
+        ]
+        t_ops = sort_ops._sort_operands(keys, None)  # ascending-comparable
+        # local live-first key sort -> evenly spaced live samples
+        s_ops = jax.lax.sort(
+            tuple([~live] + t_ops), num_keys=1 + len(t_ops), is_stable=True
+        )[1:]
+        nlive = jnp.maximum(jnp.sum(live).astype(jnp.int32), 1)
+        m = self.SORT_SAMPLES_PER_SHARD
+        pos = jnp.clip(
+            ((jnp.arange(m, dtype=jnp.int32) * 2 + 1) * nlive) // (2 * m), 0, n - 1
+        )
+        samples = [o[pos] for o in s_ops]
+        gath = [jax.lax.all_gather(s, AXIS).reshape(-1) for s in samples]
+        gsorted = jax.lax.sort(tuple(gath), num_keys=len(gath), is_stable=True)
+        total = m * self.n_devices
+        sp_pos = (jnp.arange(1, self.n_devices, dtype=jnp.int32) * total) // self.n_devices
+        splitters = [g[sp_pos] for g in gsorted]
+        # pid = number of splitters the row is lexicographically greater
+        # than (ties co-locate on the lower device)
+        pid = jnp.zeros((n,), jnp.int32)
+        for d in range(self.n_devices - 1):
+            gt = jnp.zeros((n,), bool)
+            eq = jnp.ones((n,), bool)
+            for o, sp in zip(t_ops, splitters):
+                gt = gt | (eq & (o > sp[d]))
+                eq = eq & (o == sp[d])
+            pid = pid + gt.astype(jnp.int32)
+        capacity = self.hint_capacity(hint_key, None)
+        out, overflow = exchange.repartition_by_pid(
+            page, pid, self.n_devices, capacity, AXIS
+        )
+        self.errors.append((f"CAPACITY_EXCEEDED:{hint_key}", overflow))
+        return out
+
     def sorted_page(self, page: Page, sort_channels, limit=None) -> Page:
         return super().sorted_page(gather_page(page), sort_channels, limit)
 
     def window_over_page(self, node, page: Page) -> Page:
-        # windows need whole partitions co-located; gather for now
-        # (repartition-by-partition-keys is the scalable upgrade)
-        return super().window_over_page(node, gather_page(page))
+        """Windows need whole partitions co-located: big partitioned inputs
+        hash-repartition by the PARTITION BY keys; global frames (no
+        partition keys) and small inputs gather."""
+        from trino_tpu.sql.planner import stats
+
+        if (page.replicated
+                or not stats.window_repartitions(self.session, node, self.n_devices)):
+            return super().window_over_page(node, gather_page(page))
+        recv = self._repartition(page, node.partition_channels, f"xchgw:{node.id}")
+        return Executor.window_over_page(self, node, recv)
+
+
+def _take_prefix(page: Page, k: int) -> Page:
+    """First k slots of a page (static slice; sorted pages carry their live
+    rows as a prefix)."""
+    k = min(k, page.num_rows)
+    return Page(
+        [
+            Column(c.type, c.values[:k],
+                   None if c.nulls is None else c.nulls[:k],
+                   c.dictionary, c.vrange)
+            for c in page.columns
+        ],
+        page.sel[:k] if page.sel is not None else None,
+        page.replicated,
+    )
 
 
 def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):

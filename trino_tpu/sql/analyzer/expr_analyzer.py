@@ -225,7 +225,24 @@ class ExprAnalyzer:
             out_type = _case_type([v for _, v in whens], default)
             return ir.Case(out_type, whens, default)
         if isinstance(e, ast.Cast):
-            return ir.Cast(T.parse_type(e.type_name), self.analyze(e.value))
+            target = T.parse_type(e.type_name)
+            inner = self.analyze(e.value)
+            if (target == T.DATE and isinstance(inner, ir.Constant)
+                    and inner.type.is_varchar and inner.value is not None):
+                # fold cast('1999-2-01' as date) at analysis time — the
+                # runtime lowering is dictionary-code based and cannot
+                # parse dates (reference: constant folding in
+                # IrExpressionInterpreter)
+                import datetime
+
+                try:
+                    y, m, d = (int(p) for p in str(inner.value).split("-"))
+                    days = (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+                except ValueError:
+                    raise AnalysisError(
+                        f"cannot cast {inner.value!r} to date") from None
+                return ir.Constant(T.DATE, days)
+            return ir.Cast(target, inner)
         if isinstance(e, ast.Extract):
             v = self.analyze(e.value)
             if e.field not in ("year", "month", "day", "quarter"):

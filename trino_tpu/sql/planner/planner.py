@@ -305,6 +305,133 @@ class Planner:
         fields = [Field(c.name, c.type, table) for c in meta.columns]
         return RelationPlan(node, Scope(fields, outer_scope))
 
+    # ------------------------------------------------- join-order selection
+    def _reorder_implicit_joins(self, from_rel, spec, ctes):
+        """Reorder a FROM comma-list (a chain of implicit/cross joins) so
+        every join has an equi edge when one exists: start from the largest
+        relation (the fact), repeatedly append the SMALLEST relation
+        connected by a WHERE equality to the relations already joined.
+
+        Reference role: ReorderJoins + DetermineJoinDistributionType in
+        miniature — without it, a FROM list like TPC-DS q64's (18 relations
+        whose equi predicates don't follow list order) plans Cartesian
+        products (a date_dim cross join = 73k x fact rows before the filter
+        lands). Name-based and best-effort: relations whose columns can't
+        be resolved just keep list order. Skipped for SELECT * (reordering
+        would change the star's column order)."""
+        if not isinstance(from_rel, ast.Join) or from_rel.join_type not in (
+            "cross", "implicit"
+        ):
+            return from_rel
+        if any(isinstance(it.expr, ast.Star) for it in spec.select_items or ()):
+            return from_rel
+
+        # flatten the implicit chain
+        rels: List = []
+
+        def flatten(r):
+            if isinstance(r, ast.Join) and r.join_type in ("cross", "implicit"):
+                flatten(r.left)
+                flatten(r.right)
+            else:
+                rels.append(r)
+
+        flatten(from_rel)
+        if len(rels) < 3:
+            return from_rel
+        names, sizes = [], []
+        for r in rels:
+            n, s = self._relation_columns_and_size(r, ctes)
+            names.append(n)
+            sizes.append(s)
+
+        def owner(ident: ast.Identifier):
+            parts = [p.lower() for p in ident.parts]
+            if len(parts) >= 2:
+                q = parts[-2]
+                for i, r in enumerate(rels):
+                    if self._relation_alias(r) == q:
+                        return i
+                return None
+            hits = [i for i, cols in enumerate(names) if parts[-1] in cols]
+            return hits[0] if len(hits) == 1 else None
+
+        edges = set()
+        for conj in split_conjuncts(spec.where):
+            if (isinstance(conj, ast.Comparison) and conj.op == "="
+                    and isinstance(conj.left, ast.Identifier)
+                    and isinstance(conj.right, ast.Identifier)):
+                a, b = owner(conj.left), owner(conj.right)
+                if a is not None and b is not None and a != b:
+                    edges.add((min(a, b), max(a, b)))
+        if not edges:
+            return from_rel
+
+        remaining = set(range(len(rels)))
+        start = max(remaining, key=lambda i: sizes[i])
+        order = [start]
+        remaining.discard(start)
+        while remaining:
+            connected = [
+                i for i in remaining
+                if any((min(i, j), max(i, j)) in edges for j in order)
+            ]
+            pool = connected or sorted(remaining)
+            nxt = min(pool, key=lambda i: sizes[i])
+            order.append(nxt)
+            remaining.discard(nxt)
+        if order == list(range(len(rels))):
+            return from_rel
+        out = rels[order[0]]
+        for i in order[1:]:
+            out = ast.Join(join_type="implicit", left=out, right=rels[i])
+        return out
+
+    def _relation_alias(self, r) -> Optional[str]:
+        if isinstance(r, ast.AliasedRelation):
+            return r.alias.lower()
+        if isinstance(r, ast.Table):
+            return r.parts[-1].lower()
+        return None
+
+    def _relation_columns_and_size(self, r, ctes):
+        """(column-name set, row estimate) for join-order attribution."""
+        if isinstance(r, ast.AliasedRelation):
+            cols, size = self._relation_columns_and_size(r.relation, ctes)
+            if r.column_aliases:
+                cols = {c.lower() for c in r.column_aliases}
+            return cols, size
+        if isinstance(r, ast.Table):
+            cte = ctes.get(r.parts[-1].lower()) if len(r.parts) == 1 else None
+            if cte is not None:
+                body = cte.query.body if isinstance(cte.query, ast.Query) else None
+                cols = set()
+                if isinstance(body, ast.QuerySpec):
+                    for it in body.select_items or ():
+                        if it.alias:
+                            cols.add(it.alias.lower())
+                        elif isinstance(it.expr, ast.Identifier):
+                            cols.add(it.expr.parts[-1].lower())
+                if cte.column_aliases:
+                    cols = {c.lower() for c in cte.column_aliases}
+                return cols, 100_000
+            try:
+                parts = [p.lower() for p in r.parts]
+                if len(parts) == 1:
+                    catalog, schema, table = (
+                        self.default_catalog, self.default_schema, parts[0])
+                elif len(parts) == 2:
+                    catalog, schema, table = self.default_catalog, parts[0], parts[1]
+                else:
+                    catalog, schema, table = parts[:3]
+                conn = self.catalogs[catalog]
+                meta = conn.get_table(schema, table)
+                rows = conn.table_row_count(schema, table) or 10_000
+                return {c.name.lower() for c in meta.columns}, rows
+            except Exception:  # noqa: BLE001 — best-effort attribution
+                return set(), 10_000
+        return set(), 10_000
+
     def plan_join(
         self, rel: ast.Join, outer_scope: Optional[Scope], ctes: Dict[str, ast.WithQuery]
     ) -> RelationPlan:
@@ -375,9 +502,11 @@ class Planner:
         ctes: Dict[str, ast.WithQuery],
         query: ast.Query,
     ) -> RelationPlan:
-        # FROM
+        # FROM (implicit-join chains reordered by connectivity + size first
+        # — see _reorder_implicit_joins)
         if spec.from_ is not None:
-            rp = self.plan_relation(spec.from_, outer_scope, ctes)
+            from_rel = self._reorder_implicit_joins(spec.from_, spec, ctes)
+            rp = self.plan_relation(from_rel, outer_scope, ctes)
         else:
             rp = RelationPlan(P.ValuesNode([], [], [()]), Scope([], outer_scope))
         node, scope = rp.node, rp.scope

@@ -141,6 +141,42 @@ def join_repartitions(session, node: P.JoinNode, n_devices: int) -> bool:
     return build > BROADCAST_BUILD_MAX
 
 
+def _gather_max_rows(session) -> int:
+    """Per-device row threshold above which windows/set-ops/sorts
+    repartition instead of gathering everything to every device
+    (session property gather_max_rows_per_device)."""
+    from trino_tpu.client.properties import SYSTEM_SESSION_PROPERTIES
+
+    default = SYSTEM_SESSION_PROPERTIES["gather_max_rows_per_device"].default
+    props = getattr(session, "properties", None) or {}
+    return int(props.get("gather_max_rows_per_device", default))
+
+
+def window_repartitions(session, node: P.WindowNode, n_devices: int) -> bool:
+    """True when a distributed window should hash-repartition rows by its
+    PARTITION BY keys (whole partitions co-locate) instead of gathering."""
+    if not node.partition_channels:
+        return False  # global window frame: every row is one partition
+    rows = estimate_rows(session, node.source)
+    return rows // max(n_devices, 1) > _gather_max_rows(session)
+
+
+def setop_repartitions(session, node: P.SetOpNode, n_devices: int) -> bool:
+    """True when INTERSECT/EXCEPT should co-partition both sides by whole-
+    row hash (equal rows co-locate) instead of gathering."""
+    rows = estimate_rows(session, node.left) + estimate_rows(session, node.right)
+    return rows // max(n_devices, 1) > _gather_max_rows(session)
+
+
+def sort_repartitions(session, source: P.PlanNode, n_devices: int) -> bool:
+    """True when a full ORDER BY (no limit) should range-partition by
+    sampled splitters and sort shards locally — the sharded distributed
+    sort (reference role: range exchange + ordered-merge consumer) —
+    instead of gathering the whole input to every device."""
+    rows = estimate_rows(session, source)
+    return rows // max(n_devices, 1) > _gather_max_rows(session)
+
+
 def exchange_capacity(session, source: P.PlanNode, n_devices: int) -> int:
     """Static per-(source device, destination device) block size for a hash
     exchange of ``source``'s rows: ~2x the uniform share, doubled on
@@ -162,6 +198,17 @@ def estimate_exchange_hints(session, root: P.PlanNode, n_devices: int) -> Dict[s
             if join_repartitions(session, n, n_devices):
                 hints[f"xchgl:{n.id}"] = exchange_capacity(session, n.left, n_devices)
                 hints[f"xchgr:{n.id}"] = exchange_capacity(session, n.right, n_devices)
+        elif isinstance(n, P.WindowNode):
+            if window_repartitions(session, n, n_devices):
+                hints[f"xchgw:{n.id}"] = exchange_capacity(session, n.source, n_devices)
+        elif isinstance(n, P.SetOpNode):
+            if setop_repartitions(session, n, n_devices):
+                cap_l = exchange_capacity(session, n.left, n_devices)
+                cap_r = exchange_capacity(session, n.right, n_devices)
+                hints[f"xchgs:{n.id}"] = _pow2(cap_l + cap_r)
+        elif isinstance(n, P.SortNode):
+            if sort_repartitions(session, n.source, n_devices):
+                hints[f"xchgo:{n.id}"] = exchange_capacity(session, n.source, n_devices)
     return hints
 
 
