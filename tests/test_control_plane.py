@@ -263,3 +263,67 @@ def test_worker_task_routes_require_hmac(cluster):
     except urllib.error.HTTPError as e:
         status = e.code
     assert status == 401
+
+
+def test_output_buffer_backpressure_blocks_producer():
+    """Bounded OutputBuffer (reference: OutputBufferMemoryManager): a slow
+    consumer holds producer-side buffered bytes at the watermark — the
+    producer blocks in enqueue instead of growing the buffer unboundedly."""
+    import threading
+
+    buf = OutputBuffer(consumer_count=1, max_buffer_bytes=4 * 1024)
+    page = b"x" * 1024
+    produced = 0
+
+    def producer():
+        nonlocal produced
+        for _ in range(64):
+            buf.enqueue(page, timeout=30.0)
+            produced += 1
+        buf.set_complete()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    import time as _t
+
+    _t.sleep(0.3)
+    # producer must be parked at the watermark, not 64 pages deep
+    assert produced <= 5, f"producer ran ahead: {produced}"
+    # slow consumer drains; producer resumes; everything arrives
+    token = 0
+    got = 0
+    while True:
+        pages, token, complete, failure = buf.poll(token, timeout=2.0)
+        assert failure is None
+        got += len(pages)
+        _t.sleep(0.01)
+        if complete:
+            break
+    t.join(timeout=10)
+    assert got == 64 and produced == 64
+    assert buf.peak_buffered_bytes <= 4 * 1024 + len(page)
+
+
+def test_output_buffer_abort_unblocks_producer():
+    """An aborted buffer (dead/cancelled consumer) must release a blocked
+    producer rather than wedging the worker thread."""
+    import threading
+
+    buf = OutputBuffer(consumer_count=1, max_buffer_bytes=1024)
+    blocked = threading.Event()
+
+    def producer():
+        buf.enqueue(b"y" * 1024, timeout=30.0)
+        blocked.set()
+        buf.enqueue(b"y" * 1024, timeout=30.0)  # parks at watermark
+        blocked.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    blocked.wait(5)
+    import time as _t
+
+    _t.sleep(0.2)
+    buf.abort("consumer gone")
+    t.join(timeout=5)
+    assert not t.is_alive()

@@ -1,0 +1,74 @@
+"""approx_distinct (HyperLogLog) and approx_percentile (grouped-sort
+percentile) — reference: ApproximateCountDistinctAggregation (airlift HLL,
+2.3% standard error) and approx_percentile over tdigest."""
+import pytest
+
+from trino_tpu import Session
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(properties={"schema": "tiny"})
+
+
+def test_approx_distinct_within_error(session):
+    # l_orderkey at tiny: 6000 orders ~ 6000 distinct keys in lineitem
+    out = session.execute(
+        "select count(distinct l_orderkey), approx_distinct(l_orderkey) from lineitem")
+    exact, approx = out.rows[0]
+    assert abs(approx - exact) / exact < 0.05, (exact, approx)
+
+
+def test_approx_distinct_grouped(session):
+    out = session.execute("""
+        select l_returnflag, count(distinct l_orderkey), approx_distinct(l_orderkey)
+        from lineitem group by l_returnflag order by l_returnflag""")
+    assert len(out.rows) == 3
+    for _flag, exact, approx in out.rows:
+        assert abs(approx - exact) / max(exact, 1) < 0.08, (exact, approx)
+
+
+def test_approx_distinct_small_groups_exact_range(session):
+    # linear-counting regime: tiny cardinalities must be near-exact
+    out = session.execute(
+        "select approx_distinct(n_regionkey), approx_distinct(n_nationkey) from nation")
+    assert out.rows == [(5, 25)]
+
+
+def test_approx_percentile_median(session):
+    out = session.execute(
+        "select approx_percentile(l_quantity, 0.5), approx_percentile(l_quantity, 1.0),"
+        " approx_percentile(l_quantity, 0.0) from lineitem")
+    med, hi, lo = out.rows[0]
+    from decimal import Decimal
+
+    assert hi == Decimal("50.00") and lo == Decimal("1.00")
+    assert Decimal("24.00") <= med <= Decimal("27.00")
+
+
+def test_approx_percentile_grouped_matches_sorted_rank(session):
+    out = session.execute("""
+        select o_orderpriority, approx_percentile(o_totalprice, 0.5)
+        from orders group by o_orderpriority order by o_orderpriority""")
+    # oracle: nearest-rank percentile computed in python per group
+    raw = session.execute("select o_orderpriority, o_totalprice from orders").rows
+    import math
+    from collections import defaultdict
+
+    groups = defaultdict(list)
+    for prio, price in raw:
+        groups[prio].append(price)
+    for prio, got in out.rows:
+        xs = sorted(groups[prio])
+        want = xs[max(math.ceil(0.5 * len(xs)) - 1, 0)]
+        assert got == want, (prio, got, want)
+
+
+def test_approx_percentile_nulls_excluded(session):
+    session2 = Session(properties={"catalog": "memory", "schema": "default"})
+    session2.execute("create table memory.default.px (g bigint, v bigint)")
+    session2.execute(
+        "insert into memory.default.px values (1, 10), (1, null), (1, 30), (2, null)")
+    out = session2.execute(
+        "select g, approx_percentile(v, 0.5) from memory.default.px group by g order by g")
+    assert out.rows == [(1, 10), (2, None)]

@@ -465,6 +465,13 @@ def _gen_promotion(sf, lo, hi, need):
 # --- fact tables: order/ticket index -> 1..L lines -------------------------
 
 
+def _cols(need, **makers) -> Dict[str, ColumnData]:
+    """Evaluate only the requested columns (connector projection pushdown:
+    the lambda per column defers its PRNG draws — the tpch generator's
+    `if col in need:` pattern, in combinator form)."""
+    return {k: f() for k, f in makers.items() if k in need}
+
+
 def _lines(tag: int, order: np.ndarray, max_lines: int) -> np.ndarray:
     return 1 + np.asarray(
         _stream(tag, order.astype(np.uint64)) % np.uint64(max_lines),
@@ -493,40 +500,43 @@ def _gen_store_sales(sf, lo, hi, need):
     lk = _line_key(ticket, lnum, 0)
     tidx = ticket.astype(np.uint64)
     n_item = _dim_rows("item", sf)
-    sold = _randint(4002, tidx, SALES_DATE_LO, SALES_DATE_HI)
-    whole = _randint(4005, lk, 100, 10000)
-    qty = _randint(4006, lk, 1, 100)
-    return {
-        "ss_sold_date_sk": ColumnData(T.BIGINT, _julian(sold), vrange=_J_RANGE),
-        "ss_item_sk": ColumnData(T.BIGINT, _randint(4003, lk, 1, n_item),
-                                 vrange=(1, n_item)),
-        "ss_customer_sk": ColumnData(
+    return _cols(
+        need,
+        ss_sold_date_sk=lambda: ColumnData(
+            T.BIGINT, _julian(_randint(4002, tidx, SALES_DATE_LO, SALES_DATE_HI)),
+            vrange=_J_RANGE),
+        ss_item_sk=lambda: ColumnData(
+            T.BIGINT, _randint(4003, lk, 1, n_item), vrange=(1, n_item)),
+        ss_customer_sk=lambda: ColumnData(
             T.BIGINT, _randint(4004, tidx, 1, _dim_rows("customer", sf)),
             vrange=(1, _dim_rows("customer", sf))),
-        "ss_cdemo_sk": ColumnData(
+        ss_cdemo_sk=lambda: ColumnData(
             T.BIGINT, _randint(4007, tidx, 1, _dim_rows("customer_demographics", sf)),
             vrange=(1, _dim_rows("customer_demographics", sf))),
-        "ss_hdemo_sk": ColumnData(
+        ss_hdemo_sk=lambda: ColumnData(
             T.BIGINT, _randint(4008, tidx, 1, _FIXED["household_demographics"]),
             vrange=(1, _FIXED["household_demographics"])),
-        "ss_addr_sk": ColumnData(
+        ss_addr_sk=lambda: ColumnData(
             T.BIGINT, _randint(4009, tidx, 1, _dim_rows("customer_address", sf)),
             vrange=(1, _dim_rows("customer_address", sf))),
-        "ss_store_sk": ColumnData(
+        ss_store_sk=lambda: ColumnData(
             T.BIGINT, _randint(4010, tidx, 1, _dim_rows("store", sf)),
             vrange=(1, _dim_rows("store", sf))),
-        "ss_promo_sk": ColumnData(
+        ss_promo_sk=lambda: ColumnData(
             T.BIGINT, _randint(4011, lk, 1, _dim_rows("promotion", sf)),
             vrange=(1, _dim_rows("promotion", sf))),
-        "ss_ticket_number": ColumnData(
+        ss_ticket_number=lambda: ColumnData(
             T.BIGINT, ticket, vrange=(1, order_range_count("store_sales", sf))),
-        "ss_quantity": ColumnData(T.INTEGER, qty.astype(np.int32), vrange=(1, 100)),
-        "ss_wholesale_cost": _dec(whole),
-        "ss_list_price": _dec(whole + _randint(4012, lk, 10, 5000)),
-        "ss_coupon_amt": _dec(np.where(_stream(4013, lk) % np.uint64(5) == 0,
-                                       _randint(4014, lk, 10, 2000), 0)),
-        "ss_net_profit": _dec(_randint(4015, lk, 0, 3000)),
-    }
+        ss_quantity=lambda: ColumnData(
+            T.INTEGER, _randint(4006, lk, 1, 100).astype(np.int32), vrange=(1, 100)),
+        ss_wholesale_cost=lambda: _dec(_randint(4005, lk, 100, 10000)),
+        ss_list_price=lambda: _dec(
+            _randint(4005, lk, 100, 10000) + _randint(4012, lk, 10, 5000)),
+        ss_coupon_amt=lambda: _dec(np.where(
+            _stream(4013, lk) % np.uint64(5) == 0,
+            _randint(4014, lk, 10, 2000), 0)),
+        ss_net_profit=lambda: _dec(_randint(4015, lk, 0, 3000)),
+    )
 
 
 _RETURN_MOD = 10  # ~1 in 10 sales lines is returned
@@ -538,33 +548,41 @@ def _gen_store_returns(sf, lo, hi, need):
     returned = _stream(4101, lk) % np.uint64(_RETURN_MOD) == 0
     ticket, lnum, lk = ticket[returned], lnum[returned], lk[returned]
     n_item = _dim_rows("item", sf)
-    sold = _randint(4002, ticket.astype(np.uint64), SALES_DATE_LO, SALES_DATE_HI)
-    return {
-        "sr_returned_date_sk": ColumnData(
-            T.BIGINT, _julian(sold + _randint(4102, lk, 1, 90)), vrange=_J_RANGE),
-        "sr_item_sk": ColumnData(T.BIGINT, _randint(4003, lk, 1, n_item),
-                                 vrange=(1, n_item)),
-        "sr_ticket_number": ColumnData(
+    return _cols(
+        need,
+        sr_returned_date_sk=lambda: ColumnData(
+            T.BIGINT,
+            _julian(_randint(4002, ticket.astype(np.uint64),
+                             SALES_DATE_LO, SALES_DATE_HI)
+                    + _randint(4102, lk, 1, 90)),
+            vrange=_J_RANGE),
+        sr_item_sk=lambda: ColumnData(
+            T.BIGINT, _randint(4003, lk, 1, n_item), vrange=(1, n_item)),
+        sr_ticket_number=lambda: ColumnData(
             T.BIGINT, ticket, vrange=(1, order_range_count("store_returns", sf))),
-        "sr_return_amt": _dec(_randint(4103, lk, 100, 10000)),
-    }
+        sr_return_amt=lambda: _dec(_randint(4103, lk, 100, 10000)),
+    )
 
 
 def _gen_catalog_sales(sf, lo, hi, need):
     order, lnum = _expand_orders(4201, lo, hi, 17)
     lk = _line_key(order, lnum, 1)
     n_item = _dim_rows("item", sf)
-    sold = _randint(4202, order.astype(np.uint64), SALES_DATE_LO, SALES_DATE_HI)
-    return {
-        "cs_sold_date_sk": ColumnData(T.BIGINT, _julian(sold), vrange=_J_RANGE),
-        "cs_item_sk": ColumnData(T.BIGINT, _randint(4203, lk, 1, n_item),
-                                 vrange=(1, n_item)),
-        "cs_order_number": ColumnData(
+    return _cols(
+        need,
+        cs_sold_date_sk=lambda: ColumnData(
+            T.BIGINT,
+            _julian(_randint(4202, order.astype(np.uint64),
+                             SALES_DATE_LO, SALES_DATE_HI)),
+            vrange=_J_RANGE),
+        cs_item_sk=lambda: ColumnData(
+            T.BIGINT, _randint(4203, lk, 1, n_item), vrange=(1, n_item)),
+        cs_order_number=lambda: ColumnData(
             T.BIGINT, order, vrange=(1, order_range_count("catalog_sales", sf))),
-        "cs_quantity": ColumnData(
+        cs_quantity=lambda: ColumnData(
             T.INTEGER, _randint(4204, lk, 1, 100).astype(np.int32), vrange=(1, 100)),
-        "cs_ext_list_price": _dec(_randint(4205, lk, 100, 30000)),
-    }
+        cs_ext_list_price=lambda: _dec(_randint(4205, lk, 100, 30000)),
+    )
 
 
 def _gen_catalog_returns(sf, lo, hi, need):
@@ -573,18 +591,22 @@ def _gen_catalog_returns(sf, lo, hi, need):
     returned = _stream(4301, lk) % np.uint64(_RETURN_MOD) == 0
     order, lnum, lk = order[returned], lnum[returned], lk[returned]
     n_item = _dim_rows("item", sf)
-    sold = _randint(4202, order.astype(np.uint64), SALES_DATE_LO, SALES_DATE_HI)
-    return {
-        "cr_returned_date_sk": ColumnData(
-            T.BIGINT, _julian(sold + _randint(4302, lk, 1, 90)), vrange=_J_RANGE),
-        "cr_item_sk": ColumnData(T.BIGINT, _randint(4203, lk, 1, n_item),
-                                 vrange=(1, n_item)),
-        "cr_order_number": ColumnData(
+    return _cols(
+        need,
+        cr_returned_date_sk=lambda: ColumnData(
+            T.BIGINT,
+            _julian(_randint(4202, order.astype(np.uint64),
+                             SALES_DATE_LO, SALES_DATE_HI)
+                    + _randint(4302, lk, 1, 90)),
+            vrange=_J_RANGE),
+        cr_item_sk=lambda: ColumnData(
+            T.BIGINT, _randint(4203, lk, 1, n_item), vrange=(1, n_item)),
+        cr_order_number=lambda: ColumnData(
             T.BIGINT, order, vrange=(1, order_range_count("catalog_returns", sf))),
-        "cr_refunded_cash": _dec(_randint(4303, lk, 0, 8000)),
-        "cr_reversed_charge": _dec(_randint(4304, lk, 0, 4000)),
-        "cr_store_credit": _dec(_randint(4305, lk, 0, 4000)),
-    }
+        cr_refunded_cash=lambda: _dec(_randint(4303, lk, 0, 8000)),
+        cr_reversed_charge=lambda: _dec(_randint(4304, lk, 0, 4000)),
+        cr_store_credit=lambda: _dec(_randint(4305, lk, 0, 4000)),
+    )
 
 
 def _gen_web_sales(sf, lo, hi, need):
@@ -593,27 +615,33 @@ def _gen_web_sales(sf, lo, hi, need):
     oidx = order.astype(np.uint64)
     n_item = _dim_rows("item", sf)
     n_wh = _dim_rows("warehouse", sf)
-    sold = _randint(4402, oidx, SALES_DATE_LO, SALES_DATE_HI)
-    return {
-        "ws_sold_date_sk": ColumnData(T.BIGINT, _julian(sold), vrange=_J_RANGE),
-        "ws_ship_date_sk": ColumnData(
-            T.BIGINT, _julian(sold + _randint(4403, lk, 1, 120)), vrange=_J_RANGE),
-        "ws_item_sk": ColumnData(T.BIGINT, _randint(4404, lk, 1, n_item),
-                                 vrange=(1, n_item)),
-        "ws_order_number": ColumnData(
+
+    def _sold():
+        return _randint(4402, oidx, SALES_DATE_LO, SALES_DATE_HI)
+
+    return _cols(
+        need,
+        ws_sold_date_sk=lambda: ColumnData(
+            T.BIGINT, _julian(_sold()), vrange=_J_RANGE),
+        ws_ship_date_sk=lambda: ColumnData(
+            T.BIGINT, _julian(_sold() + _randint(4403, lk, 1, 120)),
+            vrange=_J_RANGE),
+        ws_item_sk=lambda: ColumnData(
+            T.BIGINT, _randint(4404, lk, 1, n_item), vrange=(1, n_item)),
+        ws_order_number=lambda: ColumnData(
             T.BIGINT, order, vrange=(1, order_range_count("web_sales", sf))),
         # per-LINE warehouse: orders spanning warehouses feed q95's ws_wh
-        "ws_warehouse_sk": ColumnData(T.BIGINT, _randint(4405, lk, 1, n_wh),
-                                      vrange=(1, n_wh)),
-        "ws_ship_addr_sk": ColumnData(
+        ws_warehouse_sk=lambda: ColumnData(
+            T.BIGINT, _randint(4405, lk, 1, n_wh), vrange=(1, n_wh)),
+        ws_ship_addr_sk=lambda: ColumnData(
             T.BIGINT, _randint(4406, oidx, 1, _dim_rows("customer_address", sf)),
             vrange=(1, _dim_rows("customer_address", sf))),
-        "ws_web_site_sk": ColumnData(
+        ws_web_site_sk=lambda: ColumnData(
             T.BIGINT, _randint(4407, oidx, 1, _dim_rows("web_site", sf)),
             vrange=(1, _dim_rows("web_site", sf))),
-        "ws_ext_ship_cost": _dec(_randint(4408, lk, 0, 10000)),
-        "ws_net_profit": _dec(_randint(4409, lk, 0, 20000)),
-    }
+        ws_ext_ship_cost=lambda: _dec(_randint(4408, lk, 0, 10000)),
+        ws_net_profit=lambda: _dec(_randint(4409, lk, 0, 20000)),
+    )
 
 
 def _gen_web_returns(sf, lo, hi, need):
@@ -622,13 +650,17 @@ def _gen_web_returns(sf, lo, hi, need):
     returned = _stream(4501, lk) % np.uint64(4) == 0  # ~25%
     order, lnum, lk = order[returned], lnum[returned], lk[returned]
     n_item = _dim_rows("item", sf)
-    sold = _randint(4402, order.astype(np.uint64), SALES_DATE_LO, SALES_DATE_HI)
-    return {
-        "wr_returned_date_sk": ColumnData(
-            T.BIGINT, _julian(sold + _randint(4502, lk, 1, 120)), vrange=_J_RANGE),
-        "wr_item_sk": ColumnData(T.BIGINT, _randint(4404, lk, 1, n_item),
-                                 vrange=(1, n_item)),
-        "wr_order_number": ColumnData(
+    return _cols(
+        need,
+        wr_returned_date_sk=lambda: ColumnData(
+            T.BIGINT,
+            _julian(_randint(4402, order.astype(np.uint64),
+                             SALES_DATE_LO, SALES_DATE_HI)
+                    + _randint(4502, lk, 1, 120)),
+            vrange=_J_RANGE),
+        wr_item_sk=lambda: ColumnData(
+            T.BIGINT, _randint(4404, lk, 1, n_item), vrange=(1, n_item)),
+        wr_order_number=lambda: ColumnData(
             T.BIGINT, order, vrange=(1, order_range_count("web_returns", sf))),
-        "wr_return_amt": _dec(_randint(4503, lk, 100, 10000)),
-    }
+        wr_return_amt=lambda: _dec(_randint(4503, lk, 100, 10000)),
+    )

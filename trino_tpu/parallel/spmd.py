@@ -138,7 +138,7 @@ class SpmdExecutor(Executor):
         if stats.agg_repartitions(self.session, node, self.n_devices):
             page2 = self._repartition(page, node.group_channels, f"xchg:{node.id}")
             return Executor.aggregate_page(self, node, page2)  # sharded out
-        if any(c.distinct for c in node.aggregates):
+        if not P.can_split_aggs(node.aggregates):
             return super().aggregate_page(node, gather_page(page))
         partial = self.aggregate_partial(node, page)
         gathered = gather_page(partial)

@@ -24,11 +24,23 @@ import threading
 from typing import List, Optional, Tuple
 
 
+# default producer-blocking watermark (reference: sink.max-buffer-size /
+# OutputBufferMemoryManager's 32MB default)
+DEFAULT_MAX_BUFFER_BYTES = 32 * 1024 * 1024
+
+
 class OutputBuffer:
     """An ordered page stream read by ``consumer_count`` independent
-    consumers, each addressing its own buffer id ∈ [0, consumer_count)."""
+    consumers, each addressing its own buffer id ∈ [0, consumer_count).
 
-    def __init__(self, consumer_count: int = 1):
+    BOUNDED: ``enqueue`` blocks the producing driver once un-GC'd bytes
+    exceed ``max_buffer_bytes`` until consumers acknowledge pages away —
+    the reference's OutputBufferMemoryManager backpressure invariant
+    ("return a blocked future"; here the producer thread parks, which is
+    the same flow control on a thread-per-fragment worker)."""
+
+    def __init__(self, consumer_count: int = 1,
+                 max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES):
         assert consumer_count >= 1
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -37,11 +49,31 @@ class OutputBuffer:
         self._acked = [0] * consumer_count  # per-consumer ack watermark
         self._complete = False
         self._aborted: Optional[str] = None
+        self._max_bytes = max_buffer_bytes
+        self._bytes = 0  # un-GC'd page bytes
+        self.peak_buffered_bytes = 0
 
-    def enqueue(self, page_bytes: bytes) -> None:
+    def enqueue(self, page_bytes: bytes, timeout: float = 300.0) -> None:
         with self._cond:
+            if self._aborted is not None:
+                return  # writes to a destroyed buffer are discarded
             assert not self._complete, "enqueue after set_complete"
+            # block while over the watermark (unless aborted — a dead
+            # consumer must not wedge the producer forever)
+            ok = self._cond.wait_for(
+                lambda: self._aborted is not None
+                or self._bytes < self._max_bytes,
+                timeout,
+            )
+            if not ok:
+                raise TimeoutError(
+                    f"output buffer full for {timeout}s "
+                    f"({self._bytes} buffered bytes, no consumer progress)")
+            if self._aborted is not None:
+                return
             self._pages.append(page_bytes)
+            self._bytes += len(page_bytes)
+            self.peak_buffered_bytes = max(self.peak_buffered_bytes, self._bytes)
             self._cond.notify_all()
 
     def set_complete(self) -> None:
@@ -56,11 +88,14 @@ class OutputBuffer:
             self._cond.notify_all()
 
     def _gc_locked(self) -> None:
-        """Drop the prefix acknowledged by EVERY consumer."""
+        """Drop the prefix acknowledged by EVERY consumer (and wake any
+        producer blocked on the byte watermark)."""
         drop = min(min(self._acked) - self._base, len(self._pages))
         if drop > 0:
+            self._bytes -= sum(len(p) for p in self._pages[:drop])
             del self._pages[:drop]
             self._base += drop
+            self._cond.notify_all()
 
     def poll(
         self, token: int, buffer_id: int = 0, max_pages: int = 16, timeout: float = 1.0

@@ -21,6 +21,7 @@ AGGREGATE_FUNCTIONS = {
     "stddev", "stddev_samp", "stddev_pop",
     "variance", "var_samp", "var_pop",
     "approx_distinct",
+    "approx_percentile",
 }
 
 _MONTH_UNITS = {"year": 12, "month": 1}
@@ -118,6 +119,10 @@ def aggregate_result_type(fn: str, arg: Optional[T.Type]) -> T.Type:
         return T.DOUBLE
     if fn == "approx_distinct":
         return T.BIGINT
+    if fn == "approx_percentile":
+        if not arg.is_numeric:
+            raise AnalysisError(f"approx_percentile() not defined for {arg}")
+        return arg
     raise AnalysisError(f"unknown aggregate {fn}")
 
 

@@ -75,7 +75,7 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
             if rep:
                 node.source = src
                 return node, True
-            if any(a.distinct for a in node.aggregates):
+            if not P.can_split_aggs(node.aggregates):
                 # DISTINCT aggregates can't be split partial/final: gather the
                 # raw rows, aggregate single-step above the exchange
                 fid = next(_frag_ids)

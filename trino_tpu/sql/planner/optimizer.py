@@ -608,6 +608,7 @@ def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict
                 src_map[a.arg_channel] if a.arg_channel is not None else None,
                 a.output_type,
                 a.distinct,
+                a.param,
             )
             for _, a in kept_aggs
         ]

@@ -142,10 +142,11 @@ class ProjectNode(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class AggregateCall:
-    function: str  # count | count_star | sum | avg | min | max | stddev* | var* | approx_distinct
+    function: str  # count | sum | avg | min | max | stddev* | var* | approx_distinct | approx_percentile
     arg_channel: Optional[int]  # None for count(*)
     output_type: T.Type
     distinct: bool = False
+    param: Optional[float] = None  # approx_percentile's percentile
     # count(*) counts rows; count(x) counts non-null x
 
     def __post_init__(self):
@@ -212,6 +213,15 @@ def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
 
 
 _VAR_FAMILY = {"stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"}
+
+
+def can_split_aggs(aggregates) -> bool:
+    """True when every aggregate has a mergeable partial/final state.
+    DISTINCT aggregates and approx_percentile (whose per-group percentile
+    is not a combination of shard percentiles) must see all raw rows."""
+    return not any(
+        a.distinct or a.function == "approx_percentile" for a in aggregates
+    )
 
 
 def _acc_state_count(agg: AggregateCall) -> int:

@@ -832,14 +832,21 @@ class Planner:
                 agg_arg_irs.append(None)
                 agg_calls.append(P.AggregateCall("count", None, T.BIGINT))
                 continue
-            if len(a.args) != 1:
+            param = None
+            if a.name == "approx_percentile":
+                if len(a.args) != 2:
+                    raise PlanningError("approx_percentile expects 2 arguments")
+                p_ir = ExprAnalyzer(scope).analyze(a.args[1])
+                param = _constant_fraction(p_ir, "approx_percentile")
+            elif len(a.args) != 1:
                 raise PlanningError(f"{a.name} expects 1 argument")
             arg = ExprAnalyzer(scope).analyze(a.args[0])
             out_t = aggregate_result_type(a.name, arg.type)
             ch = len(pre_exprs)
             pre_exprs.append(arg)
             pre_names.append(f"aggarg{len(agg_calls)}")
-            agg_calls.append(P.AggregateCall(a.name, ch, out_t, distinct=a.distinct))
+            agg_calls.append(
+                P.AggregateCall(a.name, ch, out_t, distinct=a.distinct, param=param))
             agg_arg_irs.append(arg)
 
         if not pre_exprs:
@@ -1150,11 +1157,18 @@ class Planner:
                 calls.append(P.AggregateCall("count", None, T.BIGINT))
                 continue
             arg_ir = ExprAnalyzer(inner_scope).analyze(a.args[0])
+            param = None
+            if a.name == "approx_percentile":
+                if len(a.args) != 2:
+                    raise PlanningError("approx_percentile expects 2 arguments")
+                param = _constant_fraction(
+                    ExprAnalyzer(inner_scope).analyze(a.args[1]),
+                    "approx_percentile")
             calls.append(
                 P.AggregateCall(
                     a.name, len(pre_exprs),
                     aggregate_result_type(a.name, arg_ir.type),
-                    distinct=a.distinct,
+                    distinct=a.distinct, param=param,
                 )
             )
             pre_exprs.append(arg_ir)
@@ -1244,6 +1258,18 @@ def _fold_constant(e: ir.Expr) -> Optional[ir.Constant]:
         # rescaling shifts values by powers of ten
         return ir.Constant(e.type, _rescale(inner, e.type))
     return None
+
+
+def _constant_fraction(e: ir.Expr, fn: str) -> float:
+    """A numeric constant in [0, 1] (e.g. the percentile argument)."""
+    if not isinstance(e, ir.Constant) or e.value is None:
+        raise PlanningError(f"{fn}: percentile must be a constant")
+    v = float(e.value)
+    if e.type.is_decimal:
+        v /= 10 ** e.type.scale
+    if not 0.0 <= v <= 1.0:
+        raise PlanningError(f"{fn}: percentile must be between 0 and 1")
+    return v
 
 
 def _rescale(c: ir.Constant, target: T.Type):

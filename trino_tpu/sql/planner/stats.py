@@ -124,8 +124,8 @@ def agg_repartitions(session, node: P.AggregationNode, n_devices: int) -> bool:
     partial states (the low-cardinality path)."""
     if not node.group_channels:
         return False  # global aggregate: partial states are one row
-    if any(c.distinct for c in node.aggregates):
-        return False  # distinct fallback gathers raw rows (for now)
+    if not P.can_split_aggs(node.aggregates):
+        return False  # distinct/percentile fallback gathers raw rows (for now)
     if _keys_low_cardinality(node):
         return False
     rows = estimate_rows(session, node.source)
