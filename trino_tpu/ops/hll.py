@@ -26,7 +26,6 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from trino_tpu.ops import segments as seg
 
