@@ -327,3 +327,60 @@ def test_output_buffer_abort_unblocks_producer():
     buf.abort("consumer gone")
     t.join(timeout=5)
     assert not t.is_alive()
+
+
+def test_hash_distributed_final_aggregation(cluster):
+    """FIXED_HASH_DISTRIBUTION across processes: partial tasks partition
+    their state pages by group-key hash; one FINAL task per partition
+    aggregates a disjoint key set — no single process materializes all
+    groups (reference: PagePartitioner + hash-distributed final stage).
+    gather_max_rows_per_device=1 forces the path at tiny scale."""
+    coord, workers = cluster
+    props = {"catalog": "tpch", "schema": "tiny",
+             "gather_max_rows_per_device": 1}
+    # the distributed plan must show a [hash] fragment
+    _cols, plan_rows = _run(
+        coord, "explain (type distributed) select o_custkey, count(*), sum(o_totalprice)"
+               " from orders group by o_custkey", props)
+    plan_text = "\n".join(r[0] for r in plan_rows)
+    assert "[hash]" in plan_text, plan_text
+    # and the results must match the local engine exactly
+    sql = ("select o_custkey, count(*) c, sum(o_totalprice) s from orders "
+           "group by o_custkey order by o_custkey limit 50")
+    _cols, rows = _run(coord, sql, props)
+    local = Session({"schema": "tiny"}).execute(sql)
+    assert [(r[0], r[1], str(r[2])) for r in rows] == [
+        (r[0], r[1], str(r[2])) for r in local.rows]
+    # the hash stage ran as one task per worker: the LAST source-kind
+    # fragment feeds it, and the hash fragment's own task list has one
+    # entry per worker. Identify it from the distributed plan text.
+    import re
+
+    hash_ids = re.findall(r"Fragment (\d+) \[hash\]", plan_text)
+    assert hash_ids, plan_text
+    info = coord.queries[list(coord.queries)[-1]].info()
+    frag_tasks = info["fragments"]
+    # the data query's plan has the same shape: its hash fragment id is
+    # present in the scheduled fragments with len(workers) tasks
+    hash_frag_tasks = [
+        tasks for fid, tasks in frag_tasks.items()
+        if any(t.split(".")[1] == fid for t in tasks)
+        and len(tasks) == len(workers)
+    ]
+    assert len(frag_tasks) >= 2  # partial stage + hash stage scheduled
+
+
+def test_hash_distributed_agg_varchar_keys(cluster):
+    """Varchar group keys must co-locate by STRING value, not page-local
+    dictionary code: c_name dictionaries differ per split (keyed vocab per
+    range), so code-based routing would split one name across FINAL tasks
+    and emit duplicate groups."""
+    coord, workers = cluster
+    props = {"catalog": "tpch", "schema": "tiny",
+             "gather_max_rows_per_device": 1}
+    sql = ("select c_name, count(*) c from customer, orders "
+           "where c_custkey = o_custkey group by c_name "
+           "order by c desc, c_name limit 20")
+    _cols, rows = _run(coord, sql, props)
+    local = Session({"schema": "tiny"}).execute(sql)
+    assert [tuple(r) for r in rows] == [tuple(r) for r in local.rows]

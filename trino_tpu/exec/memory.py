@@ -90,7 +90,7 @@ def _mix64_np(x):
         return x ^ (x >> np.uint64(31))
 
 
-def partition_page_host(page, key_channels, parts: int):
+def partition_page_host(page, key_channels, parts: int, pid=None):
     """Split a page into ``parts`` hash partitions by key columns, host-side
     (numpy) — the spill write path. Equal keys co-locate (same splitmix64
     combine as the device exchange, parallel/exchange.py, so a spilled join
@@ -105,14 +105,17 @@ def partition_page_host(page, key_channels, parts: int):
 
     n = page.num_rows
     live = np.ones(n, bool) if page.sel is None else np.asarray(page.sel)
-    h = np.zeros(n, np.uint64)
-    for ch in key_channels:
-        col = page.columns[ch]
-        k = _mix64_np(np.asarray(col.values).astype(np.int64))
-        if col.nulls is not None:
-            k = np.where(np.asarray(col.nulls), np.uint64(_NULL_HASH), k)
-        h = _mix64_np(h ^ k)
-    pid = (h % np.uint64(parts)).astype(np.int64)
+    if pid is None:
+        h = np.zeros(n, np.uint64)
+        for ch in key_channels:
+            col = page.columns[ch]
+            k = _mix64_np(np.asarray(col.values).astype(np.int64))
+            if col.nulls is not None:
+                k = np.where(np.asarray(col.nulls), np.uint64(_NULL_HASH), k)
+            h = _mix64_np(h ^ k)
+        pid = (h % np.uint64(parts)).astype(np.int64)
+    else:
+        pid = np.asarray(pid)
     host_cols = [
         (np.asarray(c.values), None if c.nulls is None else np.asarray(c.nulls))
         for c in page.columns

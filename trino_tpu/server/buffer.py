@@ -134,3 +134,44 @@ class OutputBuffer:
     def buffered_bytes(self) -> int:
         with self._lock:
             return sum(len(p) for p in self._pages)
+
+
+class PartitionedOutputBuffer:
+    """Per-partition DISTINCT page streams: buffer id p serves partition p
+    (reference: PartitionedOutputBuffer.java — one client per partition),
+    unlike OutputBuffer where every consumer reads the same stream. Each
+    partition is its own bounded OutputBuffer, so backpressure applies per
+    consumer."""
+
+    def __init__(self, partitions: int,
+                 max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES):
+        assert partitions >= 1
+        self._parts = [
+            OutputBuffer(1, max_buffer_bytes=max(max_buffer_bytes // partitions, 1 << 16))
+            for _ in range(partitions)
+        ]
+
+    def enqueue_partition(self, pid: int, page_bytes: bytes, timeout: float = 300.0) -> None:
+        self._parts[pid].enqueue(page_bytes, timeout=timeout)
+
+    def set_complete(self) -> None:
+        for p in self._parts:
+            p.set_complete()
+
+    def abort(self, reason: str) -> None:
+        for p in self._parts:
+            p.abort(reason)
+
+    def poll(self, token: int, buffer_id: int = 0, max_pages: int = 16,
+             timeout: float = 1.0):
+        if not 0 <= buffer_id < len(self._parts):
+            raise ValueError(f"buffer id {buffer_id} out of range")
+        return self._parts[buffer_id].poll(token, 0, max_pages, timeout)
+
+    def destroy_consumer(self, buffer_id: int) -> None:
+        if 0 <= buffer_id < len(self._parts):
+            self._parts[buffer_id].destroy_consumer(0)
+
+    @property
+    def buffered_bytes(self) -> int:
+        return sum(p.buffered_bytes for p in self._parts)

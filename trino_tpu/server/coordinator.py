@@ -195,7 +195,8 @@ class QueryExecution:
             for node in P.walk_plan(frag.root):
                 if isinstance(node, RemoteSourceNode):
                     consumer_counts[node.fragment_id] = (
-                        len(workers) if frag.partitioning == "source" else 1)
+                        len(workers)
+                        if frag.partitioning in ("source", "hash") else 1)
         fte = str(self.session_properties.get("retry_policy", "NONE")).upper() == "TASK"
         if fte:
             from trino_tpu.server.task import spool_directory
@@ -207,6 +208,15 @@ class QueryExecution:
                     "retry_policy=TASK requires the spooled exchange: set "
                     "TRINO_TPU_SPOOL_DIR to a cluster-shared directory")
         for frag in fragments:
+            if frag.partitioning == "hash":
+                # one FINAL task per key partition (reference: the
+                # hash-distributed intermediate stage): task i pulls
+                # buffer/partition i from every upstream producer
+                self.fragment_tasks[frag.id] = [
+                    self._create_task(frag, wi, 0, {}, workers[wi], consumer_counts)
+                    for wi in range(len(workers))
+                ]
+                continue
             if frag.partitioning != "source":
                 continue
             # enumerate splits per scan node, interleave across workers
@@ -245,6 +255,8 @@ class QueryExecution:
             upstream=self._upstream_for(frag.root, consumer_index=wi),
             session_properties=self.session_properties,
             consumer_count=consumer_counts.get(frag.id, 1),
+            output_partition_channels=getattr(
+                frag, "output_partition_channels", None),
         )
         status, resp, _ = wire.http_request(
             "POST", f"{worker['url']}/v1/task/{task_id}", req.to_bytes())

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional
 from trino_tpu.data.page import Page
 from trino_tpu.data.serde import serialize_page
 from trino_tpu.exec.executor import Executor
-from trino_tpu.server.buffer import OutputBuffer
+from trino_tpu.server.buffer import OutputBuffer, PartitionedOutputBuffer
 from trino_tpu.server.statemachine import StateMachine, task_state_machine
 from trino_tpu.sql.planner import plan as P
 from trino_tpu.sql.planner.fragmenter import RemoteSourceNode
@@ -42,6 +42,11 @@ class TaskRequest:
     # how many downstream consumers will pull this task's output (reference:
     # OutputBuffers — the consumer set is declared when the task is created)
     consumer_count: int = 1
+    # when set, the task's output page is hash-partitioned by these channels
+    # into consumer_count DISTINCT streams — consumer i pulls only partition
+    # i (reference: PagePartitioner.java:134-149, FIXED_HASH_DISTRIBUTION's
+    # producer half). None = every consumer reads the same stream.
+    output_partition_channels: Optional[List[int]] = None
 
     def to_bytes(self) -> bytes:
         return pickle.dumps(self)
@@ -96,7 +101,10 @@ class SqlTask:
     def __init__(self, request: TaskRequest, session_factory):
         self.request = request
         self.state: StateMachine[str] = task_state_machine()
-        self.output = OutputBuffer(request.consumer_count)
+        if request.output_partition_channels is not None:
+            self.output = PartitionedOutputBuffer(request.consumer_count)
+        else:
+            self.output = OutputBuffer(request.consumer_count)
         self.failure: Optional[str] = None
         self._session_factory = session_factory
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -127,6 +135,25 @@ class SqlTask:
             page = ex.execute_checked(req.fragment_root)
             self.state.set("FLUSHING")
             page = page.compact()
+            if req.output_partition_channels is not None:
+                # hash-partitioned shuffle producer: split the output by
+                # key hash (same splitmix64 combine as the device exchange,
+                # so every producer places a key identically) and enqueue
+                # each partition into its consumer's stream
+                from trino_tpu.exec.memory import partition_page_host
+
+                pid = _canonical_partition_ids(
+                    page, req.output_partition_channels, req.consumer_count)
+                parts = partition_page_host(
+                    page, req.output_partition_channels, req.consumer_count,
+                    pid=pid)
+                for pid, part in enumerate(parts):
+                    part = part.compact()
+                    if part.num_rows:
+                        self.output.enqueue_partition(pid, serialize_page(part))
+                self.output.set_complete()
+                self.state.set("FINISHED")
+                return
             page_frames = [serialize_page(page)] if page.num_rows else []
             self._spool(page_frames)
             for pb in page_frames:
@@ -165,6 +192,45 @@ class SqlTask:
             "failure": self.failure,
             "bufferedBytes": self.output.buffered_bytes,
         }
+
+
+def _canonical_partition_ids(page: Page, channels, parts: int):
+    """Per-row partition ids that agree ACROSS producer processes.
+
+    partition_page_host's value hash is dictionary-scoped for varchar
+    columns (int32 codes are page-local), which is fine for the spill path
+    (one process, one dictionary) but would split equal string keys across
+    FINAL tasks here. Varchar columns therefore hash their canonical UTF-8
+    string per vocab entry (blake2b-8) and map codes through that table;
+    other columns keep the shared splitmix64 value hash."""
+    import hashlib
+
+    import numpy as np
+
+    from trino_tpu.exec.memory import _NULL_HASH, _mix64_np
+
+    n = page.num_rows
+    h = np.zeros(n, np.uint64)
+    for ch in channels:
+        col = page.columns[ch]
+        if col.type.is_varchar and col.dictionary is not None:
+            vocab_hash = np.array(
+                [
+                    int.from_bytes(
+                        hashlib.blake2b(v.encode(), digest_size=8).digest(), "little")
+                    for v in col.dictionary.values
+                ] or [0],
+                dtype=np.uint64,
+            )
+            codes = np.asarray(col.values)
+            k = vocab_hash[np.clip(codes, 0, len(vocab_hash) - 1)]
+            k = np.where(codes < 0, np.uint64(_NULL_HASH), k)
+        else:
+            k = _mix64_np(np.asarray(col.values).astype(np.int64))
+        if col.nulls is not None:
+            k = np.where(np.asarray(col.nulls), np.uint64(_NULL_HASH), k)
+        h = _mix64_np(h ^ k)
+    return (h % np.uint64(parts)).astype(np.int64)
 
 
 def spool_directory() -> Optional[str]:

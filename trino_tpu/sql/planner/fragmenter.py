@@ -51,9 +51,32 @@ class RemoteSourceNode(P.PlanNode):
 
 @dataclasses.dataclass
 class PlanFragment:
+    # 'source' (sharded over splits) | 'hash' (one task per key partition)
+    # | 'single' (replicated/coordinator)
     id: int
-    partitioning: str  # 'source' (sharded over devices) | 'single' (replicated)
+    partitioning: str
     root: P.PlanNode
+    # producer-side hash partitioning of this fragment's OUTPUT: the task
+    # splits its result by hash of these channels into one stream per
+    # consumer (FIXED_HASH_DISTRIBUTION's PartitionedOutputOperator role)
+    output_partition_channels: Optional[List[int]] = None
+
+
+def _hash_distributed_final(session, node: P.AggregationNode) -> bool:
+    """Hash-distribute the FINAL aggregation stage when the group space is
+    too big to gather into one process (threshold: the same
+    gather_max_rows_per_device session property the SPMD tier uses) and
+    the retry policy allows it (spooling of partitioned outputs is not
+    implemented, so FTE keeps the gather path)."""
+    if session is None or not node.group_channels:
+        return False
+    from trino_tpu.sql.planner import stats
+
+    props = getattr(session, "properties", None) or {}
+    if str(props.get("retry_policy", "NONE")).upper() == "TASK":
+        return False
+    rows = stats.estimate_rows(session, node.source)
+    return rows > stats._gather_max_rows(session)
 
 
 def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
@@ -87,11 +110,40 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
                     exchange_type="gather",
                 )
                 return node, True
-            # partial in a source fragment, final here above a state exchange
+            # partial in a source fragment, final above a state exchange
             partial = P.AggregationNode(
                 src, node.group_channels, node.aggregates, step="partial",
                 names=node.names,
             )
+            k = len(node.group_channels)
+            if _hash_distributed_final(session, node):
+                # FIXED_HASH_DISTRIBUTION: partial tasks partition their
+                # state pages by group-key hash; one FINAL task per
+                # partition aggregates disjoint key sets in parallel —
+                # no process ever holds all groups (reference:
+                # PagePartitioner producer + hash-distributed final stage)
+                fid = next(_frag_ids)
+                fragments.append(PlanFragment(
+                    fid, "source", partial,
+                    output_partition_channels=list(range(k))))
+                remote = RemoteSourceNode(
+                    fragment_id=fid,
+                    types=partial.output_types,
+                    names=partial.output_names,
+                    exchange_type="partitioned",
+                )
+                final = P.AggregationNode(
+                    remote, list(range(k)), node.aggregates, step="final",
+                    names=node.names,
+                )
+                hfid = next(_frag_ids)
+                fragments.append(PlanFragment(hfid, "hash", final))
+                return RemoteSourceNode(
+                    fragment_id=hfid,
+                    types=final.output_types,
+                    names=final.output_names,
+                    exchange_type="gather",
+                ), True
             fid = next(_frag_ids)
             fragments.append(PlanFragment(fid, "source", partial))
             remote = RemoteSourceNode(
@@ -100,7 +152,6 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
                 names=partial.output_names,
                 exchange_type="gather_states",
             )
-            k = len(node.group_channels)
             final = P.AggregationNode(
                 remote, list(range(k)), node.aggregates, step="final", names=node.names
             )
