@@ -491,8 +491,9 @@ def _generate_lineitem(sf: float, order_lo: int, order_hi: int, need) -> Dict[st
     okey_per_order = _order_keys(order_lo, order_hi)
     nlines = _line_count(okey_per_order)
     okey = np.repeat(okey_per_order, nlines)
-    # linenumber: 1.. within each order
-    offsets = np.concatenate([[0], np.cumsum(nlines)[:-1]])
+    # linenumber: 1.. within each order (exclusive prefix sum — stays
+    # shape-correct for an empty order range, e.g. a no-split device's scan)
+    offsets = np.cumsum(nlines) - nlines
     lnum = (np.arange(len(okey)) - np.repeat(offsets, nlines) + 1).astype(np.int64)
     lk = _line_key(okey, lnum)
     out: Dict[str, ColumnData] = {}

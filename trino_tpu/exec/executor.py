@@ -96,6 +96,81 @@ def assemble_scan_page(column_names, column_types, datas) -> Page:
     return Page(cols)
 
 
+def scan_constraint_with(node: "P.TableScanNode", dyn_domains):
+    """Effective TupleDomain for a scan: static pushdown ∩ available
+    dynamic-filter domains (reference: DynamicFilter.getCurrentPredicate).
+    Shared by the eager executor and the staged tiers (compiled/SPMD)."""
+    from trino_tpu.connector.predicate import TupleDomain
+
+    td = node.constraint
+    for join_id, key_idx, column in node.dynamic_filters or ():
+        dom = dyn_domains.get((join_id, key_idx))
+        if dom is None:
+            continue
+        extra = TupleDomain({column: dom})
+        td = extra if td is None else td.intersect(extra)
+    return td
+
+
+def dynamic_domain_map(node, dyn_domains):
+    """column -> available dynamic-filter Domain for a scan (intersecting
+    when several joins filter the same column). Shared by the phase-1 host
+    evaluator and the scan-time enforcer so both always agree on which rows
+    survive."""
+    dyn = {}
+    for join_id, key_idx, column in node.dynamic_filters or ():
+        dom = dyn_domains.get((join_id, key_idx))
+        if dom is None or dom.is_all():
+            continue
+        dyn[column] = dom.intersect(dyn[column]) if column in dyn else dom
+    return dyn
+
+
+def apply_dynamic_domains(node, dyn_domains, datas):
+    """Engine-side enforcement of a scan's available dynamic-filter domains
+    on host-side scanned data: connectors treat constraints as ADVISORY (the
+    tpch generator prunes only via its monotone key), so the scan operator
+    itself drops rows outside the domain before device transfer — the
+    reference's ScanFilterAndProjectOperator applying
+    DynamicFilter.getCurrentPredicate. Varchar domains are skipped
+    (dictionary codes are page-local)."""
+    import dataclasses as _dc
+
+    from trino_tpu.exec.host_eval import domain_mask
+
+    dyn = dynamic_domain_map(node, dyn_domains)
+    if not dyn:
+        return datas
+    out = []
+    for d in datas:
+        if not d:
+            out.append(d)
+            continue
+        n = len(next(iter(d.values())).values)
+        keep = np.ones(n, dtype=bool)
+        for column, dom in dyn.items():
+            cd = d.get(column)
+            if cd is None or cd.dictionary is not None:
+                continue
+            keep &= domain_mask(
+                dom,
+                np.asarray(cd.values),
+                np.asarray(cd.nulls) if cd.nulls is not None else None,
+            )
+        if keep.all():
+            out.append(d)
+            continue
+        out.append({
+            name: _dc.replace(
+                cd,
+                values=np.asarray(cd.values)[keep],
+                nulls=np.asarray(cd.nulls)[keep] if cd.nulls is not None else None,
+            )
+            for name, cd in d.items()
+        })
+    return out
+
+
 class Executor:
     """Traceable plan interpreter. ``execute_checked`` runs eagerly and
     raises deferred errors; the recursion itself (``execute``) is pure and
@@ -124,6 +199,9 @@ class Executor:
         # before tracing and override the class flag (Tracers have no
         # concrete min/max).
         self.dyn_domains: Dict[Tuple[int, int], object] = {}
+        # host seconds spent applying dynamic domains at scans (benchmarks
+        # charge this to the query: it is join work moved off-device)
+        self.df_apply_s = 0.0
         # rows materialized per scan plan-node id (EXPLAIN/pushdown tests)
         self.scan_stats: Dict[int, int] = {}
         # per-operator stats by plan-node id (EXPLAIN ANALYZE)
@@ -179,24 +257,16 @@ class Executor:
 
     # ----------------------------------------------------------------- scan
     def scan_constraint(self, node: P.TableScanNode):
-        """Effective TupleDomain for a scan: static pushdown ∩ available
-        dynamic-filter domains (reference: DynamicFilter.getCurrentPredicate)."""
-        from trino_tpu.connector.predicate import TupleDomain
-
-        td = node.constraint
-        for join_id, key_idx, column in node.dynamic_filters or ():
-            dom = self.dyn_domains.get((join_id, key_idx))
-            if dom is None:
-                continue
-            extra = TupleDomain({column: dom})
-            td = extra if td is None else td.intersect(extra)
-        return td
+        return scan_constraint_with(node, self.dyn_domains)
 
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
         conn = self.session.catalogs[node.catalog]
         constraint = self.scan_constraint(node)
         splits = conn.get_splits(node.schema, node.table, 1, constraint=constraint)
         datas = [conn.scan(s, node.column_names, constraint=constraint) for s in splits]
+        t0 = time.perf_counter()
+        datas = apply_dynamic_domains(node, self.dyn_domains, datas)
+        self.df_apply_s += time.perf_counter() - t0
         self.scan_stats[node.id] = sum(
             len(next(iter(d.values())).values) if d else 0 for d in datas
         )

@@ -25,6 +25,7 @@ page shuttle between stages on this path.
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
@@ -340,27 +341,39 @@ def _take_prefix(page: Page, k: int) -> Page:
     )
 
 
-def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
+def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
+                        dyn_domains=None, profile=None):
     """Enumerate splits per scan, load per-device shards, pad to a common
     per-device shape, stack [ndev, rows]. This is the SOURCE_DISTRIBUTION
-    split assignment done statically; the dynamic split-to-worker scheduler
-    lives in the DCN tier (server/coordinator.py _schedule)."""
+    split assignment done statically. ``dyn_domains`` carries phase-1
+    resolved dynamic-filter domains (exec/host_eval.py) — the reference's
+    split-time DynamicFilter blocking, realised as two-phase execution:
+    probe splits are enumerated AND row-filtered under the build-side key
+    domains before any device sees them."""
+    from trino_tpu.exec.executor import apply_dynamic_domains, scan_constraint_with
+
+    dyn_domains = dyn_domains or {}
     staged: Dict[int, List] = {}
     specs: Dict[int, PageSpec] = {}
     for node in P.walk_plan(root):
         if not isinstance(node, P.TableScanNode):
             continue
         conn = session.catalogs[node.catalog]
-        # static constraint pushdown only: staging happens before the traced
-        # program (and its build sides) runs, so dynamic filters cannot
-        # narrow here — the reference's split-time DynamicFilter blocking
-        # maps to a host-side two-phase execution (later round)
+        constraint = scan_constraint_with(node, dyn_domains)
         splits = conn.get_splits(
-            node.schema, node.table, n_devices, constraint=node.constraint)
+            node.schema, node.table, n_devices, constraint=constraint)
+        total_rows = 0
         shard_pages = []
         for di in range(n_devices):
             if di < len(splits):
-                data = conn.scan(splits[di], node.column_names, constraint=node.constraint)
+                data = conn.scan(splits[di], node.column_names, constraint=constraint)
+                t0 = _time.perf_counter()
+                (data,) = apply_dynamic_domains(node, dyn_domains, [data])
+                if profile is not None:
+                    profile["df_apply_s"] = (
+                        profile.get("df_apply_s", 0.0) + _time.perf_counter() - t0)
+                if data:
+                    total_rows += len(next(iter(data.values())).values)
             else:
                 # devices beyond the split count scan NOTHING: lo=hi and an
                 # empty info both mark emptiness (row-group connectors use
@@ -457,6 +470,7 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int):
         arrays.append(sel)
         staged[node.id] = arrays
         specs[node.id] = PageSpec(types, dicts, has_nulls, True, vranges)
+        node.runtime_rows = total_rows  # staged truth for capacity estimates
     return staged, specs
 
 
@@ -479,6 +493,10 @@ class DistributedQuery:
     session: object = None
     root: P.OutputNode = None
     capacity_hints: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # two-phase profile (see CompiledQuery): benchmarks charge this host
+    # time to every run — it is query work done off-device
+    phase1_s: float = 0.0
+    df_apply_s: float = 0.0
 
     MAX_RECOMPILES = 16
 
@@ -486,21 +504,30 @@ class DistributedQuery:
     def build(
         cls, session, root: P.OutputNode, mesh: Mesh, capacity_hints: Dict[str, int] = None
     ) -> "DistributedQuery":
-        """Compile without executing: expansion capacities come from connector
-        stats (global totals upper-bound each shard); overflow at runtime
-        doubles the bucket and recompiles (see CompiledQuery.run)."""
+        """Two-phase compile (see CompiledQuery.build): phase 1 host-resolves
+        dynamic-filter domains, scans stage narrowed, and capacities estimate
+        from staged truth (global totals upper-bound each shard); overflow at
+        runtime doubles the bucket and recompiles (see CompiledQuery.run)."""
+        from trino_tpu.exec import host_eval
         from trino_tpu.sql.planner import stats
 
         n_devices = mesh.devices.size
+        t0 = _time.perf_counter()
+        dyn = host_eval.resolve_dynamic_filters(session, root)
+        phase1_s = _time.perf_counter() - t0
+        prof: Dict[str, float] = {}
+        staged_arrays, specs = stage_sharded_scans(
+            session, root, n_devices, dyn, profile=prof)
         if capacity_hints is None:
             capacity_hints = stats.estimate_capacity_hints(session, root)
             capacity_hints.update(stats.estimate_exchange_hints(session, root, n_devices))
-        staged_arrays, specs = stage_sharded_scans(session, root, n_devices)
         layout = [(nid, len(arrs)) for nid, arrs in staged_arrays.items()]
         flat_inputs: List = []
         for _, arrs in staged_arrays.items():
             flat_inputs.extend(jnp.asarray(a) for a in arrs)
         dq = cls(mesh, None, flat_inputs, [None], [None], session, root, dict(capacity_hints))
+        dq.phase1_s = phase1_s
+        dq.df_apply_s = prof.get("df_apply_s", 0.0)
         dq._layout = layout
         dq._specs = specs
         dq._jit()

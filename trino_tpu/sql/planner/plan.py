@@ -54,6 +54,10 @@ class TableScanNode(PlanNode):
     # [(join_node_id, key_index, column_name)] — at execution the scan
     # waits for the named join's build-side key domain.
     dynamic_filters: List = None
+    # ACTUAL rows staged for this scan (set by the two-phase compiled path
+    # after phase-1 narrowing; reference: AdaptivePlanner's runtime stats) —
+    # when present, cardinality estimation starts from truth, not stats.
+    runtime_rows: Optional[int] = None
 
     @property
     def output_types(self):
@@ -250,6 +254,10 @@ class JoinNode(PlanNode):
     # dynamic filter (set by optimizer.plan_dynamic_filters) — the executor
     # extracts domains only for these
     dyn_filter_keys: List[int] = None
+    # phase-1 host evaluation produced an EXACT in-set domain that probe
+    # scans applied: every surviving probe row has >= 1 build match, so
+    # cardinality estimation skips the key-match discount
+    df_exact: bool = False
 
     @property
     def sources(self):

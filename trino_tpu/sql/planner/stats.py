@@ -31,6 +31,8 @@ MIN_CAPACITY = 1024
 def estimate_rows(session, node: P.PlanNode) -> int:
     """Rough output-row estimate per plan node (upper-bound biased)."""
     if isinstance(node, P.TableScanNode):
+        if node.runtime_rows is not None:
+            return max(int(node.runtime_rows), 1)
         conn = session.catalogs.get(node.catalog)
         n = conn.table_row_count(node.schema, node.table) if conn else None
         return int(n) if n else MIN_CAPACITY
@@ -377,6 +379,8 @@ def estimate_live_rows(session, node: P.PlanNode) -> int:
         # enforcing FilterNode is always kept (optimizer.derive_scan_
         # constraints), so the filter's predicate_selectivity already counts
         # them — discounting both would square the selectivity.
+        if node.runtime_rows is not None:
+            return max(int(node.runtime_rows), 1)  # phase-1 staged truth
         conn = session.catalogs.get(node.catalog)
         n = conn.table_row_count(node.schema, node.table) if conn else None
         return int(n) if n else MIN_CAPACITY
@@ -401,6 +405,10 @@ def estimate_live_rows(session, node: P.PlanNode) -> int:
             return left * right
         ndv = key_ndv(session, node.left, node.left_keys)
         match = min(1.0, right / ndv) if ndv else 1.0
+        if node.df_exact:
+            # probe scans were narrowed by this join's exact in-set domain:
+            # every surviving probe row matches (two-phase dynamic filtering)
+            match = 1.0
         if node.join_type == "semi":
             return max(1, int(left * match))
         if node.join_type == "anti":
