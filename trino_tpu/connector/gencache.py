@@ -1,0 +1,69 @@
+"""Scan-range generation cache for the stateless table generators.
+
+Reference role: the buffer-pool / page-cache layer under a scan (the
+reference reads ORC/Parquet through OS page cache + connector caches, so
+re-scanning a table costs IO once). Our generators ARE the storage tier;
+without a cache every scan of the same table re-synthesizes it — Q18 reads
+lineitem twice (HAVING subquery + main join), TPC-DS q95 reads web_sales
+three times. Entries key on (table, sf, lo, hi) and accumulate columns on
+demand; the whole cache clears when it exceeds its byte budget (generation
+is always correct, the cache is purely a cost optimization).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+MAX_CACHE_BYTES = 4 << 30
+MAX_ENTRY_BYTES = 2 << 30
+
+
+class GenCache:
+    def __init__(self, generate_fn: Callable):
+        self._generate = generate_fn
+        self._entries: Dict[tuple, dict] = {}
+        self._entry_bytes: Dict[tuple, int] = {}
+        self._bytes = 0
+
+    @staticmethod
+    def _cd_bytes(cd) -> int:
+        total = 0
+        for a in (cd.values, cd.nulls):
+            arr = np.asarray(a) if a is not None else None
+            if arr is not None and arr.ndim:
+                total += arr.nbytes
+        return total
+
+    def generate(self, table: str, sf: float, lo: int, hi: int, columns):
+        need = set(columns)
+        key = (table, float(sf), int(lo), int(hi))
+        ent = self._entries.get(key)
+        missing = need - set(ent or ())
+        if ent is None or missing:
+            fresh = self._generate(table, sf, lo, hi, need if ent is None else missing)
+            size = sum(self._cd_bytes(cd) for cd in fresh.values())
+            if size > MAX_ENTRY_BYTES:
+                out = dict(ent or {})
+                out.update(fresh)
+                return {c: out[c] for c in columns}
+            if self._bytes + size > MAX_CACHE_BYTES:
+                # evict everything EXCEPT the entry being filled: its
+                # already-cached columns are part of this very result
+                keep = self._entries.pop(key, None)
+                keep_bytes = self._entry_bytes.pop(key, 0)
+                self._entries.clear()
+                self._entry_bytes.clear()
+                self._bytes = 0
+                if keep is not None:
+                    self._entries[key] = keep
+                    self._entry_bytes[key] = keep_bytes
+                    self._bytes = keep_bytes
+                ent = keep
+            if ent is None:
+                ent = {}
+                self._entries[key] = ent
+            ent.update(fresh)
+            self._entry_bytes[key] = self._entry_bytes.get(key, 0) + size
+            self._bytes += size
+        return {c: ent[c] for c in columns}

@@ -30,6 +30,11 @@ class Domain:
     high_inclusive: bool = True
     values: Optional[FrozenSet] = None  # discrete set; overrides range
     null_allowed: bool = False
+    # lazily-cached sorted numpy array of ``values`` (phase-1 dynamic
+    # filters reach millions of keys; per-use frozenset iteration is the
+    # cost that matters, not storage). Excluded from equality/repr.
+    values_sorted: Optional[object] = dataclasses.field(
+        default=None, compare=False, repr=False)
 
     @staticmethod
     def all() -> "Domain":
@@ -37,7 +42,14 @@ class Domain:
 
     @staticmethod
     def from_values(vals, null_allowed: bool = False) -> "Domain":
-        return Domain(values=frozenset(vals), null_allowed=null_allowed)
+        import numpy as np
+
+        arr = None
+        if isinstance(vals, np.ndarray):
+            arr = np.sort(vals)
+            vals = arr.tolist()
+        return Domain(values=frozenset(vals), null_allowed=null_allowed,
+                      values_sorted=arr)
 
     @staticmethod
     def range(low=None, high=None, low_inclusive=True, high_inclusive=True) -> "Domain":
@@ -102,6 +114,25 @@ class Domain:
                                        or (other.high == high and not other.high_inclusive)):
             high, high_inc = other.high, other.high_inclusive
         return Domain(low, high, low_inc, high_inc, None, null_ok)
+
+
+def sorted_values_array(dom: Domain):
+    """Sorted numpy array of an in-set Domain's values, cached on the
+    instance (frozen dataclass: installed via object.__setattr__)."""
+    import numpy as np
+
+    if dom.values_sorted is not None:
+        return dom.values_sorted
+    if not dom.values:
+        arr = np.empty(0, dtype=np.int64)
+    else:
+        # dtype-aware: an int64 fromiter would silently truncate float
+        # domain values (double join keys) and drop every matching row
+        dt = np.int64 if all(
+            isinstance(v, (int, np.integer)) for v in dom.values) else np.float64
+        arr = np.sort(np.fromiter(dom.values, dtype=dt, count=len(dom.values)))
+    object.__setattr__(dom, "values_sorted", arr)
+    return arr
 
 
 @dataclasses.dataclass(frozen=True)

@@ -281,8 +281,13 @@ _J_RANGE = (_julian(np.array([DATE_LO]))[0].item(),
 
 
 def generate(table: str, sf: float, lo: int, hi: int, columns=None) -> Dict[str, ColumnData]:
-    """Generate rows of ``table`` for order/row range [lo, hi)."""
+    """Generate rows of ``table`` for order/row range [lo, hi); cached per
+    scan range (connector/gencache.py — q95 reads web_sales three times)."""
     need = set(columns) if columns is not None else {n for n, _ in SCHEMAS[table]}
+    return _gen_cache.generate(table, sf, lo, hi, need)
+
+
+def _generate(table: str, sf: float, lo: int, hi: int, need) -> Dict[str, ColumnData]:
     fn = {
         "date_dim": _gen_date_dim, "income_band": _gen_income_band,
         "household_demographics": _gen_hd, "customer_demographics": _gen_cd,
@@ -295,6 +300,11 @@ def generate(table: str, sf: float, lo: int, hi: int, columns=None) -> Dict[str,
     }[table]
     out = fn(sf, lo, hi, need)
     return {c: out[c] for c in out if c in need}
+
+
+from trino_tpu.connector.gencache import GenCache  # noqa: E402
+
+_gen_cache = GenCache(_generate)
 
 
 def _gen_date_dim(sf, lo, hi, need):

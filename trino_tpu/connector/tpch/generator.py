@@ -278,13 +278,25 @@ def generate(table: str, sf: float, lo: int, hi: int, columns=None) -> Dict[str,
     range — lineitem expands to that range's line rows). ``columns`` prunes
     generation to the requested subset (the big tables only generate what the
     scan projects — the generator-side analog of connector projection
-    pushdown, reference ConnectorMetadata.applyProjection)."""
+    pushdown, reference ConnectorMetadata.applyProjection). Results ride the
+    scan-range cache (connector/gencache.py): re-scans of the same range —
+    Q18's double lineitem read, phase-1 host evaluation before staging —
+    cost generation once."""
     need = set(columns) if columns is not None else {n for n, _ in SCHEMAS[table]}
+    return _gen_cache.generate(table, sf, lo, hi, need)
+
+
+def _generate_vranged(table: str, sf: float, lo: int, hi: int, need) -> Dict[str, ColumnData]:
     out = _generate(table, sf, lo, hi, need)
     for name, cd in out.items():
         if cd.vrange is None:
             cd.vrange = column_vrange(table, name, sf)
     return out
+
+
+from trino_tpu.connector.gencache import GenCache  # noqa: E402
+
+_gen_cache = GenCache(_generate_vranged)
 
 
 def _generate(table: str, sf: float, lo: int, hi: int, need) -> Dict[str, ColumnData]:
