@@ -57,6 +57,20 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 10_000, _positive,
         ),
         PropertyMetadata(
+            "sink_max_buffer_bytes",
+            "producer-blocking watermark of a task's output buffer "
+            "(reference: sink.max-buffer-size) — the streaming flow-control "
+            "bound between producer serialization and consumer pulls",
+            int, 32 << 20, _positive,
+        ),
+        PropertyMetadata(
+            "task_output_chunk_bytes",
+            "target serialized bytes per task output page: task results "
+            "stream to consumers in chunks of this size (reference role: "
+            "PagesSerde / output-buffer page size targets)",
+            int, 4 << 20, _positive,
+        ),
+        PropertyMetadata(
             "retry_policy",
             "NONE = pipelined all-at-once scheduling; TASK = fault-tolerant "
             "stage-by-stage execution with per-task retries over spooled "

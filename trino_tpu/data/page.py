@@ -246,6 +246,32 @@ class Page:
         ]
         return Page(cols, None, self.replicated)
 
+    def slice_rows(self, lo: int, hi: int) -> "Page":
+        """Row-range view [lo, hi) of a compacted page (sel must be None) —
+        the producer-side page chunker of the streaming output path."""
+        assert self.sel is None, "slice_rows requires a compacted page"
+        cols = [
+            Column(
+                c.type,
+                c.values[lo:hi],
+                c.nulls[lo:hi] if c.nulls is not None else None,
+                c.dictionary,
+                c.vrange,
+            )
+            for c in self.columns
+        ]
+        return Page(cols, None, self.replicated)
+
+    def row_byte_estimate(self) -> int:
+        """Rough serialized bytes per row (dtype widths; dictionaries are
+        amortized) — sizes output chunks."""
+        total = 0
+        for c in self.columns:
+            total += np.asarray(c.values).dtype.itemsize
+            if c.nulls is not None:
+                total += 1
+        return max(total, 1)
+
     def live_count(self) -> int:
         if self.sel is None:
             return self.num_rows

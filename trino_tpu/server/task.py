@@ -101,10 +101,16 @@ class SqlTask:
     def __init__(self, request: TaskRequest, session_factory):
         self.request = request
         self.state: StateMachine[str] = task_state_machine()
+        from trino_tpu.server.buffer import DEFAULT_MAX_BUFFER_BYTES
+
+        sink_max = int(request.session_properties.get(
+            "sink_max_buffer_bytes") or DEFAULT_MAX_BUFFER_BYTES)
         if request.output_partition_channels is not None:
-            self.output = PartitionedOutputBuffer(request.consumer_count)
+            self.output = PartitionedOutputBuffer(
+                request.consumer_count, max_buffer_bytes=sink_max)
         else:
-            self.output = OutputBuffer(request.consumer_count)
+            self.output = OutputBuffer(
+                request.consumer_count, max_buffer_bytes=sink_max)
         self.failure: Optional[str] = None
         self._session_factory = session_factory
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -135,6 +141,7 @@ class SqlTask:
             page = ex.execute_checked(req.fragment_root)
             self.state.set("FLUSHING")
             page = page.compact()
+            chunk_rows = self._chunk_rows(page)
             if req.output_partition_channels is not None:
                 # hash-partitioned shuffle producer: split the output by
                 # key hash (same splitmix64 combine as the device exchange,
@@ -149,21 +156,44 @@ class SqlTask:
                     pid=pid)
                 for pid, part in enumerate(parts):
                     part = part.compact()
-                    if part.num_rows:
-                        self.output.enqueue_partition(pid, serialize_page(part))
+                    for c in _chunk_pages(part, chunk_rows):
+                        self.output.enqueue_partition(pid, serialize_page(c))
                 self.output.set_complete()
                 self.state.set("FINISHED")
                 return
-            page_frames = [serialize_page(page)] if page.num_rows else []
-            self._spool(page_frames)
-            for pb in page_frames:
-                self.output.enqueue(pb)
+            # STREAMING output: size-bounded chunks enqueue as they
+            # serialize, so consumers pull chunk 0 while chunk 1 encodes,
+            # and the bounded buffer's watermark gives real backpressure
+            # (reference invariant SURVEY §A.6: incremental page flow).
+            # Under FTE (spool configured) the whole output spools FIRST —
+            # retried consumers must find the complete durable copy — which
+            # trades pipelining for recoverability, as the reference's FTE
+            # exchanges do.
+            if spool_directory():
+                page_frames = [
+                    serialize_page(c) for c in _chunk_pages(page, chunk_rows)
+                ]
+                self._spool(page_frames)
+                for pb in page_frames:
+                    self.output.enqueue(pb)
+            else:
+                for c in _chunk_pages(page, chunk_rows):
+                    self.output.enqueue(serialize_page(c))  # blocks at watermark
             self.output.set_complete()
             self.state.set("FINISHED")
         except Exception as e:  # noqa: BLE001 — reported through task status
             self.failure = f"{e}\n{traceback.format_exc()}"
             self.output.abort(str(e))
             self.state.set("FAILED")
+
+    # target serialized bytes per output chunk (reference: the page-size
+    # targets of PartitionedOutputBuffer / PagesSerde)
+    DEFAULT_CHUNK_BYTES = 4 << 20
+
+    def _chunk_rows(self, page: Page) -> int:
+        target = int(self.request.session_properties.get(
+            "task_output_chunk_bytes") or self.DEFAULT_CHUNK_BYTES)
+        return max(1, target // page.row_byte_estimate()) if page.num_rows else 1
 
     def _spool(self, page_frames) -> None:
         """Persist the task's output to the shared spool directory
@@ -192,6 +222,16 @@ class SqlTask:
             "failure": self.failure,
             "bufferedBytes": self.output.buffered_bytes,
         }
+
+
+def _chunk_pages(page: Page, chunk_rows: int):
+    """Yield size-bounded row slices of a compacted page (empty pages yield
+    nothing — downstream treats absence as zero rows)."""
+    n = page.num_rows
+    if n == 0 or page.live_count() == 0:
+        return
+    for lo in range(0, n, chunk_rows):
+        yield page.slice_rows(lo, min(n, lo + chunk_rows))
 
 
 def _canonical_partition_ids(page: Page, channels, parts: int):
