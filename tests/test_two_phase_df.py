@@ -54,24 +54,40 @@ def _build(sql, df=True):
     return CompiledQuery.build(s, root)
 
 
-def test_q3_probe_scans_narrow_on_device_and_results_match():
-    """Scattered key sets (custkey on orders, orderkey on lineitem) stay
-    fully staged but get device-side membership + compaction: the dfc
-    capacity hints must be well under the staged row counts."""
+def test_q3_strong_domains_prune_at_staging_and_results_match():
+    """Strong domains (|set|/NDV <= HOST_APPLY_MAX_SEL) prune rows host-side
+    BEFORE the device transfer: the staged probe scans physically shrink."""
+    cq = _build(Q3)
+    rows = _scan_rows_by_table(cq.session, cq)
+    # lineitem's orderkey domain is strong (~11% of NDV) -> host-pruned;
+    # orders' custkey domain at tiny is ~31% -> device-enforced instead
+    assert min(rows["lineitem"]) < 59837 / 5
+    assert any(k.startswith("dfc:") for k in cq.capacity_hints) or \
+        min(rows["orders"]) < 15000 / 3
+    got = cq.run().to_pylist()
+    assert got == _build(Q3, df=False).run().to_pylist()
+    assert got == run_query(Session(), Q3).rows
+
+
+def test_weak_domains_enforce_on_device(monkeypatch):
+    """With host application disabled (threshold 0), the same domains ride
+    the staged LUT filters + stats-sized device compaction instead — and
+    produce identical results."""
+    from trino_tpu.exec import compiled as C
+
+    monkeypatch.setattr(C, "HOST_APPLY_MAX_SEL", 0.0)
     cq = _build(Q3)
     dfc = {k: v for k, v in cq.capacity_hints.items() if k.startswith("dfc:")}
     assert dfc, cq.capacity_hints
     rows = _scan_rows_by_table(cq.session, cq)
-    assert min(dfc.values()) < max(rows["lineitem"])
-    # runtime estimates flow into the plan: narrowed scans report fewer rows
+    assert max(rows["lineitem"]) > 20000  # staged full, filtered on device
     narrowed = [
         n.runtime_rows
         for n in P.walk_plan(cq.root)
         if isinstance(n, P.TableScanNode) and n.table == "lineitem"
     ]
-    assert min(narrowed) < 59837 / 5
+    assert min(narrowed) < 59837 / 5  # estimates still reflect the filter
     got = cq.run().to_pylist()
-    assert got == _build(Q3, df=False).run().to_pylist()
     assert got == run_query(Session(), Q3).rows
 
 

@@ -85,6 +85,11 @@ class ColumnData:
     nulls: Optional[np.ndarray] = None
     dictionary: Optional[Dictionary] = None
     vrange: Optional[tuple] = None
+    # values are non-decreasing within this part (reference: the sort
+    # properties of LocalProperties/ConnectorTableProperties) — monotone
+    # generator keys and sorted file layouts declare it; the engine's
+    # sorted-input fast paths (group/join without lax.sort) consume it
+    sorted: bool = False
 
 
 def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
@@ -126,7 +131,17 @@ def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
         if any(cd.nulls is not None for cd in cols)
         else None
     )
-    return ColumnData(cols[0].type, vals, nulls, d, vrange)
+    # sortedness survives concat when every part is sorted AND callers pass
+    # parts in ascending key order (connector scans enumerate ranges
+    # ascending); last-of-prev <= first-of-next is verified cheaply
+    srt = all(cd.sorted for cd in cols)
+    if srt:
+        for a, b in zip(cols, cols[1:]):
+            va, vb = np.asarray(a.values), np.asarray(b.values)
+            if len(va) and len(vb) and va[-1] > vb[0]:
+                srt = False
+                break
+    return ColumnData(cols[0].type, vals, nulls, d, vrange, srt)
 
 
 class Connector:

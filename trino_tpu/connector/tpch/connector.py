@@ -190,6 +190,13 @@ class TpchConnector(spi.Connector):
         ]
         ranges = [(lo, hi) for lo, hi in ranges if lo < hi]
         parts = [gen.generate(split.table, sf, lo, hi, columns) for lo, hi in ranges]
+        # the monotone key column is non-decreasing within every generated
+        # range and ranges are enumerated ascending: declare its sort order
+        # (reference: ConnectorTableProperties local properties)
+        mono = self._MONOTONE.get(split.table)
+        if mono and mono[0] in columns:
+            for p in parts:
+                p[mono[0]].sorted = True
         if len(parts) == 1:
             return {c: parts[0][c] for c in columns}
         if not parts:

@@ -45,6 +45,13 @@ class Column:
     nulls: Optional[jnp.ndarray] = None  # bool[n], True where NULL; None = no nulls
     dictionary: Optional[Dictionary] = None  # required when type.is_varchar
     vrange: Optional[tuple] = None  # static (min, max) of values, Python ints
+    # values are non-decreasing in row order (connector sort order, kept by
+    # order-preserving ops: filter masks, stable compaction, probe-major
+    # join expansion). Licenses the sort-free group/join fast paths —
+    # lax.sort is the engine's dominant cost at scale, and TPC-H fact
+    # tables arrive sorted by their join key (reference: LocalProperties
+    # driving e.g. streaming aggregations).
+    ascending: bool = False
 
     def __post_init__(self):
         if self.type.is_varchar and self.dictionary is None:
@@ -166,6 +173,10 @@ class Page:
     columns: List[Column]
     sel: Optional[jnp.ndarray] = None
     replicated: bool = False
+    # sel (when present) is a LIVE PREFIX: rows [0, k) live, [k, n) dead —
+    # the shape compact_to produces. Lets sorted-input fast paths treat
+    # ascending columns as dead-tail-sorted without inspecting the mask.
+    live_prefix: bool = False
 
     @property
     def num_rows(self) -> int:
@@ -241,6 +252,7 @@ class Page:
                 jnp.asarray(np.asarray(c.nulls)[idx]) if c.nulls is not None else None,
                 c.dictionary,
                 c.vrange,
+                ascending=c.ascending,  # order-preserving
             )
             for c in self.columns
         ]
@@ -257,6 +269,7 @@ class Page:
                 c.nulls[lo:hi] if c.nulls is not None else None,
                 c.dictionary,
                 c.vrange,
+                ascending=c.ascending,
             )
             for c in self.columns
         ]

@@ -25,14 +25,23 @@ from trino_tpu.exec.page_tree import PageSpec, flatten_page, unflatten_page
 from trino_tpu.sql.planner import plan as P
 
 
+# strong domains (|set|/NDV at or below this) prune rows HOST-SIDE at
+# staging — a cheap numpy LUT pass that cuts the host->device transfer,
+# the staging bottleneck at scale; weaker domains are enforced on device
+HOST_APPLY_MAX_SEL = 0.25
+
+
 class StagingExecutor(Executor):
     """Stages scans for the compiled tier: constraint pushdown (including
     resolved dynamic domains — the connector can prune clustered key runs
-    at the generator level) but NO host row filtering: scattered-key
-    domains are enforced ON DEVICE by PreloadedExecutor, where membership
-    + compaction ride HBM bandwidth instead of host memcpy."""
+    at the generator level) plus SELECTIVE host row filtering: strongly
+    narrowing domains prune rows before the device transfer (through the
+    tunnel the transfer is the staging bottleneck at scale), while weak
+    domains are left for PreloadedExecutor to enforce on device. The split
+    is decided per domain by ``df_host_allow`` (set in
+    CompiledQuery.build from NDV selectivity estimates)."""
 
-    apply_df_host = False
+    df_host_allow = None  # callable(node, column, domain) -> bool
 
 
 class PreloadedExecutor(Executor):
@@ -143,9 +152,25 @@ class CompiledQuery:
         t0 = time.perf_counter()
         dyn = host_eval.resolve_dynamic_filters(session, root)
         phase1_s = time.perf_counter() - t0
-        base = StagingExecutor(session)
-        base.dyn_domains.update(dyn)
         scans = [n for n in P.walk_plan(root) if isinstance(n, P.TableScanNode)]
+
+        def _dom_sel(node, col_name, dom):
+            """|domain| / column NDV — the narrowing strength estimate."""
+            if dom.values is None:
+                return 1.0
+            conn = session.catalogs[node.catalog]
+            cs = conn.column_stats(node.schema, node.table, col_name)
+            if cs is not None and cs.ndv:
+                return min(1.0, len(dom.values) / cs.ndv)
+            return 1.0
+
+        def host_allow(node, col_name, dom):
+            return dom.values is not None and \
+                _dom_sel(node, col_name, dom) <= HOST_APPLY_MAX_SEL
+
+        base = StagingExecutor(session)
+        base.df_host_allow = host_allow
+        base.dyn_domains.update(dyn)
         staged_pages = {n.id: base._exec_TableScanNode(n) for n in scans}
         # device-side dynamic-filter specs + stats-sized compaction per scan
         df_hints: Dict[str, int] = {}
@@ -163,8 +188,8 @@ class CompiledQuery:
             for col_name, dom in doms.items():
                 ch = n.column_names.index(col_name)
                 col = page.columns[ch]
-                if col.type.is_varchar:
-                    continue
+                if col.type.is_varchar or host_allow(n, col_name, dom):
+                    continue  # host-applied (or inapplicable) at staging
                 if dom.values is not None:
                     from trino_tpu.connector.predicate import sorted_values_array
 
@@ -183,10 +208,7 @@ class CompiledQuery:
                         else:
                             filter_arrays.append((n.id, ch, sa.astype(dtype)))
                             specs_for_scan.append((ch, ("sorted", None)))
-                    conn = session.catalogs[n.catalog]
-                    cs = conn.column_stats(n.schema, n.table, col_name)
-                    if cs is not None and cs.ndv:
-                        sel_frac *= min(1.0, len(dom.values) / cs.ndv)
+                    sel_frac *= _dom_sel(n, col_name, dom)
                 else:
                     specs_for_scan.append(
                         (ch, ("range", dom.low, dom.high,
@@ -195,13 +217,9 @@ class CompiledQuery:
                 n.runtime_rows = staged_rows
                 continue
             filter_specs[n.id] = specs_for_scan
-            # base the estimate on the FULL table: the connector's key-run
-            # pushdown may already have narrowed staged_rows to ~the
-            # domain's rows, and discounting those again by |set|/ndv would
-            # under-size the compaction into a recompile chain
-            conn = session.catalogs[n.catalog]
-            table_rows = conn.table_row_count(n.schema, n.table) or staged_rows
-            est = max(min(staged_rows, int(table_rows * sel_frac)), 1)
+            # base the estimate on the rows actually staged (host pruning
+            # already happened); discount only the DEVICE-side domains
+            est = max(int(staged_rows * sel_frac), 1)
             n.runtime_rows = est
             cap = 1 << max(int(est * 1.3), 1024).bit_length()
             if cap < staged_rows:

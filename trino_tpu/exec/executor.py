@@ -89,6 +89,7 @@ def assemble_scan_page(column_names, column_types, datas) -> Page:
                 jnp.asarray(cd.nulls) if cd.nulls is not None else None,
                 cd.dictionary,
                 cd.vrange,
+                ascending=bool(getattr(cd, "sorted", False)),
             )
         )
     if cols and cols[0].values.shape[0] == 0:
@@ -126,19 +127,24 @@ def dynamic_domain_map(node, dyn_domains):
     return dyn
 
 
-def apply_dynamic_domains(node, dyn_domains, datas):
+def apply_dynamic_domains(node, dyn_domains, datas, allow=None):
     """Engine-side enforcement of a scan's available dynamic-filter domains
     on host-side scanned data: connectors treat constraints as ADVISORY (the
     tpch generator prunes only via its monotone key), so the scan operator
     itself drops rows outside the domain before device transfer — the
     reference's ScanFilterAndProjectOperator applying
     DynamicFilter.getCurrentPredicate. Varchar domains are skipped
-    (dictionary codes are page-local)."""
+    (dictionary codes are page-local). ``allow(column, domain)`` restricts
+    which domains apply here (the compiled tier splits strong domains —
+    host row pruning cuts the device transfer — from weak ones it enforces
+    on device)."""
     import dataclasses as _dc
 
     from trino_tpu.exec.host_eval import domain_mask
 
     dyn = dynamic_domain_map(node, dyn_domains)
+    if allow is not None:
+        dyn = {c: d for c, d in dyn.items() if allow(node, c, d)}
     if not dyn:
         return datas
     out = []
@@ -271,7 +277,9 @@ class Executor:
         datas = [conn.scan(s, node.column_names, constraint=constraint) for s in splits]
         if self.apply_df_host:
             t0 = time.perf_counter()
-            datas = apply_dynamic_domains(node, self.dyn_domains, datas)
+            datas = apply_dynamic_domains(
+                node, self.dyn_domains, datas,
+                allow=getattr(self, "df_host_allow", None))
             self.df_apply_s += time.perf_counter() - t0
         self.scan_stats[node.id] = sum(
             len(next(iter(d.values())).values) if d else 0 for d in datas
@@ -401,17 +409,25 @@ class Executor:
             if c.nulls is not None:
                 nulls = gathered[i]
                 i += 1
-            cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange))
+            # stable: live rows keep their relative order -> ascending holds
+            cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange,
+                               ascending=c.ascending))
         sel = jnp.arange(capacity, dtype=jnp.int32) < jnp.minimum(total, capacity)
-        return Page(cols, sel, page.replicated)
+        return Page(cols, sel, page.replicated, live_prefix=True)
 
     def _exec_ProjectNode(self, node: P.ProjectNode) -> Page:
         page = self.execute(node.source)
         cols = []
         for e in node.expressions:
+            if isinstance(e, ir.ColumnRef):
+                # pass-through: reuse the column wholesale (keeps vrange,
+                # dictionary, and sort-order metadata; skips re-lowering)
+                cols.append(page.columns[e.index])
+                continue
             lv = self._lower(e, page)
             cols.append(_col_from_lowered(e.type, lv))
-        return Page(cols, page.sel, page.replicated)
+        return Page(cols, page.sel, page.replicated,
+                    live_prefix=page.live_prefix)
 
     # ---------------------------------------------------------- aggregation
     def _exec_AggregationNode(self, node: P.AggregationNode) -> Page:
@@ -605,6 +621,23 @@ class Executor:
                 gids = gids + vals.astype(jnp.int32) * stride
             layout = seg.direct_layout(gids, capacity, sel)
             return layout, seg.occupancy(layout, sel), list(payloads), sel
+        presorted = self._presorted_group(group_channels, page)
+        if presorted is not None:
+            # input already group-contiguous (single ascending key, dead
+            # rows a tail): boundaries are one elementwise compare — the
+            # n·log²n lax.sort, the engine's dominant cost at scale, never
+            # runs. Layout space == original row order, so payloads and
+            # sel pass through unchanged.
+            vals = presorted
+            dead = jnp.zeros((n,), bool) if sel is None else ~sel
+            neq = vals[1:] != vals[:-1]
+            boundary = jnp.concatenate(
+                [jnp.ones((1,), bool), neq | (dead[1:] != dead[:-1])])
+            gid_sorted = (jnp.cumsum(boundary.astype(jnp.int32)) - 1).astype(jnp.int32)
+            num_groups = jnp.sum(boundary & ~dead)
+            layout = seg.sorted_layout(
+                jnp.arange(n, dtype=jnp.int32), gid_sorted, num_groups)
+            return layout, jnp.arange(n) < num_groups, list(payloads), sel
         order, gid_sorted, num_groups, payloads_l = gb.group_plan(keys, sel, payloads)
         layout = seg.sorted_layout(order, gid_sorted, num_groups)
         if sel is None:
@@ -640,6 +673,20 @@ class Executor:
             return None
         vi, hv = slot
         return (payloads_l[vi], payloads_l[vi + 1] if hv else None)
+
+    @staticmethod
+    def _presorted_group(group_channels: List[int], page: Page):
+        """The single group-key column when the page is already
+        group-contiguous: key ascending, null-free, dead rows a tail
+        (sel None or live-prefix). Returns its values array or None."""
+        if len(group_channels) != 1:
+            return None
+        col = page.columns[group_channels[0]]
+        if not col.ascending or col.nulls is not None:
+            return None
+        if page.sel is not None and not page.live_prefix:
+            return None
+        return col.values
 
     @staticmethod
     def _direct_strides(group_channels: List[int], page: Page):
@@ -982,12 +1029,26 @@ class Executor:
         probe_keys = [(jnp.zeros((left.num_rows,), jnp.int32), None)]
         return build_keys, probe_keys
 
+
+    @staticmethod
+    def _build_presorted(page: Page, key_channels) -> bool:
+        """True when the build page's single join key is ascending,
+        null-free, and dead rows form a tail — build_side skips its sort."""
+        if len(key_channels) != 1:
+            return False
+        col = page.columns[key_channels[0]]
+        if not col.ascending or col.nulls is not None:
+            return False
+        return page.sel is None or page.live_prefix
+
     def expand_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         """General M:N inner/left join: count matches per probe row, then
         gather into a static-capacity probe-major output (ops/join.py
         probe_counts + expand; reference JoinHash position-links chains)."""
         build_keys, probe_keys = self._expansion_keys(node, left, right)
-        build = join_ops.build_side(build_keys, right.sel)
+        build = join_ops.build_side(
+            build_keys, right.sel,
+            presorted=node.left_keys and self._build_presorted(right, node.right_keys))
         lo, counts = join_ops.probe_counts(build, probe_keys, left.sel)
         n = left.num_rows
         outer = node.join_type == "left"
@@ -1065,7 +1126,9 @@ class Executor:
         non-equality predicates): expand the matches, evaluate the filter,
         then reduce any-passing back to the probe rows."""
         build_keys, probe_keys = self._expansion_keys(node, left, right)
-        build = join_ops.build_side(build_keys, right.sel)
+        build = join_ops.build_side(
+            build_keys, right.sel,
+            presorted=node.left_keys and self._build_presorted(right, node.right_keys))
         lo, counts = join_ops.probe_counts(build, probe_keys, left.sel)
         n = left.num_rows
         capacity = self.hint_capacity(f"join:{node.id}", counts)
@@ -1114,7 +1177,9 @@ class Executor:
             [right.columns[c].vrange for c in node.right_keys],
             [left.columns[c].vrange for c in node.left_keys],
         )
-        build = join_ops.build_side(build_keys, right.sel)
+        build = join_ops.build_side(
+            build_keys, right.sel,
+            presorted=self._build_presorted(right, node.right_keys))
         rows, matched = join_ops.probe_unique(build, probe_keys)
         out_cols = list(left.columns)
         right_lowered = join_ops.gather_columns(
@@ -1151,7 +1216,9 @@ class Executor:
             [right.columns[c].vrange for c in node.right_keys],
             [left.columns[c].vrange for c in node.left_keys],
         )
-        hit = join_ops.membership(build_keys, right.sel, probe_keys)
+        hit = join_ops.membership(
+            build_keys, right.sel, probe_keys,
+            presorted=self._build_presorted(right, node.right_keys))
         keep = hit if node.join_type == "semi" else ~hit
         sel = keep if left.sel is None else left.sel & keep
         return Page(left.columns, sel, left.replicated)

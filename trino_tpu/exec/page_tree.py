@@ -25,6 +25,10 @@ class PageSpec:
     # static (min, max) bounds per column (data/page.py Column.vrange) —
     # static metadata, so it crosses the jit boundary in the spec
     vranges: Optional[List[Optional[tuple]]] = None
+    # per-column sort-order flags + the page's live-prefix property
+    # (data/page.py) — static metadata licensing sort-free fast paths
+    ascending: Optional[List[bool]] = None
+    live_prefix: bool = False
 
     def array_count(self) -> int:
         """How many flat arrays a page with this spec occupies."""
@@ -49,6 +53,8 @@ def flatten_page(page: Page) -> Tuple[List[jnp.ndarray], PageSpec]:
         has_nulls,
         page.sel is not None,
         [c.vrange for c in page.columns],
+        [c.ascending for c in page.columns],
+        page.live_prefix,
     )
     return arrays, spec
 
@@ -57,13 +63,15 @@ def unflatten_page(spec: PageSpec, arrays: List[jnp.ndarray]) -> Page:
     cols: List[Column] = []
     i = 0
     vranges = spec.vranges or [None] * len(spec.types)
-    for t, d, hn, vr in zip(spec.types, spec.dictionaries, spec.has_nulls, vranges):
+    asc = spec.ascending or [False] * len(spec.types)
+    for t, d, hn, vr, a in zip(
+            spec.types, spec.dictionaries, spec.has_nulls, vranges, asc):
         vals = arrays[i]
         i += 1
         nulls = None
         if hn:
             nulls = arrays[i]
             i += 1
-        cols.append(Column(t, vals, nulls, d, vr))
+        cols.append(Column(t, vals, nulls, d, vr, a))
     sel = arrays[i] if spec.has_sel else None
-    return Page(cols, sel)
+    return Page(cols, sel, live_prefix=spec.live_prefix)
