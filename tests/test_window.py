@@ -191,3 +191,62 @@ def test_window_keywords_stay_identifiers(session):
     assert s.execute(
         'select row, rows, range, current from memory.t.kwcols'
     ).rows == [(1, 2, 3, 4)]
+
+
+def test_ntile_percent_rank_cume_dist(session, oracle):
+    check(
+        session, oracle,
+        """
+        select id,
+               ntile(4) over (partition by dept order by salary, id),
+               percent_rank() over (partition by dept order by salary, id),
+               cume_dist() over (partition by dept order by salary, id)
+        from memory.t.emp order by id
+        """,
+    )
+
+
+def test_rows_offset_frames_rolling_sum(session, oracle):
+    """TPC-DS q51-style rolling window: <n> PRECEDING ROWS frames."""
+    check(
+        session, oracle,
+        """
+        select id,
+               sum(salary) over (partition by dept order by id
+                                 rows between 3 preceding and current row),
+               sum(salary) over (partition by dept order by id
+                                 rows between 2 preceding and 2 following),
+               count(bonus) over (partition by dept order by id
+                                  rows between 1 preceding and 1 following),
+               avg(salary) over (partition by dept order by id
+                                 rows between 3 preceding and 1 preceding)
+        from memory.t.emp order by id
+        """,
+    )
+
+
+def test_rows_offset_unbounded_following(session, oracle):
+    check(
+        session, oracle,
+        """
+        select id,
+               sum(salary) over (partition by dept order by id
+                                 rows between current row and unbounded following)
+        from memory.t.emp order by id
+        """,
+    )
+
+
+def test_nth_value_and_frames(session, oracle):
+    check(
+        session, oracle,
+        """
+        select id,
+               nth_value(salary, 3) over (partition by dept order by salary, id
+                                          rows between unbounded preceding
+                                          and unbounded following),
+               first_value(salary) over (partition by dept order by id
+                                         rows between 2 preceding and current row)
+        from memory.t.emp order by id
+        """,
+    )

@@ -862,38 +862,52 @@ class Executor:
                 else None
             )
             fn = call.function
+            flo, fhi = call.frame_lo, call.frame_hi
             if fn == "row_number":
                 v, valid = win_ops.row_number(layout)
             elif fn == "rank":
                 v, valid = win_ops.rank(layout)
             elif fn == "dense_rank":
                 v, valid = win_ops.dense_rank(layout)
+            elif fn == "ntile":
+                v, valid = win_ops.ntile(layout, call.offset)
+            elif fn == "percent_rank":
+                v, valid = win_ops.percent_rank(layout)
+            elif fn == "cume_dist":
+                v, valid = win_ops.cume_dist(layout)
             elif fn == "sum":
-                v, valid = win_ops.agg_sum(layout, arg, call.frame, call.output_type.np_dtype)
+                v, valid = win_ops.agg_sum(
+                    layout, arg, call.frame, call.output_type.np_dtype, flo, fhi)
             elif fn == "avg":
                 s, s_valid = win_ops.agg_sum(
                     layout, arg, call.frame,
                     call.output_type.np_dtype if call.output_type.is_decimal
                     else np.dtype(np.float64),
+                    flo, fhi,
                 )
-                cnt, _ = win_ops.agg_count(layout, arg, call.frame)
+                cnt, _ = win_ops.agg_count(layout, arg, call.frame, flo, fhi)
                 v, dvalid = agg_ops.finish_avg(s, cnt, call.output_type)
                 valid = s_valid if dvalid is None else (
                     dvalid if s_valid is None else (s_valid & dvalid)
                 )
             elif fn in ("count", "count_star"):
-                v, valid = win_ops.agg_count(layout, arg, call.frame)
+                v, valid = win_ops.agg_count(layout, arg, call.frame, flo, fhi)
             elif fn in ("min", "max"):
                 v, valid = win_ops.agg_minmax(layout, arg, call.frame, fn == "min")
             elif fn in ("lag", "lead"):
                 v, valid = win_ops.shifted_value(layout, arg, call.offset, fn == "lead")
+            elif fn == "nth_value":
+                v, valid = win_ops.nth_value(
+                    layout, arg, call.offset, call.frame, flo, fhi)
             elif fn in ("first_value", "last_value"):
-                v, valid = win_ops.edge_value(layout, arg, call.frame, fn == "first_value")
+                v, valid = win_ops.edge_value(
+                    layout, arg, call.frame, fn == "first_value", flo, fhi)
             else:
                 raise NotImplementedError(f"window function {fn}")
             # value-carrying functions keep the source column's dictionary
             dictionary = None
-            if fn in ("min", "max", "lag", "lead", "first_value", "last_value"):
+            if fn in ("min", "max", "lag", "lead", "first_value", "last_value",
+                      "nth_value"):
                 dictionary = page.columns[call.arg_channel].dictionary
             out_cols.append(
                 Column(call.output_type, v, None if valid is None else ~valid, dictionary)

@@ -125,18 +125,28 @@ def dense_rank(layout: WindowLayout) -> Lowered:
     return _to_orig(layout, v)
 
 
-def _frame_bounds(layout: WindowLayout, frame: str):
-    """[lo, hi) sorted-slot range per row for the supported frames."""
+def _frame_bounds(layout: WindowLayout, frame: str,
+                  frame_lo=None, frame_hi=None):
+    """[lo, hi) sorted-slot range per row for the supported frames.
+    ``rows_offset``: numeric ROWS bounds relative to the current row
+    (reference: window/FrameInfo), clamped to the partition."""
     idx = jnp.arange(layout.n, dtype=jnp.int32)
     if frame == "partition":
         return layout.part_start, layout.part_end
     if frame == "rows_running":
         return layout.part_start, idx + 1
+    if frame == "rows_offset":
+        lo = layout.part_start if frame_lo is None else jnp.maximum(
+            layout.part_start, idx + jnp.int32(frame_lo))
+        hi = layout.part_end if frame_hi is None else jnp.minimum(
+            layout.part_end, idx + jnp.int32(frame_hi) + 1)
+        return lo, jnp.maximum(hi, lo)  # empty frame -> hi == lo
     # default 'running': RANGE UNBOUNDED PRECEDING..CURRENT ROW = peers incl.
     return layout.part_start, layout.peer_end
 
 
-def agg_sum(layout: WindowLayout, arg: Lowered, frame: str, out_dtype) -> Lowered:
+def agg_sum(layout: WindowLayout, arg: Lowered, frame: str, out_dtype,
+            frame_lo=None, frame_hi=None) -> Lowered:
     vals, valid = arg
     x = vals[layout.order].astype(out_dtype)
     m = valid[layout.order] if valid is not None else None
@@ -144,14 +154,15 @@ def agg_sum(layout: WindowLayout, arg: Lowered, frame: str, out_dtype) -> Lowere
         x = jnp.where(m, x, jnp.zeros((), out_dtype))
     c = jnp.cumsum(x)
     c0 = jnp.concatenate([jnp.zeros((1,), c.dtype), c])
-    lo, hi = _frame_bounds(layout, frame)
+    lo, hi = _frame_bounds(layout, frame, frame_lo, frame_hi)
     s = c0[hi] - c0[lo]
     cnt = _count_in_frame(layout, m, lo, hi)
     return _to_orig(layout, s, cnt > 0)
 
 
-def agg_count(layout: WindowLayout, arg: Optional[Lowered], frame: str) -> Lowered:
-    lo, hi = _frame_bounds(layout, frame)
+def agg_count(layout: WindowLayout, arg: Optional[Lowered], frame: str,
+              frame_lo=None, frame_hi=None) -> Lowered:
+    lo, hi = _frame_bounds(layout, frame, frame_lo, frame_hi)
     if arg is None or arg[1] is None:
         return _to_orig(layout, (hi - lo).astype(jnp.int64))
     m = arg[1][layout.order]
@@ -203,16 +214,65 @@ def shifted_value(layout: WindowLayout, arg: Lowered, offset: int, lead: bool) -
     return _to_orig(layout, v, ok)
 
 
-def edge_value(layout: WindowLayout, arg: Lowered, frame: str, first: bool) -> Lowered:
+def edge_value(layout: WindowLayout, arg: Lowered, frame: str, first: bool,
+               frame_lo=None, frame_hi=None) -> Lowered:
     """first_value / last_value over the frame (default frame: last_value is
     the current peer run's end — the SQL footgun, faithfully)."""
     vals, valid = arg
     xs = vals[layout.order]
     vs = valid[layout.order] if valid is not None else None
-    lo, hi = _frame_bounds(layout, frame)
+    lo, hi = _frame_bounds(layout, frame, frame_lo, frame_hi)
     pos = lo if first else jnp.clip(hi - 1, 0, layout.n - 1)
     v = xs[pos]
     ok = None if vs is None else vs[pos]
     nonempty = hi > lo
     ok = nonempty if ok is None else (ok & nonempty)
     return _to_orig(layout, v, ok)
+
+
+def nth_value(layout: WindowLayout, arg: Lowered, nth: int, frame: str,
+              frame_lo=None, frame_hi=None) -> Lowered:
+    """nth_value(x, n): the frame's n-th row's value (NULL past the end)."""
+    vals, valid = arg
+    xs = vals[layout.order]
+    vs = valid[layout.order] if valid is not None else None
+    lo, hi = _frame_bounds(layout, frame, frame_lo, frame_hi)
+    pos = lo + jnp.int32(nth - 1)
+    inside = pos < hi
+    pos = jnp.clip(pos, 0, layout.n - 1)
+    v = xs[pos]
+    ok = inside if vs is None else (inside & vs[pos])
+    return _to_orig(layout, v, ok)
+
+
+def ntile(layout: WindowLayout, buckets: int) -> Lowered:
+    """ntile(k): partition rows into k buckets, earlier buckets one larger
+    when sizes don't divide (reference: window/NTileFunction)."""
+    idx = jnp.arange(layout.n, dtype=jnp.int64)
+    rn0 = idx - layout.part_start  # 0-based row number
+    size = (layout.part_end - layout.part_start).astype(jnp.int64)
+    k = jnp.int64(buckets)
+    q = size // k
+    r = size % k
+    big_rows = r * (q + 1)  # rows covered by the (q+1)-sized buckets
+    tile = jnp.where(
+        rn0 < big_rows,
+        rn0 // jnp.maximum(q + 1, 1),
+        r + (rn0 - big_rows) // jnp.maximum(q, 1),
+    )
+    return _to_orig(layout, tile + 1)
+
+
+def percent_rank(layout: WindowLayout) -> Lowered:
+    """(rank - 1) / (partition size - 1); 0 for single-row partitions."""
+    rk = (layout.peer_start - layout.part_start).astype(jnp.float64)
+    size = (layout.part_end - layout.part_start).astype(jnp.float64)
+    v = jnp.where(size > 1, rk / jnp.maximum(size - 1.0, 1.0), 0.0)
+    return _to_orig(layout, v)
+
+
+def cume_dist(layout: WindowLayout) -> Lowered:
+    """rows at-or-before the current peer group / partition size."""
+    covered = (layout.peer_end - layout.part_start).astype(jnp.float64)
+    size = (layout.part_end - layout.part_start).astype(jnp.float64)
+    return _to_orig(layout, covered / jnp.maximum(size, 1.0))

@@ -450,6 +450,7 @@ def find_aggregates(e: ast.Expression) -> List[ast.FunctionCall]:
 WINDOW_ONLY_FUNCTIONS = {
     "rank", "dense_rank", "row_number", "lag", "lead",
     "first_value", "last_value",
+    "ntile", "percent_rank", "cume_dist", "nth_value",
 }
 
 
@@ -477,9 +478,11 @@ def find_windows(e: ast.Expression) -> List[ast.WindowFunction]:
 
 def window_result_type(fn: str, arg: Optional[T.Type]) -> T.Type:
     """Reference: window function signatures (window/ + ranking fns)."""
-    if fn in ("rank", "dense_rank", "row_number"):
+    if fn in ("rank", "dense_rank", "row_number", "ntile"):
         return T.BIGINT
-    if fn in ("lag", "lead", "first_value", "last_value"):
+    if fn in ("percent_rank", "cume_dist"):
+        return T.DOUBLE
+    if fn in ("lag", "lead", "first_value", "last_value", "nth_value"):
         assert arg is not None
         return arg
     return aggregate_result_type(fn, arg)

@@ -290,6 +290,11 @@ class WindowCall:
     # 'partition': whole partition (default without ORDER BY / UNBOUNDED
     # PRECEDING..UNBOUNDED FOLLOWING)
     frame: str = "running"
+    # ROWS-frame numeric bounds relative to the current row (frame ==
+    # 'rows_offset'): lo = -n for "n PRECEDING", hi = +m for "m FOLLOWING",
+    # 0 = CURRENT ROW, None = unbounded on that side
+    frame_lo: Optional[int] = None
+    frame_hi: Optional[int] = None
 
 
 @dataclasses.dataclass

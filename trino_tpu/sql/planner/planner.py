@@ -716,13 +716,43 @@ class Planner:
         fn = w.name
         if fn not in WINDOW_ONLY_FUNCTIONS and fn not in AGGREGATE_FUNCTIONS:
             raise PlanningError(f"unknown window function {fn}")
-        frame = self._window_frame(w, has_order)
-        if fn in ("rank", "dense_rank", "row_number"):
+        frame, flo, fhi = self._window_frame(w, has_order)
+
+        def call(*args, **kw):
+            kw.setdefault("frame", frame)
+            kw.setdefault("frame_lo", flo)
+            kw.setdefault("frame_hi", fhi)
+            return P.WindowCall(*args, **kw)
+
+        if fn in ("rank", "dense_rank", "row_number", "percent_rank",
+                  "cume_dist"):
             if not has_order:
                 raise PlanningError(f"{fn}() requires window ORDER BY")
             if w.args:
                 raise PlanningError(f"{fn}() takes no arguments")
-            return P.WindowCall(fn, None, window_result_type(fn, None), frame=frame)
+            return call(fn, None, window_result_type(fn, None))
+        if fn == "ntile":
+            if not has_order:
+                raise PlanningError("ntile() requires window ORDER BY")
+            if len(w.args) != 1 or not (
+                isinstance(w.args[0], ast.Literal) and w.args[0].kind == "number"
+            ):
+                raise PlanningError("ntile(n) requires a literal bucket count")
+            k = int(w.args[0].value)
+            if k < 1:
+                raise PlanningError("ntile() bucket count must be positive")
+            return call(fn, None, window_result_type(fn, None), offset=k)
+        if fn == "nth_value":
+            if len(w.args) != 2 or not (
+                isinstance(w.args[1], ast.Literal) and w.args[1].kind == "number"
+            ):
+                raise PlanningError("nth_value(value, n) with literal n supported")
+            nth = int(w.args[1].value)
+            if nth < 1:
+                raise PlanningError("nth_value() offset must be positive")
+            arg = analyzer.analyze(w.args[0])
+            ch = add_input(arg, "a")
+            return call(fn, ch, window_result_type(fn, arg.type), offset=nth)
         if fn in ("lag", "lead"):
             if not has_order:
                 raise PlanningError(f"{fn}() requires window ORDER BY")
@@ -736,16 +766,16 @@ class Planner:
                 offset = int(off.value)
             arg = analyzer.analyze(w.args[0])
             ch = add_input(arg, "a")
-            return P.WindowCall(fn, ch, window_result_type(fn, arg.type), offset=offset, frame=frame)
+            return call(fn, ch, window_result_type(fn, arg.type), offset=offset)
         if fn in ("first_value", "last_value"):
             if len(w.args) != 1:
                 raise PlanningError(f"{fn}(value) expects 1 argument")
             arg = analyzer.analyze(w.args[0])
             ch = add_input(arg, "a")
-            return P.WindowCall(fn, ch, window_result_type(fn, arg.type), frame=frame)
+            return call(fn, ch, window_result_type(fn, arg.type))
         # aggregates over the window
         if w.is_star or (fn == "count" and not w.args):
-            return P.WindowCall("count", None, T.BIGINT, frame=frame)
+            return call("count", None, T.BIGINT)
         if len(w.args) != 1:
             raise PlanningError(f"{fn} window aggregate expects 1 argument")
         if fn in ("min", "max") and frame != "partition":
@@ -755,7 +785,7 @@ class Planner:
             )
         arg = analyzer.analyze(w.args[0])
         ch = add_input(arg, "a")
-        return P.WindowCall(fn, ch, window_result_type(fn, arg.type), frame=frame)
+        return call(fn, ch, window_result_type(fn, arg.type))
 
     def _append_order_by_windows(self, query, spec, select_irs, names, replacements):
         """Windows appearing only in ORDER BY get hidden projection channels
@@ -825,14 +855,35 @@ class Planner:
         )
 
     @staticmethod
-    def _window_frame(w: ast.WindowFunction, has_order: bool) -> str:
+    def _window_frame(w: ast.WindowFunction, has_order: bool):
+        """-> (frame kind, rows lo offset, rows hi offset). Offsets are
+        None except for 'rows_offset' (ROWS frames with numeric bounds —
+        reference: window/FrameInfo; RANGE value offsets are not yet
+        lowered)."""
         if w.frame is None:
-            return "running" if has_order else "partition"
+            return ("running" if has_order else "partition"), None, None
         mode, lo, hi = w.frame
+
+        def bound(s, is_lo):
+            if s == "unbounded preceding":
+                return None if is_lo else PlanningError
+            if s == "unbounded following":
+                return PlanningError if is_lo else None
+            if s == "current row":
+                return 0
+            n, kind = s.split()
+            return -int(n) if kind == "preceding" else int(n)
+
         if lo == "unbounded preceding" and hi == "unbounded following":
-            return "partition"
+            return "partition", None, None
         if lo == "unbounded preceding" and hi == "current row":
-            return "rows_running" if mode == "rows" else "running"
+            return ("rows_running" if mode == "rows" else "running"), None, None
+        if mode == "rows":
+            blo, bhi = bound(lo, True), bound(hi, False)
+            if blo is not PlanningError and bhi is not PlanningError:
+                if blo is not None and bhi is not None and blo > bhi:
+                    raise PlanningError(f"empty window frame {w.frame}")
+                return "rows_offset", blo, bhi
         raise PlanningError(f"unsupported window frame {w.frame}")
 
     # ---------------------------------------------------------- aggregation
