@@ -72,3 +72,39 @@ def test_approx_percentile_nulls_excluded(session):
     out = session2.execute(
         "select g, approx_percentile(v, 0.5) from memory.default.px group by g order by g")
     assert out.rows == [(1, 10), (2, None)]
+
+
+def test_approx_percentile_splits_partial_final():
+    """VERDICT r3 item 9: approx_percentile ships a mergeable quantile
+    summary (ops/hll.py percentile_states) instead of forcing raw-row
+    gathers when distributed."""
+    from trino_tpu.sql.planner import plan as P
+
+    call = P.AggregateCall("approx_percentile", 0, None, param=0.5)
+    assert P.can_split_aggs([call])
+    assert P._acc_state_count(call) == 66  # QUANTILE_SAMPLES + count
+
+
+def test_distributed_approx_percentile_within_1pct(session):
+    """8-device split execution merges shard summaries to within 1% of the
+    exact percentile (the single-step path reads it exactly)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.parallel.spmd import DistributedQuery
+
+    sql = """
+        select l_returnflag, approx_percentile(l_extendedprice, 0.5)
+        from lineitem group by l_returnflag order by l_returnflag
+    """
+    mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+    dist = DistributedQuery.build(session, plan_sql(session, sql), mesh).run().to_pylist()
+    exact = session.execute("""
+        select l_returnflag, approx_percentile(l_extendedprice, 0.5)
+        from lineitem group by l_returnflag order by l_returnflag""").rows
+    assert len(dist) == len(exact) == 3
+    for (df, dv), (ef, ev) in zip(dist, exact):
+        assert df == ef
+        assert abs(float(dv) - float(ev)) / float(ev) < 0.01, (dv, ev)

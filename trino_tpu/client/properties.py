@@ -57,6 +57,13 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 10_000, _positive,
         ),
         PropertyMetadata(
+            "join_max_broadcast_rows",
+            "estimated build-side rows above which a distributed join "
+            "co-partitions both sides by key hash instead of broadcasting "
+            "the build (reference: join_max_broadcast_table_size)",
+            int, 1 << 17, _positive,
+        ),
+        PropertyMetadata(
             "sink_max_buffer_bytes",
             "producer-blocking watermark of a task's output buffer "
             "(reference: sink.max-buffer-size) — the streaming flow-control "

@@ -547,6 +547,13 @@ class Executor:
                 layout, arg, sel, t.scale if t.is_decimal else 0
             )
             return [(cnt, None), (mean, None), (m2, None)]
+        if call.function == "approx_percentile":
+            from trino_tpu.ops import hll
+
+            vals_l, valid_l = arg
+            m_l = valid_l if sel is None else (
+                sel if valid_l is None else (valid_l & sel))
+            return hll.percentile_states(layout, vals_l, m_l)
         raise NotImplementedError(call.function)
 
     def _combine_state(self, call: P.AggregateCall, states, sel, layout) -> Column:
@@ -584,6 +591,16 @@ class Executor:
                 layout, cnt_i, states[1][0], states[2][0], m
             )
             v, valid = agg_ops.finish_var(cnt, mean, m2, call.function)
+            return Column(call.output_type, v, None if valid is None else ~valid, None)
+        if call.function == "approx_percentile":
+            from trino_tpu.ops import hll
+
+            cnt_state = states[-1]
+            if sel is not None:
+                cv, cm = cnt_state
+                cnt_state = (jnp.where(sel, cv, jnp.zeros((), cv.dtype)), cm)
+            v, valid = hll.percentile_merge(
+                layout, states[:-1], cnt_state, call.param)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         raise NotImplementedError(call.function)
 

@@ -152,6 +152,83 @@ def approx_percentile(
     return out, cnt > 0
 
 
+# Mergeable quantile-summary width: 64 rank intervals -> worst-case rank
+# error ~1/(2*64) < 1% after merging (reference role: the mergeable
+# t-digest/qdigest of ApproximatePercentileAggregations — here an
+# equal-rank sample summary, the natural fixed-shape formulation).
+QUANTILE_SAMPLES = 65
+
+
+def percentile_states(layout: seg.GroupLayout, vals_l, m_l):
+    """Partial approx_percentile state: per group, QUANTILE_SAMPLES local
+    values at evenly spaced ranks + the live count. All static shapes: one
+    (gid, value) sort + one [capacity, SAMPLES] bounded gather."""
+    if jnp.issubdtype(vals_l.dtype, jnp.floating):
+        sentinel = jnp.asarray(jnp.inf, vals_l.dtype)
+    else:
+        sentinel = jnp.asarray(jnp.iinfo(vals_l.dtype).max, vals_l.dtype)
+    x = vals_l if m_l is None else jnp.where(m_l, vals_l, sentinel)
+    if layout.is_direct:
+        _, x_by_group = jax.lax.sort((layout.gids, x), num_keys=2)
+        starts, cnt = _direct_ranges(layout, m_l)
+    else:
+        _, x_by_group = jax.lax.sort((layout.gid_sorted, x), num_keys=2)
+        starts = layout.starts
+        cnt = seg.seg_count(layout, m_l)
+    nn = x_by_group.shape[0]
+    j = jnp.arange(QUANTILE_SAMPLES, dtype=jnp.float64) / (QUANTILE_SAMPLES - 1)
+    ranks = jnp.round(
+        j[None, :] * jnp.maximum(cnt - 1, 0).astype(jnp.float64)[:, None]
+    ).astype(jnp.int64)
+    pos = jnp.clip(starts.astype(jnp.int64)[:, None] + ranks, 0, max(nn - 1, 0))
+    samples = x_by_group[pos]  # [capacity, SAMPLES]
+    live = cnt > 0
+    out = [(samples[:, k], live) for k in range(QUANTILE_SAMPLES)]
+    out.append((cnt, None))
+    return out
+
+
+def percentile_merge(layout: seg.GroupLayout, samples, cnt_state, p: float):
+    """Final approx_percentile: weighted quantile over every shard's
+    summary. Each partial row expands to its SAMPLES values weighted
+    count/SAMPLES; one (gid, value) sort + a cumulative-weight rank pick
+    per group slot. ``samples``/``cnt_state`` are layout-space payloads of
+    the final grouping (small arrays: shards x groups rows)."""
+    S = len(samples)
+    cnt_l, _ = cnt_state
+    n_l = cnt_l.shape[0]
+    vals = jnp.stack([v for v, _ in samples], axis=1)  # [n_l, S]
+    valid0 = samples[0][1]
+    live_row = cnt_l > 0
+    if valid0 is not None:
+        live_row = live_row & valid0
+    w_row = jnp.where(live_row, cnt_l.astype(jnp.float64) / S, 0.0)
+    if layout.is_direct:
+        gid_l = layout.gids
+        starts_l, _cnt = _direct_ranges(layout, None)
+        ends_l = starts_l.astype(jnp.int64) + seg.seg_count(layout, None)
+    else:
+        gid_l = layout.gid_sorted
+        starts_l = layout.starts
+        ends_l = layout.ends
+    gid2 = jnp.repeat(gid_l, S)
+    x2 = vals.reshape(-1)
+    w2 = jnp.repeat(w_row, S)
+    _, x_s, w_s = jax.lax.sort((gid2, x2, w2), num_keys=2, is_stable=True)
+    c = jnp.cumsum(w_s)
+    c0 = jnp.concatenate([jnp.zeros((1,), c.dtype), c])
+    e_start = starts_l.astype(jnp.int64) * S
+    e_end = ends_l.astype(jnp.int64) * S
+    w_group = c0[e_end] - c0[e_start]
+    # lower weighted percentile: first sample whose cumulative weight
+    # reaches p * W (reduces to the nearest-rank pick for equal weights)
+    target = c0[e_start] + p * w_group
+    pos = jnp.searchsorted(c, target, side="left")
+    pos = jnp.clip(pos, e_start, jnp.maximum(e_end - 1, e_start))
+    out = x_s[jnp.clip(pos, 0, max(x_s.shape[0] - 1, 0))]
+    return out, w_group > 0
+
+
 def _direct_ranges(layout: seg.GroupLayout, m_l):
     """(starts, live counts) per slot for a direct layout, derived from the
     per-slot counts (rows sort group-contiguous by gid)."""

@@ -209,13 +209,21 @@ class QueryExecution:
                     "TRINO_TPU_SPOOL_DIR to a cluster-shared directory")
         for frag in fragments:
             if frag.partitioning == "hash":
-                # one FINAL task per key partition (reference: the
-                # hash-distributed intermediate stage): task i pulls
-                # buffer/partition i from every upstream producer
-                self.fragment_tasks[frag.id] = [
-                    self._create_task(frag, wi, 0, {}, workers[wi], consumer_counts)
-                    for wi in range(len(workers))
-                ]
+                # one task per key partition (hash-distributed final
+                # aggregations and co-partitioned joins): task i pulls
+                # buffer/partition i from every upstream producer. Under
+                # FTE these tasks retry like source tasks — their inputs
+                # are durable per-partition spool files.
+                if fte:
+                    self.fragment_tasks[frag.id] = self._run_fragment_fte(
+                        frag, [dict() for _ in workers], workers,
+                        consumer_counts)
+                else:
+                    self.fragment_tasks[frag.id] = [
+                        self._create_task(frag, wi, 0, {}, workers[wi],
+                                          consumer_counts)
+                        for wi in range(len(workers))
+                    ]
                 continue
             if frag.partitioning != "source":
                 continue

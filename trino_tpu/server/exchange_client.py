@@ -98,7 +98,11 @@ class ExchangeClient:
         spool_dir = spool_directory()
         if not spool_dir:
             return False
-        path = os.path.join(spool_dir, f"{loc.task_id}.pages")
+        # partitioned producers spool one file per partition (= buffer id)
+        path = os.path.join(
+            spool_dir, f"{loc.task_id}.p{loc.buffer_id}.pages")
+        if not os.path.exists(path):
+            path = os.path.join(spool_dir, f"{loc.task_id}.pages")
         if not os.path.exists(path):
             return False
         with open(path, "rb") as f:

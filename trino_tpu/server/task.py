@@ -146,18 +146,26 @@ class SqlTask:
                 # hash-partitioned shuffle producer: split the output by
                 # key hash (same splitmix64 combine as the device exchange,
                 # so every producer places a key identically) and enqueue
-                # each partition into its consumer's stream
+                # each partition into its consumer's stream. Under FTE the
+                # per-partition streams spool FIRST (durability before
+                # visibility — retried consumers re-read partition files).
                 from trino_tpu.exec.memory import partition_page_host
 
-                pid = _canonical_partition_ids(
+                pids = _canonical_partition_ids(
                     page, req.output_partition_channels, req.consumer_count)
                 parts = partition_page_host(
                     page, req.output_partition_channels, req.consumer_count,
-                    pid=pid)
-                for pid, part in enumerate(parts):
-                    part = part.compact()
-                    for c in _chunk_pages(part, chunk_rows):
-                        self.output.enqueue_partition(pid, serialize_page(c))
+                    pid=pids)
+                part_frames = [
+                    [serialize_page(c)
+                     for c in _chunk_pages(part.compact(), chunk_rows)]
+                    for part in parts
+                ]
+                if spool_directory():
+                    self._spool_partitioned(part_frames)
+                for pid, frames in enumerate(part_frames):
+                    for pb in frames:
+                        self.output.enqueue_partition(pid, pb)
                 self.output.set_complete()
                 self.state.set("FINISHED")
                 return
@@ -194,6 +202,26 @@ class SqlTask:
         target = int(self.request.session_properties.get(
             "task_output_chunk_bytes") or self.DEFAULT_CHUNK_BYTES)
         return max(1, target // page.row_byte_estimate()) if page.num_rows else 1
+
+    def _spool_partitioned(self, part_frames) -> None:
+        """Spool each partition stream to its own durable file
+        ({task}.p{pid}.pages) — the FTE contract for hash-distributed
+        stages (reference: FileSystemExchange sink files per partition)."""
+        spool_dir = spool_directory()
+        if not spool_dir:
+            return
+        import os
+
+        from trino_tpu.server import wire
+
+        os.makedirs(spool_dir, exist_ok=True)
+        for pid, frames in enumerate(part_frames):
+            path = os.path.join(
+                spool_dir, f"{self.request.task_id}.p{pid}.pages")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(wire.frame_pages(frames))
+            os.replace(tmp, path)
 
     def _spool(self, page_frames) -> None:
         """Persist the task's output to the shared spool directory

@@ -65,16 +65,13 @@ class PlanFragment:
 def _hash_distributed_final(session, node: P.AggregationNode) -> bool:
     """Hash-distribute the FINAL aggregation stage when the group space is
     too big to gather into one process (threshold: the same
-    gather_max_rows_per_device session property the SPMD tier uses) and
-    the retry policy allows it (spooling of partitioned outputs is not
-    implemented, so FTE keeps the gather path)."""
+    gather_max_rows_per_device session property the SPMD tier uses).
+    Partitioned outputs spool per partition (server/task.py), so the FTE
+    retry policy no longer forces the gather path."""
     if session is None or not node.group_channels:
         return False
     from trino_tpu.sql.planner import stats
 
-    props = getattr(session, "properties", None) or {}
-    if str(props.get("retry_policy", "NONE")).upper() == "TASK":
-        return False
     rows = stats.estimate_rows(session, node.source)
     return rows > stats._gather_max_rows(session)
 
@@ -159,6 +156,41 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
         if isinstance(node, P.JoinNode):
             left, lrep = cut(node.left, fragments)
             right, rrep = cut(node.right, fragments)
+            if (session is not None and not lrep and not rrep
+                    and node.left_keys and node.join_type in ("inner", "semi",
+                                                              "anti", "left")):
+                from trino_tpu.sql.planner import stats
+
+                if stats.join_repartitions(session, node, 1):
+                    # co-partitioned join (FIXED_HASH_DISTRIBUTION both
+                    # sides): probe and build tasks partition their output
+                    # pages by key hash; hash-stage task p joins partition
+                    # p of each side locally — equal keys co-locate, so the
+                    # union of per-partition joins is the exact join and NO
+                    # process ever materializes a whole side (reference:
+                    # PagePartitioner.java:134-149 + partitioned join
+                    # distribution).
+                    lfid = next(_frag_ids)
+                    fragments.append(PlanFragment(
+                        lfid, "source", left,
+                        output_partition_channels=list(node.left_keys)))
+                    rfid = next(_frag_ids)
+                    fragments.append(PlanFragment(
+                        rfid, "source", right,
+                        output_partition_channels=list(node.right_keys)))
+                    node.left = RemoteSourceNode(
+                        fragment_id=lfid, types=left.output_types,
+                        names=left.output_names, exchange_type="partitioned")
+                    node.right = RemoteSourceNode(
+                        fragment_id=rfid, types=right.output_types,
+                        names=right.output_names, exchange_type="partitioned")
+                    node.distribution = "partitioned"
+                    jfid = next(_frag_ids)
+                    fragments.append(PlanFragment(jfid, "hash", node))
+                    return RemoteSourceNode(
+                        fragment_id=jfid, types=node.output_types,
+                        names=node.output_names, exchange_type="gather",
+                    ), True
             node.left = left
             if not rrep:
                 # build side broadcast: its own source fragment

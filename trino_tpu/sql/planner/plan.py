@@ -210,6 +210,12 @@ def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
         out = [T.BIGINT, T.DOUBLE, T.DOUBLE]
     elif agg.function in ("min", "max", "sum"):
         out = [agg.output_type if agg.function == "sum" else src_types[agg.arg_channel]]
+    elif agg.function == "approx_percentile":
+        # mergeable quantile summary (ops/hll.py QUANTILE_SAMPLES values at
+        # evenly spaced local ranks) + the live count
+        from trino_tpu.ops.hll import QUANTILE_SAMPLES
+
+        out = [src_types[agg.arg_channel]] * QUANTILE_SAMPLES + [T.BIGINT]
     else:
         raise NotImplementedError(agg.function)
     assert len(out) == _acc_state_count(agg)
@@ -221,15 +227,17 @@ _VAR_FAMILY = {"stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "v
 
 def can_split_aggs(aggregates) -> bool:
     """True when every aggregate has a mergeable partial/final state.
-    DISTINCT aggregates and approx_percentile (whose per-group percentile
-    is not a combination of shard percentiles) must see all raw rows."""
-    return not any(
-        a.distinct or a.function == "approx_percentile" for a in aggregates
-    )
+    DISTINCT aggregates must see all raw rows; approx_percentile ships a
+    mergeable quantile summary (ops/hll.py percentile_states)."""
+    return not any(a.distinct for a in aggregates)
 
 
 def _acc_state_count(agg: AggregateCall) -> int:
     """Number of accumulator state columns an aggregate ships partial->final."""
+    if agg.function == "approx_percentile":
+        from trino_tpu.ops.hll import QUANTILE_SAMPLES
+
+        return QUANTILE_SAMPLES + 1
     if agg.function in _VAR_FAMILY:
         return 3
     return 2 if agg.function == "avg" else 1

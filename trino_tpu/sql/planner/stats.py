@@ -136,11 +136,21 @@ def agg_repartitions(session, node: P.AggregationNode, n_devices: int) -> bool:
 
 def join_repartitions(session, node: P.JoinNode, n_devices: int) -> bool:
     """True when a distributed join should co-partition both sides by key
-    hash instead of broadcasting the build side."""
+    hash instead of broadcasting the build side (session property
+    join_max_broadcast_rows; reference: join_max_broadcast_table_size)."""
     if not node.left_keys:
         return False  # cross join: broadcast is the only option
+    from trino_tpu.client.properties import SYSTEM_SESSION_PROPERTIES
+
+    declared = SYSTEM_SESSION_PROPERTIES["join_max_broadcast_rows"].default
+    props = getattr(session, "properties", None) or {}
+    limit = int(props.get("join_max_broadcast_rows", declared))
+    if limit == declared:
+        # sessions materialize every default, so an untouched property
+        # defers to the module constant (which tests tune directly)
+        limit = BROADCAST_BUILD_MAX
     build = estimate_rows(session, node.right)
-    return build > BROADCAST_BUILD_MAX
+    return build > limit
 
 
 def _gather_max_rows(session) -> int:
