@@ -30,12 +30,7 @@ class MemoryConnector(spi.Connector):
         for i, (cname, ctype) in enumerate(schema_def):
             pycol = [r[i] for r in rows]
             col = Column.from_python(ctype, pycol)
-            cols[cname] = spi.ColumnData(
-                ctype,
-                np.asarray(col.values),
-                np.asarray(col.nulls) if col.nulls is not None else None,
-                col.dictionary,
-            )
+            cols[cname] = spi.column_data_from_column(col)
         meta = spi.TableMetadata(
             schema, name, [spi.ColumnMetadata(n, t) for n, t in schema_def]
         )
@@ -55,12 +50,7 @@ class MemoryConnector(spi.Connector):
         for i, cm in enumerate(meta.columns):
             pycol = [r[i] for r in rows]
             col = Column.from_python(cm.type, pycol)
-            new = spi.ColumnData(
-                cm.type,
-                np.asarray(col.values),
-                np.asarray(col.nulls) if col.nulls is not None else None,
-                col.dictionary,
-            )
+            new = spi.column_data_from_column(col)
             cols[cm.name] = spi.concat_column_data([cols[cm.name], new])
         return len(rows)
 
@@ -99,11 +89,5 @@ class MemoryConnector(spi.Connector):
         _, cols = self._tables[(split.schema, split.table)]
         out = {}
         for c in columns:
-            cd = cols[c]
-            out[c] = spi.ColumnData(
-                cd.type,
-                cd.values[split.lo : split.hi],
-                cd.nulls[split.lo : split.hi] if cd.nulls is not None else None,
-                cd.dictionary,
-            )
+            out[c] = spi.column_data_slice(cols[c], split.lo, split.hi)
         return out

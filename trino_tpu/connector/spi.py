@@ -90,6 +90,9 @@ class ColumnData:
     # generator keys and sorted file layouts declare it; the engine's
     # sorted-input fast paths (group/join without lax.sort) consume it
     sorted: bool = False
+    # nested (array/map/row) columns: values = per-row int32 lengths,
+    # children = flattened child columns (data/page.py Column.children)
+    children: Optional[List["ColumnData"]] = None
 
 
 def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
@@ -100,6 +103,24 @@ def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
     if len(cols) == 1:
         return cols[0]
     from trino_tpu.data.page import merge_vrange
+
+    if cols[0].children is not None:
+        # nested: lengths concatenate; flat children concatenate recursively
+        vals = np.concatenate([np.asarray(cd.values) for cd in cols])
+        nulls = (
+            np.concatenate([
+                np.asarray(cd.nulls) if cd.nulls is not None
+                else np.zeros(len(cd.values), bool)
+                for cd in cols
+            ])
+            if any(cd.nulls is not None for cd in cols)
+            else None
+        )
+        kids = [
+            concat_column_data([cd.children[i] for cd in cols])
+            for i in range(len(cols[0].children))
+        ]
+        return ColumnData(cols[0].type, vals, nulls, children=kids)
 
     vrange = cols[0].vrange
     for cd in cols[1:]:
@@ -142,6 +163,40 @@ def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
                 srt = False
                 break
     return ColumnData(cols[0].type, vals, nulls, d, vrange, srt)
+
+
+def column_data_from_column(col) -> ColumnData:
+    """data/page.py Column -> ColumnData (numpy views; recursive)."""
+    return ColumnData(
+        col.type,
+        np.asarray(col.values),
+        np.asarray(col.nulls) if col.nulls is not None else None,
+        col.dictionary,
+        col.vrange,
+        children=(
+            [column_data_from_column(k) for k in col.children]
+            if col.children is not None
+            else None
+        ),
+    )
+
+
+def column_data_slice(cd: ColumnData, lo: int, hi: int) -> ColumnData:
+    """Row-range slice [lo, hi) — offset-aware for nested columns (child
+    flats are sliced by the parent lengths' prefix sums)."""
+    nulls = cd.nulls[lo:hi] if cd.nulls is not None else None
+    if cd.children is None:
+        return ColumnData(cd.type, cd.values[lo:hi], nulls, cd.dictionary,
+                          cd.vrange, cd.sorted)
+    if cd.type.is_row:
+        kids = [column_data_slice(k, lo, hi) for k in cd.children]
+        return ColumnData(cd.type, cd.values[lo:hi], nulls, children=kids)
+    off = np.concatenate(
+        [np.zeros(1, np.int64), np.cumsum(np.asarray(cd.values, dtype=np.int64))]
+    )
+    clo, chi = int(off[lo]), int(off[hi])
+    kids = [column_data_slice(k, clo, chi) for k in cd.children]
+    return ColumnData(cd.type, cd.values[lo:hi], nulls, children=kids)
 
 
 class Connector:

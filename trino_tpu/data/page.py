@@ -52,13 +52,31 @@ class Column:
     # tables arrive sorted by their join key (reference: LocalProperties
     # driving e.g. streaming aggregations).
     ascending: bool = False
+    # Nested (array/map/row) columns: ``values`` holds per-row int32 element
+    # counts (rows for RowType ignore it) and ``children`` the flattened
+    # child columns — array: [elements], map: [keys, values], row: fields.
+    # Reference: spi/block/ArrayBlock.java / MapBlock.java (offsets + child
+    # blocks); lengths instead of offsets keep every row-parallel kernel
+    # (sel/null masks) shape-compatible with scalar columns.
+    children: Optional[List["Column"]] = None
 
     def __post_init__(self):
         if self.type.is_varchar and self.dictionary is None:
             raise ValueError("varchar column requires a dictionary")
+        if self.type.is_nested and self.children is None:
+            raise ValueError(f"nested column {self.type} requires children")
 
     def __len__(self) -> int:
         return int(self.values.shape[0])
+
+    def offsets(self) -> np.ndarray:
+        """Host-side int64 offsets[n+1] derived from the stored lengths.
+
+        Invariant: lengths always describe the flat child layout — a NULL
+        row may still own flat elements (produced by device kernels whose
+        null masks arrive after the fact); they are simply never read."""
+        lens = np.asarray(self.values, dtype=np.int64)
+        return np.concatenate([np.zeros(1, np.int64), np.cumsum(lens)])
 
     @classmethod
     def from_python(cls, typ: T.Type, data: Sequence) -> "Column":
@@ -74,6 +92,8 @@ class Column:
             d = Dictionary.build(data)
             codes = d.encode(list(data))
             return cls(typ, jnp.asarray(codes), nulls, d)
+        if typ.is_nested:
+            return cls._nested_from_python(typ, data, nulls)
         np_dtype = typ.np_dtype
         assert np_dtype is not None, f"unsupported type {typ}"
         fill = 0
@@ -82,8 +102,34 @@ class Column:
             arr = np.empty(0, dtype=np_dtype)
         return cls(typ, jnp.asarray(arr), nulls, None)
 
+    @classmethod
+    def _nested_from_python(cls, typ: T.Type, data: Sequence, nulls) -> "Column":
+        n = len(data)
+        if isinstance(typ, T.RowType):
+            kids = []
+            for i, ft in enumerate(typ.field_types):
+                kids.append(cls.from_python(ft, [None if r is None else r[i] for r in data]))
+            return cls(typ, jnp.zeros((n,), jnp.int8), nulls, None, children=kids)
+        if isinstance(typ, T.MapType):
+            rows = [[] if m is None else sorted(m.items(), key=lambda kv: str(kv[0])) for m in data]
+            lens = np.array([len(r) for r in rows], dtype=np.int32)
+            keys = [k for r in rows for k, _ in r]
+            vals = [v for r in rows for _, v in r]
+            kids = [cls.from_python(typ.key, keys), cls.from_python(typ.value, vals)]
+            return cls(typ, jnp.asarray(lens), nulls, None, children=kids)
+        assert isinstance(typ, T.ArrayType)
+        rows = [[] if a is None else list(a) for a in data]
+        lens = np.array([len(r) for r in rows], dtype=np.int32)
+        flat = [v for r in rows for v in r]
+        return cls(
+            typ, jnp.asarray(lens), nulls, None,
+            children=[cls.from_python(typ.element, flat)],
+        )
+
     def to_python(self) -> List:
         """Device -> host, decoding reprs back to Python values."""
+        if self.type.is_nested:
+            return self._nested_to_python()
         vals = np.asarray(self.values)
         nulls = np.asarray(self.nulls) if self.nulls is not None else None
         if self.type.is_varchar:
@@ -93,6 +139,27 @@ class Column:
                 out = [None if isnull else v for v, isnull in zip(out, nulls)]
             return out
         out = [_from_repr(self.type, v) for v in vals.tolist()]
+        if nulls is not None:
+            out = [None if isnull else v for v, isnull in zip(out, nulls)]
+        return out
+
+    def _nested_to_python(self) -> List:
+        nulls = np.asarray(self.nulls) if self.nulls is not None else None
+        if isinstance(self.type, T.RowType):
+            fields = [c.to_python() for c in self.children]
+            out = [tuple(f[i] for f in fields) for i in range(len(self))]
+        else:
+            off = self.offsets()
+            kids = [c.to_python() for c in self.children]
+            if isinstance(self.type, T.MapType):
+                keys, vals = kids
+                out = [
+                    dict(zip(keys[off[i] : off[i + 1]], vals[off[i] : off[i + 1]]))
+                    for i in range(len(self))
+                ]
+            else:
+                (flat,) = kids
+                out = [flat[off[i] : off[i + 1]] for i in range(len(self))]
         if nulls is not None:
             out = [None if isnull else v for v, isnull in zip(out, nulls)]
         return out
@@ -157,6 +224,79 @@ def _from_repr(typ: T.Type, r):
     return int(r)
 
 
+def _concat_col(ca: Column, cb: Column) -> Column:
+    va, vb = ca.values, cb.values
+    if ca.type.is_nested:
+        # lengths concatenate; children are flat, so their rows concatenate
+        # too (offsets re-derive from the combined lengths, which by the
+        # offsets() invariant describe the flat layout even for null rows).
+        kids = [_concat_col(ka, kb) for ka, kb in zip(ca.children, cb.children)]
+        vals = jnp.concatenate([va, vb])
+        nulls = None
+        if ca.nulls is not None or cb.nulls is not None:
+            na = ca.nulls if ca.nulls is not None else jnp.zeros((len(ca),), bool)
+            nb = cb.nulls if cb.nulls is not None else jnp.zeros((len(cb),), bool)
+            nulls = jnp.concatenate([na, nb])
+        return Column(ca.type, vals, nulls, None, children=kids)
+    if va.dtype != vb.dtype:  # mixed physical widths: promote
+        dt = jnp.promote_types(va.dtype, vb.dtype)
+        va, vb = va.astype(dt), vb.astype(dt)
+    d = ca.dictionary
+    if ca.dictionary is not None and cb.dictionary is not None:
+        if ca.dictionary is not cb.dictionary and ca.dictionary.values != cb.dictionary.values:
+            d = ca.dictionary.merge(cb.dictionary)
+            ra = jnp.asarray(ca.dictionary.recode_table(d))
+            rb = jnp.asarray(cb.dictionary.recode_table(d))
+            va = jnp.where(va >= 0, ra[jnp.clip(va, 0)], NULL_CODE)
+            vb = jnp.where(vb >= 0, rb[jnp.clip(vb, 0)], NULL_CODE)
+    vals = jnp.concatenate([va, vb])
+    if ca.nulls is None and cb.nulls is None:
+        nulls = None
+    else:
+        na = ca.nulls if ca.nulls is not None else jnp.zeros((len(ca),), bool)
+        nb = cb.nulls if cb.nulls is not None else jnp.zeros((len(cb),), bool)
+        nulls = jnp.concatenate([na, nb])
+    return Column(ca.type, vals, nulls, d, merge_vrange(ca.vrange, cb.vrange))
+
+
+def host_take(c: Column, idx: np.ndarray) -> Column:
+    """Row gather on the HOST (numpy). The one gather path that supports
+    nested columns: child segments are re-flattened by explicit offsets —
+    a data-dependent-shape operation jit'd device code cannot express."""
+    if c.type.is_nested:
+        nulls = np.asarray(c.nulls)[idx] if c.nulls is not None else None
+        if isinstance(c.type, T.RowType):
+            kids = [host_take(k, idx) for k in c.children]
+            vals = np.asarray(c.values)[idx]
+        else:
+            off = c.offsets()
+            lens = np.asarray(c.values, dtype=np.int64)
+            vals = lens[idx].astype(np.int32)
+            if len(idx):
+                child_idx = np.concatenate(
+                    [np.arange(off[i], off[i + 1], dtype=np.int64) for i in idx]
+                )
+            else:
+                child_idx = np.zeros(0, np.int64)
+            kids = [host_take(k, child_idx) for k in c.children]
+        return Column(
+            c.type, jnp.asarray(vals),
+            jnp.asarray(nulls) if nulls is not None else None,
+            None, None, children=kids,
+        )
+    # the sorted flag survives only order-preserving gathers (compact /
+    # slice pass monotone indices; arbitrary permutations must drop it)
+    monotone = bool(c.ascending) and (len(idx) < 2 or bool(np.all(np.diff(idx) >= 0)))
+    return Column(
+        c.type,
+        jnp.asarray(np.asarray(c.values)[idx]),
+        jnp.asarray(np.asarray(c.nulls)[idx]) if c.nulls is not None else None,
+        c.dictionary,
+        c.vrange,
+        ascending=monotone,
+    )
+
+
 @dataclasses.dataclass
 class Page:
     """A batch of rows: one Column per channel + optional selection mask.
@@ -194,28 +334,7 @@ class Page:
     def concat_pages(a: "Page", b: "Page") -> "Page":
         """Row-wise concatenation (static shapes: n_a + n_b). Dictionaries are
         merged host-side with device recode gathers when they differ."""
-        cols: List[Column] = []
-        for ca, cb in zip(a.columns, b.columns):
-            va, vb = ca.values, cb.values
-            if va.dtype != vb.dtype:  # mixed physical widths: promote
-                dt = jnp.promote_types(va.dtype, vb.dtype)
-                va, vb = va.astype(dt), vb.astype(dt)
-            d = ca.dictionary
-            if ca.dictionary is not None and cb.dictionary is not None:
-                if ca.dictionary is not cb.dictionary and ca.dictionary.values != cb.dictionary.values:
-                    d = ca.dictionary.merge(cb.dictionary)
-                    ra = jnp.asarray(ca.dictionary.recode_table(d))
-                    rb = jnp.asarray(cb.dictionary.recode_table(d))
-                    va = jnp.where(va >= 0, ra[jnp.clip(va, 0)], NULL_CODE)
-                    vb = jnp.where(vb >= 0, rb[jnp.clip(vb, 0)], NULL_CODE)
-            vals = jnp.concatenate([va, vb])
-            if ca.nulls is None and cb.nulls is None:
-                nulls = None
-            else:
-                na = ca.nulls if ca.nulls is not None else jnp.zeros((len(ca),), bool)
-                nb = cb.nulls if cb.nulls is not None else jnp.zeros((len(cb),), bool)
-                nulls = jnp.concatenate([na, nb])
-            cols.append(Column(ca.type, vals, nulls, d, merge_vrange(ca.vrange, cb.vrange)))
+        cols = [_concat_col(ca, cb) for ca, cb in zip(a.columns, b.columns)]
         sa = a.sel if a.sel is not None else jnp.ones((a.num_rows,), bool)
         sb = b.sel if b.sel is not None else jnp.ones((b.num_rows,), bool)
         return Page(cols, jnp.concatenate([sa, sb]), a.replicated and b.replicated)
@@ -225,16 +344,21 @@ class Page:
         """One all-dead row of the given types — the canonical empty page
         (zero-length arrays break downstream gathers: joins index
         counts[p], build.rows, etc., so 'empty' is 1 row with sel=False)."""
-        cols = [
-            Column(
+        def col_of(t: T.Type, nrows: int) -> Column:
+            kids = (
+                [col_of(ct, nrows if t.is_row else 0) for ct in T.type_children(t)]
+                if t.is_nested
+                else None
+            )
+            return Column(
                 t,
-                jnp.zeros((1,), t.np_dtype or np.dtype(np.int64)),
+                jnp.zeros((nrows,), t.np_dtype or np.dtype(np.int64)),
                 None,
                 Dictionary([""]) if t.is_varchar else None,
+                children=kids,
             )
-            for t in types
-        ]
-        return Page(cols, jnp.zeros((1,), bool))
+
+        return Page([col_of(t, 1) for t in types], jnp.zeros((1,), bool))
 
     def compact(self) -> "Page":
         """Drop dead rows (host-side gather). Used at wire boundaries: the
@@ -245,25 +369,16 @@ class Page:
             return self
         live = np.asarray(self.sel)
         idx = np.nonzero(live)[0]
-        cols = [
-            Column(
-                c.type,
-                jnp.asarray(np.asarray(c.values)[idx]),
-                jnp.asarray(np.asarray(c.nulls)[idx]) if c.nulls is not None else None,
-                c.dictionary,
-                c.vrange,
-                ascending=c.ascending,  # order-preserving
-            )
-            for c in self.columns
-        ]
-        return Page(cols, None, self.replicated)
+        return Page([host_take(c, idx) for c in self.columns], None, self.replicated)
 
     def slice_rows(self, lo: int, hi: int) -> "Page":
         """Row-range view [lo, hi) of a compacted page (sel must be None) —
         the producer-side page chunker of the streaming output path."""
         assert self.sel is None, "slice_rows requires a compacted page"
         cols = [
-            Column(
+            host_take(c, np.arange(lo, min(hi, len(c)), dtype=np.int64))
+            if c.type.is_nested
+            else Column(
                 c.type,
                 c.values[lo:hi],
                 c.nulls[lo:hi] if c.nulls is not None else None,
@@ -283,6 +398,12 @@ class Page:
             total += np.asarray(c.values).dtype.itemsize
             if c.nulls is not None:
                 total += 1
+            if c.children is not None and self.num_rows:
+                # amortize flattened children over the parent row count
+                for k in c.children:
+                    total += max(
+                        1, (len(k) * np.asarray(k.values).dtype.itemsize) // self.num_rows
+                    )
         return max(total, 1)
 
     def live_count(self) -> int:

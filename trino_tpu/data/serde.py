@@ -45,29 +45,40 @@ _DTYPE_CODES = {
 _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 
 
+def _serialize_column(col: Column, n: int, parts: List[bytes]) -> None:
+    name = str(col.type).encode()
+    parts.append(struct.pack("<H", len(name)))
+    parts.append(name)
+    if col.nulls is not None:
+        parts.append(b"\x01")
+        parts.append(np.packbits(np.asarray(col.nulls)).tobytes())
+    else:
+        parts.append(b"\x00")
+    vals_np = np.ascontiguousarray(np.asarray(col.values))
+    parts.append(struct.pack("<B", _DTYPE_CODES[vals_np.dtype]))
+    parts.append(vals_np.tobytes())
+    if col.type.is_varchar:
+        assert col.dictionary is not None
+        vocab = col.dictionary.values
+        parts.append(struct.pack("<I", len(vocab)))
+        for s in vocab:
+            b = s.encode()
+            parts.append(struct.pack("<I", len(b)))
+            parts.append(b)
+    if col.type.is_nested:
+        # children: u32 flat row count, then the child column recursively
+        # (reference: ArrayBlockEncoding/MapBlockEncoding nest the element
+        # block encodings the same way)
+        for child in col.children:
+            parts.append(struct.pack("<I", len(child)))
+            _serialize_column(child, len(child), parts)
+
+
 def serialize_page(page: Page, codec: int = CODEC_ZLIB) -> bytes:
     parts: List[bytes] = []
     n = page.num_rows
     for col in page.columns:
-        name = str(col.type).encode()
-        parts.append(struct.pack("<H", len(name)))
-        parts.append(name)
-        if col.nulls is not None:
-            parts.append(b"\x01")
-            parts.append(np.packbits(np.asarray(col.nulls)).tobytes())
-        else:
-            parts.append(b"\x00")
-        vals_np = np.ascontiguousarray(np.asarray(col.values))
-        parts.append(struct.pack("<B", _DTYPE_CODES[vals_np.dtype]))
-        parts.append(vals_np.tobytes())
-        if col.type.is_varchar:
-            assert col.dictionary is not None
-            vocab = col.dictionary.values
-            parts.append(struct.pack("<I", len(vocab)))
-            for s in vocab:
-                b = s.encode()
-                parts.append(struct.pack("<I", len(b)))
-                parts.append(b)
+        _serialize_column(col, n, parts)
     body = b"".join(parts)
     if codec == CODEC_ZLIB:
         body = zlib.compress(body, level=1)
@@ -87,34 +98,47 @@ def deserialize_page(data: bytes) -> Page:
     off = 0
     columns: List[Column] = []
     for _ in range(ncols):
-        (name_len,) = struct.unpack_from("<H", body, off)
-        off += 2
-        typ = T.parse_type(body[off : off + name_len].decode())
-        off += name_len
-        has_nulls = body[off]
-        off += 1
-        nulls = None
-        if has_nulls:
-            nbytes = (nrows + 7) // 8
-            bits = np.unpackbits(
-                np.frombuffer(body, dtype=np.uint8, count=nbytes, offset=off)
-            )[:nrows].astype(np.bool_)
-            nulls = jnp.asarray(bits)
-            off += nbytes
-        dt = _CODE_DTYPES[body[off]]
-        off += 1
-        vals = np.frombuffer(body, dtype=dt, count=nrows, offset=off)
-        off += nrows * dt.itemsize
-        dictionary = None
-        if typ.is_varchar:
-            (dlen,) = struct.unpack_from("<I", body, off)
-            off += 4
-            vocab = []
-            for _ in range(dlen):
-                (slen,) = struct.unpack_from("<I", body, off)
-                off += 4
-                vocab.append(body[off : off + slen].decode())
-                off += slen
-            dictionary = Dictionary(vocab)
-        columns.append(Column(typ, jnp.asarray(vals), nulls, dictionary))
+        col, off = _deserialize_column(body, off, nrows)
+        columns.append(col)
     return Page(columns)
+
+
+def _deserialize_column(body: bytes, off: int, nrows: int):
+    (name_len,) = struct.unpack_from("<H", body, off)
+    off += 2
+    typ = T.parse_type(body[off : off + name_len].decode())
+    off += name_len
+    has_nulls = body[off]
+    off += 1
+    nulls = None
+    if has_nulls:
+        nbytes = (nrows + 7) // 8
+        bits = np.unpackbits(
+            np.frombuffer(body, dtype=np.uint8, count=nbytes, offset=off)
+        )[:nrows].astype(np.bool_)
+        nulls = jnp.asarray(bits)
+        off += nbytes
+    dt = _CODE_DTYPES[body[off]]
+    off += 1
+    vals = np.frombuffer(body, dtype=dt, count=nrows, offset=off)
+    off += nrows * dt.itemsize
+    dictionary = None
+    if typ.is_varchar:
+        (dlen,) = struct.unpack_from("<I", body, off)
+        off += 4
+        vocab = []
+        for _ in range(dlen):
+            (slen,) = struct.unpack_from("<I", body, off)
+            off += 4
+            vocab.append(body[off : off + slen].decode())
+            off += slen
+        dictionary = Dictionary(vocab)
+    children = None
+    if typ.is_nested:
+        children = []
+        for _ in T.type_children(typ):
+            (crows,) = struct.unpack_from("<I", body, off)
+            off += 4
+            child, off = _deserialize_column(body, off, crows)
+            children.append(child)
+    return Column(typ, jnp.asarray(vals), nulls, dictionary, children=children), off

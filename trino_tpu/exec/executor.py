@@ -55,6 +55,12 @@ def raise_query_errors(codes, flags):
 
 def _col_from_lowered(t: T.Type, lv: L.LoweredVal) -> Column:
     nulls = None if lv.valid is None else ~lv.valid
+    children = None
+    if lv.children is not None:
+        children = [
+            _col_from_lowered(ct, k) for ct, k in zip(T.type_children(t), lv.children)
+        ]
+        return Column(t, lv.vals, nulls, None, children=children)
     return Column(t, lv.vals, nulls, lv.dictionary)
 
 
@@ -75,6 +81,9 @@ def assemble_scan_page(column_names, column_types, datas) -> Page:
     cols: List[Column] = []
     for name, typ in zip(column_names, column_types):
         cd = concat_column_data([d[name] for d in datas])
+        if typ.is_nested:
+            cols.append(_column_from_data(cd))
+            continue
         vals = np.asarray(cd.values)
         # Physical narrowing: int64-stored columns whose table-wide value
         # range provably fits int32 ride int32 on device — int64 is emulated
@@ -95,6 +104,23 @@ def assemble_scan_page(column_names, column_types, datas) -> Page:
     if cols and cols[0].values.shape[0] == 0:
         return Page.all_dead(column_types)
     return Page(cols)
+
+
+def _column_from_data(cd) -> Column:
+    """ColumnData -> device Column, recursing into nested children."""
+    return Column(
+        cd.type,
+        jnp.asarray(np.asarray(cd.values)),
+        jnp.asarray(cd.nulls) if cd.nulls is not None else None,
+        cd.dictionary,
+        cd.vrange,
+        ascending=bool(getattr(cd, "sorted", False)),
+        children=(
+            [_column_from_data(k) for k in cd.children]
+            if cd.children is not None
+            else None
+        ),
+    )
 
 
 def scan_constraint_with(node: "P.TableScanNode", dyn_domains):
@@ -387,6 +413,11 @@ class Executor:
         n = page.num_rows
         if page.sel is None or capacity >= n:
             return page
+        if any(c.type.is_nested for c in page.columns):
+            # device row-gathers cannot re-flatten variable-length children
+            # (data-dependent shapes); keep the selection mask instead —
+            # semantically identical, just uncompacted
+            return page
         live = page.sel
         total = jnp.sum(live.astype(jnp.int32))
         self.errors.append((f"CAPACITY_EXCEEDED:{key}", total > capacity))
@@ -428,6 +459,85 @@ class Executor:
             cols.append(_col_from_lowered(e.type, lv))
         return Page(cols, page.sel, page.replicated,
                     live_prefix=page.live_prefix)
+
+    # -------------------------------------------------------------- unnest
+    def _exec_UnnestNode(self, node: P.UnnestNode) -> Page:
+        page = self.execute(node.source)
+        return self.unnest_page(node, page)
+
+    def unnest_page(self, node: P.UnnestNode, page: Page) -> Page:
+        """Static-shape UNNEST expansion (plan.py UnnestNode docstring).
+
+        Output capacity = total flat element count across the unnested
+        expressions (the exact row count for the single-array case; an upper
+        bound when zipping several). Per-output-slot parent rows come from
+        one searchsorted over the output offsets; every produced column is
+        either a parent-row gather (replicated channels) or a flat-child
+        gather at ``child_offset[parent] + position`` (unnested channels)."""
+        from trino_tpu.ops import array_ops as A
+
+        n = page.num_rows
+        lows = [self._lower(e, page) for e in node.unnest_exprs]
+        for lv in lows:
+            if lv.children is None:
+                raise NotImplementedError("UNNEST argument must be array/map-typed")
+        for c in node.replicate_channels:
+            if page.columns[c].type.is_nested:
+                raise NotImplementedError(
+                    "replicating an array/map column through UNNEST "
+                    "(project it before/after instead)"
+                )
+        raw_lens = [lv.vals.astype(jnp.int32) for lv in lows]
+        eff_lens = [
+            jnp.where(lv.valid, ln, 0) if lv.valid is not None else ln
+            for lv, ln in zip(lows, raw_lens)
+        ]
+        out_len = eff_lens[0]
+        for ln in eff_lens[1:]:
+            out_len = jnp.maximum(out_len, ln)
+        if page.sel is not None:
+            out_len = jnp.where(page.sel, out_len, 0)
+        out_offsets = A.offsets_from_lengths(out_len)
+        capacity = max(
+            1, sum(int(lv.children[0].vals.shape[0]) for lv in lows)
+        )
+        slot = jnp.arange(capacity, dtype=jnp.int32)
+        rowid_raw = jnp.searchsorted(out_offsets, slot, side="right").astype(jnp.int32) - 1
+        rowid = jnp.clip(rowid_raw, 0, n - 1)
+        pos = slot - out_offsets[rowid]  # 0-based position within the parent row
+        sel = slot < out_offsets[-1]
+        cols: List[Column] = []
+        for ci in node.replicate_channels:
+            c = page.columns[ci]
+            cols.append(
+                Column(
+                    c.type,
+                    c.values[rowid],
+                    c.nulls[rowid] if c.nulls is not None else None,
+                    c.dictionary,
+                    c.vrange,
+                )
+            )
+        child_types = iter(node.output_types[len(node.replicate_channels):])
+        for lv, raw_ln in zip(lows, raw_lens):
+            child_off = A.offsets_from_lengths(raw_ln)
+            in_range = pos < raw_ln[rowid]
+            if lv.valid is not None:
+                in_range = in_range & lv.valid[rowid]
+            for child in lv.children:
+                flat = child.vals
+                flat_n = int(flat.shape[0])
+                safe = flat if flat_n else jnp.zeros((1,), flat.dtype)
+                idx = jnp.clip(child_off[rowid] + pos, 0, max(flat_n - 1, 0))
+                vals = safe[idx]
+                valid = in_range
+                if child.valid is not None:
+                    cvalid = child.valid if flat_n else jnp.zeros((1,), bool)
+                    valid = valid & cvalid[idx]
+                cols.append(Column(next(child_types), vals, ~valid, child.dictionary))
+        if node.ordinality:
+            cols.append(Column(T.BIGINT, (pos + 1).astype(jnp.int64)))
+        return Page(cols, sel)
 
     # ---------------------------------------------------------- aggregation
     def _exec_AggregationNode(self, node: P.AggregationNode) -> Page:
@@ -604,7 +714,9 @@ class Executor:
             return Column(call.output_type, v, None if valid is None else ~valid, None)
         raise NotImplementedError(call.function)
 
-    def group_structure(self, group_channels: List[int], page: Page, payloads=()):
+    def group_structure(
+        self, group_channels: List[int], page: Page, payloads=(), force_sort=False
+    ):
         """(GroupLayout, out_sel, payloads_l, sel_l): group assignment.
 
         Two strategies (the FlatHash vs BigintGroupByHash specialization
@@ -630,7 +742,7 @@ class Executor:
             gids = jnp.zeros((n,), dtype=jnp.int32)
             layout = seg.direct_layout(gids, 1, sel)
             return layout, jnp.arange(1) < 1, list(payloads), sel
-        direct = self._direct_strides(group_channels, page)
+        direct = None if force_sort else self._direct_strides(group_channels, page)
         if direct is not None:
             strides, capacity = direct
             gids = jnp.zeros((n,), dtype=jnp.int32)
@@ -752,8 +864,12 @@ class Executor:
             sel = page.sel
         keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
         payload_arrays, slots = self._agg_payloads(node.aggregates, page.columns)
+        # array_agg needs group-contiguous rows in layout space (its output
+        # IS the per-group row runs); the direct masked-loop layout never
+        # permutes, so force the sort strategy
+        force_sort = any(c.function == "array_agg" for c in node.aggregates)
         layout, out_sel, payloads_l, sel_l = self.group_structure(
-            node.group_channels, page, payload_arrays
+            node.group_channels, page, payload_arrays, force_sort=force_sort
         )
         out_cols: List[Column] = []
         if node.group_channels:
@@ -764,6 +880,15 @@ class Executor:
                 nulls = None if valid is None else ~valid
                 out_cols.append(Column(src.type, v, nulls, src.dictionary, src.vrange))
         for call, slot in zip(node.aggregates, slots):
+            if call.function == "array_agg":
+                if call.distinct:
+                    raise NotImplementedError("array_agg(DISTINCT): not yet supported")
+                out_cols.append(
+                    self._array_agg_column(
+                        call, page, layout, self._slot_arg(payloads_l, slot), sel_l
+                    )
+                )
+                continue
             vals, valid = self._exec_aggregate(
                 call, page, sel, layout, self._slot_arg(payloads_l, slot), sel_l
             )
@@ -776,6 +901,44 @@ class Executor:
                 )
             )
         return Page(out_cols, out_sel, page.replicated)
+
+    def _array_agg_column(self, call, page, layout, arg_l, sel_l) -> Column:
+        """array_agg: the output array column IS the group-contiguous row
+        runs of the grouping sort — per-slot lengths are the group ranges,
+        the flat child is the (layout-space) argument column itself. NULL
+        inputs are kept as NULL elements (reference: ArrayAggregation-
+        Function has them by default).
+
+        Sorted layouts put live rows first, group-contiguous from position
+        0, so cumsum(lengths) == starts for every live slot and the flat
+        child aligns with no extra gather. The global (no GROUP BY) case
+        rides the direct single-slot layout: live rows compact to a prefix
+        with one stable flag sort."""
+        vals_l, valid_l = arg_l
+        src = page.columns[call.arg_channel]
+        elem_t = call.output_type.element
+        if layout.is_direct:
+            assert layout.capacity == 1, "grouped array_agg must use a sorted layout"
+            n = layout.n
+            if sel_l is None:
+                flat, flat_valid = vals_l, valid_l
+                count = jnp.int32(n)
+            else:
+                order = jax.lax.sort(
+                    (~sel_l, jnp.arange(n, dtype=jnp.int32)), num_keys=1,
+                    is_stable=True,
+                )[1]
+                flat = vals_l[order]
+                flat_valid = valid_l[order] if valid_l is not None else None
+                count = jnp.sum(sel_l.astype(jnp.int32))
+            lengths = count[None].astype(jnp.int32)
+        else:
+            lengths = (layout.ends - layout.starts).astype(jnp.int32)
+            flat, flat_valid = vals_l, valid_l
+        child = Column(
+            elem_t, flat, None if flat_valid is None else ~flat_valid, src.dictionary
+        )
+        return Column(call.output_type, lengths, None, children=[child])
 
     _in_spill_pass = False  # reentrancy guard for partitioned passes
 
@@ -1292,6 +1455,11 @@ class Executor:
         payload-carrying sort (sort_ops.sort_payloads) — never a computed-
         permutation gather per column."""
         n = page.num_rows
+        if any(c.type.is_nested for c in page.columns):
+            # nested columns cannot ride a device payload sort (children
+            # re-flatten with data-dependent shapes); sort host-side — this
+            # path serves root-level ORDER BY over array_agg/unnest results
+            return self._sorted_page_host(page, sort_channels, limit)
         keys = [
             (_col_to_lowered(page.columns[c]), asc, nf) for c, asc, nf in sort_channels
         ]
@@ -1318,6 +1486,40 @@ class Executor:
                 i += 1
             cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange))
         return Page(cols, sel, page.replicated)
+
+    def _sorted_page_host(self, page: Page, sort_channels, limit=None) -> Page:
+        """Host (numpy) ORDER BY for pages carrying nested columns: compact,
+        lexsort with SQL null placement (ops/sort.py _sort_key semantics),
+        host_take the permutation (which re-flattens children correctly)."""
+        from trino_tpu.data.page import host_take
+
+        compacted = page.compact()
+        n = compacted.num_rows
+        lex_keys = []  # least-significant first for np.lexsort
+        for c, asc, nf in reversed(list(sort_channels)):
+            col = compacted.columns[c]
+            if col.type.is_nested:
+                raise NotImplementedError("ORDER BY an array/map column")
+            v = np.asarray(col.values)
+            if v.dtype == np.bool_:
+                v = v.astype(np.int8)
+            if not asc:
+                v = -v if np.issubdtype(v.dtype, np.floating) else ~v
+            nulls_first = (not asc) if nf is None else nf
+            if col.nulls is not None:
+                isnull = np.asarray(col.nulls)
+                rank = (~isnull).astype(np.int8) if nulls_first else isnull.astype(np.int8)
+                lex_keys.append(np.where(isnull, np.zeros((), v.dtype), v))
+                lex_keys.append(rank)
+            else:
+                lex_keys.append(v)
+        order = (
+            np.lexsort(lex_keys) if lex_keys else np.arange(n)
+        )
+        if limit is not None:
+            order = order[:limit]
+        return Page([host_take(c, order) for c in compacted.columns], None,
+                    page.replicated)
 
     def _exec_TopNNode(self, node: P.TopNNode) -> Page:
         page = self.execute(node.source)

@@ -51,6 +51,10 @@ class LoweredVal:
     # analog of the reference's precision-based short/long decimal split
     # (Int128Math vs long arithmetic).
     bound: Optional[int] = None
+    # Nested (array/map) values: ``vals`` holds per-row int32 lengths and
+    # ``children`` the flattened element LoweredVals (array: [elements],
+    # map: [keys, values]) — mirroring data/page.py Column.children.
+    children: Optional[List["LoweredVal"]] = None
 
 
 class LowerCtx:
@@ -89,7 +93,13 @@ def lower(expr: ir.Expr, ctx: LowerCtx) -> LoweredVal:
         bound = None
         if col.vrange is not None and not jnp.issubdtype(col.values.dtype, jnp.floating):
             bound = max(abs(int(col.vrange[0])), abs(int(col.vrange[1])))
-        return LoweredVal(col.values, valid, col.dictionary, bound)
+        children = None
+        if col.children is not None:
+            children = [
+                LoweredVal(k.values, None if k.nulls is None else ~k.nulls, k.dictionary)
+                for k in col.children
+            ]
+        return LoweredVal(col.values, valid, col.dictionary, bound, children)
     if isinstance(expr, ir.Constant):
         return _lower_constant(expr, ctx)
     if isinstance(expr, ir.Cast):
@@ -112,8 +122,16 @@ def _lower_constant(expr: ir.Constant, ctx: LowerCtx) -> LoweredVal:
     t = expr.type
     if expr.value is None:
         dtype = t.np_dtype if t.np_dtype is not None else np.dtype(np.int32)
+        children = None
+        if t.is_nested:
+            children = [
+                LoweredVal(jnp.zeros((0,), ct.np_dtype or np.dtype(np.int64)), None,
+                           Dictionary([]) if ct.is_varchar else None)
+                for ct in T.type_children(t)
+            ]
         return LoweredVal(
-            _const_array(ctx, dtype, 0), jnp.zeros((ctx.num_rows,), dtype=bool), None
+            _const_array(ctx, dtype, 0), jnp.zeros((ctx.num_rows,), dtype=bool), None,
+            children=children,
         )
     if t.is_varchar:
         d = Dictionary([expr.value])
@@ -142,11 +160,16 @@ def _align_varchar(a: LoweredVal, b: LoweredVal) -> Tuple[jnp.ndarray, jnp.ndarr
     return av, bv
 
 
-def _comparison(op: Callable) -> Callable:
+def _comparison(op: Callable, negate_eq: bool = False) -> Callable:
     def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
         a = lower(expr.args[0], ctx)
         b = lower(expr.args[1], ctx)
         at, bt = expr.args[0].type, expr.args[1].type
+        if at.is_array and bt.is_array:
+            out = _array_equal(a, b, at, bt)
+            if negate_eq:
+                return LoweredVal(~out.vals, out.valid, None)
+            return out
         if at.is_varchar and bt.is_varchar:
             av, bv = _align_varchar(a, b)
         else:
@@ -154,6 +177,54 @@ def _comparison(op: Callable) -> Callable:
         return LoweredVal(op(av, bv), and_valid(a.valid, b.valid), None)
 
     return fn
+
+
+def _array_equal(a: LoweredVal, b: LoweredVal, at, bt) -> LoweredVal:
+    """SQL array equality (reference: ArrayDistinctFromOperator family):
+    length mismatch -> false; any definite element mismatch -> false; else
+    NULL if any compared element pair involves a NULL; else true. Runs over
+    the LEFT flat layout with guarded gathers into the right's."""
+    from trino_tpu.ops import array_ops as A
+
+    a_len = a.vals.astype(jnp.int32)
+    b_len = b.vals.astype(jnp.int32)
+    a_off = A.offsets_from_lengths(a_len)
+    b_off = A.offsets_from_lengths(b_len)
+    ae, be = a.children[0], b.children[0]
+    av, bv = ae.vals, be.vals
+    if ae.dictionary is not None and be.dictionary is not None:
+        av, bv = _align_varchar(
+            LoweredVal(av, None, ae.dictionary), LoweredVal(bv, None, be.dictionary)
+        )
+    flat_n = int(av.shape[0])
+    lens_eq = a_len == b_len
+    if flat_n == 0:
+        vals = lens_eq
+        return LoweredVal(vals, and_valid(a.valid, b.valid), None)
+    rowid = A.rowid_of_flat(a_off, flat_n)
+    pos = jnp.arange(flat_n, dtype=jnp.int32) - a_off[rowid]
+    active = (pos < a_len[rowid]) & lens_eq[rowid]
+    bn = max(int(bv.shape[0]), 1)
+    b_safe = bv if bv.shape[0] else jnp.zeros((1,), bv.dtype)
+    b_idx = jnp.clip(b_off[rowid] + pos, 0, bn - 1)
+    b_at = b_safe[b_idx]
+    a_ok = ae.valid if ae.valid is not None else jnp.ones((flat_n,), bool)
+    b_ok = (
+        (be.valid if be.valid.shape[0] else jnp.zeros((1,), bool))[b_idx]
+        if be.valid is not None
+        else jnp.ones((flat_n,), bool)
+    )
+    if av.dtype != b_at.dtype:
+        dt = jnp.promote_types(av.dtype, b_at.dtype)
+        av, b_at = av.astype(dt), b_at.astype(dt)
+    mismatch = active & a_ok & b_ok & (av != b_at)
+    nullpair = active & (~a_ok | ~b_ok)
+    any_mismatch = A.count_in_ranges(a_off, mismatch) > 0
+    any_nullpair = A.count_in_ranges(a_off, nullpair) > 0
+    vals = lens_eq & ~any_mismatch
+    indeterminate = lens_eq & ~any_mismatch & any_nullpair
+    valid = and_valid(and_valid(a.valid, b.valid), ~indeterminate)
+    return LoweredVal(vals, valid, None)
 
 
 def _numeric_align(av, at: T.Type, bv, bt: T.Type):
@@ -903,9 +974,271 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
     return LoweredVal(a.vals.astype(tt.np_dtype), a.valid, a.dictionary)
 
 
+# --- array / map lowering (ops/array_ops.py kernels; reference:
+# operator/scalar/Array*/Map* + spi/block/ArrayBlock traversals) ---
+
+INVALID_FUNCTION_ARGUMENT = "INVALID_FUNCTION_ARGUMENT"
+
+
+def _lower_array_ctor(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    k = len(expr.args)
+    n = ctx.num_rows
+    lengths = jnp.full((n,), k, jnp.int32)
+    if k == 0:
+        elem = LoweredVal(jnp.zeros((0,), jnp.int64), None, None)
+        return LoweredVal(lengths, None, children=[elem])
+    items = [lower(a, ctx) for a in expr.args]
+    if any(it.children is not None for it in items):
+        raise NotImplementedError("nested array constructors not supported")
+    dicts = [it.dictionary for it in items]
+    d = None
+    if any(dc is not None for dc in dicts):
+        # NULL literals lower with no dictionary — they contribute no vocab
+        # and their (all-invalid) codes recode to NULL_CODE below
+        present = [dc for dc in dicts if dc is not None]
+        d = present[0]
+        for dc in present[1:]:
+            if dc.values != d.values:
+                d = d.merge(dc)
+        items = [
+            it
+            if it.dictionary is not None and it.dictionary.values == d.values
+            else LoweredVal(
+                jnp.where(
+                    (it.vals >= 0)
+                    & (it.valid if it.valid is not None else True),
+                    jnp.asarray(
+                        (it.dictionary.recode_table(d) if it.dictionary is not None
+                         else np.array([NULL_CODE], np.int32))
+                    )[jnp.clip(it.vals, 0)],
+                    NULL_CODE,
+                ),
+                it.valid,
+                d,
+            )
+            for it in items
+        ]
+    if d is None and getattr(expr.type, "element", None) is not None and expr.type.element.is_varchar:
+        d = Dictionary([])  # all-NULL varchar array literal
+    dt = items[0].vals.dtype
+    for it in items[1:]:
+        dt = jnp.promote_types(dt, it.vals.dtype)
+    # row-major flattening: row i's elements are contiguous
+    flat = jnp.stack([it.vals.astype(dt) for it in items], axis=1).reshape(-1)
+    if all(it.valid is None for it in items):
+        fvalid = None
+    else:
+        fvalid = jnp.stack(
+            [
+                it.valid if it.valid is not None else jnp.ones((n,), bool)
+                for it in items
+            ],
+            axis=1,
+        ).reshape(-1)
+    return LoweredVal(lengths, None, children=[LoweredVal(flat, fvalid, d)])
+
+
+def _nested_parts(a: LoweredVal):
+    from trino_tpu.ops import array_ops as A
+
+    # raw lengths: they describe the flat child layout even under NULL rows
+    # (data/page.py offsets() invariant); null handling rides validity masks
+    lens = a.vals.astype(jnp.int32)
+    offsets = A.offsets_from_lengths(lens)
+    return A, lens, offsets
+
+
+def _lower_cardinality(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    return LoweredVal(a.vals.astype(jnp.int64), a.valid, None)
+
+
+def _lower_subscript(strict: bool, is_map: bool):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = lower(expr.args[0], ctx)
+        key = lower(expr.args[1], ctx)
+        A, lens, offsets = _nested_parts(a)
+        if is_map:
+            kflat = a.children[0]
+            vflat = a.children[1]
+            flat_n = int(kflat.vals.shape[0])
+            rowid = A.rowid_of_flat(offsets, flat_n)
+            kv = key.vals
+            if key.dictionary is not None and kflat.dictionary is not None:
+                if key.dictionary.values != kflat.dictionary.values:
+                    kv = jnp.where(
+                        kv >= 0,
+                        jnp.asarray(
+                            key.dictionary.recode_table(kflat.dictionary)
+                        )[jnp.clip(kv, 0)],
+                        NULL_CODE,
+                    )
+            match = kflat.vals == (
+                kv[rowid] if flat_n else jnp.zeros((0,), kv.dtype)
+            )
+            idx1 = A.first_match_index(offsets, match)
+            found = idx1 > 0
+            vals, _ = A.gather_at(offsets, lens, vflat.vals, idx1)
+            evalid = None
+            if vflat.valid is not None:
+                ev, _ = A.gather_at(offsets, lens, vflat.valid, idx1)
+                evalid = ev
+            valid = and_valid(and_valid(a.valid, key.valid), and_valid(found, evalid))
+            if strict:
+                missing = ~found
+                base_ok = a.valid if a.valid is not None else jnp.ones_like(missing)
+                kok = key.valid if key.valid is not None else jnp.ones_like(missing)
+                ctx.add_error(INVALID_FUNCTION_ARGUMENT, missing & base_ok & kok, None)
+            return LoweredVal(vals, valid, vflat.dictionary)
+        eflat = a.children[0]
+        vals, in_bounds = A.gather_at(offsets, lens, eflat.vals, key.vals)
+        evalid = None
+        if eflat.valid is not None:
+            evalid, _ = A.gather_at(offsets, lens, eflat.valid, key.vals)
+        valid = and_valid(and_valid(a.valid, key.valid), and_valid(in_bounds, evalid))
+        if strict:
+            oob = ~in_bounds
+            base_ok = a.valid if a.valid is not None else jnp.ones_like(oob)
+            kok = key.valid if key.valid is not None else jnp.ones_like(oob)
+            ctx.add_error(INVALID_FUNCTION_ARGUMENT, oob & base_ok & kok, None)
+        return LoweredVal(vals, valid, eflat.dictionary)
+
+    return fn
+
+
+def _lower_contains(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    x = lower(expr.args[1], ctx)
+    A, lens, offsets = _nested_parts(a)
+    eflat = a.children[0]
+    flat_n = int(eflat.vals.shape[0])
+    rowid = A.rowid_of_flat(offsets, flat_n)
+    xv = x.vals
+    if x.dictionary is not None and eflat.dictionary is not None:
+        if x.dictionary.values != eflat.dictionary.values:
+            xv = jnp.where(
+                xv >= 0,
+                jnp.asarray(x.dictionary.recode_table(eflat.dictionary))[
+                    jnp.clip(xv, 0)
+                ],
+                NULL_CODE,
+            )
+    target = xv[rowid] if flat_n else jnp.zeros((0,), xv.dtype)
+    evalid = eflat.valid
+    match = eflat.vals == target
+    if evalid is not None:
+        match = match & evalid
+    found = A.count_in_ranges(offsets, match) > 0
+    # SQL semantics (reference ArrayContains): found -> true; not found but
+    # a NULL element present -> NULL; else false.
+    if evalid is not None:
+        has_null_elem = A.count_in_ranges(offsets, ~evalid) > 0
+        valid = and_valid(and_valid(a.valid, x.valid), found | ~has_null_elem)
+    else:
+        valid = and_valid(a.valid, x.valid)
+    return LoweredVal(found, valid, None)
+
+
+def _lower_array_position(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    x = lower(expr.args[1], ctx)
+    A, lens, offsets = _nested_parts(a)
+    eflat = a.children[0]
+    flat_n = int(eflat.vals.shape[0])
+    rowid = A.rowid_of_flat(offsets, flat_n)
+    xv = x.vals
+    if x.dictionary is not None and eflat.dictionary is not None:
+        if x.dictionary.values != eflat.dictionary.values:
+            xv = jnp.where(
+                xv >= 0,
+                jnp.asarray(x.dictionary.recode_table(eflat.dictionary))[
+                    jnp.clip(xv, 0)
+                ],
+                NULL_CODE,
+            )
+    target = xv[rowid] if flat_n else jnp.zeros((0,), xv.dtype)
+    match = eflat.vals == target
+    if eflat.valid is not None:
+        match = match & eflat.valid
+    idx1 = A.first_match_index(offsets, match)
+    return LoweredVal(idx1.astype(jnp.int64), and_valid(a.valid, x.valid), None)
+
+
+def _lower_array_reduce(kind: str):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = lower(expr.args[0], ctx)
+        A, lens, offsets = _nested_parts(a)
+        eflat = a.children[0]
+        empty = lens == 0
+        if kind == "sum":
+            x = eflat.vals
+            if eflat.valid is not None:
+                x = jnp.where(eflat.valid, x, jnp.zeros((), x.dtype))
+            out = A.segment_reduce_by_range(offsets, x)
+            valid = and_valid(a.valid, ~empty)
+            return LoweredVal(out, valid, None)
+        # min/max via sorted-per-row trick is overkill; flat cummin over a
+        # reversed/forward pass needs segment boundaries — use the
+        # first_match-style suffix scan on transformed values instead:
+        # sort-free per-row min = -segmented-max(-x); implement via
+        # double-cumulative difference is wrong for min/max, so fall back
+        # to a masked segment reduction using jax.ops (fine at array scale).
+        import jax
+
+        flat_n = int(eflat.vals.shape[0])
+        rowid = A.rowid_of_flat(offsets, flat_n)
+        x = eflat.vals
+        mask_valid = eflat.valid
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            sentinel = jnp.inf if kind == "min" else -jnp.inf
+        else:
+            info = jnp.iinfo(x.dtype)
+            sentinel = info.max if kind == "min" else info.min
+        if mask_valid is not None:
+            x = jnp.where(mask_valid, x, sentinel)
+        seg = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+        n = ctx.num_rows
+        out = (
+            seg(x, rowid, num_segments=n)
+            if flat_n
+            else jnp.full((n,), sentinel, x.dtype)
+        )
+        has_valid = (
+            A.count_in_ranges(offsets, mask_valid) > 0
+            if mask_valid is not None
+            else ~empty
+        )
+        return LoweredVal(out, and_valid(a.valid, has_valid), eflat.dictionary)
+
+    return fn
+
+
+def _lower_map_part(which: int):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = lower(expr.args[0], ctx)
+        lens = a.vals.astype(jnp.int32)
+        return LoweredVal(lens, a.valid, children=[a.children[which]])
+
+    return fn
+
+
+def _lower_map_ctor(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    ka = lower(expr.args[0], ctx)
+    va = lower(expr.args[1], ctx)
+    mismatch = ka.vals.astype(jnp.int32) != va.vals.astype(jnp.int32)
+    ctx.add_error(
+        INVALID_FUNCTION_ARGUMENT, mismatch, and_valid(ka.valid, va.valid)
+    )
+    return LoweredVal(
+        ka.vals.astype(jnp.int32),
+        and_valid(ka.valid, va.valid),
+        children=[ka.children[0], va.children[0]],
+    )
+
+
 FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "eq": _comparison(lambda a, b: a == b),
-    "ne": _comparison(lambda a, b: a != b),
+    "ne": _comparison(lambda a, b: a != b, negate_eq=True),
     "lt": _comparison(lambda a, b: a < b),
     "le": _comparison(lambda a, b: a <= b),
     "gt": _comparison(lambda a, b: a > b),
@@ -975,4 +1308,18 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "radians": _lower_math1(jnp.radians),
     "atan2": _lower_atan2,
     "truncate": _lower_truncate,
+    "array_ctor": _lower_array_ctor,
+    "cardinality": _lower_cardinality,
+    "subscript": _lower_subscript(strict=True, is_map=False),
+    "element_at": _lower_subscript(strict=False, is_map=False),
+    "map_subscript": _lower_subscript(strict=True, is_map=True),
+    "map_element_at": _lower_subscript(strict=False, is_map=True),
+    "contains": _lower_contains,
+    "array_position": _lower_array_position,
+    "array_min": _lower_array_reduce("min"),
+    "array_max": _lower_array_reduce("max"),
+    "array_sum": _lower_array_reduce("sum"),
+    "map_keys": _lower_map_part(0),
+    "map_values": _lower_map_part(1),
+    "map_ctor": _lower_map_ctor,
 }

@@ -22,6 +22,7 @@ AGGREGATE_FUNCTIONS = {
     "variance", "var_samp", "var_pop",
     "approx_distinct",
     "approx_percentile",
+    "array_agg",
 }
 
 _MONTH_UNITS = {"year": 12, "month": 1}
@@ -123,6 +124,8 @@ def aggregate_result_type(fn: str, arg: Optional[T.Type]) -> T.Type:
         if not arg.is_numeric:
             raise AnalysisError(f"approx_percentile() not defined for {arg}")
         return arg
+    if fn == "array_agg":
+        return T.array_of(arg)
     raise AnalysisError(f"unknown aggregate {fn}")
 
 
@@ -253,6 +256,32 @@ class ExprAnalyzer:
             if e.field not in ("year", "month", "day", "quarter"):
                 raise AnalysisError(f"EXTRACT({e.field}) not supported yet")
             return ir.Call(T.BIGINT, f"extract_{e.field}", (v,))
+        if isinstance(e, ast.ArrayConstructor):
+            items = tuple(self.analyze(x) for x in e.items)
+            et = T.UNKNOWN
+            for it in items:
+                et2 = T.common_super_type(et, it.type)
+                if et2 is None:
+                    raise AnalysisError(
+                        f"ARRAY elements incompatible: {et} vs {it.type}")
+                et = et2
+            if et == T.UNKNOWN:
+                et = T.BIGINT  # empty / all-null literal defaults
+            items = tuple(
+                ir.Cast(et, it) if it.type not in (et, T.UNKNOWN) else it for it in items
+            )
+            return ir.Call(T.array_of(et), "array_ctor", items)
+        if isinstance(e, ast.Subscript):
+            base = self.analyze(e.base)
+            idx = self.analyze(e.index)
+            if isinstance(base.type, T.ArrayType):
+                if not idx.type.is_integer_kind:
+                    raise AnalysisError("array subscript must be an integer")
+                return ir.Call(base.type.element, "subscript", (base, idx))
+            if isinstance(base.type, T.MapType):
+                self._check_comparable(base.type.key, idx.type, "[]")
+                return ir.Call(base.type.value, "map_subscript", (base, idx))
+            raise AnalysisError(f"cannot subscript {base.type}")
         if isinstance(e, ast.FunctionCall):
             return self._analyze_function(e)
         if isinstance(e, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
@@ -397,12 +426,64 @@ class ExprAnalyzer:
                 raise AnalysisError("mod(a, b) expects 2 arguments")
             return ir.Call(
                 arithmetic_result_type("%", args[0].type, args[1].type), "mod", args)
+        # --- array / map functions (reference: operator/scalar/ArrayFunctions,
+        # MapKeys/MapValues/MapSubscript, CardinalityFunction) ---
+        if name == "cardinality":
+            if len(args) != 1 or not (args[0].type.is_array or args[0].type.is_map):
+                raise AnalysisError("cardinality() expects an array or map")
+            return ir.Call(T.BIGINT, "cardinality", args)
+        if name == "contains":
+            if len(args) != 2 or not isinstance(args[0].type, T.ArrayType):
+                raise AnalysisError("contains(array, value)")
+            self._check_comparable(args[0].type.element, args[1].type, "contains")
+            return ir.Call(T.BOOLEAN, "contains", args)
+        if name == "array_position":
+            if len(args) != 2 or not isinstance(args[0].type, T.ArrayType):
+                raise AnalysisError("array_position(array, value)")
+            self._check_comparable(args[0].type.element, args[1].type, "array_position")
+            return ir.Call(T.BIGINT, "array_position", args)
+        if name == "element_at":
+            if len(args) != 2:
+                raise AnalysisError("element_at(container, key)")
+            if isinstance(args[0].type, T.ArrayType):
+                if not args[1].type.is_integer_kind:
+                    raise AnalysisError("element_at(array, index) index must be an integer")
+                return ir.Call(args[0].type.element, "element_at", args)
+            if isinstance(args[0].type, T.MapType):
+                self._check_comparable(args[0].type.key, args[1].type, "element_at")
+                return ir.Call(args[0].type.value, "map_element_at", args)
+            raise AnalysisError("element_at() expects an array or map")
+        if name in ("array_min", "array_max"):
+            if len(args) != 1 or not isinstance(args[0].type, T.ArrayType):
+                raise AnalysisError(f"{name}(array)")
+            return ir.Call(args[0].type.element, name, args)
+        if name in ("array_sum",):
+            if len(args) != 1 or not isinstance(args[0].type, T.ArrayType):
+                raise AnalysisError("array_sum(array)")
+            return ir.Call(aggregate_result_type("sum", args[0].type.element), name, args)
+        if name == "map_keys":
+            if len(args) != 1 or not isinstance(args[0].type, T.MapType):
+                raise AnalysisError("map_keys(map)")
+            return ir.Call(T.array_of(args[0].type.key), "map_keys", args)
+        if name == "map_values":
+            if len(args) != 1 or not isinstance(args[0].type, T.MapType):
+                raise AnalysisError("map_values(map)")
+            return ir.Call(T.array_of(args[0].type.value), "map_values", args)
+        if name == "map":
+            if len(args) != 2 or not all(isinstance(a.type, T.ArrayType) for a in args):
+                raise AnalysisError("map(key_array, value_array)")
+            return ir.Call(
+                T.map_of(args[0].type.element, args[1].type.element), "map_ctor", args
+            )
         raise AnalysisError(f"unknown function: {name}")
 
     @staticmethod
     def _check_comparable(a: T.Type, b: T.Type, op: str):
-        if T.common_super_type(a, b) is None:
+        t = T.common_super_type(a, b)
+        if t is None or not t.comparable:
             raise AnalysisError(f"cannot compare {a} {op} {b}")
+        if op in ("<", "<=", ">", ">=") and not t.orderable:
+            raise AnalysisError(f"type {t} is not orderable for {op}")
 
 
 def _case_type(values: List[ir.Expr], default: Optional[ir.Expr]) -> T.Type:

@@ -186,6 +186,17 @@ class Extract(Expression):
     value: Expression
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayConstructor(Expression):
+    items: Tuple[Expression, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Subscript(Expression):
+    base: Expression
+    index: Expression
+
+
 # --- relations -------------------------------------------------------------
 
 
@@ -213,6 +224,16 @@ class Join(Relation):
 @dataclasses.dataclass(frozen=True)
 class SubqueryRelation(Relation):
     query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Unnest(Relation):
+    """UNNEST(e1, e2, ...) [WITH ORDINALITY] — a lateral relation whose
+    argument expressions may reference columns of the preceding FROM items.
+    Reference: SqlBase.g4 unnest rule + RelationPlanner.visitUnnest."""
+
+    exprs: Tuple[Expression, ...]
+    ordinality: bool = False
 
 
 # --- query structure -------------------------------------------------------

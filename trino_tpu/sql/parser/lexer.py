@@ -42,7 +42,7 @@ class LexError(ValueError):
 
 _OPS = [
     "<>", "!=", ">=", "<=", "||", "=", "<", ">", "+", "-", "*", "/", "%",
-    "(", ")", ",", ".", ";",
+    "(", ")", "[", "]", ",", ".", ";",
 ]
 
 

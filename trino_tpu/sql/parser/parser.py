@@ -412,6 +412,20 @@ class Parser:
                 left = ast.Join(jt, left, right, on=self.expr())
 
     def table_primary(self) -> ast.Relation:
+        if self.at_soft("unnest") and self.peek(1).text == "(":
+            self.advance()
+            self.advance()  # (
+            exprs = [self.expr()]
+            while self.accept_op(","):
+                exprs.append(self.expr())
+            self.expect_op(")")
+            ordinality = False
+            if self.at_kw("with") and self.at_soft("ordinality", ahead=1):
+                self.advance()
+                self.advance()
+                ordinality = True
+            rel: ast.Relation = ast.Unnest(tuple(exprs), ordinality)
+            return self._maybe_aliased(rel)
         if self.accept_op("("):
             if self.at_kw("select", "with", "values"):
                 q = self.query()
@@ -422,6 +436,9 @@ class Parser:
                 self.expect_op(")")
         else:
             rel = ast.Table(tuple(self.qualified_name()))
+        return self._maybe_aliased(rel)
+
+    def _maybe_aliased(self, rel: ast.Relation) -> ast.Relation:
         alias = None
         col_aliases = None
         if self.accept_kw("as"):
@@ -541,7 +558,26 @@ class Parser:
         return self.primary()
 
     def primary(self) -> ast.Expression:
+        e = self._primary_base()
+        while self.at_op("["):
+            self.advance()
+            idx = self.expr()
+            self.expect_op("]")
+            e = ast.Subscript(e, idx)
+        return e
+
+    def _primary_base(self) -> ast.Expression:
         t = self.peek()
+        if self.at_soft("array") and self.peek(1).text == "[":
+            self.advance()
+            self.advance()  # [
+            items: List[ast.Expression] = []
+            if not self.at_op("]"):
+                items.append(self.expr())
+                while self.accept_op(","):
+                    items.append(self.expr())
+            self.expect_op("]")
+            return ast.ArrayConstructor(tuple(items))
         if t.kind == "number":
             self.advance()
             return ast.Literal("number", t.text)
@@ -719,7 +755,15 @@ class Parser:
         return ast.SearchedCase(tuple(whens), default)
 
     def type_name(self) -> str:
-        parts = [self.advance().text]
+        base = self.advance().text
+        if base.lower() in ("array", "map", "row") and self.at_op("("):
+            self.advance()
+            args = [self.type_name()]
+            while self.accept_op(","):
+                args.append(self.type_name())
+            self.expect_op(")")
+            return f"{base}({','.join(args)})"
+        parts = [base]
         if self.accept_op("("):
             parts.append("(")
             parts.append(self.advance().text)
@@ -728,7 +772,4 @@ class Parser:
                 parts.append(self.advance().text)
             self.expect_op(")")
             parts.append(")")
-        name = "".join(parts)
-        if name.lower() == "double" and self.at_kw():
-            pass
-        return name
+        return "".join(parts)

@@ -418,6 +418,20 @@ def push_predicates(node: P.PlanNode, conjuncts: List[ir.Expr]) -> P.PlanNode:
         # predicates distribute over UNION ALL branches (channel-aligned)
         new_sources = [push_predicates(s, list(conjuncts)) for s in node.sources]
         return _replace_sources(node, new_sources)
+    if isinstance(node, P.UnnestNode):
+        # predicates touching only replicated (source) channels push below
+        # the expansion — each survives iff its parent row survives; element
+        # predicates stay above (reference: unnest pushdown in
+        # PredicatePushDown is similarly source-channel-only)
+        rep = node.replicate_channels
+        down, up = [], []
+        for c in conjuncts:
+            if all(ch < len(rep) for ch in ir.referenced_channels(c)):
+                down.append(ir.remap_channels(c, {i: r for i, r in enumerate(rep)}))
+            else:
+                up.append(c)
+        node.source = push_predicates(node.source, down)
+        return _wrap_filter(node, up)
     if isinstance(
         node,
         (P.LimitNode, P.TopNNode, P.SortNode, P.AggregationNode, P.ExchangeNode,
@@ -574,6 +588,24 @@ def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict
         new_exprs = [ir.remap_channels(e, src_map) for e in kept_exprs]
         new = P.ProjectNode(src, new_exprs, [node.names[i] for i in keep])
         return new, {old: i for i, old in enumerate(keep)}
+    if isinstance(node, P.UnnestNode):
+        rep = node.replicate_channels
+        keep_pos = [i for i in range(len(rep)) if i in needed]
+        src_needed = {rep[i] for i in keep_pos}
+        for e in node.unnest_exprs:
+            src_needed.update(ir.referenced_channels(e))
+        src, src_map = prune_channels(node.source, src_needed)
+        new_exprs = [ir.remap_channels(e, src_map) for e in node.unnest_exprs]
+        new = P.UnnestNode(
+            source=src,
+            unnest_exprs=new_exprs,
+            ordinality=node.ordinality,
+            replicate_channels=[src_map[rep[i]] for i in keep_pos],
+        )
+        mapping = {pos: i for i, pos in enumerate(keep_pos)}
+        for j in range(len(node.output_types) - len(rep)):
+            mapping[len(rep) + j] = len(keep_pos) + j
+        return new, mapping
     if isinstance(node, P.FilterNode):
         src_needed = set(needed) | set(ir.referenced_channels(node.predicate))
         src, src_map = prune_channels(node.source, src_needed)

@@ -144,6 +144,60 @@ class ProjectNode(PlanNode):
         return ProjectNode(source, exprs + extra, source.output_names + extra_names)
 
 
+@dataclasses.dataclass
+class UnnestNode(PlanNode):
+    """Expand array/map-valued expressions into rows, replicating the source
+    columns (lateral CROSS JOIN UNNEST semantics; ordinality optional).
+
+    Reference: ``operator/unnest/UnnestOperator.java:41`` — there a
+    position-at-a-time block traversal; here one static-shape expansion:
+    output capacity = total flat element count, per-output-row parent ids
+    come from a searchsorted over the offsets, replicated columns are row
+    gathers, unnested columns are the flat children themselves (ops/
+    array_ops.py). Rows beyond a row's own length are sel-masked dead."""
+
+    source: PlanNode = None
+    unnest_exprs: List[ir.Expr] = None  # array/map-typed, over source channels
+    ordinality: bool = False
+    # source channels replicated into the output (pruning drops unused ones —
+    # critically the unnested array column itself, whose device row-gather
+    # would need data-dependent reshaping)
+    replicate_channels: List[int] = None
+
+    def __post_init__(self):
+        if self.replicate_channels is None:
+            self.replicate_channels = list(range(len(self.source.output_types)))
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        out = [self.source.output_types[c] for c in self.replicate_channels]
+        for e in self.unnest_exprs:
+            t = e.type
+            if isinstance(t, T.MapType):
+                out.extend([t.key, t.value])
+            else:
+                out.append(t.element)
+        if self.ordinality:
+            out.append(T.BIGINT)
+        return out
+
+    @property
+    def output_names(self):
+        out = [self.source.output_names[c] for c in self.replicate_channels]
+        for i, e in enumerate(self.unnest_exprs):
+            if isinstance(e.type, T.MapType):
+                out.extend([f"key_{i}" if i else "key", f"value_{i}" if i else "value"])
+            else:
+                out.append(f"col_{i}" if i else "col")
+        if self.ordinality:
+            out.append("ordinality")
+        return out
+
+
 @dataclasses.dataclass(frozen=True)
 class AggregateCall:
     function: str  # count | sum | avg | min | max | stddev* | var* | approx_distinct | approx_percentile
@@ -228,8 +282,9 @@ _VAR_FAMILY = {"stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "v
 def can_split_aggs(aggregates) -> bool:
     """True when every aggregate has a mergeable partial/final state.
     DISTINCT aggregates must see all raw rows; approx_percentile ships a
-    mergeable quantile summary (ops/hll.py percentile_states)."""
-    return not any(a.distinct for a in aggregates)
+    mergeable quantile summary (ops/hll.py percentile_states); array_agg's
+    state is the raw rows themselves (variable length — gather path)."""
+    return not any(a.distinct or a.function == "array_agg" for a in aggregates)
 
 
 def _acc_state_count(agg: AggregateCall) -> int:

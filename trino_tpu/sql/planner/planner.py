@@ -272,7 +272,37 @@ class Planner:
             return RelationPlan(sub.node, Scope(fields, outer_scope))
         if isinstance(rel, ast.Join):
             return self.plan_join(rel, outer_scope, ctes)
+        if isinstance(rel, ast.Unnest):
+            # standalone FROM UNNEST(...): constant arguments, one dummy row
+            return self.plan_unnest(
+                rel, RelationPlan(P.ValuesNode([], [], [()]), Scope([], outer_scope)),
+                None, None, outer_scope,
+            )
         raise PlanningError(f"unsupported relation {type(rel).__name__}")
+
+    def plan_unnest(
+        self, rel: ast.Unnest, left: RelationPlan, alias, col_aliases, outer_scope
+    ) -> RelationPlan:
+        """Lateral UNNEST: argument expressions resolve against the columns
+        of the preceding FROM items (reference: RelationPlanner.visitUnnest +
+        planUnnest in QueryPlanner)."""
+        analyzer = ExprAnalyzer(left.scope)
+        exprs = [analyzer.analyze(e) for e in rel.exprs]
+        for e in exprs:
+            if not (e.type.is_array or e.type.is_map):
+                raise PlanningError(f"UNNEST argument must be array or map, got {e.type}")
+        node = P.UnnestNode(
+            source=left.node, unnest_exprs=exprs, ordinality=rel.ordinality
+        )
+        produced = node.output_types[len(left.node.output_types):]
+        default_names = node.output_names[len(left.node.output_names):]
+        names = list(col_aliases) if col_aliases else default_names
+        if len(names) < len(produced):
+            names = names + default_names[len(names):]
+        unnest_fields = [
+            Field(n, t, alias) for n, t, in zip(names, produced)
+        ]
+        return RelationPlan(node, Scope(left.scope.fields + unnest_fields, outer_scope))
 
     def plan_table_scan(self, rel: ast.Table, outer_scope: Optional[Scope]) -> RelationPlan:
         parts = [p.lower() for p in rel.parts]
@@ -339,6 +369,8 @@ class Planner:
         flatten(from_rel)
         if len(rels) < 3:
             return from_rel
+        if any(self._unwrap_unnest(r)[0] is not None for r in rels):
+            return from_rel  # UNNEST is lateral: list order is a data dependency
         names, sizes, ndv_fns = [], [], []
         for r in rels:
             n, s, nf = self._relation_columns_and_size(r, ctes)
@@ -474,10 +506,30 @@ class Planner:
                 return set(), 10_000, self._no_ndv
         return set(), 10_000
 
+    @staticmethod
+    def _unwrap_unnest(r: ast.Relation):
+        """(unnest, alias, col_aliases) if ``r`` is an UNNEST relation."""
+        if isinstance(r, ast.Unnest):
+            return r, None, None
+        if isinstance(r, ast.AliasedRelation) and isinstance(r.relation, ast.Unnest):
+            return r.relation, r.alias, r.column_aliases
+        return None, None, None
+
     def plan_join(
         self, rel: ast.Join, outer_scope: Optional[Scope], ctes: Dict[str, ast.WithQuery]
     ) -> RelationPlan:
         left = self.plan_relation(rel.left, outer_scope, ctes)
+        un, un_alias, un_cols = self._unwrap_unnest(rel.right)
+        if un is not None:
+            if rel.join_type not in ("cross", "implicit", "inner"):
+                raise PlanningError(f"{rel.join_type} JOIN UNNEST not supported")
+            if rel.using:
+                raise PlanningError("JOIN UNNEST ... USING not supported")
+            out = self.plan_unnest(un, left, un_alias, un_cols, outer_scope)
+            if rel.on is not None:
+                pred = ExprAnalyzer(out.scope).analyze(rel.on)
+                return RelationPlan(P.FilterNode(out.node, pred), out.scope)
+            return out
         right = self.plan_relation(rel.right, outer_scope, ctes)
         joint_fields = left.scope.fields + right.scope.fields
         joint_scope = Scope(joint_fields, outer_scope)

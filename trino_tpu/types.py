@@ -25,7 +25,7 @@ is implemented in the expression lowering (ops/expr_lower.py).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -61,6 +61,24 @@ class Type:
     @property
     def is_numeric(self) -> bool:
         return self.is_integer_kind or self.is_floating or self.is_decimal
+
+    @property
+    def is_array(self) -> bool:
+        return self.name.startswith("array(")
+
+    @property
+    def is_map(self) -> bool:
+        return self.name.startswith("map(")
+
+    @property
+    def is_row(self) -> bool:
+        return self.name.startswith("row(")
+
+    @property
+    def is_nested(self) -> bool:
+        """Container types: device layout is per-row lengths (int32) plus
+        flattened child columns (data/page.py Column.children)."""
+        return self.is_array or self.is_map or self.is_row
 
 
 BOOLEAN = Type("boolean", np.dtype(np.bool_))
@@ -125,6 +143,84 @@ def char(length: int) -> VarcharType:
 VARCHAR = varchar()
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayType(Type):
+    """array(E). Reference: ``spi/type/ArrayType.java`` + ``spi/block/
+    ArrayBlock.java`` (offsets + element block). Device layout here is
+    struct-of-arrays: per-row int32 *lengths* ride ``Column.values`` (offsets
+    are their prefix sum) and the flattened elements ride ``Column.children
+    [0]`` — lengths rather than offsets so a length-n column keeps n slots
+    and every row-parallel kernel (sel masks, null masks) applies unchanged."""
+
+    element: Optional["Type"] = None
+
+
+def array_of(element: Type) -> ArrayType:
+    return ArrayType(
+        name=f"array({element.name})",
+        np_dtype=np.dtype(np.int32),  # physical: per-row element count
+        orderable=False,
+        element=element,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(Type):
+    """map(K, V). Reference: ``spi/type/MapType.java`` / ``MapBlock.java``.
+    Layout: per-row entry counts + two flattened children (keys, values)."""
+
+    key: Optional["Type"] = None
+    value: Optional["Type"] = None
+
+
+def map_of(key: Type, value: Type) -> MapType:
+    return MapType(
+        name=f"map({key.name}, {value.name})",
+        np_dtype=np.dtype(np.int32),
+        comparable=False,
+        orderable=False,
+        key=key,
+        value=value,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RowType(Type):
+    """row(f1 T1, ...). Reference: ``spi/type/RowType.java`` / ``RowBlock``.
+    Layout: one child column per field (no lengths; ``Column.values`` is a
+    placeholder zeros array so row-count machinery keeps working)."""
+
+    field_names: Tuple[str, ...] = ()
+    field_types: Tuple["Type", ...] = ()
+
+
+def row_of(fields) -> RowType:
+    """fields: sequence of (name|None, Type)."""
+    names = tuple(n if n is not None else f"field{i}" for i, (n, _) in enumerate(fields))
+    ftypes = tuple(t for _, t in fields)
+    inner = ", ".join(
+        f"{n} {t.name}" if n is not None else t.name for (n, _), t in zip(fields, ftypes)
+    )
+    return RowType(
+        name=f"row({inner})",
+        np_dtype=np.dtype(np.int8),
+        orderable=False,
+        field_names=names,
+        field_types=ftypes,
+    )
+
+
+def type_children(t: Type):
+    """The flattened child types a nested column carries, in child order."""
+    if isinstance(t, ArrayType):
+        return [t.element]
+    if isinstance(t, MapType):
+        return [t.key, t.value]
+    if isinstance(t, RowType):
+        return list(t.field_types)
+    return []
+
+
 def parse_type(s: str) -> Type:
     """Parse a SQL type string, e.g. ``decimal(15,2)``, ``varchar(25)``."""
     s = s.strip().lower()
@@ -153,7 +249,48 @@ def parse_type(s: str) -> Type:
         return varchar(int(s[len("varchar(") : -1]))
     if s.startswith("char(") and s.endswith(")"):
         return char(int(s[len("char(") : -1]))
+    if s.startswith("array(") and s.endswith(")"):
+        return array_of(parse_type(s[len("array(") : -1]))
+    if s.startswith("map(") and s.endswith(")"):
+        k, v = _split_top_level(s[len("map(") : -1])
+        return map_of(parse_type(k), parse_type(v))
+    if s.startswith("row(") and s.endswith(")"):
+        fields = []
+        for part in _split_all_top_level(s[len("row(") : -1]):
+            part = part.strip()
+            # "name type" or bare "type"
+            sp = part.find(" ")
+            if sp > 0 and not part[:sp].endswith("("):
+                try:
+                    fields.append((part[:sp], parse_type(part[sp + 1 :])))
+                    continue
+                except ValueError:
+                    pass
+            fields.append((None, parse_type(part)))
+        return row_of(fields)
     raise ValueError(f"unknown type: {s}")
+
+
+def _split_all_top_level(s: str):
+    """Split on commas not nested inside parentheses."""
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    parts.append(s[start:])
+    return parts
+
+
+def _split_top_level(s: str):
+    parts = _split_all_top_level(s)
+    if len(parts) != 2:
+        raise ValueError(f"expected two type arguments in {s!r}")
+    return parts[0].strip(), parts[1].strip()
 
 
 # ---------------------------------------------------------------------------
@@ -190,6 +327,13 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         return decimal(min(38, ip + scale), scale)
     if a.is_varchar and b.is_varchar:
         return VARCHAR
+    if isinstance(a, ArrayType) and isinstance(b, ArrayType):
+        e = common_super_type(a.element, b.element)
+        return array_of(e) if e is not None else None
+    if isinstance(a, MapType) and isinstance(b, MapType):
+        k = common_super_type(a.key, b.key)
+        v = common_super_type(a.value, b.value)
+        return map_of(k, v) if k is not None and v is not None else None
     if {a.name, b.name} == {"date", "timestamp(6)"}:
         return TIMESTAMP
     return None
