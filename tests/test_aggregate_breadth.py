@@ -1,0 +1,149 @@
+"""Aggregate function breadth (VERDICT round-3 'missing' item 5).
+
+Reference: operator/aggregation/ — BooleanAndAggregation, CountIfAggregation,
+ArbitraryAggregation, GeometricMeanAggregations, ChecksumAggregationFunction,
+MinMaxByAggregations, Covariance/Correlation/RegressionAggregations,
+histogram/Histogram, MapAggAggregation. Oracles: Python statistics/numpy.
+"""
+import math
+
+import numpy as np
+import pytest
+
+from trino_tpu import Session
+from trino_tpu import types as T
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = Session()
+    rng = np.random.default_rng(7)
+    rows = []
+    for i in range(300):
+        g = int(rng.integers(0, 3))
+        x = float(rng.normal(10.0, 2.0))
+        y = 2.5 * x + float(rng.normal(0.0, 0.5))
+        b = bool(rng.integers(0, 2))
+        name = f"n{int(rng.integers(0, 5))}"
+        rows.append((i, g, b, x, y, name))
+    s.catalogs["memory"].create_table(
+        "t", "w",
+        [("id", T.BIGINT), ("g", T.BIGINT), ("b", T.BOOLEAN),
+         ("x", T.DOUBLE), ("y", T.DOUBLE), ("name", T.VARCHAR)],
+        rows,
+    )
+    s._rows = rows
+    return s
+
+
+def by_group(session):
+    out = {}
+    for r in session._rows:
+        out.setdefault(r[1], []).append(r)
+    return out
+
+
+def test_bool_and_or_count_if(session):
+    got = session.execute(
+        "select g, bool_and(b), bool_or(b), every(b), count_if(b)"
+        " from memory.t.w group by g order by g"
+    ).rows
+    for g, ba, bo, ev, ci in got:
+        bs = [r[2] for r in by_group(session)[g]]
+        assert ba == all(bs) and bo == any(bs) and ev == all(bs)
+        assert ci == sum(bs)
+
+
+def test_arbitrary_any_value(session):
+    got = session.execute(
+        "select g, arbitrary(name), any_value(x) from memory.t.w group by g order by g"
+    ).rows
+    for g, nm, x in got:
+        rows = by_group(session)[g]
+        assert nm in {r[5] for r in rows}
+        assert any(abs(x - r[3]) < 1e-12 for r in rows)
+
+
+def test_min_by_max_by(session):
+    got = session.execute(
+        "select g, min_by(name, x), max_by(id, y) from memory.t.w group by g order by g"
+    ).rows
+    for g, nm, mid in got:
+        rows = by_group(session)[g]
+        assert nm == min(rows, key=lambda r: r[3])[5]
+        assert mid == max(rows, key=lambda r: r[4])[0]
+
+
+def test_bivariate_family(session):
+    got = session.execute(
+        "select g, corr(y, x), covar_pop(y, x), covar_samp(y, x),"
+        "       regr_slope(y, x), regr_intercept(y, x)"
+        " from memory.t.w group by g order by g"
+    ).rows
+    for g, corr, cpop, csamp, slope, icpt in got:
+        rows = by_group(session)[g]
+        xs = np.array([r[3] for r in rows])
+        ys = np.array([r[4] for r in rows])
+        assert corr == pytest.approx(np.corrcoef(ys, xs)[0, 1], rel=1e-9)
+        assert cpop == pytest.approx(np.cov(ys, xs, bias=True)[0, 1], rel=1e-9)
+        assert csamp == pytest.approx(np.cov(ys, xs)[0, 1], rel=1e-9)
+        want_slope, want_icpt = np.polyfit(xs, ys, 1)
+        assert slope == pytest.approx(want_slope, rel=1e-6)
+        assert icpt == pytest.approx(want_icpt, rel=1e-6)
+
+
+def test_geometric_mean(session):
+    (row,) = session.execute("select geometric_mean(x) from memory.t.w").rows
+    xs = [r[3] for r in session._rows]
+    want = math.exp(sum(math.log(v) for v in xs) / len(xs))
+    assert row[0] == pytest.approx(want, rel=1e-9)
+
+
+def test_checksum_order_independent(session):
+    (a,) = session.execute("select checksum(name) from memory.t.w").rows
+    (b,) = session.execute(
+        "select checksum(name) from (select name from memory.t.w order by x)"
+    ).rows
+    assert a[0] == b[0] and a[0] is not None
+
+
+def test_histogram(session):
+    got = session.execute(
+        "select g, histogram(name) from memory.t.w group by g order by g"
+    ).rows
+    for g, h in got:
+        want = {}
+        for r in by_group(session)[g]:
+            want[r[5]] = want.get(r[5], 0) + 1
+        assert h == want
+
+
+def test_map_agg(session):
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "kv", [("g", T.BIGINT), ("k", T.VARCHAR), ("v", T.BIGINT)],
+        [(1, "a", 10), (1, "b", 20), (2, "c", 30), (2, None, 40), (3, None, None)],
+    )
+    got = s.execute("select g, map_agg(k, v) from memory.t.kv group by g order by g").rows
+    assert got == [(1, {"a": 10, "b": 20}), (2, {"c": 30}), (3, None)]
+
+
+def test_two_arg_aggs_distributed_gather():
+    """Unsplittable aggregates still work distributed (gather path)."""
+    import jax
+
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.parallel.spmd import DistributedQuery
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "d", [("g", T.BIGINT), ("x", T.BIGINT)],
+        [(i % 3, i * 7 % 11) for i in range(64)],
+    )
+    sql = "select g, min_by(x, x), bool_and(x > 0) from memory.t.d group by g order by g"
+    expect = s.execute(sql).rows
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("d",))
+    got = DistributedQuery.build(s, plan_sql(s, sql), mesh).run().to_pylist()
+    assert got == expect

@@ -172,10 +172,13 @@ def test_long_decimal_int128_arithmetic():
 
 
 def test_decimal_overflow_raises():
+    # past the p=38 cap (reference: DecimalOperators overflow throws);
+    # within p38 the two-limb storage now computes exactly
+    # (tests/test_int128_storage.py)
     s = Session()
-    big = Decimal("9" * 18)  # 18 nines, scale 0
+    big = Decimal("9" * 20)  # 20 nines: the product has ~40 digits > p38
     s.catalogs["memory"].create_table(
-        "t", "ovf", [("a", T.decimal(18, 0)), ("b", T.decimal(18, 0))], [(big, big)]
+        "t", "ovf", [("a", T.decimal(20, 0)), ("b", T.decimal(20, 0))], [(big, big)]
     )
     with pytest.raises(QueryError) as ei:
         s.execute("select a * b from memory.t.ovf")

@@ -1,0 +1,94 @@
+"""Event listener SPI + /v1/metrics (VERDICT round-3 'missing' item 10).
+
+Reference: spi/eventlistener/EventListener + QueryCreatedEvent/
+QueryCompletedEvent dispatched by eventlistener/EventListenerManager with
+per-listener exception isolation; metrics exposition mirrors the JMX ->
+/metrics bridge.
+"""
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu.server.coordinator import CoordinatorServer
+from trino_tpu.server.events import EventListener
+
+
+class Recorder(EventListener):
+    def __init__(self):
+        self.created = []
+        self.completed = []
+
+    def query_created(self, event):
+        self.created.append(event)
+
+    def query_completed(self, event):
+        self.completed.append(event)
+
+
+class Exploder(EventListener):
+    def query_completed(self, event):
+        raise RuntimeError("listener bug")
+
+
+@pytest.fixture(scope="module")
+def coord():
+    from trino_tpu.server.worker import WorkerServer
+
+    c = CoordinatorServer()
+    c.start()
+    w = WorkerServer(coordinator_url=c.base_url, node_id="w0")
+    w.start()
+    assert c.registry.wait_for_workers(1, timeout=15.0)
+    yield c
+    w.stop()
+    c.stop()
+
+
+def _wait_terminal(q, timeout=30.0):
+    deadline = time.time() + timeout
+    while not q.state.is_terminal() and time.time() < deadline:
+        time.sleep(0.05)
+    return q.state.get()
+
+
+def test_query_events_fire(coord):
+    rec = Recorder()
+    coord.events.add(rec)
+    coord.events.add(Exploder())  # must not affect the query or the recorder
+    q = coord.submit("select 1 as x", {"catalog": "tpch", "schema": "tiny"},
+                     user="alice")
+    assert _wait_terminal(q) == "FINISHED"
+    deadline = time.time() + 5
+    while not rec.completed and time.time() < deadline:
+        time.sleep(0.05)
+    assert rec.created and rec.created[-1].user == "alice"
+    ev = rec.completed[-1]
+    assert ev.query_id == q.query_id
+    assert ev.state == "FINISHED"
+    assert ev.output_rows == 1
+    assert ev.wall_seconds >= 0
+    assert ev.error is None
+
+
+def test_failed_query_event_carries_error(coord):
+    rec = Recorder()
+    coord.events.add(rec)
+    q = coord.submit("select definitely_not_a_column from nowhere", {})
+    assert _wait_terminal(q) == "FAILED"
+    deadline = time.time() + 5
+    while not any(e.query_id == q.query_id for e in rec.completed) and time.time() < deadline:
+        time.sleep(0.05)
+    ev = next(e for e in rec.completed if e.query_id == q.query_id)
+    assert ev.state == "FAILED" and ev.error
+
+
+def test_metrics_endpoint(coord):
+    body = urllib.request.urlopen(coord.base_url + "/v1/metrics").read().decode()
+    assert "trino_tpu_queries_total" in body
+    assert 'trino_tpu_queries{state="FINISHED"}' in body
+    assert "trino_tpu_workers 1" in body
+    total = next(
+        line for line in body.splitlines() if line.startswith("trino_tpu_queries_total")
+    )
+    assert int(total.split()[-1]) >= 2

@@ -1,0 +1,125 @@
+"""Scalar breadth: regexp / JSON / datetime strings / bitwise / misc
+(VERDICT round-3 'missing' item 5, scalar half).
+
+Reference: operator/scalar/JoniRegexpFunctions, JsonFunctions,
+DateTimeFunctions (MySQL-style date_format), BitwiseFunctions,
+StringFunctions (pads, split_part, translate). Varchar functions here run
+as dictionary transforms (O(vocab) host work + device recode), the
+dictionary-first analog of the reference's per-row evaluation.
+"""
+import datetime
+
+import pytest
+
+from trino_tpu import Session
+from trino_tpu import types as T
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "s",
+        [("id", T.BIGINT), ("v", T.VARCHAR), ("d", T.DATE),
+         ("x", T.DOUBLE), ("j", T.VARCHAR)],
+        [
+            (1, "hello world", "2024-02-15", 1.5, '{"a": {"b": [1, 2, 3]}, "s": "txt"}'),
+            (2, "foo42bar", "2023-12-31", float("nan"), "[10, 20]"),
+            (3, None, None, None, None),
+        ],
+    )
+    return s
+
+
+def test_regexp_family(session):
+    rows = session.execute(
+        "select regexp_like(v, '[0-9]+'), regexp_extract(v, '([0-9]+)', 1),"
+        "       regexp_replace(v, 'o', '0'), regexp_count(v, 'o')"
+        " from memory.t.s order by id"
+    ).rows
+    assert rows == [
+        (False, None, "hell0 w0rld", 2),
+        (True, "42", "f0042bar", 2),
+        (None, None, None, None),
+    ]
+
+
+def test_pads_split_translate(session):
+    (row,) = session.execute(
+        "select lpad(v, 14, '*'), rpad(v, 5), split_part(v, ' ', 2),"
+        "       split_part(v, ' ', 9), translate(v, 'lo', 'LO')"
+        " from memory.t.s where id = 1"
+    ).rows
+    assert row == ("***hello world", "hello", "world", None, "heLLO wOrLd")
+
+
+def test_chr_codepoint_repeat(session):
+    (row,) = session.execute(
+        "select codepoint(chr(65)), repeat(v, 2) from memory.t.s where id = 2"
+    ).rows
+    assert row == (65, "foo42barfoo42bar")
+
+
+def test_string_distances(session):
+    (row,) = session.execute(
+        "select hamming_distance(v, 'hello xorld'),"
+        "       levenshtein_distance(v, 'hello') from memory.t.s where id = 1"
+    ).rows
+    assert row == (1, 6)
+
+
+def test_json_path(session):
+    rows = session.execute(
+        "select json_extract_scalar(j, '$.a.b[2]'), json_extract_scalar(j, '$.s'),"
+        "       json_array_length(j) from memory.t.s order by id"
+    ).rows
+    assert rows == [("3", "txt", None), (None, None, 2), (None, None, None)]
+
+
+def test_date_format_and_names(session):
+    rows = session.execute(
+        "select date_format(d, '%Y/%m/%d'), day_name(d), month_name(d),"
+        "       last_day_of_month(d) from memory.t.s order by id"
+    ).rows
+    assert rows == [
+        ("2024/02/15", "Thursday", "February", datetime.date(2024, 2, 29)),
+        ("2023/12/31", "Sunday", "December", datetime.date(2023, 12, 31)),
+        (None, None, None, None),
+    ]
+
+
+def test_date_parse(session):
+    assert session.execute(
+        "select date_parse('2020-03-04', '%Y-%m-%d')"
+    ).rows == [(datetime.date(2020, 3, 4),)]
+
+
+def test_bitwise(session):
+    assert session.execute(
+        "select bitwise_and(12, 10), bitwise_or(12, 10), bitwise_xor(12, 10),"
+        "       bitwise_not(0), bitwise_left_shift(1, 4),"
+        "       bitwise_right_shift(16, 2), bit_count(255)"
+    ).rows == [(8, 14, 6, -1, 16, 4, 8)]
+
+
+def test_float_classification_and_if(session):
+    rows = session.execute(
+        "select is_nan(x), is_finite(x), if(x > 1, 9, 0) from memory.t.s order by id"
+    ).rows
+    assert rows == [(False, True, 9), (True, False, 0), (None, None, 0)]
+    assert session.execute("select is_nan(nan()), is_infinite(infinity())").rows == [
+        (True, True)
+    ]
+
+
+def test_typeof(session):
+    assert session.execute(
+        "select typeof(x), typeof(v), typeof(d) from memory.t.s where id = 1"
+    ).rows == [("double", "varchar", "date")]
+
+
+def test_unixtime_roundtrip(session):
+    (row,) = session.execute(
+        "select to_unixtime(d) from memory.t.s where id = 2"
+    ).rows
+    assert row[0] == (datetime.date(2023, 12, 31) - datetime.date(1970, 1, 1)).days * 86400.0

@@ -1,0 +1,133 @@
+"""SQLite connector (the JDBC plugin family's walking skeleton).
+
+Reference: plugin/trino-base-jdbc — metadata from the remote catalog,
+rowid-range splits, TupleDomain compiled into the remote WHERE clause
+(QueryBuilder.toPredicate), write path via CREATE TABLE/INSERT.
+"""
+import datetime
+import sqlite3
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu import Session
+from trino_tpu import types as T
+from trino_tpu.connector.predicate import Domain, TupleDomain
+from trino_tpu.connector.sqlite import SqliteConnector
+
+
+@pytest.fixture()
+def session(tmp_path):
+    db = str(tmp_path / "db.sqlite")
+    con = sqlite3.connect(db)
+    con.execute(
+        "create table orders (id integer, customer text, total double,"
+        " placed date, open boolean)"
+    )
+    rows = [
+        (1, "alice", 10.5, "2024-01-05", 1),
+        (2, "bob", 20.0, "2024-02-11", 0),
+        (3, "alice", 7.25, "2024-02-20", 1),
+        (4, None, None, None, None),
+    ]
+    con.executemany("insert into orders values (?,?,?,?,?)", rows)
+    con.commit()
+    con.close()
+    s = Session({"catalog": "sqlite", "schema": "main"})
+    s.catalogs["sqlite"] = SqliteConnector(db)
+    return s
+
+
+def test_metadata(session):
+    conn = session.catalogs["sqlite"]
+    assert conn.list_tables("main") == ["orders"]
+    meta = conn.get_table("main", "orders")
+    assert [(c.name, str(c.type)) for c in meta.columns] == [
+        ("id", "bigint"), ("customer", "varchar"), ("total", "double"),
+        ("placed", "date"), ("open", "boolean"),
+    ]
+    assert conn.table_row_count("main", "orders") == 4
+    st = conn.column_stats("main", "orders", "id")
+    assert (st.low, st.high, st.ndv) == (1, 4, 4)
+
+
+def test_scan_query(session):
+    rows = session.execute(
+        "select id, customer, total, placed, open from orders order by id"
+    ).rows
+    assert rows[0] == (1, "alice", 10.5, datetime.date(2024, 1, 5), True)
+    assert rows[3] == (4, None, None, None, None)
+
+
+def test_aggregation_and_filter(session):
+    rows = session.execute(
+        "select customer, count(*), sum(total) from orders"
+        " where open group by customer order by customer"
+    ).rows
+    assert rows == [("alice", 2, 17.75)]
+
+
+def test_constraint_pushdown_reduces_scan(session):
+    conn = session.catalogs["sqlite"]
+    (split,) = conn.get_splits("main", "orders", 1)
+    td = TupleDomain({"id": Domain.range(low=2, high=3)})
+    out = conn.scan(split, ["id"], constraint=td)
+    assert sorted(out["id"].values.tolist()) == [2, 3]
+    td2 = TupleDomain({"customer": Domain.from_values(["bob"])})
+    out2 = conn.scan(split, ["id", "customer"], constraint=td2)
+    assert out2["id"].values.tolist() == [2]
+
+
+def test_date_pushdown(session):
+    rows = session.execute(
+        "select id from orders where placed >= date '2024-02-01' order by id"
+    ).rows
+    assert rows == [(2,), (3,)]
+
+
+def test_ctas_and_insert_roundtrip(session):
+    session.execute(
+        "create table sqlite.main.summary as"
+        " select customer, sum(total) as t from orders"
+        " where customer is not null group by customer"
+    )
+    rows = session.execute("select customer, t from summary order by customer").rows
+    assert rows == [("alice", 17.75), ("bob", 20.0)]
+    session.execute("insert into summary values ('carol', 1.0)")
+    rows = session.execute("select count(*) from summary").rows
+    assert rows == [(3,)]
+    session.execute("drop table sqlite.main.summary")
+    assert "summary" not in session.catalogs["sqlite"].list_tables("main")
+
+
+def test_decimal_column(tmp_path):
+    db = str(tmp_path / "d.sqlite")
+    s = Session({"catalog": "sqlite", "schema": "main"})
+    s.catalogs["sqlite"] = SqliteConnector(db)
+    s.catalogs["sqlite"].create_table(
+        "main", "prices", [("id", T.BIGINT), ("p", T.decimal(10, 2))],
+        [(1, Decimal("10.25")), (2, Decimal("4.50"))],
+    )
+    rows = s.execute("select id, p from prices order by id").rows
+    assert rows == [(1, Decimal("10.25")), (2, Decimal("4.50"))]
+    (row,) = s.execute("select sum(p) from prices").rows
+    assert row[0] == Decimal("14.75")
+
+
+def test_multi_split_scan(tmp_path):
+    db = str(tmp_path / "m.sqlite")
+    con = sqlite3.connect(db)
+    con.execute("create table nums (v integer)")
+    con.executemany("insert into nums values (?)", [(i,) for i in range(1000)])
+    con.commit()
+    con.close()
+    s = Session({"catalog": "sqlite", "schema": "main"})
+    s.catalogs["sqlite"] = SqliteConnector(db)
+    conn = s.catalogs["sqlite"]
+    splits = conn.get_splits("main", "nums", 4)
+    seen = []
+    for sp in splits:
+        seen.extend(conn.scan(sp, ["v"])["v"].values.tolist())
+    assert sorted(seen) == list(range(1000))
+    (row,) = s.execute("select count(*), sum(v) from nums").rows
+    assert row == (1000, 499500)

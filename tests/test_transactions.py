@@ -1,0 +1,100 @@
+"""Transactions: START TRANSACTION / COMMIT / ROLLBACK + atomic autocommit.
+
+Reference: transaction/InMemoryTransactionManager.java — per-catalog
+transaction handles with isolated metadata views, atomic publish on commit,
+discard on abort; non-transactional catalogs reject explicit-transaction
+writes ("Catalog only supports writes using autocommit").
+"""
+import pytest
+
+from trino_tpu import Session
+from trino_tpu import types as T
+
+
+@pytest.fixture()
+def session():
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "acct", [("id", T.BIGINT), ("bal", T.BIGINT)], [(1, 100), (2, 50)]
+    )
+    return s
+
+
+def test_commit_publishes_atomically(session):
+    session.execute("start transaction")
+    session.execute("insert into memory.t.acct values (3, 10)")
+    session.execute("insert into memory.t.acct values (4, 20)")
+    # the transaction sees its own writes...
+    assert session.execute("select count(*) from memory.t.acct").rows == [(4,)]
+    # ...but another session over the same catalogs does not
+    other = Session(catalogs=session.catalogs if False else None)
+    other.catalogs["memory"] = session.transaction.saved["memory"]
+    assert other.execute("select count(*) from memory.t.acct").rows == [(2,)]
+    session.execute("commit")
+    assert session.execute("select count(*) from memory.t.acct").rows == [(4,)]
+
+
+def test_rollback_discards(session):
+    session.execute("start transaction")
+    session.execute("insert into memory.t.acct values (3, 10)")
+    session.execute("drop table memory.t.acct")
+    session.execute("rollback")
+    assert session.execute("select count(*) from memory.t.acct").rows == [(2,)]
+
+
+def test_transactional_ctas_and_drop(session):
+    session.execute("start transaction")
+    session.execute("create table memory.t.big as select id, bal * 2 as b from memory.t.acct")
+    assert session.execute("select sum(b) from memory.t.big").rows == [(300,)]
+    session.execute("rollback")
+    with pytest.raises(Exception):
+        session.execute("select * from memory.t.big")
+
+
+def test_nested_transaction_rejected(session):
+    session.execute("start transaction")
+    with pytest.raises(Exception):
+        session.execute("start transaction")
+    session.execute("rollback")
+
+
+def test_commit_without_transaction_rejected(session):
+    with pytest.raises(Exception):
+        session.execute("commit")
+
+
+def test_non_transactional_catalog_rejected(session):
+    session.execute("start transaction")
+    with pytest.raises(Exception):
+        session.execute("create table blackhole.t.x as select 1 as a")
+    session.execute("rollback")
+
+
+def test_autocommit_insert_is_atomic(session):
+    """A failing INSERT must not leave the table half-updated (some columns
+    longer than others)."""
+    conn = session.catalogs["memory"]
+    before = conn.table_row_count("t", "acct")
+    with pytest.raises(Exception):
+        # second row has a non-coercible value for bal
+        session.execute("insert into memory.t.acct values (5, 1), (6, 'oops')")
+    assert conn.table_row_count("t", "acct") == before
+    (meta, cols) = conn._tables[("t", "acct")]
+    lens = {len(cd.values) for cd in cols.values()}
+    assert len(lens) == 1  # every column has the same length
+
+
+def test_insert_after_drop_in_transaction_errors(session):
+    session.execute("start transaction")
+    session.execute("drop table memory.t.acct")
+    with pytest.raises(Exception):
+        session.execute("insert into memory.t.acct values (7, 7)")
+    session.execute("rollback")
+    assert session.execute("select count(*) from memory.t.acct").rows == [(2,)]
+
+
+def test_begin_alias(session):
+    session.execute("begin")
+    session.execute("insert into memory.t.acct values (9, 9)")
+    session.execute("commit")
+    assert session.execute("select count(*) from memory.t.acct").rows == [(3,)]

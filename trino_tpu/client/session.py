@@ -27,6 +27,8 @@ class Session:
         self.num_partitions = num_partitions
         self.identity = identity or Identity()
         self.access_control = access_control or AccessControl()
+        # active explicit transaction (exec/transaction.py), or None
+        self.transaction = None
 
     def set_property(self, name: str, value: Any) -> None:
         """SET SESSION analog: typed/validated (client/properties.py;

@@ -296,9 +296,6 @@ def _column_data(chunked) -> spi.ColumnData:
         # decimal128's storage IS the scaled integer: read the 16-byte
         # little-endian values straight from the validity+data buffers
         # (casting through arrow would round to the integral VALUE).
-        if t.precision > 18:
-            raise NotImplementedError(
-                "parquet decimal precision > 18: int128 staging not wired yet")
         if arr.offset:
             arr = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
             arr = arr.slice(0)  # normalize; buffers() below honors offset via copy
@@ -307,6 +304,13 @@ def _column_data(chunked) -> spi.ColumnData:
         vals = np.ascontiguousarray(
             data[2 * arr.offset : 2 * (arr.offset + n) : 2]
         )  # low limb = full value for p <= 18
+        if t.precision > 18:
+            hi = np.ascontiguousarray(
+                data[2 * arr.offset + 1 : 2 * (arr.offset + n) + 1 : 2]
+            )
+            if not np.array_equal(hi, vals >> 63):
+                # genuinely-wide values: two-limb column (Column.hi)
+                return spi.ColumnData(t, vals, nulls, hi=hi)
         return spi.ColumnData(t, vals, nulls)
     vals = np.asarray(arr.fill_null(0) if arr.null_count else arr)
     return spi.ColumnData(t, np.asarray(vals, dtype=t.np_dtype), nulls)

@@ -18,6 +18,7 @@ from trino_tpu.data.dictionary import Dictionary
 class MemoryConnector(spi.Connector):
     name = "memory"
     coordinator_only = True  # tables live in this process only
+    supports_transactions = True  # overlay protocol (exec/transaction.py)
 
     def __init__(self):
         self._tables: Dict[Tuple[str, str], Tuple[spi.TableMetadata, Dict[str, spi.ColumnData]]] = {}
@@ -47,11 +48,16 @@ class MemoryConnector(spi.Connector):
             return 0
         from trino_tpu.data.page import Column
 
+        # build ALL new columns before publishing: a mid-loop failure must
+        # not leave the table with some columns longer than others
+        # (auto-commit atomicity; reference: page sinks buffer then finish)
+        new_cols = {}
         for i, cm in enumerate(meta.columns):
             pycol = [r[i] for r in rows]
             col = Column.from_python(cm.type, pycol)
             new = spi.column_data_from_column(col)
-            cols[cm.name] = spi.concat_column_data([cols[cm.name], new])
+            new_cols[cm.name] = spi.concat_column_data([cols[cm.name], new])
+        self._tables[(schema, table)] = (meta, {**cols, **new_cols})
         return len(rows)
 
     def drop_table(self, schema: str, table: str) -> None:

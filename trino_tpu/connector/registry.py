@@ -20,7 +20,7 @@ def default_catalogs() -> Dict[str, Connector]:
     from trino_tpu.connector.tpcds import TpcdsConnector
     from trino_tpu.connector.tpch import TpchConnector
 
-    return {
+    cats = {
         "tpch": TpchConnector(),
         "tpcds": TpcdsConnector(),
         "memory": MemoryConnector(),
@@ -28,3 +28,10 @@ def default_catalogs() -> Dict[str, Connector]:
         # parquet-on-disk catalog; root via env (etc/catalog/*.properties role)
         "filesystem": FileSystemConnector(os.environ.get("TRINO_TPU_FS_ROOT")),
     }
+    # RDBMS catalog (the JDBC plugin family's analog); db file via env
+    sqlite_path = os.environ.get("TRINO_TPU_SQLITE_DB")
+    if sqlite_path:
+        from trino_tpu.connector.sqlite import SqliteConnector
+
+        cats["sqlite"] = SqliteConnector(sqlite_path)
+    return cats

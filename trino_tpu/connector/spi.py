@@ -93,6 +93,8 @@ class ColumnData:
     # nested (array/map/row) columns: values = per-row int32 lengths,
     # children = flattened child columns (data/page.py Column.children)
     children: Optional[List["ColumnData"]] = None
+    # long-decimal high limb (data/page.py Column.hi)
+    hi: Optional[np.ndarray] = None
 
 
 def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
@@ -152,6 +154,13 @@ def concat_column_data(cols: Sequence[ColumnData]) -> ColumnData:
         if any(cd.nulls is not None for cd in cols)
         else None
     )
+    if any(cd.hi is not None for cd in cols):
+        hi = np.concatenate([
+            np.asarray(cd.hi) if cd.hi is not None
+            else (np.asarray(cd.values).astype(np.int64) >> 63)
+            for cd in cols
+        ])
+        return ColumnData(cols[0].type, vals.astype(np.int64), nulls, hi=hi)
     # sortedness survives concat when every part is sorted AND callers pass
     # parts in ascending key order (connector scans enumerate ranges
     # ascending); last-of-prev <= first-of-next is verified cheaply
@@ -178,6 +187,7 @@ def column_data_from_column(col) -> ColumnData:
             if col.children is not None
             else None
         ),
+        hi=np.asarray(col.hi) if col.hi is not None else None,
     )
 
 
@@ -187,7 +197,8 @@ def column_data_slice(cd: ColumnData, lo: int, hi: int) -> ColumnData:
     nulls = cd.nulls[lo:hi] if cd.nulls is not None else None
     if cd.children is None:
         return ColumnData(cd.type, cd.values[lo:hi], nulls, cd.dictionary,
-                          cd.vrange, cd.sorted)
+                          cd.vrange, cd.sorted,
+                          hi=cd.hi[lo:hi] if cd.hi is not None else None)
     if cd.type.is_row:
         kids = [column_data_slice(k, lo, hi) for k in cd.children]
         return ColumnData(cd.type, cd.values[lo:hi], nulls, children=kids)
@@ -199,6 +210,42 @@ def column_data_slice(cd: ColumnData, lo: int, hi: int) -> ColumnData:
     return ColumnData(cd.type, cd.values[lo:hi], nulls, children=kids)
 
 
+def column_data_take(cd: ColumnData, idx: np.ndarray) -> ColumnData:
+    """Row gather (indices or bool mask) — limb- and nested-aware (the
+    ColumnData analog of data/page.py host_take)."""
+    if idx.dtype == np.bool_:
+        idx = np.nonzero(idx)[0]
+    nulls = np.asarray(cd.nulls)[idx] if cd.nulls is not None else None
+    if cd.children is not None and not cd.type.is_row:
+        lens = np.asarray(cd.values, dtype=np.int64)
+        off = np.concatenate([np.zeros(1, np.int64), np.cumsum(lens)])
+        child_idx = (
+            np.concatenate([np.arange(off[i], off[i + 1], dtype=np.int64) for i in idx])
+            if len(idx)
+            else np.zeros(0, np.int64)
+        )
+        kids = [column_data_take(k, child_idx) for k in cd.children]
+        return ColumnData(cd.type, lens[idx].astype(np.int32), nulls, children=kids)
+    kids = (
+        [column_data_take(k, idx) for k in cd.children]
+        if cd.children is not None
+        else None
+    )
+    # idx from a mask (or any ascending index list) preserves row order, so
+    # the sorted-input flag survives; arbitrary permutations must clear it
+    order_preserving = len(idx) < 2 or bool(np.all(np.diff(idx) >= 0))
+    return ColumnData(
+        cd.type,
+        np.asarray(cd.values)[idx],
+        nulls,
+        cd.dictionary,
+        cd.vrange,
+        cd.sorted and order_preserving,
+        children=kids,
+        hi=np.asarray(cd.hi)[idx] if cd.hi is not None else None,
+    )
+
+
 class Connector:
     """Reference: spi/Plugin.java -> ConnectorFactory -> Connector."""
 
@@ -207,6 +254,10 @@ class Connector:
     # in-memory connector): the coordinator must not distribute scans to
     # workers, whose catalog instances would be empty.
     coordinator_only: bool = False
+    # True when the connector supports explicit transactions via the
+    # copy-on-write overlay protocol (exec/transaction.py; reference:
+    # Connector.beginTransaction / isSingleStatementWritesOnly)
+    supports_transactions: bool = False
 
     # --- metadata (ConnectorMetadata) ---
     def list_schemas(self) -> List[str]:

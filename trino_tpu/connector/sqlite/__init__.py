@@ -1,0 +1,1 @@
+from trino_tpu.connector.sqlite.connector import SqliteConnector  # noqa: F401

@@ -1,0 +1,331 @@
+"""SQLite connector: the walking skeleton of the reference's JDBC plugin
+family.
+
+Reference: ``plugin/trino-base-jdbc`` (JdbcMetadata / JdbcSplitManager /
+JdbcRecordSetProvider, predicate pushdown via ``QueryBuilder`` compiling a
+TupleDomain into a WHERE clause) and its concrete plugins (trino-postgresql,
+trino-mysql, ...). SQLite via the stdlib driver stands in for the remote
+RDBMS: metadata comes from ``sqlite_master``/``PRAGMA table_info``, splits
+are rowid ranges, scans SELECT only the requested columns with the
+constraint compiled to SQL (pushdown happens IN the remote engine — the
+whole point of the JDBC family), and writes go through CREATE TABLE/INSERT.
+
+Type mapping (reference: each JDBC plugin's StandardColumnMappings):
+INTEGER->bigint, REAL/FLOAT/DOUBLE->double, TEXT/CHAR->varchar,
+DATE->date, BOOLEAN->boolean, NUMERIC/DECIMAL(p,s)->decimal.
+"""
+from __future__ import annotations
+
+import re
+import sqlite3
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector import spi
+from trino_tpu.connector.predicate import Domain, TupleDomain
+from trino_tpu.data.dictionary import Dictionary
+from trino_tpu.data.page import Column
+
+_SPLIT_ROWS = 250_000  # rowid range per split (JdbcSplitManager's analog)
+
+
+def _type_from_sqlite(decl: str) -> T.Type:
+    d = (decl or "").strip().lower()
+    m = re.match(r"(?:numeric|decimal)\s*\((\d+)\s*,\s*(\d+)\)", d)
+    if m:
+        return T.decimal(int(m.group(1)), int(m.group(2)))
+    if "int" in d:
+        return T.BIGINT
+    if any(k in d for k in ("real", "floa", "doub")):
+        return T.DOUBLE
+    if "bool" in d:
+        return T.BOOLEAN
+    if "date" in d:
+        return T.DATE
+    # TEXT affinity catch-all (sqlite is dynamically typed)
+    return T.varchar()
+
+
+def _sqlite_decl(t: T.Type) -> str:
+    if t.is_integer_kind:
+        return "INTEGER"
+    if t.is_floating:
+        return "DOUBLE"
+    if t == T.BOOLEAN:
+        return "BOOLEAN"
+    if t == T.DATE:
+        return "DATE"
+    if t.is_decimal:
+        assert isinstance(t, T.DecimalType)
+        return f"DECIMAL({t.precision},{t.scale})"
+    return "TEXT"
+
+
+class SqliteConnector(spi.Connector):
+    name = "sqlite"
+    coordinator_only = False  # a shared db file is reachable from workers
+
+    def __init__(self, path: str):
+        self._path = path
+        self._local = threading.local()
+
+    def _conn(self) -> sqlite3.Connection:
+        # sqlite connections are not thread-safe; one per engine thread
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path)
+            self._local.conn = conn
+        return conn
+
+    # ------------------------------------------------------------ metadata
+    def list_schemas(self) -> List[str]:
+        return ["main"]
+
+    def list_tables(self, schema: str) -> List[str]:
+        cur = self._conn().execute(
+            "select name from sqlite_master where type = 'table' order by name"
+        )
+        return [r[0] for r in cur.fetchall()]
+
+    def get_table(self, schema: str, table: str) -> Optional[spi.TableMetadata]:
+        if not re.fullmatch(r"\w+", table):
+            return None
+        cur = self._conn().execute(f"PRAGMA table_info({table})")
+        cols = cur.fetchall()
+        if not cols:
+            return None
+        return spi.TableMetadata(
+            schema, table,
+            [spi.ColumnMetadata(c[1], _type_from_sqlite(c[2])) for c in cols],
+        )
+
+    def table_row_count(self, schema: str, table: str) -> Optional[int]:
+        if self.get_table(schema, table) is None:
+            return None
+        (n,) = self._conn().execute(f"select count(*) from {table}").fetchone()
+        return int(n)
+
+    def column_stats(self, schema: str, table: str, column: str):
+        meta = self.get_table(schema, table)
+        if meta is None:
+            return None
+        try:
+            t = meta.columns[meta.column_index(column)].type
+        except KeyError:
+            return None
+        if not (t.is_integer_kind or t == T.DATE or t.is_decimal):
+            return None
+        _check_ident(column)
+        lo, hi, ndv = self._conn().execute(
+            f'select min("{column}"), max("{column}"), count(distinct "{column}")'
+            f" from {table}"
+        ).fetchone()
+        if lo is None or hi is None:
+            return None
+        conv = _to_repr_fn(t)
+        return spi.ColumnStats(low=conv(lo), high=conv(hi), ndv=int(ndv))
+
+    # -------------------------------------------------------------- splits
+    def get_splits(self, schema, table, target_splits, constraint=None) -> List[spi.Split]:
+        _check_ident(table)
+        row = self._conn().execute(
+            f"select min(rowid), max(rowid) from {table}"
+        ).fetchone()
+        lo, hi = (row or (None, None))
+        if lo is None:
+            return [spi.Split(table, schema, 0, -1)]
+        lo, hi = int(lo), int(hi)
+        n = hi - lo + 1
+        parts = max(1, min(target_splits, (n + _SPLIT_ROWS - 1) // _SPLIT_ROWS))
+        bounds = [lo + n * i // parts for i in range(parts)] + [hi + 1]
+        return [
+            spi.Split(table, schema, bounds[i], bounds[i + 1] - 1)
+            for i in range(parts)
+        ]
+
+    # ---------------------------------------------------------------- scan
+    def scan(self, split: spi.Split, columns: List[str], constraint=None):
+        meta = self.get_table(split.schema, split.table)
+        assert meta is not None
+        for c in columns:
+            _check_ident(c)
+        col_types = {c.name: c.type for c in meta.columns}
+        sel = ", ".join(f'"{c}"' for c in columns)
+        where, params = ["rowid between ? and ?"], [split.lo, split.hi]
+        if constraint is not None:
+            w, p = _compile_constraint(constraint, col_types)
+            where += w
+            params += p
+        sql = f'select {sel} from {split.table} where {" and ".join(where)}'
+        rows = self._conn().execute(sql, params).fetchall()
+        out: Dict[str, spi.ColumnData] = {}
+        for i, cname in enumerate(columns):
+            t = col_types[cname]
+            pycol = [_from_sql_value(t, r[i]) for r in rows]
+            out[cname] = spi.column_data_from_column(Column.from_python(t, pycol))
+        return out
+
+    # --------------------------------------------------------------- write
+    def create_table(self, schema: str, name: str, schema_def, rows) -> None:
+        _check_ident(name)
+        for c, _ in schema_def:
+            _check_ident(c)
+        if self.get_table(schema, name) is not None:
+            raise ValueError(f"table already exists: {schema}.{name}")
+        cols = ", ".join(f'"{c}" {_sqlite_decl(t)}' for c, t in schema_def)
+        conn = self._conn()
+        conn.execute(f'create table {name} ({cols})')
+        if rows:
+            ph = ", ".join("?" * len(schema_def))
+            conn.executemany(
+                f"insert into {name} values ({ph})",
+                [tuple(_to_sql_value(t, v) for (_, t), v in zip(schema_def, r))
+                 for r in rows],
+            )
+        conn.commit()
+
+    def insert_rows(self, schema: str, table: str, rows) -> int:
+        meta = self.get_table(schema, table)
+        if meta is None:
+            raise KeyError(f"sqlite.{schema}.{table} does not exist")
+        if rows:
+            ph = ", ".join("?" * len(meta.columns))
+            conn = self._conn()
+            conn.executemany(
+                f"insert into {table} values ({ph})",
+                [tuple(_to_sql_value(c.type, v) for c, v in zip(meta.columns, r))
+                 for r in rows],
+            )
+            conn.commit()
+        return len(rows)
+
+    def drop_table(self, schema: str, table: str) -> None:
+        _check_ident(table)
+        conn = self._conn()
+        conn.execute(f"drop table if exists {table}")
+        conn.commit()
+
+
+def _check_ident(name: str) -> None:
+    """Identifiers are interpolated into remote SQL: restrict to word
+    characters (the reference's QueryBuilder quotes through the JDBC
+    driver; sqlite3 has no identifier binding)."""
+    if not re.fullmatch(r"\w+", name):
+        raise ValueError(f"invalid sqlite identifier: {name!r}")
+
+
+def _to_repr_fn(t: T.Type):
+    """SQL value -> engine storage repr (for stats)."""
+    if t == T.DATE:
+        import datetime
+
+        def conv(v):
+            if isinstance(v, str):
+                return (datetime.date.fromisoformat(v) - datetime.date(1970, 1, 1)).days
+            return int(v)
+
+        return conv
+    if t.is_decimal:
+        scale = t.scale if isinstance(t, T.DecimalType) else 0
+        return lambda v: int(round(float(v) * 10**scale))
+    return lambda v: int(v)
+
+
+def _from_sql_value(t: T.Type, v):
+    """sqlite driver value -> Python value in the engine's expected kind."""
+    if v is None:
+        return None
+    if t == T.DATE:
+        return v  # ISO string or days; Column.from_python handles both
+    if t == T.BOOLEAN:
+        return bool(v)
+    if t.is_decimal:
+        from decimal import Decimal
+
+        return Decimal(str(v))
+    return v
+
+
+def _to_sql_value(t: T.Type, v):
+    if v is None:
+        return None
+    if t == T.DATE:
+        return str(v)
+    if t.is_decimal:
+        return str(v)
+    if t == T.BOOLEAN:
+        return int(bool(v))
+    if t.is_floating:
+        return float(v)  # engine literals may arrive as Decimal
+    if t.is_integer_kind:
+        return int(v)
+    return v
+
+
+def _compile_constraint(td: TupleDomain, col_types) -> tuple:
+    """TupleDomain -> (WHERE conjuncts, bind params): the reference's
+    QueryBuilder.toPredicate — pushdown evaluated by the remote engine."""
+    where, params = [], []
+    for column, dom in (td.domains or {}).items():
+        if column not in col_types or dom.is_all():
+            continue
+        if not re.fullmatch(r"\w+", column):
+            continue  # advisory constraint: skip rather than interpolate
+        t = col_types[column]
+        if t.is_decimal and (not isinstance(t, T.DecimalType) or t.scale != 0):
+            # fractional decimals bind as floats, whose rounding past 2^53
+            # could DROP matching rows remotely (the constraint is advisory
+            # — over-approximation only) — skip the pushdown
+            continue
+        conv = _param_fn(t)
+        q = f'"{column}"'
+        parts = []
+        if dom.values is not None:
+            vals = sorted(dom.values, key=str)
+            if not vals:
+                parts.append("1 = 0")
+            elif len(vals) <= 500:
+                ph = ", ".join("?" * len(vals))
+                parts.append(f"{q} in ({ph})")
+                params.extend(conv(v) for v in vals)
+            # else: too many keys — skip (advisory constraint)
+        else:
+            if dom.low is not None:
+                parts.append(f"{q} >{'=' if dom.low_inclusive else ''} ?")
+                params.append(conv(dom.low))
+            if dom.high is not None:
+                parts.append(f"{q} <{'=' if dom.high_inclusive else ''} ?")
+                params.append(conv(dom.high))
+        if not parts:
+            if not dom.null_allowed:
+                where.append(f"({q} is not null)")
+            continue
+        pred = " and ".join(parts)
+        if dom.null_allowed:
+            pred = f"({pred} or {q} is null)"
+        where.append(f"({pred})")
+    return where, params
+
+
+def _param_fn(t: T.Type):
+    """Engine storage repr -> SQL bind value."""
+    if t == T.DATE:
+        import datetime
+
+        return lambda v: (
+            (datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))).isoformat()
+            if not isinstance(v, str)
+            else v
+        )
+    if t.is_decimal:
+        # scale-0 decimals bind as exact ints (sqlite INTEGER affinity
+        # compares exactly); fractional decimals never push down (see
+        # _compile_constraint) so floats here can't drop rows
+        scale = t.scale if isinstance(t, T.DecimalType) else 0
+        if scale == 0:
+            return lambda v: int(v)
+        return lambda v: float(v) / 10**scale
+    return lambda v: v

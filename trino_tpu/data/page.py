@@ -59,6 +59,16 @@ class Column:
     # blocks); lengths instead of offsets keep every row-parallel kernel
     # (sel/null masks) shape-compatible with scalar columns.
     children: Optional[List["Column"]] = None
+    # Long-decimal (p > 18) high limb (reference: spi/type/Int128.java —
+    # two-longs-per-position flat storage). Present when the column holds
+    # (or, for unproven arithmetic results, MAY hold) values beyond int64:
+    # ``values`` is then the low 64-bit pattern and ``hi`` the signed high
+    # limb. Absent (None) = every value provably fits int64 and the column
+    # rides the narrow single-array layout — the adaptive analog of the
+    # reference's short/long decimal split, chosen from data/stats instead
+    # of per type. Consumers without limb kernels degrade via
+    # Executor._narrowed_or_flag (low word + deferred overflow check).
+    hi: Optional[jnp.ndarray] = None
 
     def __post_init__(self):
         if self.type.is_varchar and self.dictionary is None:
@@ -97,7 +107,17 @@ class Column:
         np_dtype = typ.np_dtype
         assert np_dtype is not None, f"unsupported type {typ}"
         fill = 0
-        arr = np.array([fill if v is None else _to_repr(typ, v) for v in data], dtype=np_dtype)
+        reprs = [fill if v is None else _to_repr(typ, v) for v in data]
+        if typ.is_decimal and any(
+            isinstance(r, int) and not -(2**63) <= r < 2**63 for r in reprs
+        ):
+            # long decimal beyond int64: two-limb storage (Int128.java)
+            lo = np.array([r & (2**64 - 1) for r in reprs], dtype=np.uint64)
+            hi = np.array([r >> 64 for r in reprs], dtype=np.int64)
+            return cls(
+                typ, jnp.asarray(lo.view(np.int64)), nulls, None, hi=jnp.asarray(hi)
+            )
+        arr = np.array(reprs, dtype=np_dtype)
         if n == 0:
             arr = np.empty(0, dtype=np_dtype)
         return cls(typ, jnp.asarray(arr), nulls, None)
@@ -130,6 +150,16 @@ class Column:
         """Device -> host, decoding reprs back to Python values."""
         if self.type.is_nested:
             return self._nested_to_python()
+        if self.hi is not None:
+            his = np.asarray(self.hi).tolist()
+            los = np.asarray(self.values).view(np.uint64).tolist()
+            nulls = np.asarray(self.nulls).tolist() if self.nulls is not None else None
+            out = [
+                _from_repr(self.type, (h << 64) | l) for h, l in zip(his, los)
+            ]
+            if nulls is not None:
+                out = [None if isnull else v for v, isnull in zip(out, nulls)]
+            return out
         vals = np.asarray(self.values)
         nulls = np.asarray(self.nulls) if self.nulls is not None else None
         if self.type.is_varchar:
@@ -197,9 +227,12 @@ def _to_repr(typ: T.Type, v):
         return int(v)
     if typ.is_decimal:
         assert isinstance(typ, T.DecimalType)
+        import decimal
         from decimal import Decimal
 
-        return int(Decimal(str(v)).scaleb(typ.scale).to_integral_value())
+        with decimal.localcontext() as ctx:
+            ctx.prec = 60  # p=38 plus headroom: scaleb must not round
+            return int(Decimal(str(v)).scaleb(typ.scale).to_integral_value())
     if typ == T.BOOLEAN:
         return bool(v)
     if typ.is_floating:
@@ -214,9 +247,12 @@ def _from_repr(typ: T.Type, r):
         return datetime.date(1970, 1, 1) + datetime.timedelta(days=int(r))
     if typ.is_decimal:
         assert isinstance(typ, T.DecimalType)
+        import decimal
         from decimal import Decimal
 
-        return Decimal(r).scaleb(-typ.scale)
+        with decimal.localcontext() as ctx:
+            ctx.prec = 60
+            return Decimal(r).scaleb(-typ.scale)
     if typ == T.BOOLEAN:
         return bool(r)
     if typ.is_floating:
@@ -256,7 +292,14 @@ def _concat_col(ca: Column, cb: Column) -> Column:
         na = ca.nulls if ca.nulls is not None else jnp.zeros((len(ca),), bool)
         nb = cb.nulls if cb.nulls is not None else jnp.zeros((len(cb),), bool)
         nulls = jnp.concatenate([na, nb])
-    return Column(ca.type, vals, nulls, d, merge_vrange(ca.vrange, cb.vrange))
+    hi = None
+    if ca.hi is not None or cb.hi is not None:
+        # a missing hi limb is the sign extension of the low word
+        ha = ca.hi if ca.hi is not None else (va.astype(jnp.int64) >> 63)
+        hb = cb.hi if cb.hi is not None else (vb.astype(jnp.int64) >> 63)
+        hi = jnp.concatenate([ha, hb])
+    vr = None if hi is not None else merge_vrange(ca.vrange, cb.vrange)
+    return Column(ca.type, vals, nulls, d, vr, hi=hi)
 
 
 def host_take(c: Column, idx: np.ndarray) -> Column:
@@ -294,6 +337,7 @@ def host_take(c: Column, idx: np.ndarray) -> Column:
         c.dictionary,
         c.vrange,
         ascending=monotone,
+        hi=jnp.asarray(np.asarray(c.hi)[idx]) if c.hi is not None else None,
     )
 
 
@@ -385,6 +429,7 @@ class Page:
                 c.dictionary,
                 c.vrange,
                 ascending=c.ascending,
+                hi=c.hi[lo:hi] if c.hi is not None else None,
             )
             for c in self.columns
         ]

@@ -55,8 +55,16 @@ def _serialize_column(col: Column, n: int, parts: List[bytes]) -> None:
     else:
         parts.append(b"\x00")
     vals_np = np.ascontiguousarray(np.asarray(col.values))
-    parts.append(struct.pack("<B", _DTYPE_CODES[vals_np.dtype]))
-    parts.append(vals_np.tobytes())
+    dtype_code = _DTYPE_CODES[vals_np.dtype]
+    if col.hi is not None:
+        # long-decimal two-limb column: flag bit 7 on the dtype code, hi
+        # limb block follows the low words (reference: Int128 flat storage)
+        parts.append(struct.pack("<B", dtype_code | 0x80))
+        parts.append(vals_np.tobytes())
+        parts.append(np.ascontiguousarray(np.asarray(col.hi)).tobytes())
+    else:
+        parts.append(struct.pack("<B", dtype_code))
+        parts.append(vals_np.tobytes())
     if col.type.is_varchar:
         assert col.dictionary is not None
         vocab = col.dictionary.values
@@ -118,10 +126,16 @@ def _deserialize_column(body: bytes, off: int, nrows: int):
         )[:nrows].astype(np.bool_)
         nulls = jnp.asarray(bits)
         off += nbytes
-    dt = _CODE_DTYPES[body[off]]
+    code = body[off]
+    has_hi = bool(code & 0x80)
+    dt = _CODE_DTYPES[code & 0x7F]
     off += 1
     vals = np.frombuffer(body, dtype=dt, count=nrows, offset=off)
     off += nrows * dt.itemsize
+    hi = None
+    if has_hi:
+        hi = np.frombuffer(body, dtype=np.int64, count=nrows, offset=off)
+        off += nrows * 8
     dictionary = None
     if typ.is_varchar:
         (dlen,) = struct.unpack_from("<I", body, off)
@@ -141,4 +155,10 @@ def _deserialize_column(body: bytes, off: int, nrows: int):
             off += 4
             child, off = _deserialize_column(body, off, crows)
             children.append(child)
-    return Column(typ, jnp.asarray(vals), nulls, dictionary, children=children), off
+    return (
+        Column(
+            typ, jnp.asarray(vals), nulls, dictionary, children=children,
+            hi=jnp.asarray(hi) if hi is not None else None,
+        ),
+        off,
+    )

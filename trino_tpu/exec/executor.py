@@ -61,11 +61,32 @@ def _col_from_lowered(t: T.Type, lv: L.LoweredVal) -> Column:
             _col_from_lowered(ct, k) for ct, k in zip(T.type_children(t), lv.children)
         ]
         return Column(t, lv.vals, nulls, None, children=children)
-    return Column(t, lv.vals, nulls, lv.dictionary)
+    # a static |value| bound proven by the lowering becomes the column's
+    # vrange, so downstream consumers (sum's int64-vs-limb choice, physical
+    # narrowing) keep their fast paths for projected expressions
+    vrange = (-lv.bound, lv.bound) if lv.bound is not None and lv.hi is None else None
+    return Column(t, lv.vals, nulls, lv.dictionary, vrange, hi=lv.hi)
 
 
 def _col_to_lowered(c: Column) -> join_ops.Lowered:
     return (c.values, None if c.nulls is None else ~c.nulls)
+
+
+def _key_lowereds(c: Column, force_two_limb: bool = False) -> List[join_ops.Lowered]:
+    """Key operands for grouping/joining/sorting one column. Two-limb long
+    decimals (Column.hi) contribute TWO lexicographic key operands:
+    (hi, lo-with-flipped-sign-bit) — the flip makes the unsigned low word
+    order correctly as a signed int64, and equality is flip-invariant, so
+    the same pair serves hash, merge, and order comparisons (reference:
+    Int128.compareTo = compare hi, then unsigned lo). ``force_two_limb``
+    expands a single-limb column the same way (sign-extended hi) so the
+    two sides of a join stay symmetric."""
+    if c.hi is None and not force_two_limb:
+        return [_col_to_lowered(c)]
+    valid = None if c.nulls is None else ~c.nulls
+    lo = c.values.astype(jnp.int64)
+    hi = c.hi if c.hi is not None else (lo >> 63)
+    return [(hi, valid), (lo ^ jnp.int64(-(2**63)), valid)]
 
 
 def assemble_scan_page(column_names, column_types, datas) -> Page:
@@ -81,7 +102,7 @@ def assemble_scan_page(column_names, column_types, datas) -> Page:
     cols: List[Column] = []
     for name, typ in zip(column_names, column_types):
         cd = concat_column_data([d[name] for d in datas])
-        if typ.is_nested:
+        if typ.is_nested or cd.hi is not None:
             cols.append(_column_from_data(cd))
             continue
         vals = np.asarray(cd.values)
@@ -120,6 +141,7 @@ def _column_from_data(cd) -> Column:
             if cd.children is not None
             else None
         ),
+        hi=jnp.asarray(cd.hi) if cd.hi is not None else None,
     )
 
 
@@ -192,14 +214,9 @@ def apply_dynamic_domains(node, dyn_domains, datas, allow=None):
         if keep.all():
             out.append(d)
             continue
-        out.append({
-            name: _dc.replace(
-                cd,
-                values=np.asarray(cd.values)[keep],
-                nulls=np.asarray(cd.nulls)[keep] if cd.nulls is not None else None,
-            )
-            for name, cd in d.items()
-        })
+        from trino_tpu.connector.spi import column_data_take
+
+        out.append({name: column_data_take(cd, keep) for name, cd in d.items()})
     return out
 
 
@@ -285,6 +302,36 @@ class Executor:
         st["output_rows"] = page.live_count()  # live rows, not padded slots
         return page
 
+    def _narrowed_or_flag(self, col: Column, sel=None) -> Column:
+        """Degrade a two-limb long-decimal column to its low word for
+        consumers without limb support (window args, map-building aggregate
+        keys, ...): LIVE rows whose value does not fit int64 raise the
+        deferred DECIMAL_OVERFLOW error — exactly the pre-limb-storage
+        contract, so in-range data keeps working and out-of-range data
+        fails loudly instead of silently truncating."""
+        if col.hi is None:
+            return col
+        fits = col.hi == (col.values.astype(jnp.int64) >> 63)
+        if col.nulls is not None:
+            fits = fits | col.nulls
+        if sel is not None:
+            fits = fits | ~sel
+        self.errors.append((L.DECIMAL_OVERFLOW, jnp.any(~fits)))
+        return Column(col.type, col.values, col.nulls, col.dictionary)
+
+    def _narrow_lowered_or_flag(self, arg, hi_l, sel_l=None):
+        """The layout-space analog of _narrowed_or_flag for payload pairs."""
+        if hi_l is None:
+            return arg
+        vals_l, valid_l = arg
+        fits = hi_l == (vals_l.astype(jnp.int64) >> 63)
+        if valid_l is not None:
+            fits = fits | ~valid_l
+        if sel_l is not None:
+            fits = fits | ~sel_l
+        self.errors.append((L.DECIMAL_OVERFLOW, jnp.any(~fits)))
+        return arg
+
     def _lower(self, e: ir.Expr, page: Page) -> L.LoweredVal:
         ctx = L.LowerCtx(page.columns, page.num_rows, page.sel)
         out = L.lower(e, ctx)
@@ -366,14 +413,9 @@ class Executor:
             keep = (l_cnt > 0) & (r_cnt > 0)
         else:  # except
             keep = (l_cnt > 0) & (r_cnt == 0)
-        keys = [_col_to_lowered(both.columns[c]) for c in range(both.channel_count)]
-        key_cols = gb.gather_group_keys(keys, layout.rep)
-        out_cols = [
-            Column(both.columns[i].type, v,
-                   None if valid is None else ~valid,
-                   both.columns[i].dictionary)
-            for i, (v, valid) in enumerate(key_cols)
-        ]
+        out_cols = self._gathered_key_cols(
+            both, list(range(both.channel_count)), layout
+        )
         return Page(out_cols, out_sel & keep, both.replicated)
 
     # --------------------------------------------------------------- filter
@@ -430,6 +472,8 @@ class Executor:
             arrays.append(c.values)
             if c.nulls is not None:
                 arrays.append(c.nulls)
+            if c.hi is not None:
+                arrays.append(c.hi)
         gathered = ranks_ops.batched_gather(arrays, idx)
         cols = []
         i = 0
@@ -440,9 +484,13 @@ class Executor:
             if c.nulls is not None:
                 nulls = gathered[i]
                 i += 1
+            chi = None
+            if c.hi is not None:
+                chi = gathered[i]
+                i += 1
             # stable: live rows keep their relative order -> ascending holds
             cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange,
-                               ascending=c.ascending))
+                               ascending=c.ascending, hi=chi))
         sel = jnp.arange(capacity, dtype=jnp.int32) < jnp.minimum(total, capacity)
         return Page(cols, sel, page.replicated, live_prefix=True)
 
@@ -554,25 +602,25 @@ class Executor:
         AccumulatorCompiler intermediate states through an exchange).
         State column types follow plan._acc_types so the page can cross the
         wire (serde needs faithful dtypes)."""
-        keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
         payload_arrays, slots = self._agg_payloads(node.aggregates, page.columns)
         layout, part_sel, payloads_l, sel_l = self.group_structure(
             node.group_channels, page, payload_arrays
         )
         out_cols: List[Column] = []
         if node.group_channels:
-            key_cols = gb.gather_group_keys(keys, layout.rep)
-            for i, c in enumerate(node.group_channels):
-                src = page.columns[c]
-                v, valid = key_cols[i]
-                out_cols.append(
-                    Column(src.type, v, None if valid is None else ~valid,
-                           src.dictionary, src.vrange)
-                )
+            out_cols.extend(
+                self._gathered_key_cols(page, node.group_channels, layout)
+            )
         src_types = node.source.output_types
         for call, slot in zip(node.aggregates, slots):
+            s1 = slot[0] if slot is not None else None
+            hi_l = self._slot_hi(payloads_l, s1)
+            arg1 = self._slot_arg(payloads_l, s1)
+            if hi_l is not None and call.function not in ("sum", "count"):
+                arg1 = self._narrow_lowered_or_flag(arg1, hi_l, sel_l)
+                hi_l = None
             states = self._partial_states(
-                call, page, layout, self._slot_arg(payloads_l, slot), sel_l
+                call, page, layout, arg1, sel_l, hi_l=hi_l,
             )
             state_types = P._acc_types(call, src_types)
             for (sv, valid), st in zip(states, state_types):
@@ -584,30 +632,29 @@ class Executor:
     def aggregate_final(self, node: P.AggregationNode, page: Page) -> Page:
         """Final aggregation over gathered partial-state pages."""
         k = len(node.group_channels)
-        keys = [_col_to_lowered(page.columns[c]) for c in range(k)]
         # state columns ride the grouping sort as payloads (layout space)
         payload_arrays: List = []
         state_slots: List = []
         for c in page.columns[k:]:
+            if c.hi is not None:
+                raise NotImplementedError(
+                    "distributed final aggregation over long-decimal states "
+                    "beyond int64 (single-process paths support them)"
+                )
             vi = len(payload_arrays)
             payload_arrays.append(c.values)
             hv = c.nulls is not None
             if hv:
                 payload_arrays.append(~c.nulls)
-            state_slots.append((vi, hv))
+            state_slots.append((vi, hv, None))
         layout, out_sel, payloads_l, sel_l = self.group_structure(
             list(range(k)), page, payload_arrays
         )
         out_cols: List[Column] = []
         if k:
-            key_cols = gb.gather_group_keys(keys, layout.rep)
-            for i in range(k):
-                src = page.columns[i]
-                v, valid = key_cols[i]
-                out_cols.append(
-                    Column(src.type, v, None if valid is None else ~valid,
-                           src.dictionary, src.vrange)
-                )
+            out_cols.extend(
+                self._gathered_key_cols(page, list(range(k)), layout)
+            )
         ci = 0
         for call in node.aggregates:
             # state layout must match what aggregate_partial emitted
@@ -619,7 +666,8 @@ class Executor:
             out_cols.append(self._combine_state(call, states, sel_l, layout))
         return Page(out_cols, out_sel, page.replicated)
 
-    def _partial_states(self, call: P.AggregateCall, page, layout, arg_l, sel_l):
+    def _partial_states(self, call: P.AggregateCall, page, layout, arg_l, sel_l,
+                        hi_l=None):
         """State arrays per aggregate: [(values, valid)], layout matching
         plan._acc_types. ``arg_l``/``sel_l`` are in layout space
         (group_structure payloads)."""
@@ -637,6 +685,14 @@ class Executor:
             v, _ = agg_ops.agg_count(layout, arg, sel)
             return [(v, None)]
         if call.function == "sum":
+            if P._is_long_decimal(call.output_type):
+                # two-limb running state (plan._acc_types): exact across the
+                # partial/final split for the full p38 range
+                vals_l, valid_l = arg
+                (s_hi, s_lo), nonempty = agg_ops.agg_sum_128(
+                    layout, vals_l, hi_l, valid_l, sel
+                )
+                return [(s_lo, nonempty), (s_hi, None)]
             return [agg_ops.agg_sum(layout, arg, sel, call.output_type.np_dtype)]
         if call.function == "avg":
             base = (
@@ -664,6 +720,15 @@ class Executor:
             m_l = valid_l if sel is None else (
                 sel if valid_l is None else (valid_l & sel))
             return hll.percentile_states(layout, vals_l, m_l)
+        if call.function in ("bool_and", "bool_or"):
+            fn = agg_ops.agg_min if call.function == "bool_and" else agg_ops.agg_max
+            v, valid = fn(layout, arg, sel)
+            return [(v.astype(bool), valid)]
+        if call.function == "count_if":
+            vals_l, valid_l = arg
+            m = vals_l if valid_l is None else (vals_l & valid_l)
+            v, _ = agg_ops.agg_count_star(layout, m if sel is None else m & sel)
+            return [(v, None)]
         raise NotImplementedError(call.function)
 
     def _combine_state(self, call: P.AggregateCall, states, sel, layout) -> Column:
@@ -673,6 +738,13 @@ class Executor:
             v, _ = agg_ops.agg_sum(layout, states[0], sel, np.dtype(np.int64))
             return Column(T.BIGINT, v, None, None)
         if call.function == "sum":
+            if P._is_long_decimal(call.output_type):
+                lo_v, lo_valid = states[0]
+                hi_v, _ = states[1]
+                (s_hi, s_lo), nonempty = agg_ops.agg_sum_128(
+                    layout, lo_v, hi_v, lo_valid, sel
+                )
+                return Column(call.output_type, s_lo, ~nonempty, None, hi=s_hi)
             v, valid = agg_ops.agg_sum(
                 layout, states[0], sel, call.output_type.np_dtype
             )
@@ -712,6 +784,14 @@ class Executor:
             v, valid = hll.percentile_merge(
                 layout, states[:-1], cnt_state, call.param)
             return Column(call.output_type, v, None if valid is None else ~valid, None)
+        if call.function in ("bool_and", "bool_or"):
+            fn = agg_ops.agg_min if call.function == "bool_and" else agg_ops.agg_max
+            v, valid = fn(layout, states[0], sel)
+            return Column(T.BOOLEAN, v.astype(bool),
+                          None if valid is None else ~valid, None)
+        if call.function == "count_if":
+            v, _ = agg_ops.agg_sum(layout, states[0], sel, np.dtype(np.int64))
+            return Column(T.BIGINT, v, None, None)
         raise NotImplementedError(call.function)
 
     def group_structure(
@@ -736,7 +816,7 @@ class Executor:
         that same space (a live-prefix mask after sorting dead rows last).
         """
         n = page.num_rows
-        keys = [_col_to_lowered(page.columns[c]) for c in group_channels]
+        keys = [kl for c in group_channels for kl in _key_lowereds(page.columns[c])]
         sel = page.sel
         if not group_channels:
             gids = jnp.zeros((n,), dtype=jnp.int32)
@@ -787,21 +867,40 @@ class Executor:
             if call.arg_channel is None or call.distinct:
                 slots.append(None)
                 continue
-            col = columns[call.arg_channel]
-            vi = len(payload_arrays)
-            payload_arrays.append(col.values)
-            hv = col.nulls is not None
-            if hv:
-                payload_arrays.append(~col.nulls)
-            slots.append((vi, hv))
+            def add(col):
+                vi = len(payload_arrays)
+                payload_arrays.append(col.values)
+                hv = col.nulls is not None
+                if hv:
+                    payload_arrays.append(~col.nulls)
+                hii = None
+                if col.hi is not None:  # long-decimal high limb rides along
+                    hii = len(payload_arrays)
+                    payload_arrays.append(col.hi)
+                return (vi, hv, hii)
+
+            s1 = add(columns[call.arg_channel])
+            s2 = (
+                add(columns[call.arg2_channel])
+                if call.arg2_channel is not None
+                else None
+            )
+            slots.append((s1, s2))
         return payload_arrays, slots
 
     @staticmethod
     def _slot_arg(payloads_l, slot):
         if slot is None:
             return None
-        vi, hv = slot
+        vi, hv, _ = slot
         return (payloads_l[vi], payloads_l[vi + 1] if hv else None)
+
+    @staticmethod
+    def _slot_hi(payloads_l, slot):
+        """Layout-space high-limb array of the aggregate argument, if any."""
+        if slot is None or slot[2] is None:
+            return None
+        return payloads_l[slot[2]]
 
     @staticmethod
     def _presorted_group(group_channels: List[int], page: Page):
@@ -862,58 +961,111 @@ class Executor:
             )
             n = 1
             sel = page.sel
-        keys = [_col_to_lowered(page.columns[c]) for c in node.group_channels]
         payload_arrays, slots = self._agg_payloads(node.aggregates, page.columns)
-        # array_agg needs group-contiguous rows in layout space (its output
-        # IS the per-group row runs); the direct masked-loop layout never
-        # permutes, so force the sort strategy
-        force_sort = any(c.function == "array_agg" for c in node.aggregates)
+        # array_agg/histogram/map_agg need group-contiguous rows in layout
+        # space (their outputs ARE the per-group row runs); the direct
+        # masked-loop layout never permutes, so force the sort strategy
+        force_sort = any(
+            c.function in ("array_agg", "histogram", "map_agg")
+            for c in node.aggregates
+        )
         layout, out_sel, payloads_l, sel_l = self.group_structure(
             node.group_channels, page, payload_arrays, force_sort=force_sort
         )
         out_cols: List[Column] = []
         if node.group_channels:
-            key_cols = gb.gather_group_keys(keys, layout.rep)
-            for i, c in enumerate(node.group_channels):
-                src = page.columns[c]
-                v, valid = key_cols[i]
-                nulls = None if valid is None else ~valid
-                out_cols.append(Column(src.type, v, nulls, src.dictionary, src.vrange))
+            out_cols.extend(
+                self._gathered_key_cols(page, node.group_channels, layout)
+            )
         for call, slot in zip(node.aggregates, slots):
-            if call.function == "array_agg":
+            s1, s2 = slot if slot is not None else (None, None)
+            if call.function in ("array_agg", "histogram", "map_agg"):
                 if call.distinct:
-                    raise NotImplementedError("array_agg(DISTINCT): not yet supported")
+                    raise NotImplementedError(
+                        f"{call.function}(DISTINCT): not yet supported")
                 out_cols.append(
-                    self._array_agg_column(
-                        call, page, layout, self._slot_arg(payloads_l, slot), sel_l
+                    self._nested_agg_column(
+                        call, page, layout,
+                        self._slot_arg(payloads_l, s1),
+                        self._slot_arg(payloads_l, s2) if s2 is not None else None,
+                        sel_l,
+                        hi_l=self._slot_hi(payloads_l, s1),
                     )
                 )
                 continue
-            vals, valid = self._exec_aggregate(
-                call, page, sel, layout, self._slot_arg(payloads_l, slot), sel_l
+            res = self._exec_aggregate(
+                call, page, sel, layout, self._slot_arg(payloads_l, s1), sel_l,
+                hi_l=self._slot_hi(payloads_l, s1),
+                arg2_l=self._slot_arg(payloads_l, s2) if s2 is not None else None,
+                hi2_l=self._slot_hi(payloads_l, s2) if s2 is not None else None,
             )
+            vals, valid = res[0], res[1]
+            hi_out = res[2] if len(res) > 2 else None
+            # value-carrying aggregates keep the argument's dictionary
+            dictionary = None
+            if call.function in ("min", "max", "arbitrary", "any_value",
+                                 "min_by", "max_by") and call.arg_channel is not None:
+                dictionary = page.columns[call.arg_channel].dictionary
             out_cols.append(
                 Column(
                     call.output_type,
                     vals,
                     (~valid) if valid is not None else None,
-                    None,
+                    dictionary,
+                    hi=hi_out,
                 )
             )
         return Page(out_cols, out_sel, page.replicated)
 
-    def _array_agg_column(self, call, page, layout, arg_l, sel_l) -> Column:
-        """array_agg: the output array column IS the group-contiguous row
-        runs of the grouping sort — per-slot lengths are the group ranges,
-        the flat child is the (layout-space) argument column itself. NULL
-        inputs are kept as NULL elements (reference: ArrayAggregation-
-        Function has them by default).
+    def _gathered_key_cols(self, page: Page, channels, layout) -> List[Column]:
+        """Output group-key columns gathered at each slot's representative
+        row, rebuilding two-limb long decimals from their (hi, lo-flipped)
+        key operand pairs (_key_lowereds)."""
+        keys, spans = [], []
+        for c in channels:
+            parts = _key_lowereds(page.columns[c])
+            spans.append((len(keys), len(parts)))
+            keys.extend(parts)
+        key_cols = gb.gather_group_keys(keys, layout.rep)
+        out = []
+        for (start, cnt), c in zip(spans, channels):
+            src = page.columns[c]
+            if cnt == 2:
+                hi_v, valid = key_cols[start]
+                lo_flip, _ = key_cols[start + 1]
+                lo = lo_flip ^ jnp.int64(-(2**63))
+                out.append(
+                    Column(src.type, lo, None if valid is None else ~valid,
+                           None, hi=hi_v)
+                )
+            else:
+                v, valid = key_cols[start]
+                out.append(
+                    Column(src.type, v, None if valid is None else ~valid,
+                           src.dictionary, src.vrange)
+                )
+        return out
 
+    def _nested_agg_column(self, call, page, layout, arg_l, arg2_l, sel_l,
+                           hi_l=None) -> Column:
+        """Aggregates with nested (array/map) outputs.
+
+        array_agg: the output array column IS the group-contiguous row runs
+        of the grouping sort — per-slot lengths are the group ranges, the
+        flat child is the (layout-space) argument column itself. NULL inputs
+        are kept as NULL elements (reference: ArrayAggregationFunction).
         Sorted layouts put live rows first, group-contiguous from position
         0, so cumsum(lengths) == starts for every live slot and the flat
         child aligns with no extra gather. The global (no GROUP BY) case
         rides the direct single-slot layout: live rows compact to a prefix
-        with one stable flag sort."""
+        with one stable flag sort.
+
+        histogram / map_agg re-group on (group, key) pairs (ops/aggregate.py
+        grouped_pairs): each distinct pair is one map entry; histogram's
+        values are the run counts, map_agg's the representative row's value
+        (duplicate keys keep an arbitrary one, matching the reference)."""
+        if call.function in ("histogram", "map_agg"):
+            return self._map_agg_column(call, page, layout, sel_l)
         vals_l, valid_l = arg_l
         src = page.columns[call.arg_channel]
         elem_t = call.output_type.element
@@ -921,7 +1073,7 @@ class Executor:
             assert layout.capacity == 1, "grouped array_agg must use a sorted layout"
             n = layout.n
             if sel_l is None:
-                flat, flat_valid = vals_l, valid_l
+                flat, flat_valid, flat_hi = vals_l, valid_l, hi_l
                 count = jnp.int32(n)
             else:
                 order = jax.lax.sort(
@@ -930,15 +1082,48 @@ class Executor:
                 )[1]
                 flat = vals_l[order]
                 flat_valid = valid_l[order] if valid_l is not None else None
+                flat_hi = hi_l[order] if hi_l is not None else None
                 count = jnp.sum(sel_l.astype(jnp.int32))
             lengths = count[None].astype(jnp.int32)
         else:
             lengths = (layout.ends - layout.starts).astype(jnp.int32)
-            flat, flat_valid = vals_l, valid_l
+            flat, flat_valid, flat_hi = vals_l, valid_l, hi_l
         child = Column(
-            elem_t, flat, None if flat_valid is None else ~flat_valid, src.dictionary
+            elem_t, flat, None if flat_valid is None else ~flat_valid, src.dictionary,
+            hi=flat_hi,
         )
-        return Column(call.output_type, lengths, None, children=[child])
+        # SQL: an aggregate over zero rows is NULL (a zero-length group can
+        # only arise from an empty input set)
+        return Column(call.output_type, lengths, lengths == 0, children=[child])
+
+    def _map_agg_column(self, call, page, layout, sel_l) -> Column:
+        """histogram(x) / map_agg(k, v) over original-order page columns
+        (grouped_pairs re-sorts internally; null keys drop per SQL)."""
+        # keys/values without limb kernels degrade to the low word with a
+        # deferred overflow check (see _narrowed_or_flag)
+        key_col = self._narrowed_or_flag(page.columns[call.arg_channel], page.sel)
+        key = _col_to_lowered(key_col)
+        # sel must be in ORIGINAL row order here (grouped_pairs resorts)
+        entry_counts, rep, run_counts, entry_live = agg_ops.grouped_pairs(
+            layout, key, page.sel
+        )
+        keys_flat = Column(
+            call.output_type.key, key_col.values[rep], None, key_col.dictionary
+        )
+        if call.function == "histogram":
+            vals_flat = Column(T.BIGINT, run_counts)
+        else:
+            vcol = page.columns[call.arg2_channel]
+            vvals = vcol.values[rep]
+            vnulls = vcol.nulls[rep] if vcol.nulls is not None else None
+            vhi = vcol.hi[rep] if vcol.hi is not None else None
+            vals_flat = Column(call.output_type.value, vvals, vnulls,
+                               vcol.dictionary, hi=vhi)
+        # SQL: null for groups whose input set is empty after null-key drops
+        return Column(
+            call.output_type, entry_counts, entry_counts == 0,
+            children=[keys_flat, vals_flat],
+        )
 
     _in_spill_pass = False  # reentrancy guard for partitioned passes
 
@@ -966,10 +1151,21 @@ class Executor:
             self._in_spill_pass = False
         return out
 
-    def _exec_aggregate(self, call: P.AggregateCall, page, sel, layout, arg_l, sel_l):
-        """``arg_l``/``sel_l`` are in layout space (group_structure
+    def _exec_aggregate(
+        self, call: P.AggregateCall, page, sel, layout, arg_l, sel_l,
+        hi_l=None, arg2_l=None, hi2_l=None,
+    ):
+        """``arg_l``/``sel_l``/``hi_l`` are in layout space (group_structure
         payloads); the DISTINCT path re-groups and takes the original-order
-        page column instead."""
+        page column instead. Returns (vals, valid) — or (lo, valid, hi) for
+        two-limb long-decimal results."""
+        if hi_l is not None and call.function not in ("sum", "count"):
+            # no limb kernel for this aggregate: degrade to the low word
+            # with a deferred overflow check (the pre-limb contract)
+            arg_l = self._narrow_lowered_or_flag(arg_l, hi_l, sel_l)
+            hi_l = None
+        if hi2_l is not None:
+            arg2_l = self._narrow_lowered_or_flag(arg2_l, hi2_l, sel_l)
         if call.function == "approx_percentile":
             if call.distinct:
                 raise NotImplementedError(
@@ -999,6 +1195,25 @@ class Executor:
         if call.function == "count":
             return agg_ops.agg_count(layout, arg, sel)
         if call.function == "sum":
+            vals_l, valid_l = arg
+            out_t = call.output_type
+            need128 = hi_l is not None
+            if (not need128 and isinstance(out_t, T.DecimalType)
+                    and out_t.precision > 18):
+                # int64 accumulation is exact only when stats bound the
+                # total; otherwise take the limb path (correct for the full
+                # p38 range instead of silently wrapping)
+                src = page.columns[call.arg_channel]
+                bound_ok = False
+                if src.vrange is not None:
+                    b = max(abs(int(src.vrange[0])), abs(int(src.vrange[1])))
+                    bound_ok = b * max(layout.n, 1) < 2**62
+                need128 = not bound_ok
+            if need128:
+                (s_hi, s_lo), nonempty = agg_ops.agg_sum_128(
+                    layout, vals_l, hi_l, valid_l, sel
+                )
+                return s_lo, nonempty, s_hi
             return agg_ops.agg_sum(layout, arg, sel, call.output_type.np_dtype)
         if call.function == "avg":
             base = (
@@ -1018,6 +1233,76 @@ class Executor:
             return agg_ops.agg_var(
                 layout, arg, sel, call.function, t.scale if t.is_decimal else 0
             )
+        if call.function in ("bool_and", "bool_or"):
+            # boolean min/max (reference: BooleanAndAggregation/BooleanOr)
+            vals_l, valid_l = arg
+            fn = agg_ops.agg_min if call.function == "bool_and" else agg_ops.agg_max
+            v, valid = fn(layout, (vals_l, valid_l), sel)
+            return v.astype(bool), valid
+        if call.function == "count_if":
+            vals_l, valid_l = arg
+            m = vals_l if valid_l is None else (vals_l & valid_l)
+            return agg_ops.agg_count_star(layout, m if sel is None else m & sel)
+        if call.function in ("arbitrary", "any_value"):
+            return agg_ops.agg_first(layout, arg, sel)
+        if call.function == "geometric_mean":
+            vals_l, valid_l = arg
+            t = page.columns[call.arg_channel].type
+            x = vals_l.astype(jnp.float64)
+            if t.is_decimal:
+                x = x / (10.0 ** t.scale)
+            ln = jnp.log(jnp.maximum(x, 1e-300))  # non-positive -> NaN domain
+            ln = jnp.where(x > 0, ln, jnp.nan)
+            s, nonempty = agg_ops.agg_sum(layout, (ln, valid_l), sel, np.dtype(np.float64))
+            cnt, _ = agg_ops.agg_count(layout, arg, sel)
+            v = jnp.exp(s / jnp.maximum(cnt, 1))
+            return v, nonempty
+        if call.function == "checksum":
+            # order-independent 64-bit checksum: sum (mod 2^64) of per-row
+            # CONTENT hashes (reference ChecksumAggregation is xor-of-hash;
+            # same properties, engine-specific constant). Varchar hashes the
+            # UTF-8 string per vocab entry (dictionary codes are ranks and
+            # would collide across datasets); floats hash their bit pattern.
+            from trino_tpu.parallel.exchange import _mix64 as mix64
+
+            vals_l, valid_l = arg
+            src = page.columns[call.arg_channel]
+            if src.dictionary is not None:
+                import hashlib
+
+                lut = np.array(
+                    [
+                        int.from_bytes(
+                            hashlib.blake2b(v.encode(), digest_size=8).digest(),
+                            "little", signed=True)
+                        for v in src.dictionary.values
+                    ] or [0],
+                    dtype=np.int64,
+                )
+                h = jnp.asarray(lut)[jnp.clip(vals_l, 0, len(lut) - 1)]
+            else:
+                x = vals_l
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    x = jax.lax.bitcast_convert_type(
+                        x.astype(jnp.float64), jnp.int64)
+                h = mix64(x.astype(jnp.int64).astype(jnp.uint64)).astype(jnp.int64)
+            if valid_l is not None:
+                h = jnp.where(valid_l, h, jnp.int64(-7046029254386353131))
+            v, _ = agg_ops.agg_sum(layout, (h, None), sel, np.dtype(np.int64))
+            return v, None
+        if call.function in ("min_by", "max_by"):
+            return agg_ops.agg_minmax_by(
+                layout, arg, arg2_l, sel, call.function == "min_by"
+            )
+        if call.function in ("corr", "covar_samp", "covar_pop",
+                             "regr_slope", "regr_intercept"):
+            tx = page.columns[call.arg_channel].type
+            ty = page.columns[call.arg2_channel].type
+            return agg_ops.agg_bivariate(
+                layout, arg, arg2_l, sel, call.function,
+                tx.scale if tx.is_decimal else 0,
+                ty.scale if ty.is_decimal else 0,
+            )
         raise NotImplementedError(call.function)
 
     # -------------------------------------------------------------- window
@@ -1028,16 +1313,22 @@ class Executor:
         from trino_tpu.ops import window as win_ops
 
         n = page.num_rows
-        pkeys = [_col_to_lowered(page.columns[c]) for c in node.partition_channels]
+        pkeys = [
+            kl for c in node.partition_channels
+            for kl in _key_lowereds(page.columns[c])
+        ]
         okeys = [
-            (_col_to_lowered(page.columns[c]), asc, nf)
+            (kl, asc, nf)
             for c, asc, nf in node.order_channels
+            for kl in _key_lowereds(page.columns[c])
         ]
         layout = win_ops.build_layout(pkeys, okeys, page.sel, n)
         out_cols = list(page.columns)
         for call, name in zip(node.calls, node.names):
             arg = (
-                _col_to_lowered(page.columns[call.arg_channel])
+                _col_to_lowered(
+                    self._narrowed_or_flag(page.columns[call.arg_channel],
+                                           page.sel))
                 if call.arg_channel is not None
                 else None
             )
@@ -1209,20 +1500,64 @@ class Executor:
         self.capacity_hints[key] = cap
         return cap
 
+    @staticmethod
+    def _join_keys_aligned(left: Page, right: Page, left_keys, right_keys):
+        """(build_keys, probe_keys) aligned for the join kernels, expanding
+        two-limb long-decimal key columns into (hi, lo-flipped) pairs on
+        BOTH sides symmetrically (_key_lowereds)."""
+        build_keys, probe_keys, bvr, pvr = [], [], [], []
+        for lc, rc in zip(left_keys, right_keys):
+            bc, pc = right.columns[rc], left.columns[lc]
+            if bc.hi is not None or pc.hi is not None:
+                # symmetric two-limb expansion on BOTH sides (_key_lowereds)
+                build_keys.extend(_key_lowereds(bc, force_two_limb=True))
+                probe_keys.extend(_key_lowereds(pc, force_two_limb=True))
+                bvr.extend([None, None])
+                pvr.extend([None, None])
+            else:
+                build_keys.append(_col_to_lowered(bc))
+                probe_keys.append(_col_to_lowered(pc))
+                bvr.append(bc.vrange)
+                pvr.append(pc.vrange)
+        return join_ops.align_join_keys(build_keys, probe_keys, bvr, pvr)
+
     def _expansion_keys(self, node: P.JoinNode, left: Page, right: Page):
         if node.left_keys:
-            build_keys = [_col_to_lowered(right.columns[c]) for c in node.right_keys]
-            probe_keys = [_col_to_lowered(left.columns[c]) for c in node.left_keys]
-            return join_ops.align_join_keys(
-                build_keys, probe_keys,
-                [right.columns[c].vrange for c in node.right_keys],
-                [left.columns[c].vrange for c in node.left_keys],
+            return self._join_keys_aligned(
+                left, right, node.left_keys, node.right_keys
             )
         # cross join: everything matches everything (constant key)
         build_keys = [(jnp.zeros((right.num_rows,), jnp.int32), None)]
         probe_keys = [(jnp.zeros((left.num_rows,), jnp.int32), None)]
         return build_keys, probe_keys
 
+
+    @staticmethod
+    def _gather_right_cols(right_cols, rows, mask) -> List[Column]:
+        """Gather build-side payload columns by matched row ids, carrying
+        two-limb hi limbs as extra gather operands."""
+        lows = []
+        for rc in right_cols:
+            if rc.type.is_nested:
+                raise NotImplementedError("array/map columns through join payloads")
+            lows.append(_col_to_lowered(rc))
+        hi_map = {}
+        for i, rc in enumerate(right_cols):
+            if rc.hi is not None:
+                hi_map[i] = len(lows)
+                lows.append((rc.hi, None))
+        g = join_ops.gather_columns(lows, rows, mask)
+        out = []
+        for i, rc in enumerate(right_cols):
+            v, valid = g[i]
+            hi = g[hi_map[i]][0] if i in hi_map else None
+            out.append(
+                Column(
+                    rc.type, v, ~valid if valid is not None else None,
+                    rc.dictionary, rc.vrange if hi is None else None, hi=hi,
+                )
+            )
+        return out
 
     @staticmethod
     def _build_presorted(page: Page, key_channels) -> bool:
@@ -1259,9 +1594,13 @@ class Executor:
         # rows on v5e — see ranks.batched_gather)
         left_arrays = [lo, counts]
         for c in left.columns:
+            if c.type.is_nested:
+                raise NotImplementedError("array/map columns through join payloads")
             left_arrays.append(c.values)
             if c.nulls is not None:
                 left_arrays.append(c.nulls)
+            if c.hi is not None:
+                left_arrays.append(c.hi)
         g = ranks_ops.batched_gather(left_arrays, p)
         lo_p, counts_p = g[0], g[1]
         matched = live & (k < counts_p)
@@ -1276,14 +1615,14 @@ class Executor:
             if c.nulls is not None:
                 nulls = g[gi]
                 gi += 1
-            out_cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange))
-        right_lowered = join_ops.gather_columns(
-            [_col_to_lowered(rc) for rc in right.columns], rows, matched
-        )
-        for rc, (v, valid) in zip(right.columns, right_lowered):
+            chi = None
+            if c.hi is not None:
+                chi = g[gi]
+                gi += 1
             out_cols.append(
-                Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary, rc.vrange)
-            )
+                Column(c.type, v, nulls, c.dictionary,
+                       c.vrange if chi is None else None, hi=chi))
+        out_cols.extend(self._gather_right_cols(right.columns, rows, matched))
         page = Page(out_cols, live, left.replicated and right.replicated)
         if node.filter is None:
             return page
@@ -1330,9 +1669,13 @@ class Executor:
         self.errors.append((f"CAPACITY_EXCEEDED:join:{node.id}", total > capacity))
         left_arrays = [lo]
         for c in left.columns:
+            if c.type.is_nested:
+                raise NotImplementedError("array/map columns through join payloads")
             left_arrays.append(c.values)
             if c.nulls is not None:
                 left_arrays.append(c.nulls)
+            if c.hi is not None:
+                left_arrays.append(c.hi)
         g = ranks_ops.batched_gather(left_arrays, p)
         b_idx = jnp.clip(g[0] + k, 0, build.n - 1)
         rows = build.rows[b_idx]
@@ -1345,14 +1688,14 @@ class Executor:
             if c.nulls is not None:
                 nulls = g[gi]
                 gi += 1
-            exp_cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange))
-        right_lowered = join_ops.gather_columns(
-            [_col_to_lowered(rc) for rc in right.columns], rows, live
-        )
-        for rc, (v, valid) in zip(right.columns, right_lowered):
+            chi = None
+            if c.hi is not None:
+                chi = g[gi]
+                gi += 1
             exp_cols.append(
-                Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary, rc.vrange)
-            )
+                Column(c.type, v, nulls, c.dictionary,
+                       c.vrange if chi is None else None, hi=chi))
+        exp_cols.extend(self._gather_right_cols(right.columns, rows, live))
         exp_page = Page(exp_cols, live, left.replicated and right.replicated)
         lv = self._lower(node.filter, exp_page)
         passed = lv.vals if lv.valid is None else (lv.vals & lv.valid)
@@ -1364,25 +1707,15 @@ class Executor:
         return Page(left.columns, sel, left.replicated)
 
     def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
-        build_keys = [_col_to_lowered(right.columns[c]) for c in node.right_keys]
-        probe_keys = [_col_to_lowered(left.columns[c]) for c in node.left_keys]
-        build_keys, probe_keys = join_ops.align_join_keys(
-            build_keys, probe_keys,
-            [right.columns[c].vrange for c in node.right_keys],
-            [left.columns[c].vrange for c in node.left_keys],
+        build_keys, probe_keys = self._join_keys_aligned(
+            left, right, node.left_keys, node.right_keys
         )
         build = join_ops.build_side(
             build_keys, right.sel,
             presorted=self._build_presorted(right, node.right_keys))
         rows, matched = join_ops.probe_unique(build, probe_keys)
         out_cols = list(left.columns)
-        right_lowered = join_ops.gather_columns(
-            [_col_to_lowered(rc) for rc in right.columns], rows, matched
-        )
-        for rc, (v, valid) in zip(right.columns, right_lowered):
-            out_cols.append(
-                Column(rc.type, v, ~valid if valid is not None else None, rc.dictionary, rc.vrange)
-            )
+        out_cols.extend(self._gather_right_cols(right.columns, rows, matched))
         if node.join_type == "inner":
             sel = matched if left.sel is None else (left.sel & matched)
         else:  # left outer: probe rows always survive; build cols null when unmatched
@@ -1403,12 +1736,8 @@ class Executor:
         return page
 
     def semi_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
-        build_keys = [_col_to_lowered(right.columns[c]) for c in node.right_keys]
-        probe_keys = [_col_to_lowered(left.columns[c]) for c in node.left_keys]
-        build_keys, probe_keys = join_ops.align_join_keys(
-            build_keys, probe_keys,
-            [right.columns[c].vrange for c in node.right_keys],
-            [left.columns[c].vrange for c in node.left_keys],
+        build_keys, probe_keys = self._join_keys_aligned(
+            left, right, node.left_keys, node.right_keys
         )
         hit = join_ops.membership(
             build_keys, right.sel, probe_keys,
@@ -1461,13 +1790,17 @@ class Executor:
             # path serves root-level ORDER BY over array_agg/unnest results
             return self._sorted_page_host(page, sort_channels, limit)
         keys = [
-            (_col_to_lowered(page.columns[c]), asc, nf) for c, asc, nf in sort_channels
+            (kl, asc, nf)
+            for c, asc, nf in sort_channels
+            for kl in _key_lowereds(page.columns[c])
         ]
         payloads = []
         for c in page.columns:
             payloads.append(c.values)
             if c.nulls is not None:
                 payloads.append(c.nulls)
+            if c.hi is not None:
+                payloads.append(c.hi)
         sorted_arrays = sort_ops.sort_payloads(keys, page.sel, payloads)
         live = (
             jnp.asarray(n, dtype=jnp.int64) if page.sel is None else jnp.sum(page.sel)
@@ -1484,7 +1817,12 @@ class Executor:
             if c.nulls is not None:
                 nulls = sorted_arrays[i]
                 i += 1
-            cols.append(Column(c.type, v, nulls, c.dictionary, c.vrange))
+            chi = None
+            if c.hi is not None:
+                chi = sorted_arrays[i]
+                i += 1
+            cols.append(Column(c.type, v, nulls, c.dictionary,
+                               c.vrange if chi is None else None, hi=chi))
         return Page(cols, sel, page.replicated)
 
     def _sorted_page_host(self, page: Page, sort_channels, limit=None) -> Page:

@@ -109,6 +109,10 @@ def partition_page_host(page, key_channels, parts: int, pid=None):
         h = np.zeros(n, np.uint64)
         for ch in key_channels:
             col = page.columns[ch]
+            # hash the LOW limb only: equal values always share it, and a
+            # column's hi-limb PRESENCE is data-dependent (one join side may
+            # carry it while the other doesn't) — mixing hi in would place
+            # equal keys in different partitions across sides/producers
             k = _mix64_np(np.asarray(col.values).astype(np.int64))
             if col.nulls is not None:
                 k = np.where(np.asarray(col.nulls), np.uint64(_NULL_HASH), k)
@@ -116,27 +120,16 @@ def partition_page_host(page, key_channels, parts: int, pid=None):
         pid = (h % np.uint64(parts)).astype(np.int64)
     else:
         pid = np.asarray(pid)
-    host_cols = [
-        (np.asarray(c.values), None if c.nulls is None else np.asarray(c.nulls))
-        for c in page.columns
-    ]
+    from trino_tpu.data.page import host_take
+
     out = []
     for p in range(parts):
         idx = np.nonzero(live & (pid == p))[0]
         if len(idx) == 0:
             out.append(_pad_like(page))
             continue
-        cols = [
-            Column(
-                c.type,
-                jnp.asarray(vals[idx]),
-                jnp.asarray(nulls[idx]) if nulls is not None else None,
-                c.dictionary,
-                c.vrange,
-            )
-            for c, (vals, nulls) in zip(page.columns, host_cols)
-        ]
-        out.append(Page(cols, None, page.replicated))
+        # host_take handles two-limb and nested columns uniformly
+        out.append(Page([host_take(c, idx) for c in page.columns], None, page.replicated))
     return out
 
 

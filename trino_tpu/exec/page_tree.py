@@ -29,22 +29,36 @@ class PageSpec:
     # (data/page.py) — static metadata licensing sort-free fast paths
     ascending: Optional[List[bool]] = None
     live_prefix: bool = False
+    # per-column long-decimal high-limb presence (data/page.py Column.hi)
+    has_hi: Optional[List[bool]] = None
 
     def array_count(self) -> int:
         """How many flat arrays a page with this spec occupies."""
-        return len(self.types) + sum(self.has_nulls) + (1 if self.has_sel else 0)
+        return (
+            len(self.types) + sum(self.has_nulls) + (1 if self.has_sel else 0)
+            + sum(self.has_hi or ())
+        )
 
 
 def flatten_page(page: Page) -> Tuple[List[jnp.ndarray], PageSpec]:
     arrays: List[jnp.ndarray] = []
     has_nulls = []
+    has_hi = []
     for c in page.columns:
+        if c.type.is_nested:
+            raise NotImplementedError(
+                "array/map columns across the jit page boundary")
         arrays.append(c.values)
         if c.nulls is not None:
             arrays.append(c.nulls)
             has_nulls.append(True)
         else:
             has_nulls.append(False)
+        if c.hi is not None:
+            arrays.append(c.hi)
+            has_hi.append(True)
+        else:
+            has_hi.append(False)
     if page.sel is not None:
         arrays.append(page.sel)
     spec = PageSpec(
@@ -55,6 +69,7 @@ def flatten_page(page: Page) -> Tuple[List[jnp.ndarray], PageSpec]:
         [c.vrange for c in page.columns],
         [c.ascending for c in page.columns],
         page.live_prefix,
+        has_hi,
     )
     return arrays, spec
 
@@ -64,14 +79,19 @@ def unflatten_page(spec: PageSpec, arrays: List[jnp.ndarray]) -> Page:
     i = 0
     vranges = spec.vranges or [None] * len(spec.types)
     asc = spec.ascending or [False] * len(spec.types)
-    for t, d, hn, vr, a in zip(
-            spec.types, spec.dictionaries, spec.has_nulls, vranges, asc):
+    has_hi = spec.has_hi or [False] * len(spec.types)
+    for t, d, hn, vr, a, hh in zip(
+            spec.types, spec.dictionaries, spec.has_nulls, vranges, asc, has_hi):
         vals = arrays[i]
         i += 1
         nulls = None
         if hn:
             nulls = arrays[i]
             i += 1
-        cols.append(Column(t, vals, nulls, d, vr, a))
+        hi = None
+        if hh:
+            hi = arrays[i]
+            i += 1
+        cols.append(Column(t, vals, nulls, d, vr, a, hi=hi))
     sel = arrays[i] if spec.has_sel else None
     return Page(cols, sel, live_prefix=spec.live_prefix)

@@ -40,6 +40,23 @@ def run_query(session, sql: str) -> QueryResult:
         return _insert(session, stmt)
     if isinstance(stmt, ast.DropTable):
         return _drop_table(session, stmt)
+    if isinstance(stmt, ast.StartTransaction):
+        from trino_tpu.exec import transaction as txn_mod
+
+        txn_mod.begin(session)
+        return QueryResult(["result"], [], [("START TRANSACTION",)])
+    if isinstance(stmt, ast.Commit):
+        txn = getattr(session, "transaction", None)
+        if txn is None:
+            raise ValueError("no transaction in progress")
+        txn.commit()
+        return QueryResult(["result"], [], [("COMMIT",)])
+    if isinstance(stmt, ast.Rollback):
+        txn = getattr(session, "transaction", None)
+        if txn is None:
+            raise ValueError("no transaction in progress")
+        txn.rollback()
+        return QueryResult(["result"], [], [("ROLLBACK",)])
     if isinstance(stmt, ast.SetSession):
         session.set_property(stmt.name, stmt.value)
         return QueryResult(["result"], [], [("SET SESSION",)])
@@ -108,6 +125,11 @@ def _resolve_table_name(session, parts, write: bool = False):
         ac = getattr(session, "access_control", None)
         if ac is not None:
             ac.check_can_write(session.identity, catalog, schema, table)
+        txn = getattr(session, "transaction", None)
+        if txn is not None:
+            # writes inside an explicit transaction go to its overlay
+            # (exec/transaction.py; reference: TransactionManager handles)
+            txn.enlist(catalog)
     return session.catalogs[catalog], schema, table
 
 

@@ -1,0 +1,210 @@
+"""Transactions: explicit START TRANSACTION / COMMIT / ROLLBACK plus
+atomic auto-commit DML.
+
+Reference: ``core/trino-main/.../transaction/InMemoryTransactionManager.java``
+— a transaction owns per-catalog connector transaction handles; metadata
+reads inside it see the transaction's isolated view; commit publishes
+atomically, abort discards. Here the same shape with the engine's one
+transactional connector (memory): a transaction wraps the catalog in a
+copy-on-write OVERLAY — reads hit the overlay first, writes mutate only
+the overlay — and COMMIT swaps the staged tables into the base connector
+under its lock in one step. Non-transactional connectors inside an explicit
+transaction raise, matching the reference's "Catalog only supports writes
+using autocommit" error.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from trino_tpu.connector import spi
+
+
+class TransactionError(RuntimeError):
+    pass
+
+
+class TransactionalOverlay(spi.Connector):
+    """The memory connector's transaction view: staged creates/drops/appends
+    living only in this object until commit (reference: each connector's
+    ConnectorTransactionHandle-scoped metadata)."""
+
+    coordinator_only = True
+
+    def __init__(self, base):
+        self.base = base
+        self.name = base.name
+        self._staged: Dict[Tuple[str, str], Optional[tuple]] = {}
+        # (schema, table) -> (meta, cols) staged state, or None = dropped
+
+    # --- reads: overlay first -------------------------------------------
+    def list_schemas(self):
+        return self.base.list_schemas()
+
+    def list_tables(self, schema):
+        names = {
+            n for n in self.base.list_tables(schema)
+            if self._staged.get((schema, n), ()) is not None
+        }
+        names |= {
+            t for (s, t), v in self._staged.items() if s == schema and v is not None
+        }
+        return sorted(names)
+
+    def get_table(self, schema, table):
+        key = (schema, table)
+        if key in self._staged:
+            st = self._staged[key]
+            return None if st is None else st[0]
+        return self.base.get_table(schema, table)
+
+    def table_row_count(self, schema, table):
+        key = (schema, table)
+        if key in self._staged:
+            st = self._staged[key]
+            if st is None:
+                return None
+            _, cols = st
+            first = next(iter(cols.values()), None)
+            return 0 if first is None else len(first.values)
+        return self.base.table_row_count(schema, table)
+
+    def column_stats(self, schema, table, column):
+        if (schema, table) in self._staged:
+            return None  # staged data: no stats (conservative)
+        return self.base.column_stats(schema, table, column)
+
+    def primary_key(self, schema, table):
+        if (schema, table) in self._staged:
+            return None
+        return self.base.primary_key(schema, table)
+
+    def get_splits(self, schema, table, target_splits, constraint=None):
+        if (schema, table) in self._staged:
+            st = self._staged[(schema, table)]
+            if st is None:
+                raise KeyError(f"{self.name}.{schema}.{table} does not exist")
+            n = self.table_row_count(schema, table) or 0
+            return [spi.Split(table, schema, 0, n)]
+        return self.base.get_splits(schema, table, target_splits, constraint)
+
+    def scan(self, split, columns, constraint=None):
+        key = (split.schema, split.table)
+        if key in self._staged:
+            st = self._staged[key]
+            assert st is not None
+            _, cols = st
+            return {c: spi.column_data_slice(cols[c], split.lo, split.hi) for c in columns}
+        return self.base.scan(split, columns, constraint)
+
+    # --- writes: stage only ---------------------------------------------
+    def _snapshot(self, schema, table):
+        """Copy the base table into the overlay (copy-on-write)."""
+        key = (schema, table)
+        if key in self._staged:
+            if self._staged[key] is None:
+                raise KeyError(
+                    f"{self.name}.{schema}.{table} does not exist "
+                    "(dropped in this transaction)"
+                )
+            return
+        entry = self.base._tables.get(key)
+        if entry is None:
+            raise KeyError(f"{self.name}.{schema}.{table} does not exist")
+        meta, cols = entry
+        self._staged[key] = (meta, dict(cols))
+
+    def create_table(self, schema, name, schema_def, rows):
+        if self.get_table(schema, name) is not None:
+            raise ValueError(f"table already exists: {schema}.{name}")
+        from trino_tpu.data.page import Column
+
+        cols = {
+            cname: spi.column_data_from_column(
+                Column.from_python(ctype, [r[i] for r in rows])
+            )
+            for i, (cname, ctype) in enumerate(schema_def)
+        }
+        meta = spi.TableMetadata(
+            schema, name, [spi.ColumnMetadata(n, t) for n, t in schema_def]
+        )
+        self._staged[(schema, name)] = (meta, cols)
+
+    def insert_rows(self, schema, table, rows):
+        self._snapshot(schema, table)
+        meta, cols = self._staged[(schema, table)]
+        if not rows:
+            return 0
+        from trino_tpu.data.page import Column
+
+        new_cols = {}
+        for i, cm in enumerate(meta.columns):
+            col = Column.from_python(cm.type, [r[i] for r in rows])
+            new_cols[cm.name] = spi.concat_column_data(
+                [cols[cm.name], spi.column_data_from_column(col)]
+            )
+        self._staged[(schema, table)] = (meta, new_cols)
+        return len(rows)
+
+    def drop_table(self, schema, table):
+        if self.get_table(schema, table) is None:
+            return
+        self._staged[(schema, table)] = None
+
+    # --- lifecycle -------------------------------------------------------
+    def publish(self):
+        """Apply every staged change to the base connector atomically."""
+        with _BASE_LOCK:
+            for (schema, table), st in self._staged.items():
+                if st is None:
+                    self.base._tables.pop((schema, table), None)
+                else:
+                    self.base._tables[(schema, table)] = st
+
+
+_BASE_LOCK = threading.Lock()
+
+
+class Transaction:
+    """One explicit transaction: catalog name -> overlay."""
+
+    def __init__(self, session):
+        self.session = session
+        self.overlays: Dict[str, TransactionalOverlay] = {}
+        self.saved: Dict[str, spi.Connector] = {}
+
+    def enlist(self, catalog: str):
+        """Wrap ``catalog`` in an overlay on first touch (reference:
+        TransactionManager.getConnectorTransaction creating the handle)."""
+        if catalog in self.overlays:
+            return
+        conn = self.session.catalogs[catalog]
+        if not getattr(conn, "supports_transactions", False):
+            raise TransactionError(
+                f"catalog '{catalog}' only supports writes using autocommit"
+            )
+        ov = TransactionalOverlay(conn)
+        self.saved[catalog] = conn
+        self.overlays[catalog] = ov
+        self.session.catalogs[catalog] = ov
+
+    def commit(self):
+        for ov in self.overlays.values():
+            ov.publish()
+        self._restore()
+
+    def rollback(self):
+        self._restore()
+
+    def _restore(self):
+        for catalog, conn in self.saved.items():
+            self.session.catalogs[catalog] = conn
+        self.session.transaction = None
+
+
+def begin(session) -> Transaction:
+    if getattr(session, "transaction", None) is not None:
+        raise TransactionError("a transaction is already in progress")
+    txn = Transaction(session)
+    session.transaction = txn
+    return txn

@@ -52,6 +52,47 @@ def agg_sum(layout: GroupLayout, arg: Lowered, sel, out_dtype):
     return total, cnt > 0
 
 
+def agg_sum_128(
+    layout: GroupLayout,
+    lo: jnp.ndarray,
+    hi: Optional[jnp.ndarray],
+    valid: Optional[jnp.ndarray],
+    sel,
+):
+    """Exact int128 grouped sum via 32-bit limb decomposition (reference:
+    DecimalSumAggregation over Int128State). Each value's two's-complement
+    128-bit pattern splits into four unsigned 32-bit limbs; per-limb sums
+    are exact in int64 for < 2^31 rows (the cumsum-diff machinery of
+    seg_sum applies unchanged), and a carry-propagating recombination over
+    the capacity-sized limb sums rebuilds (hi, lo) mod 2^128 — summing
+    two's-complement patterns mod 2^128 IS signed int128 summation.
+
+    Returns ((hi, lo) int64 slot arrays, non_empty mask)."""
+    m = _live(sel, valid)
+    lo64 = lo.astype(jnp.int64)
+    hi64 = hi if hi is not None else (lo64 >> 63)
+    M32 = jnp.int64(0xFFFFFFFF)
+    limbs = [
+        lo64 & M32,
+        (lo64 >> 32) & M32,
+        hi64 & M32,
+        (hi64 >> 32) & M32,
+    ]
+    sums = [seg.seg_sum(layout, limb, m, jnp.int64) for limb in limbs]
+    t0 = sums[0].astype(jnp.uint64)
+    w0 = t0 & jnp.uint64(0xFFFFFFFF)
+    t1 = sums[1].astype(jnp.uint64) + (t0 >> 32)
+    w1 = t1 & jnp.uint64(0xFFFFFFFF)
+    t2 = sums[2].astype(jnp.uint64) + (t1 >> 32)
+    w2 = t2 & jnp.uint64(0xFFFFFFFF)
+    t3 = sums[3].astype(jnp.uint64) + (t2 >> 32)
+    w3 = t3 & jnp.uint64(0xFFFFFFFF)
+    out_lo = (w0 | (w1 << 32)).astype(jnp.int64)
+    out_hi = (w2 | (w3 << 32)).astype(jnp.int64)
+    cnt = seg.seg_count(layout, m)
+    return (out_hi, out_lo), cnt > 0
+
+
 def agg_count_distinct(layout: GroupLayout, arg: Lowered, sel):
     """count(DISTINCT x) per group: re-group on (gid, x) pairs, then count
     distinct pairs back into the outer group. Reference: MarkDistinct +
@@ -81,6 +122,126 @@ def agg_count_distinct(layout: GroupLayout, arg: Lowered, sel):
         inner_live.astype(jnp.int64), outer_of_slot, layout.capacity
     )
     return cnt, None
+
+
+def agg_first(layout: GroupLayout, arg: Lowered, sel):
+    """arbitrary()/any_value(): the first live non-null value per group
+    (reference: ArbitraryAggregation — any value is legal; first is
+    deterministic here). Scatter-free: per-slot min of masked positions,
+    then one gather."""
+    vals, valid = arg
+    m = _live(sel, valid)
+    n = layout.n
+    pos = jnp.arange(n, dtype=jnp.int32)
+    cand = pos if m is None else jnp.where(m, pos, jnp.int32(n))
+    first = seg.seg_minmax(layout, cand, None, is_min=True)
+    has = first < n
+    return vals[jnp.clip(first, 0, n - 1)], has
+
+
+def agg_minmax_by(layout: GroupLayout, arg: Lowered, key: Lowered, sel, is_min: bool):
+    """min_by/max_by(x, y): x at the row with the extreme y (reference:
+    MinMaxByAggregations). Two passes: per-slot extreme y, then the first
+    row matching it (broadcast the slot extreme back by group id), then
+    gather x there. Rows with NULL y are ignored."""
+    vals, valid = arg
+    kv, kvalid = key
+    m = _live(sel, kvalid)
+    best = seg.seg_minmax(layout, kv, m, is_min)
+    n = layout.n
+    per_row_best = best[jnp.clip(layout.gids_layout(), 0, layout.capacity - 1)]
+    hit = kv == per_row_best
+    if m is not None:
+        hit = hit & m
+    pos = jnp.arange(n, dtype=jnp.int32)
+    first = seg.seg_minmax(layout, jnp.where(hit, pos, jnp.int32(n)), None, is_min=True)
+    has = first < n
+    idx = jnp.clip(first, 0, n - 1)
+    v = vals[idx]
+    vvalid = has if valid is None else has & valid[idx]
+    return v, vvalid
+
+
+def agg_bivariate(layout: GroupLayout, argy: Lowered, argx: Lowered, sel,
+                  fn: str, y_scale: int, x_scale: int):
+    """corr / covar_samp / covar_pop / regr_slope / regr_intercept over
+    (y, x) pairs — rows where either side is NULL are ignored (reference:
+    the *Aggregation classes over CovarianceState/CorrelationState/
+    RegressionState). Raw-moment formulation: five segment sums; fine for
+    the double-precision contract these functions carry."""
+    yv, yvalid = argy
+    xv, xvalid = argx
+    m = _live(sel, _live(yvalid, xvalid))
+    y = yv.astype(jnp.float64)
+    x = xv.astype(jnp.float64)
+    if y_scale:
+        y = y / (10.0 ** y_scale)
+    if x_scale:
+        x = x / (10.0 ** x_scale)
+    cnt = seg.seg_count(layout, m)
+    sx = seg.seg_sum(layout, x, m, jnp.float64)
+    sy = seg.seg_sum(layout, y, m, jnp.float64)
+    sxy = seg.seg_sum(layout, x * y, m, jnp.float64)
+    sxx = seg.seg_sum(layout, x * x, m, jnp.float64)
+    syy = seg.seg_sum(layout, y * y, m, jnp.float64)
+    nf = jnp.maximum(cnt, 1).astype(jnp.float64)
+    mean_x = sx / nf
+    mean_y = sy / nf
+    cov_pop = sxy / nf - mean_x * mean_y
+    var_x = sxx / nf - mean_x * mean_x
+    var_y = syy / nf - mean_y * mean_y
+    if fn == "covar_pop":
+        return cov_pop, cnt > 0
+    if fn == "covar_samp":
+        v = (sxy - sx * sy / nf) / jnp.maximum(nf - 1.0, 1.0)
+        return v, cnt > 1
+    if fn == "corr":
+        denom = jnp.sqrt(jnp.maximum(var_x * var_y, 0.0))
+        v = cov_pop / jnp.where(denom > 0, denom, 1.0)
+        return v, (cnt > 1) & (denom > 0)
+    if fn == "regr_slope":
+        v = cov_pop / jnp.where(var_x > 0, var_x, 1.0)
+        return v, (cnt > 1) & (var_x > 0)
+    if fn == "regr_intercept":
+        slope = cov_pop / jnp.where(var_x > 0, var_x, 1.0)
+        v = mean_y - slope * mean_x
+        return v, (cnt > 1) & (var_x > 0)
+    raise NotImplementedError(fn)
+
+
+def grouped_pairs(layout: GroupLayout, key: Lowered, sel):
+    """Distinct (group, key) pairs for map-building aggregates (histogram,
+    map_agg). Reference: operator/aggregation/histogram/ + MapAggregation.
+
+    Reuses the count(DISTINCT) re-grouping: sort rows by (outer gid, key)
+    with dead/null-key rows last; each run is one map entry, runs are
+    ordered by outer group and contiguous from slot 0 — exactly the flat
+    child layout a nested map column wants (cumsum of per-group entry
+    counts == run starts).
+
+    Returns (entry_counts[capacity] int32, rep[n] original-row index per
+    entry slot, run_counts[n] int64 rows per entry, entry_live[n] bool)."""
+    from trino_tpu.ops import groupby as gb
+
+    vals, valid = key
+    n = vals.shape[0]
+    live = _live(sel, valid)
+    outer_gids = layout.gids_orig()
+    order, gid_sorted, num_inner, _ = gb.group_plan(
+        [(outer_gids, None), (vals, None)], live
+    )
+    inner = seg.sorted_layout(order, gid_sorted, num_inner)
+    entry_live = jnp.arange(n) < num_inner
+    outer_of_slot = jnp.where(
+        entry_live,
+        outer_gids[jnp.clip(inner.rep, 0, n - 1)].astype(jnp.int32),
+        jnp.int32(layout.capacity),
+    )
+    entry_counts = seg.monotonic_segment_sum(
+        entry_live.astype(jnp.int64), outer_of_slot, layout.capacity
+    ).astype(jnp.int32)
+    run_counts = (inner.ends - inner.starts).astype(jnp.int64)
+    return entry_counts, jnp.clip(inner.rep, 0, n - 1), run_counts, entry_live
 
 
 def var_states(layout: GroupLayout, arg: Lowered, sel, scale: int):

@@ -55,6 +55,9 @@ class LoweredVal:
     # ``children`` the flattened element LoweredVals (array: [elements],
     # map: [keys, values]) — mirroring data/page.py Column.children.
     children: Optional[List["LoweredVal"]] = None
+    # Long-decimal high limb (data/page.py Column.hi): present -> ``vals``
+    # is the low 64-bit pattern of an int128 value
+    hi: Optional[jnp.ndarray] = None
 
 
 class LowerCtx:
@@ -99,7 +102,7 @@ def lower(expr: ir.Expr, ctx: LowerCtx) -> LoweredVal:
                 LoweredVal(k.values, None if k.nulls is None else ~k.nulls, k.dictionary)
                 for k in col.children
             ]
-        return LoweredVal(col.values, valid, col.dictionary, bound, children)
+        return LoweredVal(col.values, valid, col.dictionary, bound, children, hi=col.hi)
     if isinstance(expr, ir.Constant):
         return _lower_constant(expr, ctx)
     if isinstance(expr, ir.Cast):
@@ -170,6 +173,23 @@ def _comparison(op: Callable, negate_eq: bool = False) -> Callable:
             if negate_eq:
                 return LoweredVal(~out.vals, out.valid, None)
             return out
+        if a.hi is not None or b.hi is not None:
+            # two-limb operand(s): compare as int128 at the common scale;
+            # op applied to the {-1,0,1} comparator output vs 0 reproduces
+            # every comparison operator (reference: Int128.compareTo)
+            from trino_tpu.ops import int128 as i128
+
+            if at.is_floating or bt.is_floating:
+                fa = _to_float128(a, at)
+                fb = _to_float128(b, bt)
+                return LoweredVal(op(fa, fb), and_valid(a.valid, b.valid), None)
+            s = max(_scale_of(at), _scale_of(bt))
+            a128 = i128.rescale(as_i128(a), _scale_of(at), s)
+            b128 = i128.rescale(as_i128(b), _scale_of(bt), s)
+            cmp = i128.compare(a128, b128)
+            return LoweredVal(
+                op(cmp, jnp.zeros((), cmp.dtype)), and_valid(a.valid, b.valid), None
+            )
         if at.is_varchar and bt.is_varchar:
             av, bv = _align_varchar(a, b)
         else:
@@ -227,6 +247,25 @@ def _array_equal(a: LoweredVal, b: LoweredVal, at, bt) -> LoweredVal:
     return LoweredVal(vals, valid, None)
 
 
+def as_i128(lv: LoweredVal):
+    """LoweredVal -> (hi, lo) int128 limbs (sign-extending when narrow)."""
+    lo = lv.vals.astype(jnp.int64)
+    hi = lv.hi if lv.hi is not None else (lo >> 63)
+    return hi, lo
+
+
+def _to_float128(lv: LoweredVal, t: T.Type) -> jnp.ndarray:
+    """Two-limb (or plain) numeric value -> float64 at its decimal scale."""
+    if lv.hi is None:
+        v = lv.vals.astype(jnp.float64)
+    else:
+        ulo = lv.vals.astype(jnp.uint64).astype(jnp.float64)
+        v = lv.hi.astype(jnp.float64) * float(2**64) + ulo
+    if t.is_decimal:
+        v = v / (10.0 ** _scale_of(t))
+    return v
+
+
 def _numeric_align(av, at: T.Type, bv, bt: T.Type):
     """Bring two numeric/date arrays to a common comparable representation."""
     if at.is_decimal or bt.is_decimal:
@@ -267,14 +306,26 @@ def _prec_of(t: T.Type) -> int:
     return {"tinyint": 3, "smallint": 5, "integer": 10}.get(t.name, 19)
 
 
-def _narrow128(ctx, out128, valid):
-    """int128 -> int64 storage; flags DECIMAL_OVERFLOW where it can't fit
-    (reference throws past p=38; long-decimal storage here is int64-wide,
-    see ops/int128.py)."""
+def _finish128(ctx, out128, valid, rt: T.Type, bound=None) -> LoweredVal:
+    """Finish an int128 arithmetic result: flag DECIMAL_OVERFLOW past the
+    result precision's 10^p cap (reference: Int128Math overflow checks /
+    DecimalOperators rescale throws), then store two-limb for p > 18
+    results and narrow to int64 for short ones (where |v| < 10^18 always
+    fits). Reference: the short/long decimal storage split of
+    spi/type/Int128.java, decided here by result type."""
     from trino_tpu.ops import int128 as i128
 
-    ctx.add_error(DECIMAL_OVERFLOW, ~i128.fits_int64(out128), valid)
-    return i128.to_int64(out128)
+    p = min(_prec_of(rt), 38)
+    limit = 10**p
+    (ahi, alo), _ = i128.abs128(out128)
+    lo_bits = limit & (2**64 - 1)
+    lo_signed = lo_bits - 2**64 if lo_bits >= 2**63 else lo_bits
+    lim = (jnp.full_like(ahi, limit >> 64), jnp.full_like(alo, lo_signed))
+    over = i128.compare((ahi, alo), lim) >= 0
+    ctx.add_error(DECIMAL_OVERFLOW, over, valid)
+    if p > 18:
+        return LoweredVal(out128[1], valid, None, bound, hi=out128[0])
+    return LoweredVal(i128.to_int64(out128), valid, None, bound)
 
 
 def _rescaled_bound(bound: int, from_scale: int, to_scale: int) -> int:
@@ -303,12 +354,15 @@ def _arith(name: str):
             rs = _scale_of(rt)
             sa, sb = _scale_of(at), _scale_of(bt)
             pa, pb = _prec_of(at), _prec_of(bt)
+            two_limb_in = a.hi is not None or b.hi is not None
+            if two_limb_in:
+                have_bounds = False  # bounds never cover two-limb values
             if name in ("add", "sub"):
                 # int128 path when a rescaled operand or the result can
                 # exceed 18 digits (reference: Int128Math add/subtract) —
                 # UNLESS static bounds prove an int64 fit (the value-range
                 # analog of the short/long decimal split)
-                need128 = max(pa + (rs - sa), pb + (rs - sb)) > 18
+                need128 = two_limb_in or max(pa + (rs - sa), pb + (rs - sb)) > 18
                 if need128 and have_bounds:
                     s = _rescaled_bound(ba, sa, rs) + _rescaled_bound(bb, sb, rs)
                     if s < _INT64_SAFE:
@@ -317,17 +371,16 @@ def _arith(name: str):
                 elif not need128 and have_bounds:
                     out_bound = _rescaled_bound(ba, sa, rs) + _rescaled_bound(bb, sb, rs)
                 if need128:
-                    a128, ova = i128.rescale_checked(i128.from_int64(av.astype(jnp.int64)), sa, rs)
-                    b128, ovb = i128.rescale_checked(i128.from_int64(bv.astype(jnp.int64)), sb, rs)
+                    a128, ova = i128.rescale_checked(as_i128(a), sa, rs)
+                    b128, ovb = i128.rescale_checked(as_i128(b), sb, rs)
                     ctx.add_error(DECIMAL_OVERFLOW, ova | ovb, valid)
                     out128 = i128.add(a128, b128) if name == "add" else i128.sub(a128, b128)
-                    out = _narrow128(ctx, out128, valid)
-                else:
-                    av = _rescale_decimal(av.astype(jnp.int64), sa, rs)
-                    bv = _rescale_decimal(bv.astype(jnp.int64), sb, rs)
-                    out = av + bv if name == "add" else av - bv
+                    return _finish128(ctx, out128, valid, rt)
+                av = _rescale_decimal(av.astype(jnp.int64), sa, rs)
+                bv = _rescale_decimal(bv.astype(jnp.int64), sb, rs)
+                out = av + bv if name == "add" else av - bv
             elif name == "mul":
-                need128 = pa + pb + 1 > 18
+                need128 = two_limb_in or pa + pb + 1 > 18
                 if have_bounds:
                     prod_bound = ba * bb * (10 ** max(rs - sa - sb, 0))
                     if need128 and prod_bound < _INT64_SAFE:
@@ -335,24 +388,47 @@ def _arith(name: str):
                     if prod_bound < _INT64_SAFE:
                         out_bound = _rescaled_bound(ba * bb, sa + sb, rs)
                 if need128:
-                    # full 128-bit product, rescale half-up, narrow + flag
-                    prod = i128.mul_int64(av.astype(jnp.int64), bv.astype(jnp.int64))
-                    out = _narrow128(ctx, i128.rescale(prod, sa + sb, rs), valid)
-                else:
-                    out = _rescale_decimal(av.astype(jnp.int64) * bv.astype(jnp.int64), sa + sb, rs)
+                    if two_limb_in:
+                        prod, ovm = i128.mul_checked(as_i128(a), as_i128(b))
+                        ctx.add_error(DECIMAL_OVERFLOW, ovm, valid)
+                    else:
+                        prod = i128.mul_int64(av.astype(jnp.int64), bv.astype(jnp.int64))
+                    return _finish128(ctx, i128.rescale(prod, sa + sb, rs), valid, rt)
+                out = _rescale_decimal(av.astype(jnp.int64) * bv.astype(jnp.int64), sa + sb, rs)
             elif name == "div":
+                if b.hi is not None:
+                    # two-limb divisor: full 128/128 long division, half-up
+                    bh, bl = as_i128(b)
+                    is_zero = (bh == 0) & (bl == 0)
+                    ctx.add_error(DIVISION_BY_ZERO, is_zero, valid)
+                    shift = rs - sa + sb
+                    num128, ovn = i128.rescale_checked(as_i128(a), 0, shift)
+                    ctx.add_error(DECIMAL_OVERFLOW, ovn, valid)
+                    nabs, nneg = i128.abs128(num128)
+                    dabs, dneg = i128.abs128((bh, jnp.where(is_zero, 1, bl)))
+                    q, r = i128.divmod_u128(nabs, dabs)
+                    # round half away from zero: 2r >= d
+                    r2 = i128.add(r, r)
+                    r2h = r2[0].astype(jnp.uint64)
+                    dh = dabs[0].astype(jnp.uint64)
+                    up = (r2h > dh) | ((r2h == dh) & (
+                        r2[1].astype(jnp.uint64) >= dabs[1].astype(jnp.uint64)))
+                    q = i128.add(q, (jnp.zeros_like(q[0]), up.astype(jnp.int64)))
+                    negq = i128.neg(q)
+                    flip = nneg ^ dneg
+                    out128 = (jnp.where(flip, negq[0], q[0]),
+                              jnp.where(flip, negq[1], q[1]))
+                    return _finish128(ctx, out128, valid, rt)
                 ctx.add_error(DIVISION_BY_ZERO, bv == 0, valid)
                 shift = rs - sa + sb
                 den64 = jnp.where(bv == 0, 1, bv.astype(jnp.int64))
-                need128 = pa + shift > 18
+                need128 = two_limb_in or pa + shift > 18
                 if need128 and have_bounds and ba * 10 ** max(shift, 0) < _INT64_SAFE:
                     need128 = False
                     out_bound = ba * 10 ** max(shift, 0)
                 if need128:
                     # 128-bit numerator / 64-bit divisor, half-up
-                    num128, ovn = i128.rescale_checked(
-                        i128.from_int64(av.astype(jnp.int64)), 0, shift
-                    )
+                    num128, ovn = i128.rescale_checked(as_i128(a), 0, shift)
                     ctx.add_error(DECIMAL_OVERFLOW, ovn, valid)
                     (nhi, nlo), nneg = i128.abs128(num128)
                     dabs = jnp.abs(den64).astype(jnp.uint64)
@@ -362,12 +438,21 @@ def _arith(name: str):
                     negq = i128.neg(q)
                     flip = nneg ^ (den64 < 0)
                     out128 = (jnp.where(flip, negq[0], q[0]), jnp.where(flip, negq[1], q[1]))
-                    out = _narrow128(ctx, out128, valid)
-                else:
-                    num = av.astype(jnp.int64) * (10 ** shift)
-                    q = jnp.floor_divide(jnp.abs(num) + jnp.abs(den64) // 2, jnp.abs(den64))
-                    out = jnp.sign(num) * jnp.sign(den64) * q
+                    return _finish128(ctx, out128, valid, rt)
+                num = av.astype(jnp.int64) * (10 ** shift)
+                q = jnp.floor_divide(jnp.abs(num) + jnp.abs(den64) // 2, jnp.abs(den64))
+                out = jnp.sign(num) * jnp.sign(den64) * q
             elif name == "mod":
+                if two_limb_in:
+                    # no limb kernel: degrade to the low words with the
+                    # deferred overflow check (pre-limb-storage contract)
+                    for opnd in (a, b):
+                        if opnd.hi is not None:
+                            lo64 = opnd.vals.astype(jnp.int64)
+                            ctx.add_error(
+                                DECIMAL_OVERFLOW, opnd.hi != (lo64 >> 63), valid)
+                    av = a.vals
+                    bv = b.vals
                 s = max(sa, sb)
                 av = _rescale_decimal(av.astype(jnp.int64), sa, s)
                 bv = _rescale_decimal(bv.astype(jnp.int64), sb, s)
@@ -699,9 +784,17 @@ def _lower_coalesce(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
         if acc.valid is None:
             return acc
         nxt = lower(nxt_expr, ctx)
-        vals = jnp.where(acc.valid, acc.vals, nxt.vals)
+        hi = None
+        if acc.hi is not None or nxt.hi is not None:
+            ah, al = as_i128(acc)
+            bh, bl = as_i128(nxt)
+            vals = jnp.where(acc.valid, al, bl)
+            hi = jnp.where(acc.valid, ah, bh)
+        else:
+            vals = jnp.where(acc.valid, acc.vals, nxt.vals)
         nxt_valid = nxt.valid if nxt.valid is not None else jnp.ones_like(acc.valid)
-        acc = LoweredVal(vals, acc.valid | nxt_valid, acc.dictionary or nxt.dictionary)
+        acc = LoweredVal(vals, acc.valid | nxt_valid,
+                         acc.dictionary or nxt.dictionary, hi=hi)
     return acc
 
 
@@ -886,11 +979,21 @@ def _lower_extremum(is_greatest: bool):
 
 def _lower_negate(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     a = lower(expr.args[0], ctx)
+    if a.hi is not None:
+        from trino_tpu.ops import int128 as i128
+
+        nhi, nlo = i128.neg(as_i128(a))
+        return LoweredVal(nlo, a.valid, None, hi=nhi)
     return LoweredVal(-a.vals, a.valid, None)
 
 
 def _lower_abs(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     a = lower(expr.args[0], ctx)
+    if a.hi is not None:
+        from trino_tpu.ops import int128 as i128
+
+        (ahi, alo), _ = i128.abs128(as_i128(a))
+        return LoweredVal(alo, a.valid, None, hi=ahi)
     return LoweredVal(jnp.abs(a.vals), a.valid, None)
 
 
@@ -899,7 +1002,7 @@ def _lower_nullif(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     eq = lower(ir.Call(T.BOOLEAN, "eq", (expr.args[0], expr.args[1])), ctx)
     hit = eq.vals if eq.valid is None else eq.vals & eq.valid
     valid = (~hit) if a.valid is None else (a.valid & ~hit)
-    return LoweredVal(a.vals, valid, a.dictionary)
+    return LoweredVal(a.vals, valid, a.dictionary, hi=a.hi)
 
 
 def _lower_case(expr: ir.Case, ctx: LowerCtx) -> LoweredVal:
@@ -909,6 +1012,7 @@ def _lower_case(expr: ir.Case, ctx: LowerCtx) -> LoweredVal:
     valid = jnp.zeros((ctx.num_rows,), dtype=bool)
     decided = jnp.zeros((ctx.num_rows,), dtype=bool)
     dictionary = None
+    hi = None  # grows when any branch carries a two-limb long decimal
     for cond_e, val_e in expr.whens:
         c = lower(cond_e, ctx)
         cv = c.vals if c.valid is None else c.vals & c.valid
@@ -919,14 +1023,28 @@ def _lower_case(expr: ir.Case, ctx: LowerCtx) -> LoweredVal:
                 # Mixed-dictionary CASE branches need a recode pass (not yet implemented).
                 raise NotImplementedError("varchar CASE over distinct dictionaries")
             dictionary = v.dictionary
-        vals = jnp.where(take, v.vals.astype(dtype), vals)
+        if v.hi is not None and hi is None:
+            hi = vals.astype(jnp.int64) >> 63  # promote accumulated branches
+        if hi is not None:
+            vh, vl = as_i128(v)
+            vals = jnp.where(take, vl, vals.astype(jnp.int64))
+            hi = jnp.where(take, vh, hi)
+        else:
+            vals = jnp.where(take, v.vals.astype(dtype), vals)
         valid = jnp.where(take, v.valid if v.valid is not None else True, valid)
         decided = decided | take
     if expr.default is not None:
         d = lower(expr.default, ctx)
-        vals = jnp.where(decided, vals, d.vals.astype(dtype))
+        if d.hi is not None and hi is None:
+            hi = vals.astype(jnp.int64) >> 63
+        if hi is not None:
+            dh, dl = as_i128(d)
+            vals = jnp.where(decided, vals.astype(jnp.int64), dl)
+            hi = jnp.where(decided, hi, dh)
+        else:
+            vals = jnp.where(decided, vals, d.vals.astype(dtype))
         valid = jnp.where(decided, valid, d.valid if d.valid is not None else True)
-    return LoweredVal(vals, valid, dictionary)
+    return LoweredVal(vals, valid, dictionary, hi=hi)
 
 
 def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
@@ -935,12 +1053,20 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
     if ft == tt:
         return a
     if tt.is_floating:
+        if a.hi is not None:
+            return LoweredVal(_to_float128(a, ft).astype(tt.np_dtype), a.valid, None)
         v = a.vals.astype(jnp.float64)
         if ft.is_decimal:
             v = v / (10.0 ** _scale_of(ft))
         return LoweredVal(v.astype(tt.np_dtype), a.valid, None)
     if tt.is_decimal:
         rs = _scale_of(tt)
+        if a.hi is not None:
+            from trino_tpu.ops import int128 as i128
+
+            out128, ov = i128.rescale_checked(as_i128(a), _scale_of(ft), rs)
+            ctx.add_error(DECIMAL_OVERFLOW, ov, a.valid)
+            return _finish128(ctx, out128, a.valid, tt)
         if ft.is_floating:
             scaled = a.vals.astype(jnp.float64) * (10.0**rs)
             # half away from zero (reference DecimalCasts), not jnp.round's
@@ -956,6 +1082,14 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
         return LoweredVal(v, a.valid, None, bound)
     if tt.is_integer_kind:
         if ft.is_decimal:
+            if a.hi is not None:
+                from trino_tpu.ops import int128 as i128
+
+                out128 = i128.rescale(as_i128(a), _scale_of(ft), 0)
+                ctx.add_error(NUMERIC_OVERFLOW, ~i128.fits_int64(out128), a.valid)
+                return LoweredVal(
+                    i128.to_int64(out128).astype(tt.np_dtype), a.valid, None
+                )
             v = _rescale_decimal(a.vals.astype(jnp.int64), _scale_of(ft), 0)
             bound = None if a.bound is None else _rescaled_bound(a.bound, _scale_of(ft), 0)
         elif ft.is_floating:
@@ -972,6 +1106,384 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
             return LoweredVal(a.vals, a.valid, a.dictionary)
         raise NotImplementedError("cast to varchar lowering: not yet supported")
     return LoweredVal(a.vals.astype(tt.np_dtype), a.valid, a.dictionary)
+
+
+# --- scalar breadth: regexp / JSON / datetime strings / bitwise ----------
+# Varchar functions are DICTIONARY TRANSFORMS: the host applies the Python
+# implementation once per vocab entry, the device gathers codes through a
+# lookup table (_vocab_transform/_vocab_lut) — O(vocab) host work replaces
+# O(rows) per-row evaluation (reference: operator/scalar/StringFunctions,
+# JoniRegexpFunctions, JsonFunctions evaluate per row).
+
+
+def _lower_regexp(kind: str):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        x = lower(expr.args[0], ctx)
+        pat_e = expr.args[1]
+        assert isinstance(pat_e, ir.Constant) and isinstance(pat_e.value, str), (
+            "regexp pattern must be a varchar literal")
+        pattern = re.compile(pat_e.value)
+        if kind == "like":
+            return _vocab_lut(ctx, x, lambda v: pattern.search(v) is not None, np.bool_)
+        if kind == "count":
+            return _vocab_lut(
+                ctx, x, lambda v: len(pattern.findall(v)), np.int64)
+        if kind == "extract":
+            group = 0
+            if len(expr.args) == 3:
+                a2 = expr.args[2]
+                assert isinstance(a2, ir.Constant), "regexp group must be a literal"
+                group = int(a2.value)
+
+            def ext(v):
+                m = pattern.search(v)
+                return m.group(group) if m else ""
+
+            # NULL result when no match (Trino returns NULL, not ''):
+            has = _vocab_lut(ctx, x, lambda v: pattern.search(v) is not None, np.bool_)
+            out = _vocab_transform(ctx, x, ext)
+            return LoweredVal(out.vals, and_valid(out.valid, has.vals), out.dictionary)
+        # replace
+        repl = _const_str_args(expr, 2)[0] if len(expr.args) == 3 else ""
+        repl_py = re.sub(r"\$(\d+)", r"\\\1", repl)  # $1 -> \1 (Trino syntax)
+        return _vocab_transform(ctx, x, lambda v: pattern.sub(repl_py, v))
+
+    return fn
+
+
+def _lower_pad(left: bool):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        x = lower(expr.args[0], ctx)
+        size_e = expr.args[1]
+        assert isinstance(size_e, ir.Constant), "pad size must be a literal"
+        size = int(size_e.value)
+        pad = _const_str_args(expr, 2)[0] if len(expr.args) == 3 else " "
+
+        def dopad(v):
+            if len(v) >= size:
+                return v[:size]
+            fill = (pad * size)[: size - len(v)]
+            return fill + v if left else v + fill
+
+        return _vocab_transform(ctx, x, dopad)
+
+    return fn
+
+
+def _lower_split_part(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    delim_e = expr.args[1]
+    assert isinstance(delim_e, ir.Constant) and isinstance(delim_e.value, str), (
+        "split_part delimiter must be a varchar literal")
+    delim = delim_e.value
+    idx_e = expr.args[2]
+    assert isinstance(idx_e, ir.Constant), "split_part index must be a literal"
+    idx = int(idx_e.value)
+
+    def part(v):
+        parts = v.split(delim)
+        return parts[idx - 1] if 1 <= idx <= len(parts) else ""
+
+    # out-of-range index -> NULL (Trino)
+    has = _vocab_lut(
+        ctx, x, lambda v: 1 <= idx <= len(v.split(delim)), np.bool_)
+    out = _vocab_transform(ctx, x, part)
+    return LoweredVal(out.vals, and_valid(out.valid, has.vals), out.dictionary)
+
+
+def _lower_translate(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    frm, to = _const_str_args(expr, 1)
+    table = {ord(f): (to[i] if i < len(to) else None) for i, f in enumerate(frm)}
+    return _vocab_transform(ctx, x, lambda v: v.translate(table))
+
+
+def _lower_repeat_str(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    n_e = expr.args[1]
+    assert isinstance(n_e, ir.Constant), "repeat count must be a literal"
+    k = int(n_e.value)
+    return _vocab_transform(ctx, x, lambda v: v * k)
+
+
+def _lower_chr(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = expr.args[0]
+    if isinstance(a, ir.Constant):
+        d = Dictionary([chr(int(a.value))])
+        return LoweredVal(_const_array(ctx, np.int32, 0), None, d)
+    raise NotImplementedError("chr() over a column (value-dependent vocabulary)")
+
+
+def _lower_codepoint(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    return _vocab_lut(ctx, x, lambda v: ord(v[0]) if v else 0, np.int64)
+
+
+def _lower_str_distance(kind: str):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        x = lower(expr.args[0], ctx)
+        other = _const_str_args(expr, 1)[0]
+        if kind == "hamming":
+            def dist(v):
+                if len(v) != len(other):
+                    return -1
+                return sum(a != b for a, b in zip(v, other))
+        else:
+            def dist(v):
+                # classic O(nm) DP over the (small) vocab
+                if not v:
+                    return len(other)
+                prev = list(range(len(other) + 1))
+                for i, cv in enumerate(v, 1):
+                    cur = [i]
+                    for j, co in enumerate(other, 1):
+                        cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                                       prev[j - 1] + (cv != co)))
+                    prev = cur
+                return prev[-1]
+
+        out = _vocab_lut(ctx, x, dist, np.int64)
+        if kind == "hamming":
+            bad = out.vals < 0
+            ctx.add_error(INVALID_FUNCTION_ARGUMENT, bad, out.valid)
+        return out
+
+    return fn
+
+
+def _lower_json_extract_scalar(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    import json
+
+    x = lower(expr.args[0], ctx)
+    path = _const_str_args(expr, 1)[0]
+    steps = _parse_json_path(path)
+
+    def ext(v):
+        try:
+            cur = json.loads(v)
+        except (ValueError, TypeError):
+            return None
+        for s in steps:
+            if isinstance(s, int):
+                if not isinstance(cur, list) or not -len(cur) <= s < len(cur):
+                    return None
+                cur = cur[s]
+            else:
+                if not isinstance(cur, dict) or s not in cur:
+                    return None
+                cur = cur[s]
+        if cur is None or isinstance(cur, (dict, list)):
+            return None  # json_extract_scalar: scalars only
+        if isinstance(cur, bool):
+            return "true" if cur else "false"
+        return str(cur)
+
+    has = _vocab_lut(ctx, x, lambda v: ext(v) is not None, np.bool_)
+    out = _vocab_transform(ctx, x, lambda v: ext(v) or "")
+    return LoweredVal(out.vals, and_valid(out.valid, has.vals), out.dictionary)
+
+
+def _parse_json_path(path: str):
+    """Subset of the JSON path language: $.a.b[0]['c'] (reference:
+    JsonPath — the lax default mode's field/subscript steps)."""
+    steps = []
+    s = path.strip()
+    if not s.startswith("$"):
+        raise NotImplementedError(f"json path must start with $: {path!r}")
+    s = s[1:]
+    token = re.compile(r"\.(\w+)|\[(\d+)\]|\['([^']*)'\]|\[\"([^\"]*)\"\]")
+    pos = 0
+    while pos < len(s):
+        m = token.match(s, pos)
+        if not m:
+            raise NotImplementedError(f"unsupported json path step at {s[pos:]!r}")
+        if m.group(1) is not None:
+            steps.append(m.group(1))
+        elif m.group(2) is not None:
+            steps.append(int(m.group(2)))
+        else:
+            steps.append(m.group(3) if m.group(3) is not None else m.group(4))
+        pos = m.end()
+    return steps
+
+
+def _lower_json_array_length(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    import json
+
+    x = lower(expr.args[0], ctx)
+
+    def ln(v):
+        try:
+            arr = json.loads(v)
+        except (ValueError, TypeError):
+            return -1
+        return len(arr) if isinstance(arr, list) else -1
+
+    out = _vocab_lut(ctx, x, ln, np.int64)
+    return LoweredVal(out.vals, and_valid(out.valid, out.vals >= 0), None)
+
+
+_MYSQL_FMT = {  # date_format uses MySQL-style specifiers (reference:
+    # DateTimeFunctions.dateFormat)
+    "%Y": "%Y", "%y": "%y", "%m": "%m", "%d": "%d", "%e": "%-d",
+    "%H": "%H", "%i": "%M", "%s": "%S", "%f": "%f", "%W": "%A",
+    "%a": "%a", "%b": "%b", "%M": "%B", "%j": "%j", "%%": "%%",
+}
+
+
+def _mysql_to_py_fmt(fmt: str) -> str:
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "%" and i + 1 < len(fmt):
+            spec = fmt[i : i + 2]
+            out.append(_MYSQL_FMT.get(spec, spec))
+            i += 2
+        else:
+            out.append(fmt[i])
+            i += 1
+    return "".join(out)
+
+
+def _date_lut(ctx: LowerCtx, x: LoweredVal, pyfn, fallback_range=(-25567, 47847)):
+    """date (epoch days) -> string via a day-indexed lookup table bounded by
+    the column's static value range (Column.vrange via LoweredVal.bound) or
+    a 1900..2100 fallback — the numeric->varchar analog of the vocab
+    transform: the VALUE is the code."""
+    import datetime
+
+    lo, hi = fallback_range
+    if x.bound is not None:
+        lo, hi = -x.bound, x.bound
+        lo, hi = max(lo, fallback_range[0]), min(hi, fallback_range[1])
+    epoch = datetime.date(1970, 1, 1)
+    strings = [
+        pyfn(epoch + datetime.timedelta(days=d)) for d in range(lo, hi + 1)
+    ]
+    d_new = Dictionary.build(strings)
+    lut = np.array([d_new.code_of(sv) for sv in strings], dtype=np.int32)
+    idx = jnp.clip(x.vals.astype(jnp.int32) - lo, 0, len(lut) - 1)
+    in_range = (x.vals >= lo) & (x.vals <= hi)
+    # out-of-range dates fail LOUDLY (deferred error) rather than silently
+    # returning NULL — the window is an implementation bound, not semantics
+    ctx.add_error(INVALID_FUNCTION_ARGUMENT, ~in_range, x.valid)
+    out = jnp.asarray(lut)[idx]
+    return LoweredVal(out, and_valid(x.valid, in_range), d_new)
+
+
+def _lower_date_format(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    fmt = _mysql_to_py_fmt(_const_str_args(expr, 1)[0])
+    if expr.args[0].type == T.TIMESTAMP:
+        raise NotImplementedError("date_format over timestamps (use a date)")
+    return _date_lut(ctx, x, lambda d: d.strftime(fmt))
+
+
+def _lower_date_parse(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    import datetime
+
+    x = lower(expr.args[0], ctx)
+    fmt = _mysql_to_py_fmt(_const_str_args(expr, 1)[0])
+
+    def parse(v):
+        try:
+            d = datetime.datetime.strptime(v, fmt).date()
+        except ValueError:
+            return -(10**9)
+        return (d - datetime.date(1970, 1, 1)).days
+
+    out = _vocab_lut(ctx, x, parse, np.int32)
+    bad = out.vals == -(10**9)
+    ctx.add_error(INVALID_FUNCTION_ARGUMENT, bad, out.valid)
+    return LoweredVal(out.vals, out.valid, None)
+
+
+def _lower_day_name(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    return _date_lut(ctx, x, lambda d: d.strftime("%A"))
+
+
+def _lower_month_name(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    return _date_lut(ctx, x, lambda d: d.strftime("%B"))
+
+
+def _lower_last_day_of_month(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    x = lower(expr.args[0], ctx)
+    y = dt.extract_year(x.vals)
+    m = dt.extract_month(x.vals)
+    d = dt.extract_day(x.vals)
+    days = x.vals.astype(jnp.int32)
+    last = days - d.astype(jnp.int32) + dt.days_in_month(y, m).astype(jnp.int32)
+    return LoweredVal(last, x.valid, None)
+
+
+def _lower_from_unixtime(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    us = (a.vals.astype(jnp.float64) * 1e6).astype(jnp.int64)
+    return LoweredVal(us, a.valid, None)
+
+
+def _lower_to_unixtime(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    if expr.args[0].type == T.DATE:
+        return LoweredVal(a.vals.astype(jnp.float64) * 86400.0, a.valid, None)
+    return LoweredVal(a.vals.astype(jnp.float64) / 1e6, a.valid, None)
+
+
+def _lower_bitwise(op: str):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = lower(expr.args[0], ctx)
+        av = a.vals.astype(jnp.int64)
+        if op == "not":
+            return LoweredVal(~av, a.valid, None)
+        b = lower(expr.args[1], ctx)
+        bv = b.vals.astype(jnp.int64)
+        valid = and_valid(a.valid, b.valid)
+        if op == "and":
+            return LoweredVal(av & bv, valid, None)
+        if op == "or":
+            return LoweredVal(av | bv, valid, None)
+        if op == "xor":
+            return LoweredVal(av ^ bv, valid, None)
+        # shift >= 64 yields 0 (reference BitwiseFunctions); negative
+        # shift amounts are invalid arguments
+        ctx.add_error(INVALID_FUNCTION_ARGUMENT, bv < 0, valid)
+        in_range = (bv >= 0) & (bv < 64)
+        sh = jnp.clip(bv, 0, 63)
+        if op == "lshift":
+            out = jnp.where(in_range, av << sh, jnp.int64(0))
+            return LoweredVal(out, valid, None)
+        shifted = (av.astype(jnp.uint64) >> sh.astype(jnp.uint64)).astype(jnp.int64)
+        return LoweredVal(jnp.where(in_range, shifted, jnp.int64(0)), valid, None)
+
+    return fn
+
+
+def _lower_bit_count(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    v = a.vals.astype(jnp.int64).astype(jnp.uint64)
+    cnt = jnp.zeros(v.shape, jnp.int64)
+    lut = jnp.asarray(np.array([bin(i).count("1") for i in range(256)], np.int64))
+    for shift in range(0, 64, 8):
+        byte = (v >> jnp.uint64(shift)) & jnp.uint64(0xFF)
+        cnt = cnt + lut[byte.astype(jnp.int32)]
+    return LoweredVal(cnt, a.valid, None)
+
+
+def _lower_float_class(kind: str):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        a = lower(expr.args[0], ctx)
+        v = a.vals.astype(jnp.float64)
+        if kind == "nan":
+            out = jnp.isnan(v)
+        elif kind == "finite":
+            out = jnp.isfinite(v)
+        else:
+            out = jnp.isinf(v)
+        return LoweredVal(out, a.valid, None)
+
+    return fn
 
 
 # --- array / map lowering (ops/array_ops.py kernels; reference:
@@ -1308,6 +1820,38 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "radians": _lower_math1(jnp.radians),
     "atan2": _lower_atan2,
     "truncate": _lower_truncate,
+    "regexp_like": _lower_regexp("like"),
+    "regexp_extract": _lower_regexp("extract"),
+    "regexp_replace": _lower_regexp("replace"),
+    "regexp_count": _lower_regexp("count"),
+    "lpad": _lower_pad(True),
+    "rpad": _lower_pad(False),
+    "split_part": _lower_split_part,
+    "translate": _lower_translate,
+    "repeat_str": _lower_repeat_str,
+    "chr": _lower_chr,
+    "codepoint": _lower_codepoint,
+    "hamming_distance": _lower_str_distance("hamming"),
+    "levenshtein_distance": _lower_str_distance("levenshtein"),
+    "json_extract_scalar": _lower_json_extract_scalar,
+    "json_array_length": _lower_json_array_length,
+    "date_format": _lower_date_format,
+    "date_parse": _lower_date_parse,
+    "day_name": _lower_day_name,
+    "month_name": _lower_month_name,
+    "last_day_of_month": _lower_last_day_of_month,
+    "from_unixtime": _lower_from_unixtime,
+    "to_unixtime": _lower_to_unixtime,
+    "bitwise_and": _lower_bitwise("and"),
+    "bitwise_or": _lower_bitwise("or"),
+    "bitwise_xor": _lower_bitwise("xor"),
+    "bitwise_not": _lower_bitwise("not"),
+    "bitwise_left_shift": _lower_bitwise("lshift"),
+    "bitwise_right_shift": _lower_bitwise("rshift"),
+    "bit_count": _lower_bit_count,
+    "is_nan": _lower_float_class("nan"),
+    "is_finite": _lower_float_class("finite"),
+    "is_infinite": _lower_float_class("inf"),
     "array_ctor": _lower_array_ctor,
     "cardinality": _lower_cardinality,
     "subscript": _lower_subscript(strict=True, is_map=False),

@@ -6,12 +6,12 @@ of int64 arrays ``(hi, lo)``; ``lo`` carries the low 64 bits as a raw bit
 pattern (interpreted unsigned), ``hi`` the high 64 bits including sign.
 
 Engaged by the expression lowering (ops/expr_lower.py) for decimal
-arithmetic whose INTERMEDIATES can exceed int64 — e.g. the full product of
-two scaled int64 decimals, or numerators scaled up before division. Values
-AT REST narrow back to a single int64 array; a result whose magnitude does
-not fit int64 raises the deferred DECIMAL_OVERFLOW error (the reference
-throws past p=38; this engine's long-decimal storage is int64-wide, so the
-practical range is |v| < 2^63 at the result scale — documented in types.py).
+arithmetic whose intermediates or results exceed int64. Long-decimal
+(p > 18) values AT REST are adaptive two-limb: columns carry an optional
+``hi`` int64 limb (data/page.py Column.hi) exactly when the data needs it,
+so the full ±(10^38 - 1) range round-trips, joins, groups, and sums;
+results past the p=38 cap raise the deferred DECIMAL_OVERFLOW error
+(matching the reference's Int128Math overflow throws).
 
 All ops are elementwise on uint64 words (TPU-native 32-bit pairs under the
 hood; no Python bigints inside jit).
@@ -120,6 +120,35 @@ def mul_small_checked(a: I128, m: int) -> Tuple[I128, jnp.ndarray]:
     return (jnp.where(n, nres[0], res[0]), jnp.where(n, nres[1], res[1])), overflow
 
 
+def mul_checked(a: I128, b: I128) -> Tuple[I128, jnp.ndarray]:
+    """(a * b, overflowed) for two int128 operands — the low 128 bits of the
+    signed product, flagging rows whose |a|*|b| exceeds 2^127 - 1
+    (reference: Int128Math.multiply)."""
+    (ahi, alo), na = abs128(a)
+    (bhi, blo), nb = abs128(b)
+    p_hi, p_lo = _mul_u64(_u(alo), _u(blo))  # |a|.lo * |b|.lo, 128-bit
+    c1_hi, c1_lo = _mul_u64(_u(alo), _u(bhi))  # contributes << 64
+    c2_hi, c2_lo = _mul_u64(_u(ahi), _u(blo))  # contributes << 64
+    hh = (_u(ahi) != 0) & (_u(bhi) != 0)  # |a|.hi * |b|.hi -> always >= 2^128
+    hi1 = p_hi + c1_lo
+    hi2 = hi1 + c2_lo
+    overflow = (
+        hh
+        | (c1_hi != 0)
+        | (c2_hi != 0)
+        | (hi1 < p_hi)
+        | (hi2 < hi1)
+        | (_s(hi2) < 0)  # >= 2^127
+    )
+    res = (_s(hi2), _s(p_lo))
+    nres = neg(res)
+    flip = na ^ nb
+    return (
+        jnp.where(flip, nres[0], res[0]),
+        jnp.where(flip, nres[1], res[1]),
+    ), overflow
+
+
 def _divmod_core(hi: jnp.ndarray, lo: jnp.ndarray, dd: jnp.ndarray):
     """Unsigned (hi,lo) u64 pair divided by u64 ``dd`` (< 2^63): shift-
     subtract over the low word after dividing the high word (64 unrolled
@@ -134,6 +163,38 @@ def _divmod_core(hi: jnp.ndarray, lo: jnp.ndarray, dd: jnp.ndarray):
         r = jnp.where(ge, r - dd, r)
         q_lo = q_lo | (ge.astype(jnp.uint64) << jnp.uint64(i))
     return (_s(q_hi), _s(q_lo)), r
+
+
+def divmod_u128(a: I128, b: I128) -> Tuple[I128, I128]:
+    """Unsigned 128/128 division of NON-NEGATIVE operands (b > 0): classic
+    shift-subtract long division, 128 unrolled vector steps (reference:
+    Int128Math.divide's unsigned core). Returns (quotient, remainder)."""
+    n_hi, n_lo = _u(a[0]), _u(a[1])
+    d_hi, d_lo = _u(b[0]), _u(b[1])
+    r_hi = jnp.zeros_like(n_hi)
+    r_lo = jnp.zeros_like(n_lo)
+    q_hi = jnp.zeros_like(n_hi)
+    q_lo = jnp.zeros_like(n_lo)
+    one = jnp.uint64(1)
+    for i in range(127, -1, -1):
+        bit = (
+            (n_hi >> jnp.uint64(i - 64)) & one
+            if i >= 64
+            else (n_lo >> jnp.uint64(i)) & one
+        )
+        # r = (r << 1) | bit
+        r_hi = (r_hi << one) | (r_lo >> jnp.uint64(63))
+        r_lo = (r_lo << one) | bit
+        ge = (r_hi > d_hi) | ((r_hi == d_hi) & (r_lo >= d_lo))
+        # r -= d where ge
+        borrow = (r_lo < d_lo).astype(jnp.uint64)
+        r_lo = jnp.where(ge, r_lo - d_lo, r_lo)
+        r_hi = jnp.where(ge, r_hi - d_hi - borrow, r_hi)
+        if i >= 64:
+            q_hi = q_hi | jnp.where(ge, one << jnp.uint64(i - 64), jnp.uint64(0))
+        else:
+            q_lo = q_lo | jnp.where(ge, one << jnp.uint64(i), jnp.uint64(0))
+    return (_s(q_hi), _s(q_lo)), (_s(r_hi), _s(r_lo))
 
 
 def divmod_u64(a: I128, d: int) -> Tuple[I128, jnp.ndarray]:

@@ -73,6 +73,10 @@ def repartition_page(
     Returns (received_page [n_devices*capacity rows, sharded], overflow_flag).
     Dead rows (sel False) are not sent; received pad slots carry sel False.
     """
+    for c in page.columns:
+        if c.hi is not None or c.type.is_nested:
+            raise NotImplementedError(
+                "device hash exchange over long-decimal/nested columns")
     keys = [
         (page.columns[c].values, None if page.columns[c].nulls is None else ~page.columns[c].nulls)
         for c in key_channels

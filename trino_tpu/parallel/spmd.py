@@ -65,6 +65,7 @@ def gather_page(page: Page) -> Page:
             _gather_flat(c.nulls) if c.nulls is not None else None,
             c.dictionary,
             c.vrange,
+            hi=_gather_flat(c.hi) if c.hi is not None else None,
         )
         for c in page.columns
     ]
@@ -102,6 +103,13 @@ class SpmdExecutor(Executor):
     def _repartition(self, page: Page, key_channels, hint_key: str) -> Page:
         from trino_tpu.parallel import exchange
 
+        if any(c.hi is not None for c in page.columns):
+            # the device exchange has no limb lanes: degrade to low words
+            # with the deferred overflow check (Executor._narrowed_or_flag)
+            page = Page(
+                [self._narrowed_or_flag(c, page.sel) for c in page.columns],
+                page.sel, page.replicated, live_prefix=page.live_prefix,
+            )
         capacity = self.hint_capacity(hint_key, None)
         out, overflow = exchange.repartition_page(
             page, key_channels, self.n_devices, capacity, AXIS
@@ -397,6 +405,7 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
                         np.asarray(cd.nulls) if cd.nulls is not None else None,
                         cd.dictionary,
                         cd.vrange,
+                        hi=np.asarray(cd.hi) if cd.hi is not None else None,
                     )
                 )
             shard_pages.append(cols)
@@ -427,8 +436,13 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
                     p[ci] = Column(typ, p[ci].values, p[ci].nulls, merged)
         stacked_cols = []
         for ci in range(len(node.column_names)):
+            anyhi = any(p[ci].hi is not None for p in shard_pages)
             vals = np.stack(
-                [_pad(np.asarray(p[ci].values), max_rows) for p in shard_pages]
+                [
+                    _pad(np.asarray(p[ci].values).astype(np.int64)
+                         if anyhi else np.asarray(p[ci].values), max_rows)
+                    for p in shard_pages
+                ]
             )
             anynull = any(p[ci].nulls is not None for p in shard_pages)
             nulls = (
@@ -446,7 +460,24 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
                 if anynull
                 else None
             )
-            stacked_cols.append((vals, nulls, shard_pages[0][ci].dictionary))
+            # hi-limb presence must be uniform across shards (the PageSpec
+            # is static): missing shards sign-extend their low words
+            hi = (
+                np.stack(
+                    [
+                        _pad(
+                            np.asarray(p[ci].hi)
+                            if p[ci].hi is not None
+                            else (np.asarray(p[ci].values).astype(np.int64) >> 63),
+                            max_rows,
+                        )
+                        for p in shard_pages
+                    ]
+                )
+                if anyhi
+                else None
+            )
+            stacked_cols.append((vals, nulls, hi, shard_pages[0][ci].dictionary))
         sel = np.stack(
             [
                 np.arange(max_rows) < len(p[0].values) if p else np.zeros(max_rows, bool)
@@ -457,8 +488,9 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
         types = []
         dicts = []
         has_nulls = []
+        has_hi = []
         vranges = [c.vrange for c in shard_pages[0]]
-        for (vals, nulls, d), typ in zip(stacked_cols, node.column_types):
+        for (vals, nulls, hi, d), typ in zip(stacked_cols, node.column_types):
             arrays.append(vals)
             types.append(typ)
             dicts.append(d)
@@ -467,9 +499,15 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
                 has_nulls.append(True)
             else:
                 has_nulls.append(False)
+            if hi is not None:
+                arrays.append(hi)
+                has_hi.append(True)
+            else:
+                has_hi.append(False)
         arrays.append(sel)
         staged[node.id] = arrays
-        specs[node.id] = PageSpec(types, dicts, has_nulls, True, vranges)
+        specs[node.id] = PageSpec(types, dicts, has_nulls, True, vranges,
+                                  has_hi=has_hi)
         node.runtime_rows = total_rows  # staged truth for capacity estimates
     return staged, specs
 

@@ -443,6 +443,13 @@ class CoordinatorServer:
         # admission control (reference: resource groups / DispatchManager's
         # resource-group submission)
         self.resource_group = resource_group or ResourceGroup()
+        # event listener SPI (server/events.py; reference:
+        # eventlistener/EventListenerManager)
+        from trino_tpu.server.events import EventListenerManager
+
+        self.events = EventListenerManager()
+        self.queries_submitted = 0
+        self.start_time = time.time()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -471,6 +478,24 @@ class CoordinatorServer:
             for qid in terminal[: max(0, len(terminal) - self.MAX_QUERY_HISTORY)]:
                 del self.queries[qid]
             self.queries[query_id] = execution
+            self.queries_submitted += 1
+        from trino_tpu.server import events as ev
+
+        created_at = time.time()
+        self.events.fire_created(
+            ev.QueryCreatedEvent(query_id, user, sql, created_at))
+        def fire_terminal(state):
+            if state not in ("FINISHED", "FAILED", "CANCELED"):
+                return
+            now = time.time()
+            self.events.fire_completed(
+                ev.QueryCompletedEvent(
+                    query_id, user, sql, state, created_at, now,
+                    now - created_at, len(execution.rows), execution.failure,
+                )
+            )
+
+        execution.state.add_listener(fire_terminal)
         # admission is ASYNC: the submit POST returns a QUEUED payload
         # immediately and the client polls nextUri; the query starts when
         # its group grants a slot (reference: QueuedStatementResource's
@@ -643,6 +668,12 @@ def _make_handler(server: CoordinatorServer):
             if self.path == "/v1/info":
                 self._send(200, json.dumps(
                     {"coordinator": True, "state": "ACTIVE"}).encode())
+                return
+            if self.path == "/v1/metrics":
+                from trino_tpu.server.events import render_metrics
+
+                self._send(200, render_metrics(server).encode(),
+                           "text/plain; version=0.0.4")
                 return
             if self.path in ("/ui", "/ui/"):
                 self._send(200, _render_ui(server).encode(), "text/html")

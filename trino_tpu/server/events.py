@@ -1,0 +1,113 @@
+"""Event listener SPI + metrics collection.
+
+Reference: ``core/trino-spi/.../spi/eventlistener/`` — ``EventListener``
+(queryCreated / queryCompleted), ``QueryCompletedEvent`` (metadata, stats,
+failure info), registered via EventListenerFactory plugins and dispatched by
+``eventlistener/EventListenerManager`` with per-listener exception isolation.
+Here the same shape: listeners attach to a Session or a CoordinatorServer,
+events are plain dataclasses, and a failing listener never fails the query.
+
+The metrics side (``render_metrics``) exposes the coordinator's counters in
+the Prometheus text format — the role of the reference's JMX-to-/metrics
+bridge (``trino-jmx`` + airlift's MetricsResource).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCreatedEvent:
+    """Reference: spi/eventlistener/QueryCreatedEvent.java."""
+
+    query_id: str
+    user: str
+    sql: str
+    create_time: float  # epoch seconds
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCompletedEvent:
+    """Reference: spi/eventlistener/QueryCompletedEvent.java (metadata +
+    statistics + failureInfo, flattened to the fields the engine tracks)."""
+
+    query_id: str
+    user: str
+    sql: str
+    state: str  # FINISHED | FAILED | CANCELED
+    create_time: float
+    end_time: float
+    wall_seconds: float
+    output_rows: int
+    error: Optional[str] = None
+
+
+class EventListener:
+    """Subclass and override either hook (reference: EventListener's
+    default methods are no-ops, so listeners implement only what they use)."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:  # noqa: B027
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:  # noqa: B027
+        pass
+
+
+class EventListenerManager:
+    """Dispatch with per-listener exception isolation (reference:
+    eventlistener/EventListenerManager catches and logs per listener)."""
+
+    def __init__(self):
+        self._listeners: List[EventListener] = []
+        self._lock = threading.Lock()
+
+    def add(self, listener: EventListener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+    def fire_created(self, event: QueryCreatedEvent) -> None:
+        for lsn in list(self._listeners):
+            try:
+                lsn.query_created(event)
+            except Exception:  # noqa: BLE001 — listener faults never fail queries
+                pass
+
+    def fire_completed(self, event: QueryCompletedEvent) -> None:
+        for lsn in list(self._listeners):
+            try:
+                lsn.query_completed(event)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def render_metrics(server) -> str:
+    """Coordinator counters in the Prometheus text exposition format."""
+    by_state: Dict[str, int] = {}
+    total_rows = 0
+    with server._qlock:
+        queries = list(server.queries.values())
+    for q in queries:
+        st = q.state.get()
+        by_state[st] = by_state.get(st, 0) + 1
+        if st == "FINISHED":
+            total_rows += len(q.rows)
+    lines = [
+        "# TYPE trino_tpu_queries gauge",
+    ]
+    for st in sorted(by_state):
+        lines.append(f'trino_tpu_queries{{state="{st}"}} {by_state[st]}')
+    lines.append("# TYPE trino_tpu_queries_total counter")
+    lines.append(f"trino_tpu_queries_total {getattr(server, 'queries_submitted', 0)}")
+    lines.append("# TYPE trino_tpu_result_rows gauge")
+    lines.append(f"trino_tpu_result_rows {total_rows}")
+    workers = server.registry.alive() if hasattr(server, "registry") else []
+    lines.append("# TYPE trino_tpu_workers gauge")
+    lines.append(f"trino_tpu_workers {len(workers)}")
+    lines.append("# TYPE trino_tpu_uptime_seconds gauge")
+    lines.append(
+        f"trino_tpu_uptime_seconds {time.time() - getattr(server, 'start_time', time.time()):.1f}"
+    )
+    return "\n".join(lines) + "\n"

@@ -294,6 +294,9 @@ def _canonical_partition_ids(page: Page, channels, parts: int):
             k = vocab_hash[np.clip(codes, 0, len(vocab_hash) - 1)]
             k = np.where(codes < 0, np.uint64(_NULL_HASH), k)
         else:
+            # low limb only: equal values share it and hi-limb presence is
+            # data-dependent per producer — mixing hi would break cross-
+            # producer placement consistency (see exec/memory.py)
             k = _mix64_np(np.asarray(col.values).astype(np.int64))
         if col.nulls is not None:
             k = np.where(np.asarray(col.nulls), np.uint64(_NULL_HASH), k)

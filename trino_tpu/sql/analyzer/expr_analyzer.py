@@ -23,6 +23,14 @@ AGGREGATE_FUNCTIONS = {
     "approx_distinct",
     "approx_percentile",
     "array_agg",
+    "bool_and", "bool_or", "every",
+    "count_if",
+    "arbitrary", "any_value",
+    "geometric_mean",
+    "checksum",
+    "min_by", "max_by",
+    "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
+    "histogram", "map_agg",
 }
 
 _MONTH_UNITS = {"year": 12, "month": 1}
@@ -95,11 +103,46 @@ def _prec_scale(t: T.Type) -> Tuple[int, int]:
     return {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}[t.name], 0
 
 
-def aggregate_result_type(fn: str, arg: Optional[T.Type]) -> T.Type:
+def aggregate_result_type(
+    fn: str, arg: Optional[T.Type], arg2: Optional[T.Type] = None
+) -> T.Type:
     """Reference: operator/aggregation function signatures."""
     if fn == "count":
         return T.BIGINT
     assert arg is not None
+    if fn in ("bool_and", "bool_or", "every"):
+        if arg != T.BOOLEAN:
+            raise AnalysisError(f"{fn}() expects a boolean argument")
+        return T.BOOLEAN
+    if fn == "count_if":
+        if arg != T.BOOLEAN:
+            raise AnalysisError("count_if() expects a boolean argument")
+        return T.BIGINT
+    if fn in ("arbitrary", "any_value"):
+        return arg
+    if fn == "geometric_mean":
+        if not arg.is_numeric:
+            raise AnalysisError(f"geometric_mean() not defined for {arg}")
+        return T.DOUBLE
+    if fn == "checksum":
+        return T.BIGINT
+    if fn in ("min_by", "max_by"):
+        assert arg2 is not None
+        if not arg2.orderable:
+            raise AnalysisError(f"{fn}() ordering argument {arg2} is not orderable")
+        return arg
+    if fn in ("corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept"):
+        assert arg2 is not None
+        if not (arg.is_numeric and arg2.is_numeric):
+            raise AnalysisError(f"{fn}() expects numeric arguments")
+        return T.DOUBLE
+    if fn == "histogram":
+        if not arg.comparable:
+            raise AnalysisError(f"histogram() argument {arg} is not comparable")
+        return T.map_of(arg, T.BIGINT)
+    if fn == "map_agg":
+        assert arg2 is not None
+        return T.map_of(arg, arg2)
     if fn == "sum":
         if arg.is_decimal:
             return T.decimal(38, arg.scale)
@@ -426,6 +469,109 @@ class ExprAnalyzer:
                 raise AnalysisError("mod(a, b) expects 2 arguments")
             return ir.Call(
                 arithmetic_result_type("%", args[0].type, args[1].type), "mod", args)
+        # --- regexp / string breadth (reference: operator/scalar/
+        # JoniRegexpFunctions, StringFunctions, PadFunctions) ---
+        if name == "regexp_like":
+            if len(args) != 2:
+                raise AnalysisError("regexp_like(string, pattern)")
+            return ir.Call(T.BOOLEAN, "regexp_like", args)
+        if name == "regexp_extract":
+            if len(args) not in (2, 3):
+                raise AnalysisError("regexp_extract(string, pattern[, group])")
+            return ir.Call(T.varchar(), "regexp_extract", args)
+        if name == "regexp_replace":
+            if len(args) not in (2, 3):
+                raise AnalysisError("regexp_replace(string, pattern[, replacement])")
+            return ir.Call(T.varchar(), "regexp_replace", args)
+        if name == "regexp_count":
+            if len(args) != 2:
+                raise AnalysisError("regexp_count(string, pattern)")
+            return ir.Call(T.BIGINT, "regexp_count", args)
+        if name in ("lpad", "rpad"):
+            if len(args) not in (2, 3):
+                raise AnalysisError(f"{name}(string, size[, padstring])")
+            return ir.Call(T.varchar(), name, args)
+        if name == "split_part":
+            if len(args) != 3:
+                raise AnalysisError("split_part(string, delimiter, index)")
+            return ir.Call(T.varchar(), "split_part", args)
+        if name == "translate":
+            if len(args) != 3:
+                raise AnalysisError("translate(string, from, to)")
+            return ir.Call(T.varchar(), "translate", args)
+        if name == "repeat" and args and args[0].type.is_varchar:
+            return ir.Call(T.varchar(), "repeat_str", args)
+        if name == "chr":
+            return ir.Call(T.varchar(), "chr", args)
+        if name == "codepoint":
+            return ir.Call(T.BIGINT, "codepoint", args)
+        if name == "hamming_distance":
+            return ir.Call(T.BIGINT, "hamming_distance", args)
+        if name == "levenshtein_distance":
+            return ir.Call(T.BIGINT, "levenshtein_distance", args)
+        # --- JSON (reference: operator/scalar/JsonFunctions + JsonPath) ---
+        if name == "json_extract_scalar":
+            if len(args) != 2:
+                raise AnalysisError("json_extract_scalar(json, path)")
+            return ir.Call(T.varchar(), "json_extract_scalar", args)
+        if name == "json_array_length":
+            return ir.Call(T.BIGINT, "json_array_length", args)
+        # --- datetime breadth (reference: operator/scalar/DateTimeFunctions) ---
+        if name == "date_format":
+            if len(args) != 2 or args[0].type not in (T.DATE, T.TIMESTAMP):
+                raise AnalysisError("date_format(date, format)")
+            return ir.Call(T.varchar(), "date_format", args)
+        if name == "date_parse":
+            if len(args) != 2:
+                raise AnalysisError("date_parse(string, format)")
+            return ir.Call(T.DATE, "date_parse", args)
+        if name == "day_name":
+            return ir.Call(T.varchar(), "day_name", args)
+        if name == "month_name":
+            return ir.Call(T.varchar(), "month_name", args)
+        if name == "last_day_of_month":
+            return ir.Call(T.DATE, "last_day_of_month", args)
+        if name == "from_unixtime":
+            return ir.Call(T.TIMESTAMP, "from_unixtime", args)
+        if name == "to_unixtime":
+            return ir.Call(T.DOUBLE, "to_unixtime", args)
+        # --- bitwise (reference: operator/scalar/BitwiseFunctions) ---
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_left_shift", "bitwise_right_shift"):
+            if len(args) != 2:
+                raise AnalysisError(f"{name}(a, b)")
+            return ir.Call(T.BIGINT, name, args)
+        if name == "bitwise_not":
+            return ir.Call(T.BIGINT, "bitwise_not", args)
+        if name == "bit_count":
+            return ir.Call(T.BIGINT, "bit_count", args)
+        # --- float classification / misc ---
+        if name == "is_nan":
+            return ir.Call(T.BOOLEAN, "is_nan", args)
+        if name == "is_finite":
+            return ir.Call(T.BOOLEAN, "is_finite", args)
+        if name == "is_infinite":
+            return ir.Call(T.BOOLEAN, "is_infinite", args)
+        if name == "nan":
+            return ir.Constant(T.DOUBLE, float("nan"))
+        if name == "infinity":
+            return ir.Constant(T.DOUBLE, float("inf"))
+        if name == "typeof":
+            if len(args) != 1:
+                raise AnalysisError("typeof(x)")
+            return ir.Constant(T.varchar(), str(args[0].type))
+        if name == "if":
+            if len(args) not in (2, 3):
+                raise AnalysisError("if(condition, true_value[, false_value])")
+            t = args[1].type
+            if len(args) == 3:
+                t2 = T.common_super_type(t, args[2].type)
+                if t2 is None:
+                    raise AnalysisError("IF branches are incompatible")
+                t = t2
+            whens = ((args[0], args[1]),)
+            default = args[2] if len(args) == 3 else None
+            return ir.Case(t, whens, default)
         # --- array / map functions (reference: operator/scalar/ArrayFunctions,
         # MapKeys/MapValues/MapSubscript, CardinalityFunction) ---
         if name == "cardinality":

@@ -338,6 +338,21 @@ class DropTable(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class StartTransaction(Statement):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Commit(Statement):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Rollback(Statement):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class SetSession(Statement):
     """SET SESSION name = value (reference: sql/tree/SetSession.java)."""
 

@@ -121,6 +121,21 @@ class Parser:
 
     # --- statements ---
     def statement(self) -> ast.Statement:
+        if self.at_soft("start") and self.at_soft("transaction", ahead=1):
+            self.advance()
+            self.advance()
+            return ast.StartTransaction()
+        if self.at_soft("begin") and (
+            self.peek(1).kind == "eof" or self.peek(1).text == ";"
+        ):
+            self.advance()
+            return ast.StartTransaction()
+        if self.at_soft("commit"):
+            self.advance()
+            return ast.Commit()
+        if self.at_soft("rollback"):
+            self.advance()
+            return ast.Rollback()
         if self.accept_kw("explain"):
             analyze = bool(self.accept_kw("analyze"))
             mode, fmt = "distributed", "text"

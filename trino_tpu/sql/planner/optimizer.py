@@ -633,6 +633,8 @@ def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict
         for _, a in kept_aggs:
             if a.arg_channel is not None:
                 src_needed.add(a.arg_channel)
+            if a.arg2_channel is not None:
+                src_needed.add(a.arg2_channel)
         src, src_map = prune_channels(node.source, src_needed)
         new_aggs = [
             P.AggregateCall(
@@ -641,6 +643,9 @@ def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict
                 a.output_type,
                 a.distinct,
                 a.param,
+                arg2_channel=(
+                    src_map[a.arg2_channel] if a.arg2_channel is not None else None
+                ),
             )
             for _, a in kept_aggs
         ]

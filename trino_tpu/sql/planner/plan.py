@@ -200,11 +200,13 @@ class UnnestNode(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class AggregateCall:
-    function: str  # count | sum | avg | min | max | stddev* | var* | approx_distinct | approx_percentile
+    function: str  # count | sum | avg | min | max | stddev* | var* | approx_* | bool_* | *_by | corr | ...
     arg_channel: Optional[int]  # None for count(*)
     output_type: T.Type
     distinct: bool = False
     param: Optional[float] = None  # approx_percentile's percentile
+    # second argument channel (min_by/max_by key, corr/covar/regr y, map_agg value)
+    arg2_channel: Optional[int] = None
     # count(*) counts rows; count(x) counts non-null x
 
     def __post_init__(self):
@@ -262,8 +264,19 @@ def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
         # merged with the exact multi-way Chan decomposition
         # (ops/aggregate.py combine_var_states)
         out = [T.BIGINT, T.DOUBLE, T.DOUBLE]
-    elif agg.function in ("min", "max", "sum"):
-        out = [agg.output_type if agg.function == "sum" else src_types[agg.arg_channel]]
+    elif agg.function == "sum":
+        out = [agg.output_type]
+        if _is_long_decimal(agg.output_type):
+            # two-limb running sum: (lo bit pattern, hi limb) — exact for
+            # the full p38 range across the partial/final split
+            # (ops/aggregate.py agg_sum_128; reference: Int128State)
+            out.append(T.BIGINT)
+    elif agg.function in ("min", "max"):
+        out = [src_types[agg.arg_channel]]
+    elif agg.function in ("bool_and", "bool_or", "every"):
+        out = [T.BOOLEAN]
+    elif agg.function == "count_if":
+        out = [T.BIGINT]
     elif agg.function == "approx_percentile":
         # mergeable quantile summary (ops/hll.py QUANTILE_SAMPLES values at
         # evenly spaced local ranks) + the live count
@@ -279,12 +292,23 @@ def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
 _VAR_FAMILY = {"stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"}
 
 
+# Aggregates whose partial state is the raw rows themselves (variable
+# length or pair-valued) — the planner routes them through a gather
+# exchange instead of a partial/final split.
+_UNSPLITTABLE = {
+    "array_agg", "histogram", "map_agg", "min_by", "max_by",
+    "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
+    "arbitrary", "any_value", "geometric_mean", "checksum",
+}
+
+
 def can_split_aggs(aggregates) -> bool:
     """True when every aggregate has a mergeable partial/final state.
     DISTINCT aggregates must see all raw rows; approx_percentile ships a
-    mergeable quantile summary (ops/hll.py percentile_states); array_agg's
-    state is the raw rows themselves (variable length — gather path)."""
-    return not any(a.distinct or a.function == "array_agg" for a in aggregates)
+    mergeable quantile summary (ops/hll.py percentile_states)."""
+    return not any(
+        a.distinct or a.function in _UNSPLITTABLE for a in aggregates
+    )
 
 
 def _acc_state_count(agg: AggregateCall) -> int:
@@ -295,7 +319,19 @@ def _acc_state_count(agg: AggregateCall) -> int:
         return QUANTILE_SAMPLES + 1
     if agg.function in _VAR_FAMILY:
         return 3
+    if agg.function == "sum" and _is_long_decimal(agg.output_type):
+        return 2
     return 2 if agg.function == "avg" else 1
+
+
+def _is_long_decimal(t: T.Type) -> bool:
+    return isinstance(t, T.DecimalType) and t.precision > 18
+
+
+_TWO_ARG_AGGS = {
+    "min_by", "max_by", "map_agg",
+    "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
+}
 
 
 @dataclasses.dataclass

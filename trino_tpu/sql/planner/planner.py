@@ -978,20 +978,33 @@ class Planner:
                 agg_calls.append(P.AggregateCall("count", None, T.BIGINT))
                 continue
             param = None
-            if a.name == "approx_percentile":
+            arg2 = None
+            fname = "bool_and" if a.name == "every" else a.name
+            if fname == "approx_percentile":
                 if len(a.args) != 2:
                     raise PlanningError("approx_percentile expects 2 arguments")
                 p_ir = ExprAnalyzer(scope).analyze(a.args[1])
                 param = _constant_fraction(p_ir, "approx_percentile")
+            elif fname in P._TWO_ARG_AGGS:
+                if len(a.args) != 2:
+                    raise PlanningError(f"{fname} expects 2 arguments")
+                arg2 = ExprAnalyzer(scope).analyze(a.args[1])
             elif len(a.args) != 1:
                 raise PlanningError(f"{a.name} expects 1 argument")
             arg = ExprAnalyzer(scope).analyze(a.args[0])
-            out_t = aggregate_result_type(a.name, arg.type)
+            out_t = aggregate_result_type(
+                fname, arg.type, arg2.type if arg2 is not None else None)
             ch = len(pre_exprs)
             pre_exprs.append(arg)
             pre_names.append(f"aggarg{len(agg_calls)}")
+            ch2 = None
+            if arg2 is not None:
+                ch2 = len(pre_exprs)
+                pre_exprs.append(arg2)
+                pre_names.append(f"aggarg{len(agg_calls)}b")
             agg_calls.append(
-                P.AggregateCall(a.name, ch, out_t, distinct=a.distinct, param=param))
+                P.AggregateCall(fname, ch, out_t, distinct=a.distinct,
+                                param=param, arg2_channel=ch2))
             agg_arg_irs.append(arg)
 
         if not pre_exprs:
@@ -1301,6 +1314,9 @@ class Planner:
             if a.is_star:
                 calls.append(P.AggregateCall("count", None, T.BIGINT))
                 continue
+            if a.name in P._TWO_ARG_AGGS:
+                raise PlanningError(
+                    f"{a.name} in a correlated scalar subquery: not supported")
             arg_ir = ExprAnalyzer(inner_scope).analyze(a.args[0])
             param = None
             if a.name == "approx_percentile":

@@ -10,10 +10,10 @@ to a fixed-width device representation (TPUs want fixed-width):
 - REAL/DOUBLE        -> float32/float64
 - DATE               -> int32 (days since 1970-01-01)
 - TIMESTAMP(6)       -> int64 (microseconds since epoch)
-- DECIMAL(p, s)      -> int64 scaled by 10**s at rest; p > 18 arithmetic
-                        intermediates run on 2x int64 limbs (ops/int128.py,
-                        reference Int128Math.java) and overflow past int64
-                        raises DECIMAL_OVERFLOW (see decimal() below)
+- DECIMAL(p, s)      -> int64 scaled by 10**s, plus an adaptive high limb
+                        for p > 18 columns whose data exceeds int64
+                        (ops/int128.py, reference Int128Math.java); results
+                        past 10^38 raise DECIMAL_OVERFLOW (see decimal())
 - VARCHAR/CHAR       -> int32 dictionary codes; the dictionary (the actual
                         UTF-8 strings) lives host-side (data/dictionary.py).
                         TPUs excel at fixed width; strings are dictionary-first
@@ -107,13 +107,12 @@ class DecimalType(Type):
 def decimal(precision: int, scale: int) -> DecimalType:
     if not 1 <= precision <= 38:
         raise ValueError(f"decimal precision out of range: {precision}")
-    # Storage is a scaled int64 for every precision. For p > 18 the
-    # expression lowering routes arithmetic whose INTERMEDIATES can exceed
-    # 64 bits (products, rescaled operands/numerators) through the int128
-    # limb kernels in ops/int128.py (reference: Int128Math.java), then
-    # narrows back; a long-decimal RESULT beyond int64 range raises the
-    # deferred DECIMAL_OVERFLOW error rather than wrapping. So the practical
-    # long-decimal value range at rest is |v| < 2^63 at the result scale.
+    # Storage is a scaled int64, plus an ADAPTIVE second limb for p > 18
+    # columns whose data exceeds int64 (data/page.py Column.hi — the
+    # reference's short/long decimal split, spi/type/Int128.java, decided
+    # per column from the data). Arithmetic routes through the int128 limb
+    # kernels (ops/int128.py, reference Int128Math.java); results past the
+    # 10^38 cap raise the deferred DECIMAL_OVERFLOW error.
     return DecimalType(
         name=f"decimal({precision},{scale})",
         np_dtype=np.dtype(np.int64),
