@@ -1,0 +1,71 @@
+"""PEP 249 DB-API client (the reference's trino-jdbc / trino-python-client
+role): connect -> cursor -> execute/fetch over both the embedded engine and
+the REST coordinator protocol.
+"""
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.client import dbapi
+
+
+def test_embedded_roundtrip():
+    conn = dbapi.connect(catalog="memory", schema="t")
+    conn._session.catalogs["memory"].create_table(
+        "t", "people", [("id", T.BIGINT), ("name", T.VARCHAR)],
+        [(1, "ada"), (2, "bob"), (3, "eve")],
+    )
+    cur = conn.cursor()
+    cur.execute("select id, name from people where id > ? order by id", (1,))
+    assert [d[0] for d in cur.description] == ["id", "name"]
+    assert cur.rowcount == 2
+    assert cur.fetchone() == (2, "bob")
+    assert cur.fetchall() == [(3, "eve")]
+    assert cur.fetchone() is None
+    cur.execute("select name from people where name = ?", ("ada",))
+    assert cur.fetchall() == [("ada",)]
+    # string literals with embedded quotes escape correctly
+    cur.execute("select ? ", ("o''clock".replace("''", "'"),))
+    assert cur.fetchall() == [("o'clock",)]
+    conn.close()
+    with pytest.raises(dbapi.InterfaceError):
+        conn.cursor()
+
+
+def test_iteration_and_fetchmany():
+    conn = dbapi.connect(catalog="tpch", schema="tiny")
+    cur = conn.cursor()
+    cur.execute("select n_nationkey, n_name from tpch.tiny.nation order by n_nationkey")
+    first = cur.fetchmany(3)
+    assert len(first) == 3 and first[0][0] == 0
+    rest = list(cur)
+    assert len(rest) == cur.rowcount - 3
+
+
+def test_database_error_taxonomy():
+    conn = dbapi.connect()
+    cur = conn.cursor()
+    with pytest.raises(dbapi.DatabaseError):
+        cur.execute("select definitely_missing from nowhere")
+
+
+def test_remote_transport():
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    coord = CoordinatorServer()
+    coord.start()
+    w = WorkerServer(coordinator_url=coord.base_url, node_id="w0")
+    w.start()
+    try:
+        assert coord.registry.wait_for_workers(1, timeout=15.0)
+        conn = dbapi.connect(coordinator_url=coord.base_url)
+        cur = conn.cursor()
+        cur.execute(
+            "select n_regionkey, count(*) from tpch.tiny.nation"
+            " group by n_regionkey order by n_regionkey"
+        )
+        rows = cur.fetchall()
+        assert len(rows) == 5 and all(r[1] == 5 for r in rows)
+    finally:
+        w.stop()
+        coord.stop()

@@ -1,0 +1,117 @@
+"""SQL surface breadth: FILTER clause, prepared statements, lambdas,
+GROUPING SETS / ROLLUP / CUBE.
+
+Reference: AggregationNode.Aggregation filter symbols, execution/PrepareTask
++ sql/tree/Parameter, sql/tree/LambdaExpression + Array*MatchFunction /
+ArrayTransformFunction, and QueryPlanner.planGroupingSets (GroupIdNode —
+expanded here into per-set aggregations).
+"""
+import pytest
+
+from trino_tpu import Session
+from trino_tpu import types as T
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = Session()
+    s.catalogs["memory"].create_table(
+        "t", "sales",
+        [("region", T.VARCHAR), ("prod", T.VARCHAR), ("amt", T.BIGINT),
+         ("flag", T.BOOLEAN)],
+        [("e", "a", 10, True), ("e", "b", 20, False),
+         ("w", "a", 5, True), ("w", "b", 15, True)],
+    )
+    s.catalogs["memory"].create_table(
+        "t", "arr", [("id", T.BIGINT), ("xs", T.array_of(T.BIGINT))],
+        [(1, [1, 2, 3]), (2, []), (3, None), (4, [5, None])],
+    )
+    return s
+
+
+def test_aggregate_filter_clause(session):
+    rows = session.execute(
+        "select region, count(*) filter (where flag),"
+        "       sum(amt) filter (where amt > 9)"
+        " from memory.t.sales group by region order by region"
+    ).rows
+    assert rows == [("e", 1, 30), ("w", 2, 15)]
+
+
+def test_prepared_statements(session):
+    session.execute(
+        "prepare q1 from select region, sum(amt) from memory.t.sales"
+        " where amt > ? group by region order by region"
+    )
+    assert session.execute("execute q1 using 9").rows == [("e", 30), ("w", 15)]
+    assert session.execute("execute q1 using 15").rows == [("e", 20)]
+    with pytest.raises(Exception):
+        session.execute("execute q1")  # missing parameter
+    session.execute("deallocate prepare q1")
+    with pytest.raises(Exception):
+        session.execute("execute q1 using 1")
+
+
+def test_lambda_transform(session):
+    rows = session.execute(
+        "select id, transform(xs, x -> x * 2 + 1) from memory.t.arr order by id"
+    ).rows
+    assert rows == [(1, [3, 5, 7]), (2, []), (3, None), (4, [11, None])]
+
+
+def test_lambda_matches_three_valued(session):
+    rows = session.execute(
+        "select id, any_match(xs, x -> x > 2), all_match(xs, x -> x > 0),"
+        "       none_match(xs, x -> x > 9) from memory.t.arr order by id"
+    ).rows
+    assert rows == [
+        (1, True, True, True),
+        (2, False, True, True),   # vacuous truth on empty arrays
+        (3, None, None, None),
+        (4, True, None, None),    # NULL element -> unknown
+    ]
+
+
+def test_lambda_over_varchar(session):
+    assert session.execute(
+        "select transform(array['a','bb'], s -> length(s))"
+    ).rows == [([1, 2],)]
+
+
+def test_grouping_sets(session):
+    rows = session.execute(
+        "select region, prod, sum(amt) from memory.t.sales"
+        " group by grouping sets ((region, prod), (region), ())"
+        " order by region nulls last, prod nulls last"
+    ).rows
+    assert rows == [
+        ("e", "a", 10), ("e", "b", 20), ("e", None, 30),
+        ("w", "a", 5), ("w", "b", 15), ("w", None, 20),
+        (None, None, 50),
+    ]
+
+
+def test_rollup(session):
+    rows = session.execute(
+        "select region, sum(amt) from memory.t.sales group by rollup(region)"
+        " order by region nulls last"
+    ).rows
+    assert rows == [("e", 30), ("w", 20), (None, 50)]
+
+
+def test_cube(session):
+    rows = session.execute(
+        "select region, prod, sum(amt) from memory.t.sales"
+        " group by cube(region, prod)"
+        " order by region nulls last, prod nulls last"
+    ).rows
+    assert len(rows) == 9  # 2x2 + 2 + 2 + 1
+    assert rows[-1] == (None, None, 50)
+
+
+def test_rollup_with_limit(session):
+    rows = session.execute(
+        "select region, sum(amt) as total from memory.t.sales"
+        " group by rollup(region) order by 2 desc limit 1"
+    ).rows
+    assert rows == [(None, 50)]

@@ -281,10 +281,15 @@ def _concat_col(ca: Column, cb: Column) -> Column:
     if ca.dictionary is not None and cb.dictionary is not None:
         if ca.dictionary is not cb.dictionary and ca.dictionary.values != cb.dictionary.values:
             d = ca.dictionary.merge(cb.dictionary)
-            ra = jnp.asarray(ca.dictionary.recode_table(d))
-            rb = jnp.asarray(cb.dictionary.recode_table(d))
-            va = jnp.where(va >= 0, ra[jnp.clip(va, 0)], NULL_CODE)
-            vb = jnp.where(vb >= 0, rb[jnp.clip(vb, 0)], NULL_CODE)
+
+            def recode(src_dict):
+                t = np.asarray(src_dict.recode_table(d))
+                # an all-NULL side has an empty vocab: pad so the gather
+                # below stays in range (its codes are all NULL_CODE anyway)
+                return jnp.asarray(t if len(t) else np.array([NULL_CODE], np.int32))
+
+            va = jnp.where(va >= 0, recode(ca.dictionary)[jnp.clip(va, 0)], NULL_CODE)
+            vb = jnp.where(vb >= 0, recode(cb.dictionary)[jnp.clip(vb, 0)], NULL_CODE)
     vals = jnp.concatenate([va, vb])
     if ca.nulls is None and cb.nulls is None:
         nulls = None

@@ -25,7 +25,38 @@ def plan_sql(session, sql: str):
 
 
 def run_query(session, sql: str) -> QueryResult:
-    stmt = parse_statement(sql)
+    return _dispatch_statement(session, parse_statement(sql))
+
+
+def _bind_parameters(stmt, params):
+    """Substitute ``?`` placeholders with the EXECUTE ... USING expressions
+    (reference: planner/ParameterRewriter): a generic rewrite over the
+    frozen AST."""
+    import dataclasses as _dc
+
+    def rewrite(node):
+        if isinstance(node, ast.Parameter):
+            if node.index >= len(params):
+                raise ValueError(
+                    f"prepared statement needs {node.index + 1} parameters, "
+                    f"got {len(params)}")
+            return params[node.index]
+        if isinstance(node, tuple):
+            return tuple(rewrite(x) for x in node)
+        if _dc.is_dataclass(node) and not isinstance(node, type):
+            changes = {}
+            for f in _dc.fields(node):
+                v = getattr(node, f.name)
+                nv = rewrite(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            return _dc.replace(node, **changes) if changes else node
+        return node
+
+    return rewrite(stmt)
+
+
+def _dispatch_statement(session, stmt) -> QueryResult:
     if isinstance(stmt, ast.Explain):
         if stmt.analyze:
             text = explain_analyze(session, stmt.statement)
@@ -40,6 +71,25 @@ def run_query(session, sql: str) -> QueryResult:
         return _insert(session, stmt)
     if isinstance(stmt, ast.DropTable):
         return _drop_table(session, stmt)
+    if isinstance(stmt, ast.Prepare):
+        # reference: execution/PrepareTask — the statement is stored parsed;
+        # parameters bind at EXECUTE time (sql/tree/Parameter)
+        if not hasattr(session, "prepared_statements"):
+            session.prepared_statements = {}
+        session.prepared_statements[stmt.name] = stmt.statement
+        return QueryResult(["result"], [], [("PREPARE",)])
+    if isinstance(stmt, ast.ExecutePrepared):
+        prepared = getattr(session, "prepared_statements", {}).get(stmt.name)
+        if prepared is None:
+            raise ValueError(f"prepared statement not found: {stmt.name}")
+        bound = _bind_parameters(prepared, stmt.params)
+        return _dispatch_statement(session, bound)
+    if isinstance(stmt, ast.Deallocate):
+        store = getattr(session, "prepared_statements", {})
+        if stmt.name not in store:
+            raise ValueError(f"prepared statement not found: {stmt.name}")
+        del store[stmt.name]
+        return QueryResult(["result"], [], [("DEALLOCATE",)])
     if isinstance(stmt, ast.StartTransaction):
         from trino_tpu.exec import transaction as txn_mod
 

@@ -156,10 +156,13 @@ def _align_varchar(a: LoweredVal, b: LoweredVal) -> Tuple[jnp.ndarray, jnp.ndarr
     if a.dictionary is b.dictionary or a.dictionary.values == b.dictionary.values:
         return a.vals, b.vals
     merged = a.dictionary.merge(b.dictionary)
-    ra = jnp.asarray(a.dictionary.recode_table(merged))
-    rb = jnp.asarray(b.dictionary.recode_table(merged))
-    av = jnp.where(a.vals >= 0, ra[jnp.clip(a.vals, 0)], NULL_CODE)
-    bv = jnp.where(b.vals >= 0, rb[jnp.clip(b.vals, 0)], NULL_CODE)
+
+    def recode(d):
+        t = np.asarray(d.recode_table(merged))
+        return jnp.asarray(t if len(t) else np.array([NULL_CODE], np.int32))
+
+    av = jnp.where(a.vals >= 0, recode(a.dictionary)[jnp.clip(a.vals, 0)], NULL_CODE)
+    bv = jnp.where(b.vals >= 0, recode(b.dictionary)[jnp.clip(b.vals, 0)], NULL_CODE)
     return av, bv
 
 
@@ -1052,6 +1055,14 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
     ft, tt = expr.value.type, expr.type
     if ft == tt:
         return a
+    if ft == T.UNKNOWN:
+        # typed NULL: every row invalid, representation per target type
+        dtype = tt.np_dtype if tt.np_dtype is not None else np.dtype(np.int32)
+        return LoweredVal(
+            _const_array(ctx, dtype, 0),
+            jnp.zeros((ctx.num_rows,), bool),
+            Dictionary([]) if tt.is_varchar else None,
+        )
     if tt.is_floating:
         if a.hi is not None:
             return LoweredVal(_to_float128(a, ft).astype(tt.np_dtype), a.valid, None)
@@ -1734,6 +1745,84 @@ def _lower_map_part(which: int):
     return fn
 
 
+def _lower_lambda_over_flat(ctx: LowerCtx, arr: LoweredVal, lam: "ir.Lambda",
+                            elem_type) -> LoweredVal:
+    """Evaluate a lambda body over an array's FLATTENED child: the element
+    column becomes channel 0 of a fresh lowering context whose row space is
+    the flat space — one vectorized pass over all elements of all rows
+    (reference evaluates the lambda per element via generated bytecode)."""
+    child = arr.children[0]
+    flat_n = int(child.vals.shape[0])
+    elem_col = Column(
+        elem_type,
+        child.vals if flat_n else jnp.zeros((1,), child.vals.dtype),
+        None if child.valid is None else (
+            ~child.valid if flat_n else jnp.zeros((1,), bool)),
+        child.dictionary,
+    )
+    inner = LowerCtx([elem_col], max(flat_n, 1))
+    out = lower(lam.body, inner)
+    ctx.errors.extend(inner.errors)
+    if flat_n == 0:
+        out = LoweredVal(out.vals[:0], None if out.valid is None else out.valid[:0],
+                         out.dictionary)
+    return out
+
+
+def _lower_transform(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    arr = lower(expr.args[0], ctx)
+    lam = expr.args[1]
+    elem_t = expr.args[0].type.element
+    out = _lower_lambda_over_flat(ctx, arr, lam, elem_t)
+    return LoweredVal(
+        arr.vals.astype(jnp.int32), arr.valid,
+        children=[LoweredVal(out.vals, out.valid, out.dictionary)],
+    )
+
+
+def _lower_match(kind: str):
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        arr = lower(expr.args[0], ctx)
+        lam = expr.args[1]
+        elem_t = expr.args[0].type.element
+        out = _lower_lambda_over_flat(ctx, arr, lam, elem_t)
+        A, lens, offsets = _nested_parts(arr)
+        flat_true = out.vals
+        flat_known = out.valid
+        if flat_known is not None:
+            flat_true = flat_true & flat_known
+        n_true = A.count_in_ranges(offsets, flat_true)
+        n_unknown = (
+            A.count_in_ranges(offsets, ~flat_known)
+            if flat_known is not None
+            else None
+        )
+        # SQL three-valued semantics (reference Array*MatchFunction):
+        # any_match: true if any true; null if none true but some unknown
+        # all_match: false if any false; null if rest unknown; else true
+        # none_match: !any_match
+        if kind in ("any", "none"):
+            hit = n_true > 0
+            if n_unknown is not None:
+                valid = and_valid(arr.valid, hit | (n_unknown == 0))
+            else:
+                valid = arr.valid
+            vals = hit if kind == "any" else ~hit
+            return LoweredVal(vals, valid, None)
+        flat_false = ~out.vals
+        if flat_known is not None:
+            flat_false = flat_false & flat_known
+        n_false = A.count_in_ranges(offsets, flat_false)
+        any_false = n_false > 0
+        if n_unknown is not None:
+            valid = and_valid(arr.valid, any_false | (n_unknown == 0))
+        else:
+            valid = arr.valid
+        return LoweredVal(~any_false, valid, None)
+
+    return fn
+
+
 def _lower_map_ctor(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     ka = lower(expr.args[0], ctx)
     va = lower(expr.args[1], ctx)
@@ -1866,4 +1955,8 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "map_keys": _lower_map_part(0),
     "map_values": _lower_map_part(1),
     "map_ctor": _lower_map_ctor,
+    "transform": _lower_transform,
+    "any_match": _lower_match("any"),
+    "all_match": _lower_match("all"),
+    "none_match": _lower_match("none"),
 }

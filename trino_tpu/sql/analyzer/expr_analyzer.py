@@ -13,7 +13,7 @@ from typing import Dict, List, Optional, Tuple
 
 from trino_tpu import types as T
 from trino_tpu.sql import ir
-from trino_tpu.sql.analyzer.scope import AnalysisError, Scope
+from trino_tpu.sql.analyzer.scope import AnalysisError, Field, Scope
 from trino_tpu.sql.parser import ast
 
 AGGREGATE_FUNCTIONS = {
@@ -368,6 +368,27 @@ class ExprAnalyzer:
                 if not self.allow_aggregates
                 else f"aggregate {name}() must be substituted by the planner"
             )
+        # higher-order array functions take a lambda argument (reference:
+        # operator/scalar/ArrayTransformFunction, ArrayAnyMatchFunction, ...)
+        if name in ("transform", "any_match", "all_match", "none_match"):
+            if len(e.args) != 2 or not isinstance(e.args[1], ast.Lambda):
+                raise AnalysisError(f"{name}(array, x -> expression)")
+            arr = self.analyze(e.args[0])
+            if not isinstance(arr.type, T.ArrayType):
+                raise AnalysisError(f"{name}() expects an array")
+            lam = e.args[1]
+            if len(lam.params) != 1:
+                raise AnalysisError(f"{name}() lambda takes one parameter")
+            elem_scope = Scope([Field(lam.params[0], arr.type.element)], None)
+            body = ExprAnalyzer(elem_scope).analyze(lam.body)
+            lam_ir = ir.Lambda(body.type, body, 1)
+            if name == "transform":
+                return ir.Call(T.array_of(body.type), "transform", (arr, lam_ir))
+            if body.type != T.BOOLEAN:
+                raise AnalysisError(f"{name}() lambda must return boolean")
+            return ir.Call(T.BOOLEAN, name, (arr, lam_ir))
+        if any(isinstance(a, ast.Lambda) for a in e.args):
+            raise AnalysisError(f"{name}() does not take a lambda argument")
         args = tuple(self.analyze(a) for a in e.args)
         if name == "coalesce":
             t = args[0].type

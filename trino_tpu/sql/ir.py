@@ -53,6 +53,24 @@ class ColumnRef(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class Lambda(Expr):
+    """A lambda argument of a higher-order array function (reference:
+    sql/ir LambdaExpression). ``body`` is analyzed in an element scope where
+    ColumnRef(0..n_params-1) are the lambda parameters; the lowering
+    evaluates it over the FLATTENED child column(s)."""
+
+    type: T.Type  # body's type
+    body: "Expr" = None
+    n_params: int = 1
+
+    def children(self):
+        return (self.body,)
+
+    def __repr__(self):
+        return f"Lambda({self.body!r})"
+
+
+@dataclasses.dataclass(frozen=True)
 class OuterRef(Expr):
     """Correlated reference to channel ``index`` of the OUTER query's scope.
 
@@ -130,11 +148,28 @@ def walk(e: Expr):
 
 
 def referenced_channels(e: Expr) -> List[int]:
-    return sorted({n.index for n in walk(e) if isinstance(n, ColumnRef)})
+    """Input channels an expression reads. Lambda bodies are element-scoped
+    — their ColumnRefs name lambda parameters, not input channels — so the
+    walk does not descend into them."""
+    out = set()
+
+    def visit(x: Expr):
+        if isinstance(x, Lambda):
+            return
+        if isinstance(x, ColumnRef):
+            out.add(x.index)
+        for c in x.children():
+            visit(c)
+
+    visit(e)
+    return sorted(out)
 
 
 def remap_channels(e: Expr, mapping: dict) -> Expr:
-    """Rewrite ColumnRef indices through ``mapping`` (for projection pushdown)."""
+    """Rewrite ColumnRef indices through ``mapping`` (for projection
+    pushdown). Lambda bodies are element-scoped and pass through unchanged."""
+    if isinstance(e, Lambda):
+        return e
     if isinstance(e, ColumnRef):
         return ColumnRef(e.type, mapping[e.index], e.name)
     if isinstance(e, Call):

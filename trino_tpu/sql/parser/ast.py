@@ -192,6 +192,15 @@ class ArrayConstructor(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class Lambda(Expression):
+    """x -> expr (reference: sql/tree/LambdaExpression.java); valid only as
+    an argument of the higher-order array functions."""
+
+    params: Tuple[str, ...]
+    body: Expression
+
+
+@dataclasses.dataclass(frozen=True)
 class Subscript(Expression):
     base: Expression
     index: Expression
@@ -260,6 +269,9 @@ class QuerySpec(Node):
     where: Optional[Expression]
     group_by: Tuple[Expression, ...]
     having: Optional[Expression]
+    # GROUPING SETS / ROLLUP / CUBE: a tuple of grouping sets (each a tuple
+    # of expressions); the planner expands them (reference: GroupIdNode)
+    grouping_sets: Optional[Tuple[Tuple[Expression, ...], ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -335,6 +347,31 @@ class Insert(Statement):
 class DropTable(Statement):
     name: tuple
     if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Parameter(Expression):
+    """A ``?`` placeholder in a prepared statement (reference:
+    sql/tree/Parameter.java); bound at EXECUTE ... USING time."""
+
+    index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Statement):
+    name: str
+    statement: "Statement"
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutePrepared(Statement):
+    name: str
+    params: Tuple[Expression, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Statement):
+    name: str
 
 
 @dataclasses.dataclass(frozen=True)

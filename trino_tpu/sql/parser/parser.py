@@ -130,6 +130,26 @@ class Parser:
         ):
             self.advance()
             return ast.StartTransaction()
+        if self.at_soft("prepare") and self.peek(1).kind == "ident":
+            self.advance()
+            name = self.identifier()
+            self.expect_kw("from")
+            self._param_counter = 0
+            return ast.Prepare(name.lower(), self.statement())
+        if self.at_soft("execute") and self.peek(1).kind == "ident":
+            self.advance()
+            name = self.identifier()
+            params: List[ast.Expression] = []
+            if self.at_soft("using"):
+                self.advance()
+                params.append(self.expr())
+                while self.accept_op(","):
+                    params.append(self.expr())
+            return ast.ExecutePrepared(name.lower(), tuple(params))
+        if self.at_soft("deallocate"):
+            self.advance()
+            self.accept_soft("prepare")
+            return ast.Deallocate(self.identifier().lower())
         if self.at_soft("commit"):
             self.advance()
             return ast.Commit()
@@ -357,14 +377,61 @@ class Parser:
             from_ = self.relation()
         where = self.expr() if self.accept_kw("where") else None
         group_by: Tuple[ast.Expression, ...] = ()
+        grouping_sets = None
         if self.accept_kw("group"):
             self.expect_kw("by")
-            gb = [self.expr()]
-            while self.accept_op(","):
-                gb.append(self.expr())
-            group_by = tuple(gb)
+            if self.at_soft("grouping") and self.at_soft("sets", ahead=1):
+                self.advance()
+                self.advance()
+                self.expect_op("(")
+                grouping_sets = [self._grouping_set()]
+                while self.accept_op(","):
+                    grouping_sets.append(self._grouping_set())
+                self.expect_op(")")
+            elif self.at_soft("rollup") and self.peek(1).text == "(":
+                self.advance()
+                self.advance()
+                cols = [self.expr()]
+                while self.accept_op(","):
+                    cols.append(self.expr())
+                self.expect_op(")")
+                # ROLLUP(a,b) == GROUPING SETS ((a,b),(a),())
+                grouping_sets = [tuple(cols[:k]) for k in range(len(cols), -1, -1)]
+            elif self.at_soft("cube") and self.peek(1).text == "(":
+                self.advance()
+                self.advance()
+                cols = [self.expr()]
+                while self.accept_op(","):
+                    cols.append(self.expr())
+                self.expect_op(")")
+                import itertools as _it
+
+                grouping_sets = [
+                    tuple(c for c, keep in zip(cols, mask) if keep)
+                    for mask in _it.product([True, False], repeat=len(cols))
+                ]
+            else:
+                gb = [self.expr()]
+                while self.accept_op(","):
+                    gb.append(self.expr())
+                group_by = tuple(gb)
         having = self.expr() if self.accept_kw("having") else None
-        return ast.QuerySpec(tuple(items), distinct, from_, where, group_by, having)
+        return ast.QuerySpec(
+            tuple(items), distinct, from_, where, group_by, having,
+            grouping_sets=tuple(grouping_sets) if grouping_sets is not None else None,
+        )
+
+    def _grouping_set(self) -> tuple:
+        if self.accept_op("("):
+            if self.at_op(")"):
+                self.advance()
+                return ()
+            cols = [self.expr()]
+            while self.accept_op(","):
+                cols.append(self.expr())
+            self.expect_op(")")
+            return tuple(cols)
+        return (self.expr(),)
 
     def select_item(self) -> ast.SelectItem:
         if self.at_op("*"):
@@ -583,6 +650,11 @@ class Parser:
 
     def _primary_base(self) -> ast.Expression:
         t = self.peek()
+        if self.at_op("?"):
+            self.advance()
+            idx = getattr(self, "_param_counter", 0)
+            self._param_counter = idx + 1
+            return ast.Parameter(idx)
         if self.at_soft("array") and self.peek(1).text == "[":
             self.advance()
             self.advance()  # [
@@ -685,6 +757,13 @@ class Parser:
                 self.advance()  # (
                 if self.accept_op("*"):
                     self.expect_op(")")
+                    if self.at_soft("filter") and self.peek(1).text == "(":
+                        self.advance()
+                        self.advance()
+                        self.expect_kw("where")
+                        cond = self.expr()
+                        self.expect_op(")")
+                        return ast.FunctionCall("count_if", (cond,))
                     if self.at_soft("over") and self.peek(1).text == "(":
                         return self.window_suffix(name.lower(), (), is_star=True)
                     return ast.FunctionCall(name.lower(), (), is_star=True)
@@ -692,10 +771,29 @@ class Parser:
                 self.accept_kw("all")
                 args: List[ast.Expression] = []
                 if not self.at_op(")"):
-                    args.append(self.expr())
+                    args.append(self._arg_or_lambda())
                     while self.accept_op(","):
-                        args.append(self.expr())
+                        args.append(self._arg_or_lambda())
                 self.expect_op(")")
+                # FILTER (WHERE cond) — aggregate filter clause; rewritten
+                # at parse time: agg(x) FILTER (WHERE c) == agg(CASE WHEN c
+                # THEN x END), count(*) == count_if(c) (reference:
+                # AggregationNode.Aggregation's filter symbol; the rewrite
+                # is exact because aggregates ignore NULL inputs)
+                if self.at_soft("filter") and self.peek(1).text == "(":
+                    self.advance()
+                    self.advance()
+                    self.expect_kw("where")
+                    cond = self.expr()
+                    self.expect_op(")")
+                    fn = name.lower()
+                    if fn == "count" and not args:
+                        return ast.FunctionCall("count_if", (cond,))
+                    if distinct or not args:
+                        raise ParseError(
+                            "FILTER is supported on single-argument aggregates")
+                    filtered = ast.SearchedCase(((cond, args[0]),), None)
+                    return ast.FunctionCall(fn, (filtered,) + tuple(args[1:]))
                 if self.at_soft("over") and self.peek(1).text == "(":
                     if distinct:
                         raise ParseError("DISTINCT window aggregates not supported")
@@ -704,6 +802,31 @@ class Parser:
             parts = self.qualified_name()
             return ast.Identifier(tuple(parts))
         raise ParseError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def _arg_or_lambda(self) -> ast.Expression:
+        """A function argument: ``x -> expr`` / ``(x, y) -> expr`` lambdas
+        or a plain expression."""
+        if self.peek().kind == "ident" and self.peek(1).text == "->":
+            p = self.identifier()
+            self.advance()  # ->
+            return ast.Lambda((p,), self.expr())
+        if (self.at_op("(") and self.peek(1).kind == "ident"
+                and self.peek(2).text in (",", ")")):
+            # lookahead for "(a, b, ...) ->"
+            save = self.i
+            try:
+                self.advance()
+                ps = [self.identifier()]
+                while self.accept_op(","):
+                    ps.append(self.identifier())
+                if self.at_op(")") and self.peek(1).text == "->":
+                    self.advance()
+                    self.advance()
+                    return ast.Lambda(tuple(ps), self.expr())
+            except ParseError:
+                pass
+            self.i = save
+        return self.expr()
 
     def window_suffix(self, name, args, is_star=False) -> ast.WindowFunction:
         """OVER ( [PARTITION BY ...] [ORDER BY ...] [frame] )"""

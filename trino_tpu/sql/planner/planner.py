@@ -596,6 +596,8 @@ class Planner:
         ctes: Dict[str, ast.WithQuery],
         query: ast.Query,
     ) -> RelationPlan:
+        if spec.grouping_sets is not None:
+            return self._plan_grouping_sets(spec, outer_scope, ctes, query)
         # FROM (implicit-join chains reordered by connectivity + size first
         # — see _reorder_implicit_joins)
         if spec.from_ is not None:
@@ -680,6 +682,85 @@ class Planner:
                 node = P.LimitNode(node, query.limit)
         node = self._drop_hidden(node, names, n_visible)
         return RelationPlan(node, out_scope)
+
+    def _plan_grouping_sets(self, spec, outer_scope, ctes, query) -> RelationPlan:
+        """GROUPING SETS / ROLLUP / CUBE by expansion: one aggregation per
+        set, keys absent from a set become NULL in its select list, results
+        concatenate (UNION ALL shape). The reference computes all sets in
+        one pass over a GroupIdNode-expanded input (sql/planner/
+        QueryPlanner.planGroupingSets); the expansion here re-reads the
+        source per set — correct, simpler, and each branch still takes the
+        engine's fast single-set path."""
+        all_keys = {k for gs in spec.grouping_sets for k in gs}
+
+        def null_missing(e, present):
+            if e in all_keys and e not in present:
+                return ast.Literal("null", None)
+            if isinstance(e, tuple):
+                return tuple(null_missing(x, present) for x in e)
+            if hasattr(e, "__dataclass_fields__") and isinstance(e, (ast.Expression,)):
+                import dataclasses as _dc
+
+                changes = {}
+                for f in _dc.fields(e):
+                    v = getattr(e, f.name)
+                    if isinstance(v, (ast.Expression, tuple)):
+                        nv = null_missing(v, present)
+                        if nv is not v:
+                            changes[f.name] = nv
+                return _dc.replace(e, **changes) if changes else e
+            return e
+
+        branches = []
+        for gs in spec.grouping_sets:
+            present = set(gs)
+            items = tuple(
+                ast.SelectItem(null_missing(it.expr, present), it.alias)
+                for it in spec.select_items
+            )
+            branches.append(
+                dataclasses.replace(
+                    spec, select_items=items, group_by=tuple(gs),
+                    grouping_sets=None,
+                )
+            )
+        # branches must not apply the query's ORDER BY/LIMIT — those wrap
+        # the union below
+        inner_q = dataclasses.replace(query, order_by=(), limit=None)
+        plan = self.plan_query_spec(branches[0], outer_scope, ctes, inner_q)
+        nodes = [plan.node]
+        for b in branches[1:]:
+            nodes.append(self.plan_query_spec(b, outer_scope, ctes, inner_q).node)
+        width = len(nodes[0].output_types)
+        out_types = []
+        for i in range(width):
+            t = nodes[0].output_types[i]
+            for n in nodes[1:]:
+                t2 = T.common_super_type(t, n.output_types[i])
+                if t2 is None:
+                    raise PlanningError("grouping sets branches: incompatible types")
+                t = t2
+            out_types.append(t)
+        names = [f.name or f"_col{i}" for i, f in enumerate(plan.scope.fields)]
+        casted = [_cast_to(n, out_types, names) for n in nodes]
+        union = casted[0]
+        for n in casted[1:]:
+            union = P.UnionNode(sources_=[union, n], names=names)
+        fields = [
+            Field(f.name, t, None)
+            for f, t in zip(plan.scope.fields, out_types)
+        ]
+        scope = Scope(fields, outer_scope)
+        node: P.PlanNode = union
+        if query is not None and query.order_by:
+            node = self._plan_order_by(
+                query, node, scope, replacements={}, select_asts=[])
+        if query is not None and query.limit is not None:
+            if isinstance(node, P.SortNode):
+                node = P.TopNNode(node.source, query.limit, node.sort_channels)
+            else:
+                node = P.LimitNode(node, query.limit)
+        return RelationPlan(node, scope)
 
     def _plan_select_items(self, spec, scope, ctes, node, replacements=None):
         select_irs: List[ir.Expr] = []
