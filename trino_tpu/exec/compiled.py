@@ -29,6 +29,10 @@ from trino_tpu.sql.planner import plan as P
 # staging — a cheap numpy LUT pass that cuts the host->device transfer,
 # the staging bottleneck at scale; weaker domains are enforced on device
 HOST_APPLY_MAX_SEL = 0.25
+# max probe-column value span for an in-program boolean LUT (bytes on the
+# device = span); wider spans degrade to min/max range narrowing. 1<<28 =
+# 256 MB worst case — big enough for sf100 orderkeys (150M span)
+LUT_MAX_SPAN = 1 << 28
 
 
 class StagingExecutor(Executor):
@@ -46,37 +50,66 @@ class StagingExecutor(Executor):
 
 class PreloadedExecutor(Executor):
     """Executor that reads table scans from pre-staged pages (the traced
-    inputs) instead of calling the connector. Scans listed in
-    ``scan_filters`` apply their phase-1 dynamic-filter domains on device:
-    sel &= sorted-set membership (jnp.searchsorted) or range compares, then
-    compact to a stats-sized capacity — the traced-tier half of two-phase
-    dynamic filtering (reference: DynamicFilterService; the compaction is
-    the AdaptivePlanner-style runtime right-sizing)."""
+    inputs) instead of calling the connector, with IN-PROGRAM dynamic
+    filtering: when a join executes its build side, the traced key values
+    ride into a boolean lookup table (one scatter, statically sized from
+    the probe column's vrange) or a min/max range; probe scans deeper in
+    the recursion mask against it and compact to a stats-sized capacity.
+    The whole collect->apply dataflow lives inside the single compiled
+    program — ZERO host work repeats per run (reference:
+    DynamicFilterService.java:105 + DynamicFiltersCollector, redesigned as
+    a pure dataflow instead of a coordinator round-trip)."""
 
     eager_tier = False  # runs under jax tracing: no host-side syncs
-    enable_dynamic_filtering = False  # scans pre-staged before tracing
+    enable_dynamic_filtering = True  # traced collection (see below)
     collect_stats = False  # tracing once; per-call timing is meaningless
 
     def __init__(self, session, staged: Dict[int, Page], capacity_hints=None,
-                 scan_filters=None):
+                 device_df=None):
         super().__init__(session, capacity_hints)
         self.staged = staged
-        # node_id -> [(channel, spec)]; spec = ("set", jnp sorted array)
-        # or ("range", lo, hi, lo_inc, hi_inc) with static bounds
-        self.scan_filters = scan_filters or {}
+        # scan node_id -> [(channel, join_id, key_idx, spec)] where spec is
+        # ("lut", lo, span) with STATIC bounds from the probe column's
+        # vrange, or ("range",) for min/max-only narrowing
+        self.device_df = device_df or {}
+        # (join_id, key_idx) -> (traced key values, traced live mask),
+        # registered by _collect_dynamic_filters during the build-side
+        # visit, consumed by probe scans later in the same trace
+        self.traced_domains: Dict[Tuple[int, int], tuple] = {}
+
+    def _collect_dynamic_filters(self, node: P.JoinNode, build: Page) -> None:
+        """Traced collection: no host syncs, just remember the build-side
+        key column (+liveness) for probe scans to mask against."""
+        for i in node.dyn_filter_keys:
+            ch = node.right_keys[i]
+            col = build.columns[ch]
+            if col.type.is_varchar or col.hi is not None:
+                continue  # dictionary codes are page-local; two-limb later
+            live = (build.sel if build.sel is not None
+                    else jnp.ones(build.num_rows, bool))
+            if col.nulls is not None:
+                live = live & ~col.nulls
+            self.traced_domains[(node.id, i)] = (col.values, live)
 
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
         page = self.staged[node.id]
-        filters = self.scan_filters.get(node.id)
-        if not filters:
+        entries = self.device_df.get(node.id)
+        if not entries:
             return page
         sel = page.sel if page.sel is not None else jnp.ones(page.num_rows, bool)
-        for ch, spec in filters:
+        applied = False
+        for ch, join_id, key_idx, spec in entries:
+            dom = self.traced_domains.get((join_id, key_idx))
+            if dom is None:
+                continue  # build side could not register (exotic key type)
             col = page.columns[ch]
-            m = _device_domain_mask(col.values, spec)
+            m = _traced_domain_mask(col.values, dom, spec)
             if col.nulls is not None:
                 m = m & ~col.nulls
             sel = sel & m
+            applied = True
+        if not applied:
+            return page
         page = Page(list(page.columns), sel, page.replicated)
         cap = self.capacity_hints.get(f"dfc:{node.id}")
         if cap is not None:
@@ -84,32 +117,27 @@ class PreloadedExecutor(Executor):
         return page
 
 
-def _device_domain_mask(values, spec):
-    """Membership of ``values`` in a dynamic-filter domain, on device.
-    NEVER jnp.searchsorted (log2(n) dependent random-gather passes — 2.5 s
-    for 6M probes on v5e): dense-span int domains ride a staged boolean
-    lookup table (ONE bounded gather); wide-span sets use the combined-sort
-    merge ranks of ops/ranks.py; ranges are pure compares."""
-    kind = spec[0]
-    if kind == "empty":
-        return jnp.zeros(values.shape[0], bool)
-    if kind == "lut":
-        _, lut, lo = spec
-        idx = jnp.clip(values - lo, 0, lut.shape[0] - 1)
-        return (values >= lo) & (values <= lo + (lut.shape[0] - 1)) & lut[idx]
-    if kind == "sorted":
-        from trino_tpu.ops import ranks
+def _traced_domain_mask(values, dom, spec):
+    """Membership of probe ``values`` in a traced build-side key set.
+    LUT path: the dense boolean-table membership kernel shared with semi
+    joins (ops/join.py dense_membership — one scatter, one bounded gather;
+    NEVER jnp.searchsorted, whose log2(n) dependent random-gather passes
+    cost ~2.5 s for 6M probes on v5e). Range path: masked min/max
+    reductions — empty build sides yield an all-false mask (inner/semi
+    join with an empty build emits nothing)."""
+    from trino_tpu.ops import join as join_ops
 
-        arr = spec[1]
-        _, counts = ranks.sorted_ranks([arr], [values])
-        return counts > 0
-    _, lo, hi, lo_inc, hi_inc = spec
-    m = jnp.ones(values.shape[0], bool)
-    if lo is not None:
-        m = m & (values >= lo if lo_inc else values > lo)
-    if hi is not None:
-        m = m & (values <= hi if hi_inc else values < hi)
-    return m
+    bvals, blive = dom
+    if spec[0] == "lut":
+        _, lo, span = spec
+        return join_ops.dense_membership(
+            (bvals, None), blive, (values, None), lo, span)
+    bv = bvals.astype(jnp.int64)
+    big = jnp.int64(1) << 62
+    lo = jnp.min(jnp.where(blive, bv, big))
+    hi = jnp.max(jnp.where(blive, bv, -big))
+    v = values.astype(jnp.int64)
+    return (v >= lo) & (v <= hi)
 
 
 @dataclasses.dataclass
@@ -147,8 +175,6 @@ class CompiledQuery:
         from trino_tpu.exec import host_eval
         from trino_tpu.sql.planner import stats
 
-        from trino_tpu.exec.executor import dynamic_domain_map
-
         t0 = time.perf_counter()
         dyn = host_eval.resolve_dynamic_filters(session, root)
         phase1_s = time.perf_counter() - t0
@@ -172,53 +198,60 @@ class CompiledQuery:
         base.df_host_allow = host_allow
         base.dyn_domains.update(dyn)
         staged_pages = {n.id: base._exec_TableScanNode(n) for n in scans}
-        # device-side dynamic-filter specs + stats-sized compaction per scan
+        # in-program dynamic-filter specs + stats-sized compaction per scan.
+        # Every (join, key) the optimizer annotated is applied ON DEVICE by
+        # the traced collect->mask dataflow — including builds the host
+        # evaluator cannot reproduce (host_eval's Unsupported shapes); the
+        # host-resolved domains are used here only to (a) prune STAGING for
+        # strong domains and (b) right-size the compaction capacities.
         df_hints: Dict[str, int] = {}
-        filter_specs: Dict[int, List] = {}  # nid -> [(ch, spec)]
-        filter_arrays: List[Tuple[int, int, object]] = []  # (nid, ch, np array)
+        device_df: Dict[int, List] = {}  # nid -> [(ch, join_id, key_idx, spec)]
+        joins_by_id = {
+            n.id: n for n in P.walk_plan(root) if isinstance(n, P.JoinNode)
+        }
         for n in scans:
-            doms = dynamic_domain_map(n, dyn)
-            if not doms:
-                n.runtime_rows = base.scan_stats.get(n.id)
-                continue
-            page = staged_pages[n.id]
-            staged_rows = base.scan_stats.get(n.id, page.num_rows)
-            sel_frac = 1.0
-            specs_for_scan: List = []
-            for col_name, dom in doms.items():
-                ch = n.column_names.index(col_name)
-                col = page.columns[ch]
-                if col.type.is_varchar or host_allow(n, col_name, dom):
-                    continue  # host-applied (or inapplicable) at staging
-                if dom.values is not None:
-                    from trino_tpu.connector.predicate import sorted_values_array
-
-                    dtype = np.asarray(col.values).dtype
-                    sa = sorted_values_array(dom)
-                    if sa.size == 0:
-                        specs_for_scan.append((ch, ("empty",)))
-                    else:
-                        lo_v, hi_v = int(sa[0]), int(sa[-1])
-                        span = hi_v - lo_v + 1
-                        if sa.dtype.kind in "iu" and span <= 1 << 24:
-                            lut = np.zeros(span, dtype=bool)
-                            lut[(sa - lo_v).astype(np.int64)] = True
-                            filter_arrays.append((n.id, ch, lut))
-                            specs_for_scan.append((ch, ("lut", None, lo_v)))
-                        else:
-                            filter_arrays.append((n.id, ch, sa.astype(dtype)))
-                            specs_for_scan.append((ch, ("sorted", None)))
-                    sel_frac *= _dom_sel(n, col_name, dom)
-                else:
-                    specs_for_scan.append(
-                        (ch, ("range", dom.low, dom.high,
-                              dom.low_inclusive, dom.high_inclusive)))
-            if not specs_for_scan:
+            staged_rows = base.scan_stats.get(n.id, staged_pages[n.id].num_rows)
+            if not n.dynamic_filters:
                 n.runtime_rows = staged_rows
                 continue
-            filter_specs[n.id] = specs_for_scan
+            page = staged_pages[n.id]
+            sel_frac = 1.0
+            entries: List = []
+            for join_id, key_idx, col_name in n.dynamic_filters:
+                ch = n.column_names.index(col_name)
+                col = page.columns[ch]
+                join = joins_by_id.get(join_id)
+                if col.type.is_varchar or col.hi is not None or join is None:
+                    continue
+                bcol_t = join.right.output_types[join.right_keys[key_idx]]
+                if bcol_t.is_varchar:
+                    continue  # build side cannot register this key
+                dom_known = dyn.get((join_id, key_idx))
+                if dom_known is not None and host_allow(n, col_name, dom_known):
+                    # already physically applied at staging: an in-program
+                    # mask would be provably all-true — skip the hot-path
+                    # scatter+gather entirely
+                    continue
+                vr = col.vrange
+                lut = vr is not None and (vr[1] - vr[0] + 1) <= LUT_MAX_SPAN
+                if lut:
+                    entries.append(
+                        (ch, join_id, key_idx,
+                         ("lut", int(vr[0]), int(vr[1] - vr[0] + 1))))
+                else:
+                    entries.append((ch, join_id, key_idx, ("range",)))
+                if dom_known is not None and lut:
+                    # discount only set domains the device enforces EXACTLY
+                    # (the LUT); a range-degraded spec keeps far more rows
+                    # than |set|/NDV, so it must not shrink the estimate,
+                    # and host-applied domains already shrank staged_rows
+                    sel_frac *= _dom_sel(n, col_name, dom_known)
+            if not entries:
+                n.runtime_rows = staged_rows
+                continue
+            device_df[n.id] = entries
             # base the estimate on the rows actually staged (host pruning
-            # already happened); discount only the DEVICE-side domains
+            # already happened); discount only the device-side narrowing
             est = max(int(staged_rows * sel_frac), 1)
             n.runtime_rows = est
             cap = 1 << max(int(est * 1.3), 1024).bit_length()
@@ -235,25 +268,19 @@ class CompiledQuery:
             specs[nid] = spec
             layout.append((nid, len(arrays)))
             flat_inputs.extend(arrays)
-        # domain set arrays ride as trailing traced inputs (values change
-        # with data; sizes force a recompile anyway, so no need to bake)
-        filter_layout: List[Tuple[int, int]] = [(nid, ch) for nid, ch, _ in filter_arrays]
-        flat_inputs.extend(jnp.asarray(a) for _, _, a in filter_arrays)
         cq = cls(session, root, flat_inputs, specs, None, [None], [None], dict(capacity_hints))
         cq.phase1_s = phase1_s
         cq.df_apply_s = base.df_apply_s
         cq.scan_rows = dict(base.scan_stats)
         cq._layout = layout
-        cq._filter_specs = filter_specs
-        cq._filter_layout = filter_layout
+        cq._device_df = device_df
         cq._jit()
         return cq
 
     def _jit(self):
         session, root, specs = self.session, self.root, self.input_specs
         layout, hints = self._layout, self.capacity_hints
-        filter_specs = getattr(self, "_filter_specs", {})
-        filter_layout = getattr(self, "_filter_layout", [])
+        device_df = getattr(self, "_device_df", {})
         out_spec_cell, error_codes_cell = self.out_spec_cell, self.error_codes_cell
 
         def run(flat):
@@ -262,22 +289,7 @@ class CompiledQuery:
             for nid, count in layout:
                 pages[nid] = unflatten_page(specs[nid], flat[i : i + count])
                 i += count
-            # trailing inputs: sorted dynamic-filter domain arrays, slotted
-            # into their ("set", arr) specs in layout order
-            sf: Dict[int, List] = {}
-            arr_by_slot = {}
-            for (nid, ch), a in zip(filter_layout, flat[i:]):
-                arr_by_slot[(nid, ch)] = a
-            for nid, entries in filter_specs.items():
-                out_entries = []
-                for ch, spec in entries:
-                    if spec[0] in ("lut", "sorted"):
-                        out_entries.append(
-                            (ch, (spec[0], arr_by_slot[(nid, ch)]) + spec[2:]))
-                    else:
-                        out_entries.append((ch, spec))
-                sf[nid] = out_entries
-            ex = PreloadedExecutor(session, pages, dict(hints), sf)
+            ex = PreloadedExecutor(session, pages, dict(hints), device_df)
             out_page = ex.execute(root)
             out_arrays, out_spec = flatten_page(out_page)
             out_spec_cell[0] = out_spec

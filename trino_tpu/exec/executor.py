@@ -1706,14 +1706,43 @@ class Executor:
         sel = keep if left.sel is None else left.sel & keep
         return Page(left.columns, sel, left.replicated)
 
+    def _dense_join_cols(self, node: P.JoinNode, left: Page, right: Page):
+        """(build_col, probe_col, lo, span) when the single-int-key dense
+        direct-address kernel applies (ops/join.py dense_span), else None.
+        Varchar (page-local dictionary codes) and two-limb decimals stay on
+        the sort path."""
+        if len(node.right_keys) != 1:
+            return None
+        bc = right.columns[node.right_keys[0]]
+        pc = left.columns[node.left_keys[0]]
+        if bc.hi is not None or pc.hi is not None:
+            return None
+        if bc.type.is_varchar or pc.type.is_varchar:
+            return None
+        if not (jnp.issubdtype(bc.values.dtype, jnp.integer)
+                and jnp.issubdtype(pc.values.dtype, jnp.integer)):
+            return None
+        ds = join_ops.dense_span(bc.vrange, right.num_rows)
+        if ds is None:
+            return None
+        return bc, pc, ds[0], ds[1]
+
     def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
-        build_keys, probe_keys = self._join_keys_aligned(
-            left, right, node.left_keys, node.right_keys
-        )
-        build = join_ops.build_side(
-            build_keys, right.sel,
-            presorted=self._build_presorted(right, node.right_keys))
-        rows, matched = join_ops.probe_unique(build, probe_keys)
+        dense = self._dense_join_cols(node, left, right)
+        if dense is not None:
+            bc, pc, lo, span = dense
+            table = join_ops.dense_unique_table(
+                _col_to_lowered(bc), right.sel, lo, span)
+            rows, matched = join_ops.dense_probe_unique(
+                table, _col_to_lowered(pc), lo)
+        else:
+            build_keys, probe_keys = self._join_keys_aligned(
+                left, right, node.left_keys, node.right_keys
+            )
+            build = join_ops.build_side(
+                build_keys, right.sel,
+                presorted=self._build_presorted(right, node.right_keys))
+            rows, matched = join_ops.probe_unique(build, probe_keys)
         out_cols = list(left.columns)
         out_cols.extend(self._gather_right_cols(right.columns, rows, matched))
         if node.join_type == "inner":
@@ -1736,6 +1765,14 @@ class Executor:
         return page
 
     def semi_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        dense = self._dense_join_cols(node, left, right)
+        if dense is not None:
+            bc, pc, lo, span = dense
+            hit = join_ops.dense_membership(
+                _col_to_lowered(bc), right.sel, _col_to_lowered(pc), lo, span)
+            keep = hit if node.join_type == "semi" else ~hit
+            sel = keep if left.sel is None else left.sel & keep
+            return Page(left.columns, sel, left.replicated)
         build_keys, probe_keys = self._join_keys_aligned(
             left, right, node.left_keys, node.right_keys
         )

@@ -258,6 +258,85 @@ def expand(
     return p, k, live, total
 
 
+# ---------------------------------------------------------------- dense path
+# Direct-address join: when the single integer build key rides a known value
+# range (Column.vrange) whose span fits a device table, the build side
+# scatters row ids into a span-sized table and the probe side does ONE
+# bounded gather — no sort of either side ever happens. This is the TPU
+# answer to the reference's array-based lookup sources
+# (``operator/join/ArrayBasedLookupSource``): TPC-H/DS keys are dense
+# integer sequences, so the "hash" is the identity map onto the vrange.
+DENSE_SPAN_MAX = 1 << 27  # int32 table slots (512 MiB worst case)
+
+
+def dense_span(build_vrange, n_build: int) -> Optional[Tuple[int, int]]:
+    """(lo, span) when a direct-address table is worth it, else None.
+    Worth it = span bounded AND not absurdly sparse relative to the build
+    (a 128x-over-provisioned table still beats a sort at these sizes)."""
+    if build_vrange is None:
+        return None
+    lo, hi = int(build_vrange[0]), int(build_vrange[1])
+    span = hi - lo + 1
+    if span <= 0 or span > DENSE_SPAN_MAX:
+        return None
+    if span > 128 * max(n_build, 1024):
+        return None
+    return lo, span
+
+
+def dense_unique_table(
+    key: Lowered, sel: Optional[jnp.ndarray], lo: int, span: int
+) -> jnp.ndarray:
+    """Scatter build row ids (+1; 0 = empty) into the span table. Dead rows
+    scatter to DISTINCT out-of-bounds slots (span + iota) and are dropped,
+    so ``unique_indices`` stays truthful — the planner proved live-key
+    uniqueness (right_unique) before choosing this kernel."""
+    vals, valid = key
+    n = vals.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int64)
+    live = jnp.ones((n,), bool) if sel is None else sel
+    if valid is not None:
+        live = live & valid
+    idx = jnp.where(live, vals.astype(jnp.int64) - lo, span + iota)
+    return jnp.zeros((span,), jnp.int32).at[idx].set(
+        iota.astype(jnp.int32) + 1, mode="drop", unique_indices=True)
+
+
+def dense_probe_unique(
+    table: jnp.ndarray, key: Lowered, lo: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(build_row_idx, matched) — the dense analog of probe_unique."""
+    vals, valid = key
+    span = table.shape[0]
+    v = vals.astype(jnp.int64)
+    slot = table[jnp.clip(v - lo, 0, span - 1)]
+    matched = (v >= lo) & (v < lo + span) & (slot > 0)
+    if valid is not None:
+        matched = matched & valid
+    return jnp.maximum(slot - 1, 0), matched
+
+
+def dense_membership(
+    build_key: Lowered, build_sel: Optional[jnp.ndarray],
+    probe_key: Lowered, lo: int, span: int,
+) -> jnp.ndarray:
+    """Semi-join membership via a boolean LUT (build duplicates are fine:
+    True is idempotent, so the non-unique scatter-set is deterministic)."""
+    bvals, bvalid = build_key
+    live = (jnp.ones((bvals.shape[0],), bool) if build_sel is None
+            else build_sel)
+    if bvalid is not None:
+        live = live & bvalid
+    idx = jnp.where(live, bvals.astype(jnp.int64) - lo, span)
+    lut = jnp.zeros((span,), bool).at[idx].set(True, mode="drop")
+    pvals, pvalid = probe_key
+    v = pvals.astype(jnp.int64)
+    hit = (v >= lo) & (v < lo + span) & lut[jnp.clip(v - lo, 0, span - 1)]
+    if pvalid is not None:
+        hit = hit & pvalid
+    return hit
+
+
 def gather_columns(
     cols: List[Lowered], rows: jnp.ndarray, matched: jnp.ndarray
 ) -> List[Lowered]:
