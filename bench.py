@@ -179,7 +179,6 @@ def _build(session, name: str):
     # remaining host DF cost is the one-time staging narrowing (phase-1
     # numpy + domain application), reported as staging_df_s, a storage-read
     # cost like generation itself.
-    steady_df_s = 0.0
     scans_by_id = {
         n.id: n for n in P.walk_plan(root) if isinstance(n, P.TableScanNode)
     }
@@ -208,7 +207,6 @@ def _build(session, name: str):
         "staged_rows": staged_rows,
         "bytes": logical_bytes,
         "staged_bytes": staged_bytes,
-        "host_df_s": steady_df_s,
         "staging_df_s": round(cq.phase1_s + cq.df_apply_s, 3),  # one-time
     }
     return cq, prof, set(starts)
@@ -343,7 +341,7 @@ def _bench_query(session, name: str):
     cq, prof, scan_starts = _build(session, name)
     _log(f"{name}: staged {prof['staged_rows']}/{prof['rows']} rows "
          f"({int(prof['staged_bytes']) // 1048576} MiB) in {time.time() - t0:.1f}s "
-         f"host_df={prof['host_df_s'] * 1000:.0f}ms hints={cq.capacity_hints}")
+         f"staging_df={prof['staging_df_s'] * 1000:.0f}ms hints={cq.capacity_hints}")
     res = None
     if name not in TRAIN_ONLY and SPECS[name][2] not in TRAIN_ONLY \
             and _remaining() > 120:
@@ -361,7 +359,7 @@ def _bench_query(session, name: str):
     # collect->mask inside the one compiled body), so repeated executions
     # repeat no host work; staging_df_s (one-time, storage-read-class) is
     # reported separately in the profile
-    total = per + prof["host_df_s"]
+    total = per
     device_bw = prof["staged_bytes"] / per
     sanity = "ok" if device_bw <= HBM_BYTES_PER_S else "fail"
     if sanity == "fail":
@@ -372,7 +370,6 @@ def _bench_query(session, name: str):
         "staged_rows": prof["staged_rows"],
         "seconds": round(total, 5),
         "device_seconds": round(per, 5),
-        "host_df_s": round(prof["host_df_s"], 4),
         "staging_df_s": prof["staging_df_s"],
         "rows_per_sec": round(prof["rows"] / total, 1),
         "input_gbytes_per_sec": round(prof["bytes"] / total / 1e9, 2),
@@ -515,7 +512,7 @@ def _cpu_single(session, name: str):
     t0 = time.time()
     outs, _f = cq.fn(cq.input_arrays)
     np.asarray(outs[0].ravel()[0])
-    per = time.time() - t0 + prof["host_df_s"]
+    per = time.time() - t0
     return {"rows": prof["rows"], "seconds": round(per, 4),
             "rows_per_sec": round(prof["rows"] / per, 1)}
 

@@ -1,0 +1,92 @@
+"""Cluster memory management (round-4 verdict item 7): workers report
+per-query reservations in their announce, the coordinator aggregates them,
+and a worker over its pool triggers the low-memory killer on the largest
+query while smaller queries keep running.
+
+Reference test-strategy analog: TestClusterMemoryManager /
+TestTotalReservationOnBlockedNodesLowMemoryKiller
+(core/trino-main/src/test/java/io/trino/memory/).
+"""
+import time
+
+import pytest
+
+from trino_tpu import Session
+from trino_tpu.server.cluster_memory import (
+    ClusterMemoryManager, total_reservation_killer)
+from trino_tpu.server.coordinator import CoordinatorServer
+from trino_tpu.server.worker import WorkerServer
+
+
+def test_killer_policy_picks_largest_reservation():
+    assert total_reservation_killer({"a": 10, "b": 99, "c": 5}) == "b"
+    assert total_reservation_killer({}) is None
+
+
+def test_manager_kills_once_per_pressure_window():
+    killed = []
+    mgr = ClusterMemoryManager(kill=lambda q, r: killed.append((q, r)))
+    mgr.update("w0", {"queryMemory": {"q1": 100, "q2": 900},
+                      "memoryBytes": 1000, "memoryLimit": 500})
+    assert [q for q, _ in killed] == ["q2"]
+    assert "EXCEEDED_CLUSTER_MEMORY" in killed[0][1]
+    # after forgetting q2's reservations the worker is under limit: the
+    # same pressure window must not take a second victim
+    mgr.update("w0", {"queryMemory": {"q1": 100},
+                      "memoryBytes": 100, "memoryLimit": 500})
+    assert len(killed) == 1
+
+
+def test_dispatch_gate_blocks_over_cluster_limit():
+    mgr = ClusterMemoryManager(kill=lambda q, r: None,
+                               cluster_limit_bytes=1000)
+    assert mgr.has_headroom()
+    mgr.update("w0", {"queryMemory": {"q": 2000}, "memoryBytes": 2000,
+                      "memoryLimit": None})
+    assert not mgr.has_headroom()
+
+
+@pytest.fixture()
+def tight_cluster():
+    """2-worker cluster whose workers declare a 64 KiB memory pool — any
+    real scan blows it, so the killer must fire."""
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [
+        WorkerServer(coordinator_url=coord.base_url, node_id=f"mw{i}",
+                     memory_limit_bytes=64 * 1024)
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    assert coord.registry.wait_for_workers(2, timeout=15.0)
+    yield coord, workers
+    for w in workers:
+        w.stop()
+    coord.stop()
+
+
+def test_oversized_query_killed_small_query_finishes(tight_cluster):
+    coord, workers = tight_cluster
+    props = {"catalog": "tpch", "schema": "tiny",
+             # park the big query's tasks on their sink watermark so they
+             # stay alive (announcing memory) long enough to be killed
+             "task_output_chunk_bytes": 16 * 1024,
+             "sink_max_buffer_bytes": 32 * 1024}
+    big = coord.submit(
+        "select l_orderkey, l_partkey, l_comment from lineitem "
+        "order by l_extendedprice, l_comment", props)
+    deadline = time.time() + 60
+    while not big.state.is_terminal() and time.time() < deadline:
+        time.sleep(0.1)
+    assert big.state.get() == "FAILED", big.state.get()
+    assert "EXCEEDED_CLUSTER_MEMORY" in (big.failure or ""), big.failure
+    assert coord.cluster_memory.kills
+    # the cluster remains usable: a small query completes normally
+    small = coord.submit("select count(*) from nation",
+                         {"catalog": "tpch", "schema": "tiny"})
+    deadline = time.time() + 60
+    while not small.state.is_terminal() and time.time() < deadline:
+        time.sleep(0.1)
+    assert small.state.get() == "FINISHED", small.failure
+    assert small.rows == [(25,)]

@@ -300,6 +300,11 @@ class Executor:
         )
         st["wall_s"] += wall
         st["output_rows"] = page.live_count()  # live rows, not padded slots
+        # operator-output reservation rolls into the query's peak (the
+        # LocalMemoryContext -> query-pool rollup, exact from static shapes)
+        from trino_tpu.exec import memory as mem
+
+        self.memory.observe(mem.page_bytes(page))
         return page
 
     def _narrowed_or_flag(self, col: Column, sel=None) -> Column:
@@ -664,6 +669,81 @@ class Executor:
             ]
             ci += n_states
             out_cols.append(self._combine_state(call, states, sel_l, layout))
+        return Page(out_cols, out_sel, page.replicated)
+
+    # aggregate functions whose partial STATES merge into states of the
+    # same dtypes with plain sum/min/max reductions — the set the streaming
+    # consumer's intermediate fold supports (reference:
+    # AggregationNode.Step.INTERMEDIATE)
+    MERGEABLE_STATE_FNS = {"count", "sum", "avg", "min", "max", "count_if"}
+
+    def aggregate_intermediate(self, node: P.AggregationNode, page: Page) -> Page:
+        """Merge partial-state pages into a COMBINED partial-state page of
+        the same schema (reference: AggregationNode.Step.INTERMEDIATE —
+        the reference inserts these between partial and final exchanges;
+        here they are the fold step of the streaming consumer loop: state
+        pages accumulate per arriving micro-batch, memory stays
+        O(groups + batch) no matter how much the producer emits)."""
+        k = len(node.group_channels)
+        payload_arrays: List = []
+        state_slots: List = []
+        for c in page.columns[k:]:
+            if c.hi is not None:
+                raise NotImplementedError(
+                    "intermediate merge over long-decimal two-limb states")
+            vi = len(payload_arrays)
+            payload_arrays.append(c.values)
+            hv = c.nulls is not None
+            if hv:
+                payload_arrays.append(~c.nulls)
+            state_slots.append((vi, hv, None))
+        layout, out_sel, payloads_l, sel_l = self.group_structure(
+            list(range(k)), page, payload_arrays
+        )
+        out_cols: List[Column] = []
+        if k:
+            out_cols.extend(
+                self._gathered_key_cols(page, list(range(k)), layout)
+            )
+        ci = 0
+        for call in node.aggregates:
+            n_states = P._acc_state_count(call)
+            states = [
+                self._slot_arg(payloads_l, state_slots[ci + j])
+                for j in range(n_states)
+            ]
+            types = [page.columns[k + ci + j].type for j in range(n_states)]
+            ci += n_states
+            fn = call.function
+            if fn not in self.MERGEABLE_STATE_FNS or call.distinct:
+                raise NotImplementedError(f"intermediate merge of {fn}")
+            if fn in ("count", "count_if"):
+                merged = [agg_ops.agg_sum(layout, states[0], sel_l,
+                                          np.dtype(np.int64))]
+            elif fn == "sum" and n_states == 2:
+                # long-decimal running sum: (lo, hi) limb-pair states merge
+                # through the same exact int128 grouped sum the partial used
+                lo_vals, lo_valid = states[0]
+                hi_vals, _ = states[1]
+                (m_hi, m_lo), nonempty = agg_ops.agg_sum_128(
+                    layout, lo_vals, hi_vals, lo_valid, sel_l)
+                merged = [(m_lo, nonempty), (m_hi, None)]
+            elif fn == "sum":
+                merged = [agg_ops.agg_sum(layout, states[0], sel_l,
+                                          types[0].np_dtype)]
+            elif fn == "avg":
+                merged = [
+                    agg_ops.agg_sum(layout, states[0], sel_l, types[0].np_dtype),
+                    agg_ops.agg_sum(layout, states[1], sel_l, np.dtype(np.int64)),
+                ]
+            elif fn == "min":
+                merged = [agg_ops.agg_min(layout, states[0], sel_l)]
+            else:  # max
+                merged = [agg_ops.agg_max(layout, states[0], sel_l)]
+            for (sv, valid), st in zip(merged, types):
+                out_cols.append(
+                    Column(st, sv, None if valid is None else ~valid, None)
+                )
         return Page(out_cols, out_sel, page.replicated)
 
     def _partial_states(self, call: P.AggregateCall, page, layout, arg_l, sel_l,

@@ -1,0 +1,101 @@
+"""Cluster memory management: pool aggregation + the low-memory killer.
+
+Reference: ``memory/ClusterMemoryManager.java:89`` (aggregates every node's
+pool usage from node status, enforces query.max-memory cluster-wide, and
+invokes a pluggable LowMemoryKiller when nodes run out) with
+``TotalReservationOnBlockedNodesQueryLowMemoryKiller`` as the default
+policy. Here the node status ride-along is the worker announce payload
+(queryMemory / memoryBytes / memoryLimit, server/worker.py), and the killer
+fires when any worker reports usage over its declared pool.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+# policy: {query_id: total_reserved_bytes_across_cluster} -> victim query id
+KillerPolicy = Callable[[Dict[str, int]], Optional[str]]
+
+
+def total_reservation_killer(query_mem: Dict[str, int]) -> Optional[str]:
+    """Default policy: kill the query holding the most cluster memory
+    (reference: TotalReservationLowMemoryKiller)."""
+    if not query_mem:
+        return None
+    return max(query_mem.items(), key=lambda kv: kv[1])[0]
+
+
+class ClusterMemoryManager:
+    """Aggregates per-worker announce payloads; blocks dispatch over the
+    cluster limit; kills the policy's victim when a worker is over its
+    pool."""
+
+    def __init__(self, kill, cluster_limit_bytes: Optional[int] = None,
+                 policy: KillerPolicy = total_reservation_killer):
+        # kill(query_id, reason) — provided by the coordinator
+        self._kill = kill
+        self.cluster_limit_bytes = cluster_limit_bytes
+        self.policy = policy
+        self._lock = threading.Lock()
+        # node_id -> {"queryMemory": {...}, "memoryBytes": n, "memoryLimit": n|None}
+        self._nodes: Dict[str, dict] = {}
+        self.kills: list = []  # (query_id, reason) history for tests/UI
+
+    # ------------------------------------------------------------- ingest
+    def update(self, node_id: str, payload: dict) -> None:
+        with self._lock:
+            self._nodes[node_id] = {
+                "queryMemory": dict(payload.get("queryMemory") or {}),
+                "memoryBytes": int(payload.get("memoryBytes") or 0),
+                "memoryLimit": payload.get("memoryLimit"),
+                "at": time.monotonic(),
+            }
+        self._maybe_kill()
+
+    # ----------------------------------------------------------- accessors
+    def query_reservations(self) -> Dict[str, int]:
+        """Cluster-wide reserved bytes per query."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for info in self._nodes.values():
+                for qid, b in info["queryMemory"].items():
+                    out[qid] = out.get(qid, 0) + int(b)
+            return out
+
+    def cluster_reserved(self) -> int:
+        with self._lock:
+            return sum(i["memoryBytes"] for i in self._nodes.values())
+
+    def has_headroom(self) -> bool:
+        """Dispatch gate: admit new work only under the cluster limit
+        (reference: ClusterMemoryManager's query.max-memory admission)."""
+        if self.cluster_limit_bytes is None:
+            return True
+        return self.cluster_reserved() < self.cluster_limit_bytes
+
+    # -------------------------------------------------------------- killer
+    def _maybe_kill(self) -> None:
+        over = []
+        with self._lock:
+            for nid, info in self._nodes.items():
+                limit = info["memoryLimit"]
+                if limit is not None and info["memoryBytes"] > int(limit):
+                    over.append(nid)
+        if not over:
+            return
+        victim = self.policy(self.query_reservations())
+        if victim is None:
+            return
+        reason = (
+            f"Query exceeded distributed memory limit: worker(s) "
+            f"{','.join(sorted(over))} over their memory pool; killed as the "
+            f"largest reservation (EXCEEDED_CLUSTER_MEMORY)")
+        self.kills.append((victim, reason))
+        # forget the victim's reservations immediately so one announce
+        # cannot kill two queries for the same pressure window
+        with self._lock:
+            for info in self._nodes.values():
+                info["queryMemory"].pop(victim, None)
+                info["memoryBytes"] = sum(info["queryMemory"].values())
+        self._kill(victim, reason)

@@ -109,6 +109,14 @@ class QueryExecution:
         if self.state.set("CANCELED"):
             self._cancel_tasks()
 
+    def kill(self, reason: str) -> None:
+        """Administrative kill (low-memory killer): FAILED with the given
+        reason; running tasks are canceled (reference:
+        QueryExecution.fail from ClusterMemoryManager's killer)."""
+        self.failure = reason
+        if self.state.set("FAILED"):
+            self._cancel_tasks()
+
     # ------------------------------------------------------------ lifecycle
     def _run(self) -> None:
         try:
@@ -159,7 +167,10 @@ class QueryExecution:
             self.rows = result_page.to_pylist()
             self.state.set("FINISHED")
         except Exception as e:  # noqa: BLE001 — reported through query info
-            self.failure = f"{e}\n{traceback.format_exc()}"
+            if self.failure is None:
+                # an administrative kill() may already have set the real
+                # reason; the task-cancellation fallout must not clobber it
+                self.failure = f"{e}\n{traceback.format_exc()}"
             self._cancel_tasks()
             self.state.set("FAILED")
         finally:
@@ -420,11 +431,19 @@ class QueryExecution:
 class CoordinatorServer:
     """The coordinator process: discovery registry + dispatch + protocol."""
 
-    def __init__(self, port: int = 0, session_factory=None, resource_group=None):
+    def __init__(self, port: int = 0, session_factory=None, resource_group=None,
+                 cluster_memory_limit_bytes=None, low_memory_killer=None,
+                 authenticator=None):
         from trino_tpu.server.resource_groups import ResourceGroup
         from trino_tpu.connector.registry import default_catalogs
+        from trino_tpu.server.cluster_memory import (
+            ClusterMemoryManager, total_reservation_killer)
 
         self.registry = NodeRegistry()
+        self.cluster_memory = ClusterMemoryManager(
+            kill=self._kill_query,
+            cluster_limit_bytes=cluster_memory_limit_bytes,
+            policy=low_memory_killer or total_reservation_killer)
         # one shared catalog map for every query this server runs: DDL/DML
         # against stateful connectors (memory) must be visible to later
         # statements (reference: MetadataManager's catalog handles living at
@@ -443,6 +462,9 @@ class CoordinatorServer:
         # admission control (reference: resource groups / DispatchManager's
         # resource-group submission)
         self.resource_group = resource_group or ResourceGroup()
+        # end-user authentication on the public API (None = open cluster;
+        # reference: PasswordAuthenticatorManager / jwt — server/auth.py)
+        self.authenticator = authenticator
         # event listener SPI (server/events.py; reference:
         # eventlistener/EventListenerManager)
         from trino_tpu.server.events import EventListenerManager
@@ -501,15 +523,31 @@ class CoordinatorServer:
         # its group grants a slot (reference: QueuedStatementResource's
         # queued/executing split + ResourceGroupManager.submit)
         def admit_and_start():
-            if not self.resource_group.submit(timeout=600.0):
+            if not self.resource_group.submit(timeout=600.0, user=user):
                 execution.failure = "Query queue is full (resource group limit)"
                 execution.state.set("FAILED")
                 return
-            if execution.state.is_terminal():  # canceled while queued
-                self.resource_group.finish()
+            # cluster-memory admission: dispatch blocks while the cluster
+            # pool is over its limit (reference: ClusterMemoryManager's
+            # query.max-memory gate) — the killer frees it if needed; a
+            # cluster that stays saturated past the deadline FAILS the
+            # query loudly (never silently dispatches over the limit)
+            deadline = time.monotonic() + 600.0
+            while (not self.cluster_memory.has_headroom()
+                   and not execution.state.is_terminal()
+                   and time.monotonic() < deadline):
+                time.sleep(0.2)
+            if (not execution.state.is_terminal()
+                    and not self.cluster_memory.has_headroom()):
+                execution.failure = (
+                    "Cluster is out of memory and did not recover within the "
+                    "admission deadline (EXCEEDED_CLUSTER_MEMORY)")
+                execution.state.set("FAILED")
+            if execution.state.is_terminal():  # canceled/killed while queued
+                self.resource_group.finish(user=user)
                 return
             execution.state.add_listener(
-                lambda s: self.resource_group.finish()
+                lambda s: self.resource_group.finish(user=user)
                 if s in ("FINISHED", "FAILED", "CANCELED") else None)
             execution.start()
 
@@ -519,6 +557,11 @@ class CoordinatorServer:
     def get_query(self, query_id: str) -> Optional[QueryExecution]:
         with self._qlock:
             return self.queries.get(query_id)
+
+    def _kill_query(self, query_id: str, reason: str) -> None:
+        q = self.get_query(query_id)
+        if q is not None and not q.state.is_terminal():
+            q.kill(reason)
 
 
 def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) -> dict:
@@ -604,10 +647,13 @@ def _make_handler(server: CoordinatorServer):
             pass
 
         def _send(self, status: int, body: bytes = b"",
-                  content_type: str = "application/json"):
+                  content_type: str = "application/json",
+                  headers: Optional[dict] = None):
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -624,6 +670,7 @@ def _make_handler(server: CoordinatorServer):
                     return
                 info = json.loads(body)
                 server.registry.announce(m.group(1), info["url"])
+                server.cluster_memory.update(m.group(1), info)
                 self._send(200, b"{}")
                 return
             self._send(404)
@@ -636,14 +683,51 @@ def _make_handler(server: CoordinatorServer):
                     if header.lower().startswith("x-trino-session-"):
                         props[header[len("x-trino-session-"):].lower()] = value
                 user = self.headers.get("X-Trino-User", "anonymous")
+                if server.authenticator is not None and server.authenticator.required:
+                    from trino_tpu.server.auth import AuthenticationError
+
+                    try:
+                        identity = server.authenticator.authenticate_header(
+                            self.headers.get("Authorization"))
+                    except AuthenticationError as e:
+                        self._send(401, json.dumps(
+                            {"error": {"message": f"Authentication failed: {e}"}}
+                        ).encode(), headers={
+                            "WWW-Authenticate": 'Basic realm="trino-tpu", Bearer'})
+                        return
+                    # the authenticated principal wins over the client's
+                    # claimed user header (no impersonation by default)
+                    user = identity.user
                 q = server.submit(sql, props, user=user)
                 self._send(200, json.dumps(_result_payload(server, q, 0)).encode())
                 return
             self._send(404)
 
+        def _authenticated(self):
+            """Gate for query-scoped routes when an authenticator is
+            configured: results, query info, and cancel carry user data and
+            control — they are NOT open even though submission already
+            authenticated (predictable query ids must not leak results)."""
+            if server.authenticator is None or not server.authenticator.required:
+                return True
+            from trino_tpu.server.auth import AuthenticationError
+
+            try:
+                server.authenticator.authenticate_header(
+                    self.headers.get("Authorization"))
+                return True
+            except AuthenticationError as e:
+                self._send(401, json.dumps(
+                    {"error": {"message": f"Authentication failed: {e}"}}
+                ).encode(), headers={
+                    "WWW-Authenticate": 'Basic realm="trino-tpu", Bearer'})
+                return False
+
         def do_GET(self):
             m = _RESULT_RE.match(self.path)
             if m:
+                if not self._authenticated():
+                    return
                 q = server.get_query(m.group(1))
                 if q is None:
                     self._send(404, b'{"error": "no such query"}')
@@ -656,6 +740,8 @@ def _make_handler(server: CoordinatorServer):
                 return
             m = _QUERY_RE.match(self.path)
             if m:
+                if not self._authenticated():
+                    return
                 q = server.get_query(m.group(1))
                 if q is None:
                     self._send(404, b'{"error": "no such query"}')
@@ -683,6 +769,8 @@ def _make_handler(server: CoordinatorServer):
         def do_DELETE(self):
             m = _RESULT_RE.match(self.path)
             if m:
+                if not self._authenticated():
+                    return
                 q = server.get_query(m.group(1))
                 if q is not None:
                     q.cancel()

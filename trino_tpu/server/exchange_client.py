@@ -145,11 +145,12 @@ class ExchangeClient:
                 self._remaining -= 1
             self._queue.put(None)  # wake the consumer
 
-    def pages(self) -> List[Page]:
-        """Block until every upstream completes; return all pages in arrival
-        order. (A streaming iterator is the next step; fragment bodies here
-        consume whole inputs, matching the bulk-synchronous XLA dispatch.)"""
-        out: List[Page] = []
+    def iter_pages(self):
+        """Yield pages in arrival order WHILE upstreams are still producing
+        — the WorkProcessor-style pull surface (reference:
+        operator/WorkProcessor.java:31; Driver.java:449's blocked-future
+        loop is the bounded queue block here). The consumer's memory bound
+        is max_buffered_pages + whatever it holds per yielded page."""
         done = 0
         total = len(self._locations)
         while done < total:
@@ -160,5 +161,10 @@ class ExchangeClient:
                     if self._failure is not None:
                         raise RuntimeError(self._failure)
                 continue
-            out.append(item)
-        return out
+            yield item
+
+    def pages(self) -> List[Page]:
+        """Block until every upstream completes; return all pages in arrival
+        order (the bulk-synchronous path: fragment bodies that need their
+        whole input — joins, final aggregations, sorts)."""
+        return list(self.iter_pages())

@@ -1,16 +1,20 @@
 """Resource groups: admission control for query dispatch.
 
 Reference: ``execution/resourcegroups/InternalResourceGroup.java:75`` + the
-resource-group manager SPI — a tree of groups with concurrency/queue
-limits; queries QUEUE when their group is at its hard concurrency limit and
-dispatch as running queries finish. This is the flat single-group core of
-that design (per-user subgroup trees are configuration, not mechanism).
+resource-group manager SPI — a TREE of groups with concurrency/queue
+limits: a query queues when its group (or any ancestor) is at its hard
+concurrency limit, and as running queries finish, freed slots dispatch
+queued queries chosen by weighted scheduling across sibling subgroups
+(``WeightedScheduler``'s role). ``ResourceGroup`` is the flat single-group
+gate (kept as the default); ``ResourceGroupManager`` adds per-user
+subgroup trees (the ``user.${USER}`` selector template of the reference's
+resource-group configuration files).
 """
 from __future__ import annotations
 
 import collections
 import threading
-from typing import Deque, Optional
+from typing import Deque, Dict, Optional
 
 
 class ResourceGroup:
@@ -25,10 +29,13 @@ class ResourceGroup:
         self._running = 0
         self._queue: Deque[threading.Event] = collections.deque()
 
-    def submit(self, timeout: Optional[float] = None) -> bool:
+    def submit(self, timeout: Optional[float] = None,
+               user: str = "anonymous") -> bool:
         """Block until admitted (True) or rejected/timed out (False).
         Rejection happens immediately when the queue is full (the
-        reference's QUERY_QUEUE_FULL error)."""
+        reference's QUERY_QUEUE_FULL error). ``user`` is ignored by the
+        flat group (one queue for everyone); ResourceGroupManager routes
+        it to the per-user subgroup."""
         with self._lock:
             if self._running < self.hard_concurrency_limit and not self._queue:
                 self._running += 1
@@ -46,7 +53,7 @@ class ResourceGroup:
             return False
         return True
 
-    def finish(self) -> None:
+    def finish(self, user: str = "anonymous") -> None:
         with self._lock:
             if self._queue:
                 gate = self._queue.popleft()
@@ -62,3 +69,103 @@ class ResourceGroup:
                 "queued": len(self._queue),
                 "hardConcurrencyLimit": self.hard_concurrency_limit,
             }
+
+
+class ResourceGroupManager:
+    """Per-user subgroup tree under one root: global.user:<name>.
+
+    Admission needs a slot in BOTH the user's subgroup and the root; when a
+    query finishes, the freed root slot goes to the queued subgroup with
+    the smallest running/weight ratio (weighted fair scheduling,
+    reference: InternalResourceGroup.internalStartNext + the weighted
+    scheduling policy). Subgroups are created on first use from a template
+    (the ``user.${USER}`` expansion of resource-group config files)."""
+
+    def __init__(self, root_concurrency_limit: int = 16,
+                 per_user_concurrency_limit: int = 8,
+                 per_user_max_queued: int = 100,
+                 user_weights: Optional[Dict[str, int]] = None):
+        self.root_limit = root_concurrency_limit
+        self.user_limit = per_user_concurrency_limit
+        self.user_max_queued = per_user_max_queued
+        self.user_weights = dict(user_weights or {})
+        self._lock = threading.Lock()
+        self._root_running = 0
+        # user -> state
+        self._groups: Dict[str, dict] = {}
+
+    # compatibility with the flat ResourceGroup surface (coordinator calls
+    # submit()/finish() without a user for internal work)
+    def submit(self, timeout: Optional[float] = None, user: str = "anonymous") -> bool:
+        g = self._group(user)
+        with self._lock:
+            if self._can_start(g):
+                self._start(g)
+                return True
+            if len(g["queue"]) >= self.user_max_queued:
+                return False
+            gate = threading.Event()
+            g["queue"].append(gate)
+        if not gate.wait(timeout):
+            with self._lock:
+                try:
+                    g["queue"].remove(gate)
+                except ValueError:
+                    return True  # raced with a dispatch: already admitted
+            return False
+        return True
+
+    def finish(self, user: str = "anonymous") -> None:
+        with self._lock:
+            g = self._groups.get(user)
+            if g is not None:
+                g["running"] = max(0, g["running"] - 1)
+            self._root_running = max(0, self._root_running - 1)
+            self._dispatch_next()
+
+    def info(self) -> dict:
+        with self._lock:
+            return {
+                "name": "global",
+                "running": self._root_running,
+                "queued": sum(len(g["queue"]) for g in self._groups.values()),
+                "hardConcurrencyLimit": self.root_limit,
+                "subgroups": {
+                    u: {"running": g["running"], "queued": len(g["queue"]),
+                        "weight": g["weight"]}
+                    for u, g in sorted(self._groups.items())
+                },
+            }
+
+    # ----------------------------------------------------------- internals
+    def _group(self, user: str) -> dict:
+        with self._lock:
+            g = self._groups.get(user)
+            if g is None:
+                g = {"running": 0, "queue": collections.deque(),
+                     "weight": max(1, int(self.user_weights.get(user, 1)))}
+                self._groups[user] = g
+            return g
+
+    def _can_start(self, g: dict) -> bool:
+        return (g["running"] < self.user_limit
+                and self._root_running < self.root_limit)
+
+    def _start(self, g: dict) -> None:
+        g["running"] += 1
+        self._root_running += 1
+
+    def _dispatch_next(self) -> None:
+        """Weighted fair pick among queued subgroups with capacity: the
+        eligible group with the smallest running/weight starts next."""
+        while self._root_running < self.root_limit:
+            eligible = [
+                g for g in self._groups.values()
+                if g["queue"] and g["running"] < self.user_limit
+            ]
+            if not eligible:
+                return
+            g = min(eligible, key=lambda g: g["running"] / g["weight"])
+            gate = g["queue"].popleft()
+            self._start(g)
+            gate.set()

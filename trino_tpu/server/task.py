@@ -112,8 +112,34 @@ class SqlTask:
             self.output = OutputBuffer(
                 request.consumer_count, max_buffer_bytes=sink_max)
         self.failure: Optional[str] = None
+        # peak device/host bytes observed by this task's executors — rolls
+        # up into the worker announce for cluster memory management
+        # (reference: QueryContext reservations -> ClusterMemoryPool)
+        self.peak_memory_bytes = 0
         self._session_factory = session_factory
         self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _track_executor(self, ex) -> None:
+        self._live_executor = ex
+        self.peak_memory_bytes = max(self.peak_memory_bytes, ex.memory.peak)
+
+    @property
+    def memory_bytes(self) -> int:
+        """Reservation gauge for cluster memory management: the executor's
+        peak while the body RUNS; once the body finished (FLUSHING) it
+        decays to what the drain actually still holds — the result page
+        being chunked out plus buffered frames — so a transient
+        mid-execution peak does not outlive the body and starve admission
+        (exact liveness would need per-page refcounts)."""
+        state = self.state.get()
+        if state in ("FINISHED", "FAILED", "CANCELED"):
+            return 0
+        if state not in ("PLANNED", "RUNNING"):
+            return int(getattr(self, "flushing_bytes", 0)
+                       + self.output.buffered_bytes)
+        live = getattr(self, "_live_executor", None)
+        peak = live.memory.peak if live is not None else 0
+        return max(self.peak_memory_bytes, peak)
 
     def start(self) -> None:
         if self.state.compare_and_set("PLANNED", "RUNNING"):
@@ -127,8 +153,12 @@ class SqlTask:
             inject = str(req.session_properties.get("failure_injection") or "")
             if inject and inject in req.task_id:
                 raise RuntimeError(f"injected failure for {req.task_id}")
-            # pull all upstream fragments first (fragment bodies are
-            # bulk-synchronous; the pull itself streams + backpressures)
+            session = self._session_factory(req.session_properties)
+            if self._try_streaming(req, session):
+                return
+            # pull all upstream fragments first (bulk-synchronous bodies:
+            # joins/final aggs/sorts need their whole input; the pull itself
+            # streams + backpressures)
             remote_pages: Dict[int, List[Page]] = {}
             for fid, locations in req.upstream.items():
                 from trino_tpu.server.exchange_client import ExchangeClient, TaskLocation
@@ -136,11 +166,15 @@ class SqlTask:
                 client = ExchangeClient([TaskLocation(u, t, b) for u, t, b in locations])
                 client.start()
                 remote_pages[fid] = client.pages()
-            session = self._session_factory(req.session_properties)
             ex = FragmentExecutor(session, req.splits, remote_pages)
+            self._track_executor(ex)
             page = ex.execute_checked(req.fragment_root)
-            self.state.set("FLUSHING")
+            self._track_executor(ex)
+            from trino_tpu.exec.memory import page_bytes
+
             page = page.compact()
+            self.flushing_bytes = page_bytes(page)  # held through the drain
+            self.state.set("FLUSHING")
             chunk_rows = self._chunk_rows(page)
             if req.output_partition_channels is not None:
                 # hash-partitioned shuffle producer: split the output by
@@ -193,6 +227,163 @@ class SqlTask:
             self.failure = f"{e}\n{traceback.format_exc()}"
             self.output.abort(str(e))
             self.state.set("FAILED")
+
+    # ------------------------------------------------------- streaming loop
+    @staticmethod
+    def _streamable_source(root: P.PlanNode):
+        """The single RemoteSourceNode leaf of a streamable fragment, else
+        None. Streamable = every operator on the chain is row-local or a
+        PARTIAL aggregation: executing it per arriving chunk and
+        concatenating outputs is semantically identical to one bulk run
+        (partial-agg outputs may legally contain multiple rows per group —
+        the downstream FINAL merge makes them one). This is the
+        WorkProcessor pull model (reference: operator/WorkProcessor.java:31,
+        Driver.java:449's blocked futures) with the micro-batch as the unit
+        instead of the page."""
+        node = root
+        while True:
+            if isinstance(node, RemoteSourceNode):
+                return node
+            if isinstance(node, (P.FilterNode, P.ProjectNode, P.CompactNode)):
+                node = node.source
+                continue
+            if isinstance(node, P.AggregationNode) and node.step == "partial":
+                node = node.source
+                continue
+            return None
+
+    @staticmethod
+    def _streaming_final_agg(root: P.PlanNode):
+        """The (final-agg node, its RemoteSourceNode) when the fragment is a
+        hash-distributed FINAL aggregation whose states the intermediate
+        fold can merge — the streaming consumer then folds arriving partial
+        states instead of buffering them all (reference:
+        AggregationNode.Step.INTERMEDIATE)."""
+        from trino_tpu.exec.executor import Executor
+
+        if not (isinstance(root, P.AggregationNode) and root.step == "final"
+                and isinstance(root.source, RemoteSourceNode)):
+            return None
+        for call in root.aggregates:
+            if call.distinct or call.function not in Executor.MERGEABLE_STATE_FNS:
+                return None
+        return root, root.source
+
+    # accumulate arriving pages to at least this many rows before running
+    # the fragment body over the batch (tiny per-page dispatches would
+    # dominate otherwise)
+    STREAM_BATCH_ROWS = 65536
+
+    def _try_streaming(self, req: TaskRequest, session) -> bool:
+        """Micro-batch driver loop for streamable consumer fragments: pull
+        chunks from the ONE upstream, execute the fragment per batch, and
+        enqueue each batch's output immediately — the consumer makes
+        progress (and its output becomes pullable) while the producer is
+        still FLUSHING, and holds only ~batch rows of input at a time.
+        Returns False when the fragment shape or config requires the bulk
+        path (joins/final aggs; FTE spooling needs the complete output
+        durable before visibility, so it stays bulk)."""
+        final_agg = self._streaming_final_agg(req.fragment_root)
+        src = (final_agg[1] if final_agg is not None
+               else self._streamable_source(req.fragment_root))
+        if src is None or spool_directory() or len(req.upstream) != 1:
+            return False
+        if req.splits:  # mixed scan+remote shapes are not chain-shaped
+            return False
+        locations = req.upstream.get(src.fragment_id)
+        if locations is None:
+            return False
+        from trino_tpu.server.exchange_client import ExchangeClient, TaskLocation
+
+        client = ExchangeClient([TaskLocation(u, t, b) for u, t, b in locations])
+        client.start()
+        part_channels = req.output_partition_channels
+
+        def enqueue_out(out: Page) -> None:
+            """Partition-aware enqueue of one output page (shared by the
+            per-batch chain path and the fold path's finalization)."""
+            if out.num_rows == 0 or out.live_count() == 0:
+                return
+            chunk_rows = self._chunk_rows(out)
+            if part_channels is not None:
+                from trino_tpu.exec.memory import partition_page_host
+
+                pids = _canonical_partition_ids(
+                    out, part_channels, req.consumer_count)
+                parts = partition_page_host(
+                    out, part_channels, req.consumer_count, pid=pids)
+                for pid, part in enumerate(parts):
+                    for c in _chunk_pages(part.compact(), chunk_rows):
+                        self.output.enqueue_partition(pid, serialize_page(c))
+            else:
+                for c in _chunk_pages(out, chunk_rows):
+                    self.output.enqueue(serialize_page(c))
+
+        def emit(batch: List[Page]) -> None:
+            page = batch[0]
+            for p in batch[1:]:
+                page = Page.concat_pages(page, p)
+            ex = FragmentExecutor(session, {}, {src.fragment_id: [page]})
+            self._track_executor(ex)
+            out = ex.execute_checked(req.fragment_root).compact()
+            enqueue_out(out)
+
+        if final_agg is not None:
+            # fold arriving partial-state pages into ONE running state page
+            # (intermediate merge), finalize once the upstream is exhausted
+            node = final_agg[0]
+            running: Optional[Page] = None
+            batch: List[Page] = []
+            batch_rows = 0
+
+            def fold(running, batch):
+                page = batch[0]
+                for p in batch[1:]:
+                    page = Page.concat_pages(page, p)
+                if running is not None:
+                    page = Page.concat_pages(running, page)
+                ex = FragmentExecutor(session, {}, {})
+                self._track_executor(ex)
+                out = ex.aggregate_intermediate(node, page).compact()
+                ex.raise_errors()
+                return out
+
+            for page in client.iter_pages():
+                if page.num_rows == 0:
+                    continue
+                batch.append(page)
+                batch_rows += page.num_rows
+                if batch_rows >= self.STREAM_BATCH_ROWS:
+                    running = fold(running, batch)
+                    batch, batch_rows = [], 0
+            if batch:
+                running = fold(running, batch)
+            if running is None:
+                running = Page.all_dead(src.types)
+            ex = FragmentExecutor(session, {}, {})
+            out = ex.aggregate_final(node, running).compact()
+            ex.raise_errors()
+            self.state.set("FLUSHING")
+            enqueue_out(out)
+            self.output.set_complete()
+            self.state.set("FINISHED")
+            return True
+        batch: List[Page] = []
+        batch_rows = 0
+        for page in client.iter_pages():
+            if page.num_rows == 0:
+                continue
+            batch.append(page)
+            batch_rows += page.num_rows
+            if batch_rows >= self.STREAM_BATCH_ROWS:
+                emit(batch)
+                batch, batch_rows = [], 0
+        if batch:
+            emit(batch)
+        self.state.set("FLUSHING")
+        self.output.set_complete()
+        self.state.set("FINISHED")
+        return True
 
     # target serialized bytes per output chunk (reference: the page-size
     # targets of PartitionedOutputBuffer / PagesSerde)
@@ -249,6 +440,7 @@ class SqlTask:
             "state": self.state.get(),
             "failure": self.failure,
             "bufferedBytes": self.output.buffered_bytes,
+            "memoryBytes": self.memory_bytes,
         }
 
 
@@ -350,3 +542,18 @@ class TaskManager:
     def list_info(self) -> List[dict]:
         with self._lock:
             return [t.info() for t in self._tasks.values()]
+
+    def query_memory(self) -> Dict[str, int]:
+        """Reserved bytes per query on this worker (peak-while-running /
+        buffered-while-flushing, see SqlTask.memory_bytes): the per-node
+        half of the cluster memory pool (reference:
+        memory/LocalMemoryManager feeding ClusterMemoryManager through
+        node status)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for t in self._tasks.values():
+                if t.state.is_terminal():
+                    continue
+                qid = t.request.query_id
+                out[qid] = out.get(qid, 0) + t.memory_bytes
+            return out

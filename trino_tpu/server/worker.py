@@ -54,10 +54,19 @@ class WorkerServer:
     """One worker process: task manager + HTTP endpoint + announcer."""
 
     def __init__(self, port: int = 0, coordinator_url: Optional[str] = None,
-                 node_id: Optional[str] = None, session_factory=None):
+                 node_id: Optional[str] = None, session_factory=None,
+                 memory_limit_bytes: Optional[int] = None):
+        import os
+
         self.tasks = TaskManager(session_factory or shared_catalog_session_factory())
         self.node_id = node_id or f"worker-{time.time_ns() & 0xFFFFFF:x}"
         self.coordinator_url = coordinator_url
+        # per-worker memory pool size (reference: memory.heap-headroom /
+        # query.max-memory-per-node config); None = unlimited
+        env_limit = os.environ.get("TRINO_TPU_WORKER_MEMORY_BYTES")
+        self.memory_limit_bytes = (
+            memory_limit_bytes if memory_limit_bytes is not None
+            else int(env_limit) if env_limit else None)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -82,15 +91,23 @@ class WorkerServer:
         pings — here the worker pushes, the coordinator ages entries out)."""
         while not self._stop.is_set():
             try:
+                qmem = self.tasks.query_memory()
                 wire.json_request(
                     "PUT",
                     f"{self.coordinator_url}/v1/announce/{self.node_id}",
-                    {"url": self.base_url, "tasks": len(self.tasks.list_info())},
+                    {"url": self.base_url,
+                     "tasks": len(self.tasks.list_info()),
+                     # per-query live reservations + this worker's pool size:
+                     # the coordinator's ClusterMemoryManager aggregates
+                     # these (reference: node status -> ClusterMemoryPool)
+                     "queryMemory": qmem,
+                     "memoryBytes": sum(qmem.values()),
+                     "memoryLimit": self.memory_limit_bytes},
                     timeout=5.0,
                 )
             except Exception:  # noqa: BLE001 — coordinator may not be up yet
                 pass
-            self._stop.wait(1.0)
+            self._stop.wait(0.5)
 
 
 def _make_handler(server: WorkerServer):
