@@ -108,6 +108,35 @@ def test_basic_and_bearer_submit_accepted(authed_cluster):
     assert coord.get_query(body["id"]).user == "bob"
 
 
+def test_cross_user_query_access_denied(authed_cluster):
+    """A valid principal must not read or cancel another user's query
+    (reference: AccessControl.checkCanViewQueryOwnedBy)."""
+    coord = authed_cluster
+    alice = {"Authorization": "Basic " + base64.b64encode(b"alice:wonder").decode()}
+    bob = {"Authorization": "Basic " + base64.b64encode(b"bob:builder").decode()}
+    status, body = _post_statement(coord, "select 41 + 1", alice)
+    assert status == 200, body
+    qid = body["id"]
+    import urllib.error
+    import urllib.request
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{coord.base_url}/v1/query/{qid}", headers=bob), timeout=10)
+    assert e.value.code == 403
+    with pytest.raises(urllib.error.HTTPError) as e2:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{coord.base_url}/v1/statement/executing/{qid}/0",
+            headers=bob, method="DELETE"), timeout=10)
+    assert e2.value.code == 403
+    # the owner still reads it fine
+    with urllib.request.urlopen(urllib.request.Request(
+            f"{coord.base_url}/v1/query/{qid}", headers=alice), timeout=10) as r:
+        import json
+
+        assert json.loads(r.read())["user"] == "alice"
+
+
 def test_per_user_groups_enforce_separate_limits():
     """per-user limit 1: alice's second query queues behind her first,
     while bob's query is admitted immediately — one user cannot starve

@@ -450,11 +450,14 @@ def test_partitioned_join_no_process_holds_both_sides(cluster):
     coord, workers = cluster
     props = {"catalog": "tpch", "schema": "tiny",
              "join_max_broadcast_rows": 1000}
+    # customer/orders do NOT share a connector partitioning family (unlike
+    # orders/lineitem, which now take the co-located zero-exchange path —
+    # tests/test_pushdown_negotiation.py), so this join must repartition
     sql = """
-        select o_orderpriority, count(*) as c, sum(l_quantity) as q
-        from orders, lineitem
-        where o_orderkey = l_orderkey and l_quantity > 30
-        group by o_orderpriority order by o_orderpriority
+        select c_mktsegment, count(*) as c, sum(o_totalprice) as q
+        from customer, orders
+        where c_custkey = o_custkey and o_totalprice > 1000
+        group by c_mktsegment order by c_mktsegment
     """
     # fragment shape: a hash fragment rooted at the join, fed by two
     # partitioned remote sources (no broadcast of either side)

@@ -49,7 +49,8 @@ class BlackHoleConnector(spi.Connector):
     def table_row_count(self, schema: str, table: str) -> Optional[int]:
         return 0 if (schema, table) in self._tables else None
 
-    def get_splits(self, schema: str, table: str, target_splits: int, constraint=None) -> List[spi.Split]:
+    def get_splits(self, schema: str, table: str, target_splits: int, constraint=None,
+                   handle=None) -> List[spi.Split]:
         return [spi.Split(table, schema, 0, 0)]
 
     def scan(self, split: spi.Split, columns: List[str], constraint=None) -> Dict[str, spi.ColumnData]:

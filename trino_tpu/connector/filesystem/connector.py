@@ -128,7 +128,8 @@ class FileSystemConnector(spi.Connector):
 
     # ------------------------------------------------------------- splits
     def get_splits(
-        self, schema: str, table: str, target_splits: int, constraint=None
+        self, schema: str, table: str, target_splits: int, constraint=None,
+        handle=None,
     ) -> List[spi.Split]:
         """One split per row-group run; row groups whose min/max statistics
         contradict the constraint are pruned (ParquetReader's predicate

@@ -81,7 +81,8 @@ class MemoryConnector(spi.Connector):
         first = next(iter(cols.values()), None)
         return 0 if first is None else len(first.values)
 
-    def get_splits(self, schema: str, table: str, target_splits: int, constraint=None) -> List[spi.Split]:
+    def get_splits(self, schema: str, table: str, target_splits: int, constraint=None,
+                   handle=None) -> List[spi.Split]:
         n = self.table_row_count(schema, table) or 0
         target_splits = max(1, min(target_splits, max(n, 1)))
         bounds = [n * i // target_splits for i in range(target_splits + 1)]

@@ -69,6 +69,38 @@ class Split:
     info: object = None
 
 
+@dataclasses.dataclass(frozen=True)
+class SortItem:
+    """One ORDER BY term for TopN pushdown (reference:
+    spi/connector/SortItem)."""
+
+    column: str
+    ascending: bool = True
+    nulls_first: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateSpec:
+    """One aggregate for aggregation pushdown (reference:
+    spi/connector/AggregateFunction): ``column`` None = count(*)."""
+
+    function: str  # count | sum | min | max
+    column: Optional[str]
+    output_type: T.Type
+
+
+@dataclasses.dataclass(frozen=True)
+class TablePartitioning:
+    """Connector-declared physical partitioning (reference:
+    ConnectorTablePartitioning + ConnectorNodePartitioningProvider): two
+    tables whose partitionings share ``family`` split their rows by the
+    SAME key boundaries — split i of one co-locates with split i of the
+    other, so a join on the partitioning columns needs no exchange."""
+
+    columns: tuple  # partitioning column names, in key order
+    family: str  # co-location domain (same family => aligned splits)
+
+
 @dataclasses.dataclass
 class ColumnData:
     """One scanned column: numpy values (+nulls) host-side; the executor
@@ -285,14 +317,45 @@ class Connector:
         spi/connector/ConnectorMetadata getTableProperties)."""
         return None
 
+    # --- pushdown negotiation (ConnectorMetadata.apply*) ---
+    # Each apply_* returns a NEW opaque table handle when the connector can
+    # serve the narrowed request, or None to decline; the engine stores the
+    # handle on the scan node and keeps its own enforcing operator (split
+    # semantics make connector guarantees per-split, not global), exactly
+    # like the reference keeps the plan node unless the handle is
+    # guaranteed (ConnectorMetadata.java:80 applyLimit/applyTopN/
+    # applyAggregation contracts).
+    def apply_limit(self, schema: str, table: str, handle, count: int):
+        return None
+
+    def apply_topn(self, schema: str, table: str, handle, count: int,
+                   order: List["SortItem"]):
+        return None
+
+    def apply_aggregation(self, schema: str, table: str, handle,
+                          group_columns: List[str],
+                          aggregates: List["AggregateSpec"]):
+        """-> (handle, output ColumnMetadata list) or None. Output columns
+        must be [group columns..., one per aggregate...], with values the
+        ENGINE's exact semantics — a connector whose arithmetic differs
+        (e.g. float sums for decimals) must decline."""
+        return None
+
+    def table_partitioning(self, schema: str, table: str) -> Optional["TablePartitioning"]:
+        """Physical partitioning for co-located joins, if any."""
+        return None
+
     # --- splits (ConnectorSplitManager) ---
     def get_splits(
-        self, schema: str, table: str, target_splits: int, constraint=None
+        self, schema: str, table: str, target_splits: int, constraint=None,
+        handle=None,
     ) -> List[Split]:
         """``constraint`` is an ADVISORY TupleDomain (connector/predicate.py;
         reference: ConnectorMetadata.applyFilter + the DynamicFilter the
         split manager receives): a connector may use it to skip splits but
-        the engine keeps the enforcing filter, so ignoring it is correct."""
+        the engine keeps the enforcing filter, so ignoring it is correct.
+        ``handle`` is the pushdown handle minted by apply_* (if any); a
+        connector embeds it in Split.info so scan() sees it."""
         raise NotImplementedError
 
     # --- data (ConnectorPageSource) ---

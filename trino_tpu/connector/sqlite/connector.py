@@ -16,6 +16,7 @@ DATE->date, BOOLEAN->boolean, NUMERIC/DECIMAL(p,s)->decimal.
 """
 from __future__ import annotations
 
+import dataclasses
 import re
 import sqlite3
 import threading
@@ -30,6 +31,31 @@ from trino_tpu.data.dictionary import Dictionary
 from trino_tpu.data.page import Column
 
 _SPLIT_ROWS = 250_000  # rowid range per split (JdbcSplitManager's analog)
+
+
+@dataclasses.dataclass(frozen=True)
+class SqlitePushdown:
+    """Opaque table handle carrying negotiated pushdown (reference: the
+    JdbcTableHandle's limit/sortOrder/groupingSets state that QueryBuilder
+    compiles into the remote SELECT)."""
+
+    limit: Optional[int] = None
+    order: tuple = ()  # ((column, ascending, nulls_first), ...)
+    group_by: Optional[tuple] = None  # grouping column names
+    aggs: tuple = ()  # ((function, column|None, output_name, output_type), ...)
+
+    def __repr__(self):
+        parts = []
+        if self.aggs:
+            gb = ", ".join(self.group_by or ())
+            parts.append(f"aggregate[{', '.join(f'{f}({c or chr(42)})' for f, c, _, _ in self.aggs)}"
+                         + (f" group by {gb}" if gb else "") + "]")
+        if self.order:
+            parts.append("sort[" + ", ".join(
+                f"{c} {'asc' if a else 'desc'}" for c, a, _ in self.order) + "]")
+        if self.limit is not None:
+            parts.append(f"limit[{self.limit}]")
+        return " ".join(parts) or "none"
 
 
 def _type_from_sqlite(decl: str) -> T.Type:
@@ -128,21 +154,84 @@ class SqliteConnector(spi.Connector):
         conv = _to_repr_fn(t)
         return spi.ColumnStats(low=conv(lo), high=conv(hi), ndv=int(ndv))
 
+    # ----------------------------------------------------------- pushdown
+    def apply_limit(self, schema, table, handle, count: int):
+        h = handle or SqlitePushdown()
+        if h.limit is not None and h.limit <= count:
+            return None  # already at least as narrow — fixpoint
+        return dataclasses.replace(h, limit=count)
+
+    def apply_topn(self, schema, table, handle, count: int, order):
+        h = handle or SqlitePushdown()
+        want = tuple((o.column, o.ascending, o.nulls_first) for o in order)
+        for c, _a, _n in want:
+            if not re.fullmatch(r"\w+", c):
+                return None
+        if h.order == want and h.limit is not None and h.limit <= count:
+            return None
+        if h.aggs:
+            return None  # ordering over pushed aggregates: not composed yet
+        return dataclasses.replace(h, order=want, limit=count)
+
+    def apply_aggregation(self, schema, table, handle, group_columns, aggregates):
+        h = handle or SqlitePushdown()
+        if h.aggs or h.limit is not None or h.order:
+            return None  # aggregation must be innermost
+        meta = self.get_table(schema, table)
+        if meta is None:
+            return None
+        col_types = {c.name: c.type for c in meta.columns}
+        for c in group_columns:
+            if c not in col_types or not re.fullmatch(r"\w+", c):
+                return None
+        out_cols = [spi.ColumnMetadata(c, col_types[c]) for c in group_columns]
+        specs = []
+        for i, a in enumerate(aggregates):
+            # exactness gate: sqlite sums of INTEGER-affinity columns are
+            # exact int64; float/fractional-decimal arithmetic differs from
+            # the engine's, so decline (the reference's JDBC plugins gate
+            # applyAggregate the same way via type mappings)
+            if a.function == "count" and a.column is None:
+                specs.append(("count", None, f"agg{i}", a.output_type))
+            elif a.function in ("count", "min", "max", "sum"):
+                t = col_types.get(a.column)
+                if t is None or not re.fullmatch(r"\w+", a.column or ""):
+                    return None
+                exact = (t.is_integer_kind or t == T.DATE
+                         or (t.is_decimal and isinstance(t, T.DecimalType)
+                             and t.scale == 0))
+                if a.function != "count" and not exact:
+                    return None
+                specs.append((a.function, a.column, f"agg{i}", a.output_type))
+            else:
+                return None
+            out_cols.append(spi.ColumnMetadata(f"agg{i}", a.output_type))
+        new_handle = dataclasses.replace(
+            h, group_by=tuple(group_columns), aggs=tuple(specs))
+        return new_handle, out_cols
+
     # -------------------------------------------------------------- splits
-    def get_splits(self, schema, table, target_splits, constraint=None) -> List[spi.Split]:
+    def get_splits(self, schema, table, target_splits, constraint=None,
+                   handle=None) -> List[spi.Split]:
         _check_ident(table)
+        if handle is not None and (
+                handle.aggs or handle.limit is not None or handle.order):
+            # pushed aggregation/topN/limit is a GLOBAL statement: one split
+            # (the remote engine does the work; splitting would make the
+            # guarantee per-range)
+            return [spi.Split(table, schema, 0, 1 << 62, info=handle)]
         row = self._conn().execute(
             f"select min(rowid), max(rowid) from {table}"
         ).fetchone()
         lo, hi = (row or (None, None))
         if lo is None:
-            return [spi.Split(table, schema, 0, -1)]
+            return [spi.Split(table, schema, 0, -1, info=handle)]
         lo, hi = int(lo), int(hi)
         n = hi - lo + 1
         parts = max(1, min(target_splits, (n + _SPLIT_ROWS - 1) // _SPLIT_ROWS))
         bounds = [lo + n * i // parts for i in range(parts)] + [hi + 1]
         return [
-            spi.Split(table, schema, bounds[i], bounds[i + 1] - 1)
+            spi.Split(table, schema, bounds[i], bounds[i + 1] - 1, info=handle)
             for i in range(parts)
         ]
 
@@ -150,18 +239,52 @@ class SqliteConnector(spi.Connector):
     def scan(self, split: spi.Split, columns: List[str], constraint=None):
         meta = self.get_table(split.schema, split.table)
         assert meta is not None
-        for c in columns:
-            _check_ident(c)
+        h: Optional[SqlitePushdown] = split.info if isinstance(
+            split.info, SqlitePushdown) else None
         col_types = {c.name: c.type for c in meta.columns}
-        sel = ", ".join(f'"{c}"' for c in columns)
-        where, params = ["rowid between ? and ?"], [split.lo, split.hi]
+        where, params = [], []
+        if h is None or not (h.aggs or h.limit is not None or h.order):
+            where, params = ["rowid between ? and ?"], [split.lo, split.hi]
         if constraint is not None:
             w, p = _compile_constraint(constraint, col_types)
             where += w
             params += p
-        sql = f'select {sel} from {split.table} where {" and ".join(where)}'
+        where_sql = f' where {" and ".join(where)}' if where else ""
+        if h is not None and h.aggs:
+            # the handle defines output names: group columns + aggN aliases
+            sel_parts = [f'"{c}"' for c in h.group_by]
+            for fn, col, alias, _t in h.aggs:
+                expr = "count(*)" if col is None else f'{fn}("{col}")'
+                sel_parts.append(f"{expr} as {alias}")
+            gb = (" group by " + ", ".join(f'"{c}"' for c in h.group_by)
+                  if h.group_by else "")
+            sql = (f"select {', '.join(sel_parts)} from {split.table}"
+                   f"{where_sql}{gb}")
+        else:
+            for c in columns:
+                _check_ident(c)
+            sel = ", ".join(f'"{c}"' for c in columns)
+            order_sql = ""
+            if h is not None and h.order:
+                terms = []
+                for c, asc, nf in h.order:
+                    nulls = "nulls first" if nf else "nulls last"
+                    terms.append(f'"{c}" {"asc" if asc else "desc"} {nulls}')
+                order_sql = " order by " + ", ".join(terms)
+            limit_sql = (f" limit {int(h.limit)}"
+                         if h is not None and h.limit is not None else "")
+            sql = (f"select {sel} from {split.table}{where_sql}"
+                   f"{order_sql}{limit_sql}")
         rows = self._conn().execute(sql, params).fetchall()
         out: Dict[str, spi.ColumnData] = {}
+        if h is not None and h.aggs:
+            names = list(h.group_by) + [alias for _, _, alias, _ in h.aggs]
+            types = [col_types[c] for c in h.group_by] + [t for _, _, _, t in h.aggs]
+            assert list(columns) == names, (columns, names)
+            for i, (cname, t) in enumerate(zip(names, types)):
+                pycol = [_from_sql_value(t, r[i]) for r in rows]
+                out[cname] = spi.column_data_from_column(Column.from_python(t, pycol))
+            return out
         for i, cname in enumerate(columns):
             t = col_types[cname]
             pycol = [_from_sql_value(t, r[i]) for r in rows]

@@ -65,7 +65,8 @@ class TpcdsConnector(spi.Connector):
         return self._PRIMARY_KEYS.get(table)
 
     def get_splits(
-        self, schema: str, table: str, target_splits: int, constraint=None
+        self, schema: str, table: str, target_splits: int, constraint=None,
+        handle=None,
     ) -> List[spi.Split]:
         sf = schema_scale_factor(schema)
         n = gen.order_range_count(table, sf)

@@ -77,6 +77,21 @@ class TpchConnector(spi.Connector):
     def primary_key(self, schema: str, table: str):
         return self._PRIMARY_KEYS.get(table)
 
+    def table_partitioning(self, schema: str, table: str):
+        """orders and lineitem are both generated in ORDER-index ranges
+        with identical split-boundary arithmetic (get_splits), so they
+        co-partition on the order key: split i of one holds exactly the
+        orders whose lines are in split i of the other — a join on
+        o_orderkey = l_orderkey needs no exchange (reference:
+        ConnectorTablePartitioning + ConnectorNodePartitioningProvider,
+        the bucketed-table co-located join contract)."""
+        family = f"tpch:{schema}:order-range"
+        if table == "orders":
+            return spi.TablePartitioning(("o_orderkey",), family)
+        if table == "lineitem":
+            return spi.TablePartitioning(("l_orderkey",), family)
+        return None
+
     # Columns monotone in the generator's row index (key = row + 1; lineitem
     # rows are indexed by ORDER row; partsupp rows are 4 per part). A range
     # or in-set constraint on these maps directly to row-range narrowing —
@@ -147,7 +162,8 @@ class TpchConnector(spi.Connector):
         return [(lo, hi)] if lo < hi else []
 
     def get_splits(
-        self, schema: str, table: str, target_splits: int, constraint=None
+        self, schema: str, table: str, target_splits: int, constraint=None,
+        handle=None,
     ) -> List[spi.Split]:
         """Never returns more than ``target_splits`` splits (callers shard
         them 1:1 onto devices/workers). When the constraint's key runs

@@ -351,7 +351,8 @@ class Executor:
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
         conn = self.session.catalogs[node.catalog]
         constraint = self.scan_constraint(node)
-        splits = conn.get_splits(node.schema, node.table, 1, constraint=constraint)
+        splits = conn.get_splits(node.schema, node.table, 1, constraint=constraint,
+                                 handle=node.table_handle)
         datas = [conn.scan(s, node.column_names, constraint=constraint) for s in splits]
         if self.apply_df_host:
             t0 = time.perf_counter()

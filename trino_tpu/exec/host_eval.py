@@ -166,7 +166,8 @@ class HostEvaluator:
         dyn = dynamic_domain_map(node, self.dyn_domains)
         if dyn:
             td = TupleDomain(dict(dyn)) if td is None else td.intersect(TupleDomain(dict(dyn)))
-        splits = conn.get_splits(node.schema, node.table, 1, constraint=td)
+        splits = conn.get_splits(node.schema, node.table, 1, constraint=td,
+                                 handle=node.table_handle)
         datas = [conn.scan(s, node.column_names, constraint=td) for s in splits]
         from trino_tpu.connector.spi import concat_column_data
 

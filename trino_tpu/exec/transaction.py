@@ -79,14 +79,16 @@ class TransactionalOverlay(spi.Connector):
             return None
         return self.base.primary_key(schema, table)
 
-    def get_splits(self, schema, table, target_splits, constraint=None):
+    def get_splits(self, schema, table, target_splits, constraint=None,
+                   handle=None):
         if (schema, table) in self._staged:
             st = self._staged[(schema, table)]
             if st is None:
                 raise KeyError(f"{self.name}.{schema}.{table} does not exist")
             n = self.table_row_count(schema, table) or 0
             return [spi.Split(table, schema, 0, n)]
-        return self.base.get_splits(schema, table, target_splits, constraint)
+        return self.base.get_splits(schema, table, target_splits, constraint,
+                                    handle=handle)
 
     def scan(self, split, columns, constraint=None):
         key = (split.schema, split.table)

@@ -369,7 +369,8 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
         conn = session.catalogs[node.catalog]
         constraint = scan_constraint_with(node, dyn_domains)
         splits = conn.get_splits(
-            node.schema, node.table, n_devices, constraint=constraint)
+            node.schema, node.table, n_devices, constraint=constraint,
+            handle=node.table_handle)
         total_rows = 0
         shard_pages = []
         for di in range(n_devices):
@@ -383,13 +384,19 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
                 if data:
                     total_rows += len(next(iter(data.values())).values)
             else:
-                # devices beyond the split count scan NOTHING: lo=hi and an
-                # empty info both mark emptiness (row-group connectors use
-                # info, range connectors use lo/hi)
-                empty = dataclasses.replace(
-                    (splits or [spi_mod.Split(node.table, node.schema, 0, 0)])[0],
-                    lo=0, hi=0, info=())
-                data = conn.scan(empty, node.column_names)
+                # devices beyond the split count scan NOTHING. Built here
+                # from the scan node's own schema — no connector round-trip:
+                # a synthetic empty Split would either clobber a pushdown
+                # handle riding Split.info (breaking schema resolution for
+                # pushed aggregations) or, preserved, re-run a GLOBAL pushed
+                # statement on every extra device (duplicating rows).
+                from trino_tpu.data.page import Column as _Col
+
+                data = {
+                    name: spi_mod.column_data_from_column(
+                        _Col.from_python(typ, []))
+                    for name, typ in zip(node.column_names, node.column_types)
+                }
             cols = []
             for name, typ in zip(node.column_names, node.column_types):
                 cd = data[name]

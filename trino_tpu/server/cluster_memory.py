@@ -84,7 +84,20 @@ class ClusterMemoryManager:
                     over.append(nid)
         if not over:
             return
-        victim = self.policy(self.query_reservations())
+        # candidates = queries actually HOLDING memory on an over-limit
+        # node (killing anything else frees nothing there — the
+        # "OnBlockedNodes" half of the reference policy's name); the
+        # policy then ranks candidates by their CLUSTER-wide reservation
+        with self._lock:
+            blocked = set()
+            for nid in over:
+                blocked.update(
+                    q for q, b in self._nodes[nid]["queryMemory"].items()
+                    if int(b) > 0)
+        candidates = {
+            q: b for q, b in self.query_reservations().items() if q in blocked
+        }
+        victim = self.policy(candidates)
         if victim is None:
             return
         reason = (

@@ -246,7 +246,8 @@ class QueryExecution:
                 conn = session.catalogs[node.catalog]
                 splits = conn.get_splits(node.schema, node.table,
                                          max(len(workers), 1),
-                                         constraint=node.constraint)
+                                         constraint=node.constraint,
+                                         handle=node.table_handle)
                 for i, split in enumerate(splits):
                     w = i % len(workers)
                     per_worker_splits[w].setdefault(node.id, []).append(split)
@@ -703,32 +704,41 @@ def _make_handler(server: CoordinatorServer):
                 return
             self._send(404)
 
-        def _authenticated(self):
+        def _authenticated(self, query=None):
             """Gate for query-scoped routes when an authenticator is
             configured: results, query info, and cancel carry user data and
             control — they are NOT open even though submission already
-            authenticated (predictable query ids must not leak results)."""
+            authenticated (predictable query ids must not leak results).
+            With ``query``, the authenticated principal must also OWN it
+            (reference: AccessControl.checkCanViewQueryOwnedBy /
+            checkCanKillQueryOwnedBy)."""
             if server.authenticator is None or not server.authenticator.required:
                 return True
             from trino_tpu.server.auth import AuthenticationError
 
             try:
-                server.authenticator.authenticate_header(
+                identity = server.authenticator.authenticate_header(
                     self.headers.get("Authorization"))
-                return True
             except AuthenticationError as e:
                 self._send(401, json.dumps(
                     {"error": {"message": f"Authentication failed: {e}"}}
                 ).encode(), headers={
                     "WWW-Authenticate": 'Basic realm="trino-tpu", Bearer'})
                 return False
+            if query is not None and query.user != identity.user:
+                self._send(403, json.dumps(
+                    {"error": {"message":
+                               "Access Denied: query belongs to another user"}}
+                ).encode())
+                return False
+            return True
 
         def do_GET(self):
             m = _RESULT_RE.match(self.path)
             if m:
-                if not self._authenticated():
-                    return
                 q = server.get_query(m.group(1))
+                if not self._authenticated(query=q):
+                    return
                 if q is None:
                     self._send(404, b'{"error": "no such query"}')
                     return
@@ -740,9 +750,9 @@ def _make_handler(server: CoordinatorServer):
                 return
             m = _QUERY_RE.match(self.path)
             if m:
-                if not self._authenticated():
-                    return
                 q = server.get_query(m.group(1))
+                if not self._authenticated(query=q):
+                    return
                 if q is None:
                     self._send(404, b'{"error": "no such query"}')
                     return
@@ -769,9 +779,9 @@ def _make_handler(server: CoordinatorServer):
         def do_DELETE(self):
             m = _RESULT_RE.match(self.path)
             if m:
-                if not self._authenticated():
-                    return
                 q = server.get_query(m.group(1))
+                if not self._authenticated(query=q):
+                    return
                 if q is not None:
                     q.cancel()
                 self._send(204)

@@ -76,6 +76,41 @@ def _hash_distributed_final(session, node: P.AggregationNode) -> bool:
     return rows > stats._gather_max_rows(session)
 
 
+def _colocated_join(session, node: P.JoinNode, left, right) -> bool:
+    """True when both join sides trace to scans whose connector-declared
+    partitionings share a family on exactly the join keys, and neither
+    scan's static constraint narrows the partitioning column (which could
+    desynchronize the two sides' split boundaries). Split alignment then
+    holds by the connector contract: same family => same key->split map."""
+    if not node.left_keys or len(node.left_keys) != 1:
+        return False
+    if node.join_type not in ("inner", "semi", "anti", "left"):
+        return False
+    from trino_tpu.sql.planner.optimizer import _trace_to_scan
+
+    lt = _trace_to_scan(left, node.left_keys[0])
+    rt = _trace_to_scan(right, node.right_keys[0])
+    if lt is None or rt is None:
+        return False
+    (lscan, lcol), (rscan, rcol) = lt, rt
+    if lscan.catalog != rscan.catalog:
+        return False
+    conn = session.catalogs.get(lscan.catalog)
+    if conn is None:
+        return False
+    lp = conn.table_partitioning(lscan.schema, lscan.table)
+    rp = conn.table_partitioning(rscan.schema, rscan.table)
+    if lp is None or rp is None or lp.family != rp.family:
+        return False
+    if lp.columns != (lcol,) or rp.columns != (rcol,):
+        return False
+    for scan, col in ((lscan, lcol), (rscan, rcol)):
+        td = scan.constraint
+        if td is not None and not td.domain(col).is_all():
+            return False  # key-narrowed splits could misalign
+    return True
+
+
 def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
     """Cut the optimized plan into fragments mirroring the SPMD execution."""
     global _frag_ids
@@ -156,6 +191,17 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
         if isinstance(node, P.JoinNode):
             left, lrep = cut(node.left, fragments)
             right, rrep = cut(node.right, fragments)
+            if (session is not None and not lrep and not rrep
+                    and _colocated_join(session, node, left, right)):
+                # connector-partitioned co-located join (reference:
+                # ConnectorNodePartitioningProvider + bucketed-table
+                # execution): both sides' scans split by the SAME key
+                # boundaries, and the scheduler assigns same-index splits
+                # to the same task — so the join runs INSIDE the source
+                # fragment with ZERO exchange on either side.
+                node.left, node.right = left, right
+                node.distribution = "colocated"
+                return node, False
             if (session is not None and not lrep and not rrep
                     and node.left_keys and node.join_type in ("inner", "semi",
                                                               "anti", "left")):

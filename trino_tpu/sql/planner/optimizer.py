@@ -31,6 +31,13 @@ def optimize(root: P.OutputNode, session=None) -> P.OutputNode:
     node = orient_joins(node, session)
     node, _ = prune_channels(node, set(range(len(node.output_types))))
     node = merge_identity_projects(node)
+    # local rewrites run as memo-resident rules to fixpoint (reference:
+    # IterativeOptimizer + rule/ — the scaling path for new rewrites;
+    # the passes above stay whole-tree, as PredicatePushDown does there)
+    from trino_tpu.sql.planner.iterative import IterativeOptimizer
+    from trino_tpu.sql.planner.rules import DEFAULT_RULES
+
+    node = IterativeOptimizer(DEFAULT_RULES).optimize(node, session)
     derive_scan_constraints(node)
     plan_dynamic_filters(node)
     if session is not None:

@@ -610,6 +610,8 @@ def format_plan(node: PlanNode, indent: int = 0, executor=None) -> str:
         detail = f" {node.catalog}.{node.schema}.{node.table} -> {node.column_names}"
         if node.constraint is not None:
             detail += f" constraint={node.constraint!r}"
+        if node.table_handle is not None:
+            detail += f" pushdown={node.table_handle!r}"
         if node.dynamic_filters:
             detail += f" dynamic_filters={[c for _, _, c in node.dynamic_filters]}"
     elif isinstance(node, FilterNode):
