@@ -1,0 +1,91 @@
+"""Multi-device coverage breadth (round-4 verdict item 10): UNNEST,
+map_agg, int128 (long-decimal) sums, and window frames on the 8-device
+virtual CPU mesh — each cross-checked against single-device execution.
+
+Reference test-strategy analog: the DistributedQueryRunner suites that run
+the same SQL against the distributed and local runners (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from trino_tpu import Session
+from trino_tpu.exec.query import plan_sql, run_query
+from trino_tpu.parallel.spmd import DistributedQuery
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest should provide 8 virtual CPU devices"
+    return Mesh(np.array(devs[:8]), ("d",))
+
+
+def _check(session, mesh, sql):
+    want = run_query(Session(), sql).rows
+    dq = DistributedQuery.build(session, plan_sql(session, sql), mesh)
+    got = dq.run().to_pylist()
+    assert got == want, f"distributed != local:\n{got[:3]}\nvs\n{want[:3]}"
+    return got
+
+
+def test_unnest_on_mesh(session, mesh):
+    """UNNEST of a projected array across devices: expansion capacities
+    are per-shard; the gathered result must equal local."""
+    got = _check(session, mesh, """
+        select n_name, u from nation
+        cross join unnest(array[n_nationkey, n_regionkey]) as t(u)
+        where n_regionkey = 1 order by n_name, u
+    """)
+    assert len(got) == 10  # 5 AMERICA nations x 2 elements
+
+
+def test_map_agg_on_mesh(session, mesh):
+    """map_agg builds per-shard maps whose entries merge through the
+    gathered final step; compare via sorted map items."""
+    got = _check(session, mesh, """
+        select r_name, map_agg(n_name, n_nationkey) m
+        from nation, region where n_regionkey = r_regionkey
+        group by r_name order by r_name
+    """)
+    assert got[0][0] == "AFRICA" and len(got[0][1]) == 5
+
+
+def test_int128_sum_on_mesh(session, mesh):
+    """A decimal(38) sum whose running value exceeds int64 forces the
+    two-limb (int128) accumulation path on every device and through the
+    final merge."""
+    got = _check(session, mesh, """
+        select sum(cast(o_totalprice as decimal(38,2)) * 100000000000) s
+        from orders
+    """)
+    # the result's scaled storage exceeds int64 by construction
+    assert got[0][0] is not None
+    assert abs(int(got[0][0] * 100)) > 2**63
+
+
+def test_window_frame_on_mesh(session, mesh):
+    """Bounded ROWS frames (k PRECEDING/FOLLOWING) over partitions that
+    repartition across devices."""
+    _check(session, mesh, """
+        select n_regionkey, n_name,
+               sum(n_nationkey) over (partition by n_regionkey
+                                      order by n_name
+                                      rows between 1 preceding and 1 following) w
+        from nation order by n_regionkey, n_name
+    """)
+
+
+def test_grouping_sets_on_mesh(session, mesh):
+    """ROLLUP expansion through the distributed aggregation tiers."""
+    _check(session, mesh, """
+        select n_regionkey, count(*) c from nation
+        group by rollup(n_regionkey) order by n_regionkey
+    """)

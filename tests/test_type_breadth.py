@@ -1,0 +1,251 @@
+"""Type breadth wave (round-4 verdict item 8): timestamp(p) with/without
+time zone, varbinary, and row-valued columns through the engine.
+
+Reference test-strategy analog: spi/type tests (TestTimestampType,
+TestVarbinaryType, TestRowType) + operator-level round-trips — assert
+literal analysis, casts, comparisons, arithmetic, serde round-trips, and
+an oracle cross-check of the timestamp epoch math against Python's
+datetime.
+"""
+import datetime
+
+import pytest
+
+from trino_tpu import Session
+from trino_tpu import types as T
+from trino_tpu.data.page import Column, Page
+from trino_tpu.data.serde import deserialize_page, serialize_page
+
+
+@pytest.fixture()
+def s():
+    return Session({"catalog": "tpch", "schema": "tiny"})
+
+
+# ------------------------------------------------------------- timestamps
+
+
+def test_timestamp_literal_precisions(s):
+    rows = s.execute(
+        "select timestamp '2024-03-15 10:30:45', "
+        "timestamp '2024-03-15 10:30:45.123', "
+        "timestamp '2024-03-15 10:30:45.123456'").rows
+    assert rows == [(
+        datetime.datetime(2024, 3, 15, 10, 30, 45),
+        datetime.datetime(2024, 3, 15, 10, 30, 45, 123000),
+        datetime.datetime(2024, 3, 15, 10, 30, 45, 123456),
+    )]
+
+
+def test_timestamp_type_parsing():
+    assert T.parse_type("timestamp(3)").precision == 3
+    assert T.parse_type("timestamp").precision == 6
+    t = T.parse_type("timestamp(9) with time zone")
+    assert t.precision == 9 and t.with_tz
+    with pytest.raises(ValueError):
+        T.timestamp(12)
+
+
+def test_timestamp_interval_arithmetic(s):
+    rows = s.execute(
+        "select timestamp '2024-03-15 23:30:00' + interval '45' minute, "
+        "timestamp '2024-03-15 00:10:00' - interval '1' day, "
+        "timestamp '2024-01-31 12:00:00' + interval '1' month").rows
+    assert rows == [(
+        datetime.datetime(2024, 3, 16, 0, 15),
+        datetime.datetime(2024, 3, 14, 0, 10),
+        datetime.datetime(2024, 2, 29, 12, 0),  # month-end clamp
+    )]
+
+
+def test_timestamp_extract_and_comparisons(s):
+    rows = s.execute(
+        "select extract(year from timestamp '2024-03-15 10:30:45'), "
+        "extract(hour from timestamp '2024-03-15 10:30:45'), "
+        "extract(minute from timestamp '2024-03-15 10:30:45'), "
+        "extract(second from timestamp '2024-03-15 10:30:45')").rows
+    assert rows == [(2024, 10, 30, 45)]
+    # cross-precision + date/timestamp comparisons align at max precision
+    rows = s.execute(
+        "select timestamp '2024-03-15 10:00:00' > timestamp '2024-03-15 09:59:59.999999', "
+        "date '2024-03-16' > timestamp '2024-03-15 23:59:59', "
+        "date '2024-03-15' = timestamp '2024-03-15 00:00:00'").rows
+    assert rows == [(True, True, True)]
+
+
+def test_timestamp_casts_round_half_up(s):
+    rows = s.execute(
+        "select cast(timestamp '2024-03-15 10:30:45.5' as timestamp(0)), "
+        "cast(timestamp '2024-03-15 10:30:45.4999' as timestamp(0)), "
+        "cast(date '2024-03-15' as timestamp(3)), "
+        "cast(timestamp '2024-03-15 23:59:59' as date)").rows
+    assert rows == [(
+        datetime.datetime(2024, 3, 15, 10, 30, 46),
+        datetime.datetime(2024, 3, 15, 10, 30, 45),
+        datetime.datetime(2024, 3, 15, 0, 0),
+        datetime.date(2024, 3, 15),
+    )]
+
+
+def test_at_time_zone_fixed_offsets(s):
+    """Reference semantics: the instant is UNCHANGED (the wall-clock value
+    is read in the session zone = UTC); only the rendering zone changes,
+    and this engine renders tz values in UTC."""
+    rows = s.execute(
+        "select timestamp '2024-03-15 10:00:00' at time zone '+05:30', "
+        "timestamp '2024-03-15 10:00:00' at time zone 'UTC'").rows
+    utc = datetime.timezone.utc
+    assert rows == [(
+        datetime.datetime(2024, 3, 15, 10, 0, tzinfo=utc),
+        datetime.datetime(2024, 3, 15, 10, 0, tzinfo=utc),
+    )]
+    with pytest.raises(Exception):
+        s.execute("select timestamp '2024-03-15 10:00:00' at time zone 'Mars/Olympus'")
+    # tz literals normalize to UTC storage
+    rows = s.execute("select timestamp '2024-03-15 10:00:00+02:00'").rows
+    assert rows == [(datetime.datetime(2024, 3, 15, 8, 0, tzinfo=utc),)]
+
+
+def test_timestamp_column_group_and_sort(s):
+    """Timestamps ride int64 storage through grouping/sorting/joins."""
+    rows = s.execute(
+        "select t, count(*) from (values "
+        "(timestamp '2024-01-01 10:00:00'), (timestamp '2024-01-01 10:00:00'), "
+        "(timestamp '2024-01-02 09:00:00')) as v(t) "
+        "group by t order by t desc").rows
+    assert rows == [
+        (datetime.datetime(2024, 1, 2, 9, 0), 1),
+        (datetime.datetime(2024, 1, 1, 10, 0), 2),
+    ]
+
+
+def test_timestamp_oracle_epoch_math():
+    """Storage repr cross-check against Python datetime over a spread of
+    instants and precisions (pre-epoch included: floor semantics)."""
+    from trino_tpu.data.page import _from_repr, _to_repr
+
+    cases = [
+        datetime.datetime(1969, 12, 31, 23, 59, 59, 750000),
+        datetime.datetime(1970, 1, 1),
+        datetime.datetime(2024, 3, 15, 10, 30, 45, 123456),
+        datetime.datetime(1901, 7, 4, 1, 2, 3),
+    ]
+    for p in (0, 3, 6, 9):
+        t = T.timestamp(p)
+        for v in cases:
+            r = _to_repr(t, v)
+            back = _from_repr(t, r)
+            trunc_us = v.replace(microsecond=0) if p == 0 else (
+                v.replace(microsecond=v.microsecond // 1000 * 1000)
+                if p == 3 else v)
+            assert back == trunc_us, (p, v, back)
+
+
+def test_tpcds_timestamp_arithmetic_query():
+    """TPC-DS date_dim with timestamp arithmetic (the verdict's done-bar:
+    a TPC-DS query using timestamp arithmetic passes)."""
+    s = Session({"catalog": "tpcds", "schema": "sf0.01"})
+    rows = s.execute(
+        "select count(*) from date_dim "
+        "where cast(d_date as timestamp(3)) + interval '12' hour "
+        "      < timestamp '1999-06-01 11:00:00' "
+        "  and d_year = 1999").rows
+    want = s.execute(
+        "select count(*) from date_dim "
+        "where d_date < date '1999-06-01' and d_year = 1999").rows
+    assert rows == want
+    assert rows[0][0] > 0
+
+
+# -------------------------------------------------------------- varbinary
+
+
+def test_varbinary_literals_and_functions(s):
+    rows = s.execute(
+        "select X'DEADBEEF', length(X'DEADBEEF'), to_hex(X'0a1b'), "
+        "from_hex('0A1B'), to_utf8('hi'), from_utf8(X'6869')").rows
+    assert rows == [(b"\xde\xad\xbe\xef", 4, "0A1B", b"\x0a\x1b", b"hi", "hi")]
+    rows = s.execute("select md5(to_utf8('abc'))").rows
+    import hashlib
+
+    assert rows == [(hashlib.md5(b"abc").digest(),)]
+
+
+def test_varbinary_comparison_and_grouping(s):
+    rows = s.execute(
+        "select X'01' < X'02', X'ff' > X'0102', X'AB' = X'ab'").rows
+    # unsigned byte order: 0xff > 0x0102 is FALSE in length-aware bytes
+    # comparison? No: Trino compares lexicographically byte-wise, so
+    # [0xff] > [0x01, 0x02] is TRUE (first byte decides).
+    assert rows == [(True, True, True)]
+    rows = s.execute(
+        "select b, count(*) from (values (X'01'), (X'01'), (X'02')) as v(b) "
+        "group by b order by b").rows
+    assert rows == [(b"\x01", 2), (b"\x02", 1)]
+
+
+def test_varchar_varbinary_casts_reencode(s):
+    rows = s.execute(
+        "select cast('abc' as varbinary), cast(X'616263' as varchar)").rows
+    assert rows == [(b"abc", "abc")]
+
+
+def test_from_hex_invalid_fails_only_live_rows(s):
+    # the bad entry is filtered out before from_hex: no error
+    rows = s.execute(
+        "select from_hex(h) from (values ('6869'), ('zz')) as v(h) "
+        "where h != 'zz'").rows
+    assert rows == [(b"hi",)]
+    # a LIVE bad entry raises (correct-or-error, never silent)
+    with pytest.raises(Exception):
+        s.execute("select from_hex(h) from (values ('zz')) as v(h)")
+
+
+def test_varbinary_serde_round_trip():
+    col = Column.from_python(T.VARBINARY, [b"\x00\x01", b"", None, b"\xff"])
+    p2 = deserialize_page(serialize_page(Page([col])))
+    assert p2.to_pylist() == [(b"\x00\x01",), (b"",), (None,), (b"\xff",)]
+
+
+# ------------------------------------------------------------ row columns
+
+
+def test_row_constructor_field_access(s):
+    from decimal import Decimal
+
+    assert s.execute("select row(1, 'a', 2.5)").rows == [
+        ((1, "a", Decimal("2.5")),)]
+    rows = s.execute("select row(1, 'a')[1], row(1, 'a')[2]").rows
+    assert rows == [(1, "a")]
+    rows = s.execute(
+        "select row(o_orderkey, o_totalprice)[1] from orders "
+        "order by o_orderkey limit 3").rows
+    assert rows == [(1,), (2,), (3,)]
+
+
+def test_row_null_and_cast(s):
+    assert s.execute("select cast(null as row(x bigint, y varchar))").rows \
+        == [(None,)]
+    # field access over a NULL row is NULL
+    rows = s.execute(
+        "select cast(null as row(x bigint, y varchar))[1]").rows
+    assert rows == [(None,)]
+
+
+def test_row_column_page_serde_round_trip():
+    rt = T.row_of([("a", T.BIGINT), ("b", T.varchar()),
+                   ("c", T.decimal(10, 2))])
+    from decimal import Decimal
+
+    data = [(1, "x", Decimal("1.50")), (2, "y", Decimal("-3.25")), None]
+    col = Column.from_python(rt, data)
+    p2 = deserialize_page(serialize_page(Page([col])))
+    assert p2.to_pylist() == [(v,) for v in data]
+
+
+def test_array_of_rows_round_trip():
+    rt = T.row_of([("a", T.BIGINT), ("b", T.varchar())])
+    art = T.array_of(rt)
+    data = [[(1, "x"), (2, "y")], [], [(3, "z")]]
+    col = Column.from_python(art, data)
+    assert Column.to_python(col) == data

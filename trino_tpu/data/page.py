@@ -99,6 +99,11 @@ class Column:
             else None
         )
         if typ.is_varchar:
+            if typ.is_varbinary:
+                # bytes ride the dictionary as hex strings (hex order ==
+                # unsigned-byte order, so comparisons/sorts agree)
+                data = [v.hex() if isinstance(v, (bytes, bytearray)) else v
+                        for v in data]
             d = Dictionary.build(data)
             codes = d.encode(list(data))
             return cls(typ, jnp.asarray(codes), nulls, d)
@@ -165,6 +170,8 @@ class Column:
         if self.type.is_varchar:
             assert self.dictionary is not None
             out = self.dictionary.decode(vals)
+            if self.type.is_varbinary:
+                out = [bytes.fromhex(v) if v is not None else v for v in out]
             if nulls is not None:
                 out = [None if isnull else v for v, isnull in zip(out, nulls)]
             return out
@@ -214,6 +221,23 @@ def merge_vrange(a, b):
 
 def _to_repr(typ: T.Type, v):
     """Python value -> device representation (int days, scaled int, ...)."""
+    if isinstance(typ, T.TimestampType):
+        import datetime
+
+        unit = 10 ** typ.precision
+        if isinstance(v, str):
+            v = datetime.datetime.fromisoformat(v)
+        if isinstance(v, datetime.datetime):
+            if v.tzinfo is not None:
+                v = v.astimezone(datetime.timezone.utc).replace(tzinfo=None)
+            epoch = datetime.datetime(1970, 1, 1)
+            delta = v - epoch
+            micros = (delta.days * 86_400_000_000
+                      + delta.seconds * 1_000_000 + delta.microseconds)
+            return micros * unit // 1_000_000
+        if isinstance(v, datetime.date):
+            return (v - datetime.date(1970, 1, 1)).days * 86_400 * unit
+        return int(v)
     if typ == T.DATE:
         if isinstance(v, str):
             import datetime
@@ -241,6 +265,15 @@ def _to_repr(typ: T.Type, v):
 
 
 def _from_repr(typ: T.Type, r):
+    if isinstance(typ, T.TimestampType):
+        import datetime
+
+        unit = 10 ** typ.precision
+        micros = int(r) * 1_000_000 // unit
+        base = datetime.datetime(
+            1970, 1, 1,
+            tzinfo=datetime.timezone.utc if typ.with_tz else None)
+        return base + datetime.timedelta(microseconds=micros)
     if typ == T.DATE:
         import datetime
 

@@ -1,8 +1,13 @@
 """Page <-> flat array-list conversion (pytree-style) for jit boundaries.
 
-The dynamic parts of a Page (values, null masks, selection) flatten to a
-list of arrays; the static parts (types, dictionaries) go into a PageSpec
-captured in the compiled closure.
+The dynamic parts of a Page (values, null masks, selection, nested child
+columns) flatten to a list of arrays; the static parts (types,
+dictionaries, vranges) go into a PageSpec captured in the compiled
+closure. Nested (array/map/row) columns flatten RECURSIVELY: the parent's
+lengths/placeholder array first, then each child column — static shapes
+throughout, so a traced program can ship nested results across the jit
+boundary (the Block-tree serialization role of the reference's
+``spi/block`` serde, re-targeted at XLA buffers).
 """
 from __future__ import annotations
 
@@ -17,81 +22,100 @@ from trino_tpu.data.page import Column, Page
 
 
 @dataclasses.dataclass
+class ColSpec:
+    """Static description of one column's flat layout."""
+
+    type: T.Type
+    dictionary: Optional[Dictionary]
+    has_nulls: bool
+    vrange: Optional[tuple] = None
+    ascending: bool = False
+    has_hi: bool = False
+    children: Optional[List["ColSpec"]] = None
+
+    def count(self) -> int:
+        return (1 + (1 if self.has_nulls else 0) + (1 if self.has_hi else 0)
+                + sum(k.count() for k in (self.children or ())))
+
+
+@dataclasses.dataclass
 class PageSpec:
-    types: List[T.Type]
-    dictionaries: List[Optional[Dictionary]]
-    has_nulls: List[bool]
+    col_specs: List[ColSpec]
     has_sel: bool
-    # static (min, max) bounds per column (data/page.py Column.vrange) —
-    # static metadata, so it crosses the jit boundary in the spec
-    vranges: Optional[List[Optional[tuple]]] = None
-    # per-column sort-order flags + the page's live-prefix property
-    # (data/page.py) — static metadata licensing sort-free fast paths
-    ascending: Optional[List[bool]] = None
     live_prefix: bool = False
-    # per-column long-decimal high-limb presence (data/page.py Column.hi)
-    has_hi: Optional[List[bool]] = None
+
+    # legacy accessors (older callers address columns by parallel lists)
+    @property
+    def types(self) -> List[T.Type]:
+        return [c.type for c in self.col_specs]
+
+    @property
+    def dictionaries(self):
+        return [c.dictionary for c in self.col_specs]
+
+    @property
+    def has_nulls(self):
+        return [c.has_nulls for c in self.col_specs]
+
+    @property
+    def vranges(self):
+        return [c.vrange for c in self.col_specs]
 
     def array_count(self) -> int:
         """How many flat arrays a page with this spec occupies."""
-        return (
-            len(self.types) + sum(self.has_nulls) + (1 if self.has_sel else 0)
-            + sum(self.has_hi or ())
-        )
+        return sum(c.count() for c in self.col_specs) + (1 if self.has_sel else 0)
+
+
+def _flatten_col(c: Column, arrays: List[jnp.ndarray]) -> ColSpec:
+    arrays.append(c.values)
+    if c.nulls is not None:
+        arrays.append(c.nulls)
+    if c.hi is not None:
+        arrays.append(c.hi)
+    children = None
+    if c.children is not None:
+        children = [_flatten_col(k, arrays) for k in c.children]
+    return ColSpec(
+        c.type, c.dictionary, c.nulls is not None, c.vrange,
+        bool(c.ascending), c.hi is not None, children,
+    )
+
+
+def _unflatten_col(spec: ColSpec, arrays: List[jnp.ndarray], i: int
+                   ) -> Tuple[Column, int]:
+    vals = arrays[i]
+    i += 1
+    nulls = None
+    if spec.has_nulls:
+        nulls = arrays[i]
+        i += 1
+    hi = None
+    if spec.has_hi:
+        hi = arrays[i]
+        i += 1
+    children = None
+    if spec.children is not None:
+        children = []
+        for ks in spec.children:
+            k, i = _unflatten_col(ks, arrays, i)
+            children.append(k)
+    return Column(spec.type, vals, nulls, spec.dictionary, spec.vrange,
+                  spec.ascending, hi=hi, children=children), i
 
 
 def flatten_page(page: Page) -> Tuple[List[jnp.ndarray], PageSpec]:
     arrays: List[jnp.ndarray] = []
-    has_nulls = []
-    has_hi = []
-    for c in page.columns:
-        if c.type.is_nested:
-            raise NotImplementedError(
-                "array/map columns across the jit page boundary")
-        arrays.append(c.values)
-        if c.nulls is not None:
-            arrays.append(c.nulls)
-            has_nulls.append(True)
-        else:
-            has_nulls.append(False)
-        if c.hi is not None:
-            arrays.append(c.hi)
-            has_hi.append(True)
-        else:
-            has_hi.append(False)
+    col_specs = [_flatten_col(c, arrays) for c in page.columns]
     if page.sel is not None:
         arrays.append(page.sel)
-    spec = PageSpec(
-        [c.type for c in page.columns],
-        [c.dictionary for c in page.columns],
-        has_nulls,
-        page.sel is not None,
-        [c.vrange for c in page.columns],
-        [c.ascending for c in page.columns],
-        page.live_prefix,
-        has_hi,
-    )
-    return arrays, spec
+    return arrays, PageSpec(col_specs, page.sel is not None, page.live_prefix)
 
 
 def unflatten_page(spec: PageSpec, arrays: List[jnp.ndarray]) -> Page:
     cols: List[Column] = []
     i = 0
-    vranges = spec.vranges or [None] * len(spec.types)
-    asc = spec.ascending or [False] * len(spec.types)
-    has_hi = spec.has_hi or [False] * len(spec.types)
-    for t, d, hn, vr, a, hh in zip(
-            spec.types, spec.dictionaries, spec.has_nulls, vranges, asc, has_hi):
-        vals = arrays[i]
-        i += 1
-        nulls = None
-        if hn:
-            nulls = arrays[i]
-            i += 1
-        hi = None
-        if hh:
-            hi = arrays[i]
-            i += 1
-        cols.append(Column(t, vals, nulls, d, vr, a, hi=hi))
+    for cs in spec.col_specs:
+        c, i = _unflatten_col(cs, arrays, i)
+        cols.append(c)
     sel = arrays[i] if spec.has_sel else None
     return Page(cols, sel, live_prefix=spec.live_prefix)

@@ -271,6 +271,20 @@ def _to_float128(lv: LoweredVal, t: T.Type) -> jnp.ndarray:
 
 def _numeric_align(av, at: T.Type, bv, bt: T.Type):
     """Bring two numeric/date arrays to a common comparable representation."""
+    if at.is_timestamp or bt.is_timestamp:
+        # timestamps compare at the MAX precision; DATE promotes to the
+        # other side's timestamp unit (UTC midnight)
+        pa = at.precision if isinstance(at, T.TimestampType) else None
+        pb = bt.precision if isinstance(bt, T.TimestampType) else None
+        p = max(x for x in (pa, pb) if x is not None)
+
+        def up(v, t):
+            if t == T.DATE:
+                return v.astype(jnp.int64) * (86_400 * 10**p)
+            assert isinstance(t, T.TimestampType)
+            return v.astype(jnp.int64) * (10 ** (p - t.precision))
+
+        return up(av, at), up(bv, bt)
     if at.is_decimal or bt.is_decimal:
         sa = at.scale if isinstance(at, T.DecimalType) else 0
         sb = bt.scale if isinstance(bt, T.DecimalType) else 0
@@ -724,8 +738,78 @@ def _lower_starts_with(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     return _vocab_lut(ctx, x, lambda v: v.startswith(prefix), np.bool_)
 
 
+def _lower_binary_fn(kind: str):
+    """varbinary scalar family over the hex-string dictionary (reference:
+    operator/scalar/VarbinaryFunctions.java): to_hex/from_hex/to_utf8/
+    from_utf8/md5/sha256 are all vocabulary transforms."""
+    import hashlib
+
+    def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+        x = lower(expr.args[0], ctx)
+        if kind == "to_hex":
+            return _vocab_transform(ctx, x, lambda h: h.upper())
+        if kind == "from_hex":
+            # dictionary-wide evaluation sees vocab entries of rows the
+            # query may never touch: an invalid entry must not abort the
+            # host transform. Invalid codes become NULL slots and a
+            # deferred INVALID_FUNCTION_ARGUMENT fires iff a LIVE row
+            # actually references one (correct-or-error, never silent).
+            vocab = x.dictionary.values if x.dictionary is not None else []
+            bad_codes = []
+            mapped = []
+            for i, s in enumerate(vocab):
+                try:
+                    mapped.append(bytes.fromhex(s).hex())
+                except ValueError:
+                    mapped.append("")
+                    bad_codes.append(i)
+            out = _vocab_transform(
+                ctx, x, lambda s, _m=dict(zip(vocab, mapped)): _m.get(s, ""))
+            if bad_codes:
+                bad = jnp.isin(x.vals, jnp.asarray(np.array(bad_codes, np.int32)))
+                ctx.add_error(INVALID_FUNCTION_ARGUMENT, bad, x.valid)
+                valid = (x.valid if x.valid is not None
+                         else jnp.ones(ctx.num_rows, bool)) & ~bad
+                out = LoweredVal(out.vals, valid, out.dictionary)
+            return out
+        if kind == "to_utf8":
+            return _vocab_transform(ctx, x, lambda s: s.encode().hex())
+        if kind == "from_utf8":
+            return _vocab_transform(
+                ctx, x, lambda h: bytes.fromhex(h).decode(errors="replace"))
+        digest = {"md5": hashlib.md5, "sha256": hashlib.sha256}[kind]
+        return _vocab_transform(
+            ctx, x, lambda h: digest(bytes.fromhex(h)).hexdigest())
+
+    return fn
+
+
+def _lower_row_ctor(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """ROW(a, b, ...): one child column per field, same row count as the
+    parent (reference: RowBlock — field blocks share positions). The row
+    value itself is non-null; field nulls live in the children."""
+    items = [lower(a, ctx) for a in expr.args]
+    return LoweredVal(
+        jnp.zeros((ctx.num_rows,), jnp.int8), None, None, children=items)
+
+
+def _lower_row_field(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """row[i] field access (1-based constant ordinal). A NULL row makes
+    every field NULL (reference: DereferenceExpression null semantics)."""
+    base = lower(expr.args[0], ctx)
+    idx_e = expr.args[1]
+    assert isinstance(idx_e, ir.Constant)
+    field = base.children[int(idx_e.value) - 1]
+    valid = and_valid(base.valid, field.valid)
+    return LoweredVal(field.vals, valid, field.dictionary,
+                      children=field.children, hi=field.hi)
+
+
 def _lower_length(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     x = lower(expr.args[0], ctx)
+    if expr.args[0].type.is_varbinary:
+        # dictionary entries are hex: two hex digits per byte
+        return _vocab_lut(ctx, x, lambda s: len(s) // 2, np.int64)
     return _vocab_lut(ctx, x, len, np.int64)
 
 
@@ -801,9 +885,34 @@ def _lower_coalesce(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     return acc
 
 
+def _ts_split(vals, t: T.Type):
+    """Timestamp storage -> (epoch days, in-day unit remainder, unit/sec).
+    Floor semantics keep pre-epoch instants on the correct day."""
+    assert isinstance(t, T.TimestampType)
+    unit = 10 ** t.precision
+    day = 86_400 * unit
+    v = vals.astype(jnp.int64)
+    days = jnp.floor_divide(v, day)
+    rem = v - days * day
+    return days.astype(jnp.int32), rem, unit
+
+
 def _lower_extract(field: str):
     def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
         a = lower(expr.args[0], ctx)
+        t = expr.args[0].type
+        if isinstance(t, T.TimestampType):
+            days, rem, unit = _ts_split(a.vals, t)
+            if field in ("hour", "minute", "second"):
+                secs = rem // unit
+                out = {"hour": secs // 3600,
+                       "minute": (secs // 60) % 60,
+                       "second": secs % 60}[field].astype(jnp.int64)
+                return LoweredVal(out, a.valid, None)
+            out = getattr(dt, f"extract_{field}")(days)
+            return LoweredVal(out, a.valid, None)
+        if field in ("hour", "minute", "second"):
+            raise NotImplementedError(f"extract({field}) over {t}")
         out = getattr(dt, f"extract_{field}")(a.vals)
         return LoweredVal(out, a.valid, None)
 
@@ -813,6 +922,14 @@ def _lower_extract(field: str):
 def _lower_date_add_months(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     a = lower(expr.args[0], ctx)
     n = lower(expr.args[1], ctx)
+    t = expr.args[0].type
+    if isinstance(t, T.TimestampType):
+        # shift the DAY part through the calendar; the in-day time-of-day
+        # remainder is calendar-invariant
+        days, rem, unit = _ts_split(a.vals, t)
+        new_days = dt.add_months(days, n.vals).astype(jnp.int64)
+        out = new_days * (86_400 * unit) + rem
+        return LoweredVal(out, and_valid(a.valid, n.valid), None)
     out = dt.add_months(a.vals, n.vals).astype(jnp.int32)
     return LoweredVal(out, and_valid(a.valid, n.valid), None)
 
@@ -1058,11 +1175,49 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
     if ft == T.UNKNOWN:
         # typed NULL: every row invalid, representation per target type
         dtype = tt.np_dtype if tt.np_dtype is not None else np.dtype(np.int32)
+        if tt.is_nested:
+            def null_child(ct: T.Type, n: int) -> LoweredVal:
+                cd = ct.np_dtype if ct.np_dtype is not None else np.dtype(np.int32)
+                # a ROW child's fields share its row count; array/map
+                # children have zero flat elements (lengths are all 0)
+                kids = ([null_child(k, n if isinstance(ct, T.RowType) else 0)
+                         for k in T.type_children(ct)]
+                        if ct.is_nested else None)
+                vals = (jnp.full((n,), NULL_CODE, jnp.int32) if ct.is_varchar
+                        else jnp.zeros((n,), cd))
+                return LoweredVal(
+                    vals, jnp.zeros((n,), bool),
+                    Dictionary([]) if ct.is_varchar else None, children=kids)
+
+            n = ctx.num_rows
+            flat_n = n if isinstance(tt, T.RowType) else 0
+            kids = [null_child(k, flat_n) for k in T.type_children(tt)]
+            return LoweredVal(
+                _const_array(ctx, dtype, 0),
+                jnp.zeros((n,), bool), None, children=kids)
         return LoweredVal(
             _const_array(ctx, dtype, 0),
             jnp.zeros((ctx.num_rows,), bool),
             Dictionary([]) if tt.is_varchar else None,
         )
+    if isinstance(tt, T.TimestampType):
+        if isinstance(ft, T.TimestampType):
+            # precision rescale (round half up, like decimal rescale —
+            # reference TimestampType cast semantics); the with-time-zone
+            # flip is representation-free (UTC storage both sides)
+            v = _rescale_decimal(
+                a.vals.astype(jnp.int64), ft.precision, tt.precision)
+            return LoweredVal(v.astype(jnp.int64), a.valid, None)
+        if ft == T.DATE:
+            return LoweredVal(
+                a.vals.astype(jnp.int64) * (86_400 * 10**tt.precision),
+                a.valid, None)
+        raise NotImplementedError(f"cast {ft} -> {tt}")
+    if tt == T.DATE and isinstance(ft, T.TimestampType):
+        unit = 86_400 * 10**ft.precision
+        return LoweredVal(
+            jnp.floor_divide(a.vals.astype(jnp.int64), unit).astype(jnp.int32),
+            a.valid, None)
     if tt.is_floating:
         if a.hi is not None:
             return LoweredVal(_to_float128(a, ft).astype(tt.np_dtype), a.valid, None)
@@ -1113,6 +1268,14 @@ def _lower_cast(expr: ir.Cast, ctx: LowerCtx) -> LoweredVal:
     if tt == T.DATE and ft.is_varchar:
         raise NotImplementedError("cast(varchar as date) lowering: not yet supported")
     if tt.is_varchar:
+        # varbinary and varchar share the dictionary layout but NOT the
+        # encoding (hex vs text): cast re-encodes through the vocabulary
+        # (reference: VarbinaryFunctions' varchar<->varbinary casts = utf8)
+        if ft.is_varchar and ft.is_varbinary and not tt.is_varbinary:
+            return _vocab_transform(
+                ctx, a, lambda h: bytes.fromhex(h).decode(errors="replace"))
+        if ft.is_varchar and not ft.is_varbinary and tt.is_varbinary:
+            return _vocab_transform(ctx, a, lambda s: s.encode().hex())
         if ft.is_varchar:  # varchar(n) <-> varchar: same codes/dictionary
             return LoweredVal(a.vals, a.valid, a.dictionary)
         raise NotImplementedError("cast to varchar lowering: not yet supported")
@@ -1867,6 +2030,14 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "ltrim": _lower_str_fn(str.lstrip),
     "rtrim": _lower_str_fn(str.rstrip),
     "length": _lower_length,
+    "row_ctor": _lower_row_ctor,
+    "row_field": _lower_row_field,
+    "to_hex": _lower_binary_fn("to_hex"),
+    "from_hex": _lower_binary_fn("from_hex"),
+    "to_utf8": _lower_binary_fn("to_utf8"),
+    "from_utf8": _lower_binary_fn("from_utf8"),
+    "md5": _lower_binary_fn("md5"),
+    "sha256": _lower_binary_fn("sha256"),
     "concat": _lower_concat,
     "sqrt": _lower_math1(jnp.sqrt),
     "cbrt": _lower_math1(jnp.cbrt),
@@ -1885,6 +2056,9 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "least": _lower_extremum(False),
     "extract_year": _lower_extract("year"),
     "extract_month": _lower_extract("month"),
+    "extract_hour": _lower_extract("hour"),
+    "extract_minute": _lower_extract("minute"),
+    "extract_second": _lower_extract("second"),
     "extract_day": _lower_extract("day"),
     "extract_quarter": _lower_extract("quarter"),
     "extract_dow": _lower_extract("dow"),

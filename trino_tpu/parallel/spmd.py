@@ -39,7 +39,7 @@ from trino_tpu.connector import spi as spi_mod
 from trino_tpu.data.page import Column, Page
 from trino_tpu.data import page as page_mod
 from trino_tpu.exec.executor import Executor, QueryError
-from trino_tpu.exec.page_tree import PageSpec, flatten_page, unflatten_page
+from trino_tpu.exec.page_tree import ColSpec, PageSpec, flatten_page, unflatten_page
 from trino_tpu.ops import aggregate as agg_ops
 from trino_tpu.ops import groupby as gb
 from trino_tpu.sql.planner import plan as P
@@ -492,29 +492,20 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
             ]
         )
         arrays = []
-        types = []
-        dicts = []
-        has_nulls = []
-        has_hi = []
+        col_specs = []
         vranges = [c.vrange for c in shard_pages[0]]
-        for (vals, nulls, hi, d), typ in zip(stacked_cols, node.column_types):
+        for (vals, nulls, hi, d), typ, vr in zip(
+                stacked_cols, node.column_types, vranges):
             arrays.append(vals)
-            types.append(typ)
-            dicts.append(d)
             if nulls is not None:
                 arrays.append(nulls)
-                has_nulls.append(True)
-            else:
-                has_nulls.append(False)
             if hi is not None:
                 arrays.append(hi)
-                has_hi.append(True)
-            else:
-                has_hi.append(False)
+            col_specs.append(ColSpec(
+                typ, d, nulls is not None, vr, has_hi=hi is not None))
         arrays.append(sel)
         staged[node.id] = arrays
-        specs[node.id] = PageSpec(types, dicts, has_nulls, True, vranges,
-                                  has_hi=has_hi)
+        specs[node.id] = PageSpec(col_specs, True)
         node.runtime_rows = total_rows  # staged truth for capacity estimates
     return staged, specs
 
@@ -557,6 +548,15 @@ class DistributedQuery:
         from trino_tpu.sql.planner import stats
 
         n_devices = mesh.devices.size
+        # a ROOT-level ORDER BY over nested (array/map/row) outputs cannot
+        # sort under tracing (the nested host-sort fallback needs concrete
+        # arrays); peel it off the traced plan and apply it host-side after
+        # the gather — semantically identical (the sort is the last step)
+        post_sort = None
+        if (isinstance(root.source, P.SortNode)
+                and any(t.is_nested for t in root.source.output_types)):
+            post_sort = list(root.source.sort_channels)
+            root = P.OutputNode(root.source.source, root.column_names)
         t0 = _time.perf_counter()
         dyn = host_eval.resolve_dynamic_filters(session, root)
         phase1_s = _time.perf_counter() - t0
@@ -575,6 +575,7 @@ class DistributedQuery:
         dq.df_apply_s = prof.get("df_apply_s", 0.0)
         dq._layout = layout
         dq._specs = specs
+        dq._post_sort = post_sort
         dq._jit()
         return dq
 
@@ -632,5 +633,11 @@ class DistributedQuery:
             raise_query_errors(codes, error_flags)
             # results are replicated across shards post-gather: take shard 0
             local = [np.asarray(a)[0] for a in out_arrays]
-            return unflatten_page(self.out_spec_cell[0], local)
+            page = unflatten_page(self.out_spec_cell[0], local)
+            post_sort = getattr(self, "_post_sort", None)
+            if post_sort is not None:
+                from trino_tpu.exec.executor import Executor
+
+                page = Executor(self.session).sorted_page(page, post_sort)
+            return page
         raise QueryError("capacity still exceeded after recompiles (join or exchange bucket)")

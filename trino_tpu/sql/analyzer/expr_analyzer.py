@@ -35,6 +35,60 @@ AGGREGATE_FUNCTIONS = {
 
 _MONTH_UNITS = {"year": 12, "month": 1}
 _DAY_UNITS = {"day": 1}
+_SECOND_UNITS = {"day": 86_400, "hour": 3_600, "minute": 60, "second": 1}
+
+
+def _zone_offset_seconds(zone: str) -> int:
+    """Fixed-offset zone id -> seconds east of UTC. 'UTC'/'Z' and
+    '[+-]HH:MM' are supported; region ids with DST rules would need
+    per-value offsets (documented limitation)."""
+    z = zone.strip().upper()
+    if z in ("UTC", "Z", "+00:00", "-00:00"):
+        return 0
+    import re as _re
+
+    m = _re.fullmatch(r"([+-])(\d{2}):(\d{2})", z)
+    if not m:
+        raise AnalysisError(
+            f"unsupported time zone {zone!r} (fixed offsets and UTC only)")
+    sign = 1 if m.group(1) == "+" else -1
+    return sign * (int(m.group(2)) * 3600 + int(m.group(3)) * 60)
+
+
+def _timestamp_literal(text: str) -> ir.Constant:
+    """TIMESTAMP 'YYYY-MM-DD hh:mm:ss[.fff][+HH:MM]' — precision inferred
+    from the fractional digits (0 -> 0, <=3 -> 3, <=6 -> 6, else 9); a
+    trailing offset makes it WITH TIME ZONE, normalized to UTC storage
+    (reference: TimestampType literal analysis)."""
+    s = text.strip().replace(" ", "T", 1) if " " in text.strip() else text.strip()
+    try:
+        v = datetime.datetime.fromisoformat(s)
+    except ValueError:
+        raise AnalysisError(f"invalid timestamp literal {text!r}") from None
+    frac = ""
+    if "." in s:
+        tail = s.split(".", 1)[1]
+        frac = "".join(c for c in tail if c.isdigit())
+        # fromisoformat keeps at most 6 digits; count the written ones
+        for sep in ("+", "-", "Z"):
+            i = tail.find(sep, 1)
+            if i > 0:
+                frac = "".join(c for c in tail[:i] if c.isdigit())
+                break
+    p = 0 if not frac else (3 if len(frac) <= 3 else (6 if len(frac) <= 6 else 9))
+    with_tz = v.tzinfo is not None
+    if with_tz:
+        v = v.astimezone(datetime.timezone.utc).replace(tzinfo=None)
+    epoch = datetime.datetime(1970, 1, 1)
+    delta = v - epoch
+    micros = (delta.days * 86_400_000_000 + delta.seconds * 1_000_000
+              + delta.microseconds)
+    unit = 10 ** p
+    extra = 0
+    if p == 9 and len(frac) > 6:
+        extra = int(frac[6:9].ljust(3, "0"))
+    value = micros * unit // 1_000_000 + extra
+    return ir.Constant(T.timestamp(p, with_tz), value)
 
 
 def analyze_literal(lit: ast.Literal) -> ir.Constant:
@@ -47,6 +101,16 @@ def analyze_literal(lit: ast.Literal) -> ir.Constant:
     if lit.kind == "date":
         days = (datetime.date.fromisoformat(lit.value) - datetime.date(1970, 1, 1)).days
         return ir.Constant(T.DATE, days)
+    if lit.kind == "timestamp":
+        return _timestamp_literal(str(lit.value))
+    if lit.kind == "varbinary":
+        hexs = str(lit.value).replace(" ", "").lower()
+        try:
+            bytes.fromhex(hexs)
+        except ValueError:
+            raise AnalysisError(f"invalid varbinary literal X'{lit.value}'") from None
+        # dictionary repr is the hex string (see types.VARBINARY)
+        return ir.Constant(T.VARBINARY, hexs)
     if lit.kind == "number":
         text = str(lit.value)
         if "e" in text.lower():
@@ -296,9 +360,29 @@ class ExprAnalyzer:
             return ir.Cast(target, inner)
         if isinstance(e, ast.Extract):
             v = self.analyze(e.value)
-            if e.field not in ("year", "month", "day", "quarter"):
+            time_fields = ("hour", "minute", "second")
+            if e.field in time_fields:
+                if not isinstance(v.type, T.TimestampType):
+                    raise AnalysisError(f"EXTRACT({e.field}) needs a timestamp")
+            elif e.field not in ("year", "month", "day", "quarter"):
                 raise AnalysisError(f"EXTRACT({e.field}) not supported yet")
             return ir.Call(T.BIGINT, f"extract_{e.field}", (v,))
+        if isinstance(e, ast.AtTimeZone):
+            v = self.analyze(e.value)
+            _zone_offset_seconds(e.zone.strip())  # validate the zone id
+            if isinstance(v.type, T.TimestampType) and v.type.with_tz:
+                # instant unchanged; zone is rendering metadata (UTC here)
+                return v
+            if v.type == T.DATE:
+                v = ir.Cast(T.timestamp(0), v)
+            if not isinstance(v.type, T.TimestampType):
+                raise AnalysisError("AT TIME ZONE needs a timestamp")
+            # Reference semantics (DateTimeFunctions.atTimeZone): the plain
+            # timestamp is a wall-clock reading in the SESSION zone (UTC
+            # here), so the INSTANT is unchanged — only the rendering zone
+            # becomes `zone`, and this engine renders tz values in UTC.
+            p = v.type.precision
+            return ir.Cast(T.timestamp(p, True), v)
         if isinstance(e, ast.ArrayConstructor):
             items = tuple(self.analyze(x) for x in e.items)
             et = T.UNKNOWN
@@ -324,6 +408,16 @@ class ExprAnalyzer:
             if isinstance(base.type, T.MapType):
                 self._check_comparable(base.type.key, idx.type, "[]")
                 return ir.Call(base.type.value, "map_subscript", (base, idx))
+            if isinstance(base.type, T.RowType):
+                # row[i]: 1-based CONSTANT field ordinal (reference:
+                # RowType subscript / DereferenceExpression)
+                if not isinstance(idx, ir.Constant) or idx.value is None:
+                    raise AnalysisError("row subscript must be a constant")
+                i = int(idx.value)
+                if not 1 <= i <= len(base.type.field_types):
+                    raise AnalysisError(f"row field index {i} out of range")
+                return ir.Call(base.type.field_types[i - 1], "row_field",
+                               (base, ir.Constant(T.INTEGER, i)))
             raise AnalysisError(f"cannot subscript {base.type}")
         if isinstance(e, ast.FunctionCall):
             return self._analyze_function(e)
@@ -341,13 +435,20 @@ class ExprAnalyzer:
                 base = self.analyze(left_ast)
                 iv = right_ast
                 mult = iv.sign * (1 if e.op == "+" else -1)
-                if base.type not in (T.DATE, T.TIMESTAMP):
+                is_ts = isinstance(base.type, T.TimestampType)
+                if base.type != T.DATE and not is_ts:
                     raise AnalysisError("interval arithmetic requires a date/timestamp")
                 if iv.unit in _MONTH_UNITS:
                     months = iv.value * _MONTH_UNITS[iv.unit] * mult
                     return ir.Call(
                         base.type, "date_add_months", (base, ir.Constant(T.INTEGER, months))
                     )
+                if is_ts and iv.unit in _SECOND_UNITS:
+                    # day-time intervals over timestamps add in storage units
+                    n = (iv.value * _SECOND_UNITS[iv.unit] * mult
+                         * 10 ** base.type.precision)
+                    return ir.Call(
+                        base.type, "add", (base, ir.Constant(T.BIGINT, n)))
                 if iv.unit == "day":
                     return ir.Call(
                         base.type,
@@ -410,6 +511,19 @@ class ExprAnalyzer:
             return ir.Call(T.varchar(), name, args)
         if name == "length":
             return ir.Call(T.BIGINT, "length", args)
+        if name in ("to_hex", "from_utf8"):
+            if len(args) != 1 or not args[0].type.is_varbinary:
+                raise AnalysisError(f"{name}(varbinary)")
+            return ir.Call(T.varchar(), name, args)
+        if name in ("from_hex", "to_utf8"):
+            if len(args) != 1 or not args[0].type.is_varchar \
+                    or args[0].type.is_varbinary:
+                raise AnalysisError(f"{name}(varchar)")
+            return ir.Call(T.VARBINARY, name, args)
+        if name in ("md5", "sha256"):
+            if len(args) != 1 or not args[0].type.is_varbinary:
+                raise AnalysisError(f"{name}(varbinary)")
+            return ir.Call(T.VARBINARY, name, args)
         if name in ("round", "ceil", "ceiling", "floor"):
             return ir.Call(args[0].type if args[0].type.is_decimal else T.DOUBLE if args[0].type.is_floating else T.BIGINT, name, args)
         if name in ("sqrt", "cbrt", "ln", "log2", "log10", "exp"):
@@ -642,6 +756,13 @@ class ExprAnalyzer:
             return ir.Call(
                 T.map_of(args[0].type.element, args[1].type.element), "map_ctor", args
             )
+        if name == "row":
+            if not args:
+                raise AnalysisError("row() needs at least one field")
+            if any(a.type == T.UNKNOWN for a in args):
+                raise AnalysisError("row() fields must be typed (cast NULLs)")
+            return ir.Call(
+                T.row_of([(None, a.type) for a in args]), "row_ctor", args)
         raise AnalysisError(f"unknown function: {name}")
 
     @staticmethod

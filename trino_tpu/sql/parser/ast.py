@@ -206,6 +206,15 @@ class Subscript(Expression):
     index: Expression
 
 
+@dataclasses.dataclass(frozen=True)
+class AtTimeZone(Expression):
+    """value AT TIME ZONE 'zone' (reference: grammar atTimeZone +
+    DateTimeFunctions.timeZone*)."""
+
+    value: Expression
+    zone: str
+
+
 # --- relations -------------------------------------------------------------
 
 

@@ -641,12 +641,25 @@ class Parser:
 
     def primary(self) -> ast.Expression:
         e = self._primary_base()
-        while self.at_op("["):
-            self.advance()
-            idx = self.expr()
-            self.expect_op("]")
-            e = ast.Subscript(e, idx)
-        return e
+        while True:
+            if self.at_op("["):
+                self.advance()
+                idx = self.expr()
+                self.expect_op("]")
+                e = ast.Subscript(e, idx)
+                continue
+            if (self.at_kw("at") or self.at_soft("at")) \
+                    and self.at_soft("time", ahead=1) \
+                    and self.at_soft("zone", ahead=2):
+                self.advance()
+                self.advance()
+                self.advance()
+                z = self.advance()
+                if z.kind != "string":
+                    raise ParseError(f"expected time zone string at {z.pos}")
+                e = ast.AtTimeZone(e, z.text)
+                continue
+            return e
 
     def _primary_base(self) -> ast.Expression:
         t = self.peek()
@@ -683,6 +696,9 @@ class Parser:
         if self.at_kw("timestamp") and self.peek(1).kind == "string":
             self.advance()
             return ast.Literal("timestamp", self.advance().text)
+        if t.kind == "ident" and t.lower == "x" and self.peek(1).kind == "string":
+            self.advance()
+            return ast.Literal("varbinary", self.advance().text)
         if self.at_kw("interval"):
             self.advance()
             sign = 1
@@ -894,7 +910,15 @@ class Parser:
 
     def type_name(self) -> str:
         base = self.advance().text
-        if base.lower() in ("array", "map", "row") and self.at_op("("):
+        if base.lower() == "row" and self.at_op("("):
+            # row fields: [name] type, ...
+            self.advance()
+            fields = [self._row_field()]
+            while self.accept_op(","):
+                fields.append(self._row_field())
+            self.expect_op(")")
+            return f"{base}({', '.join(fields)})"
+        if base.lower() in ("array", "map") and self.at_op("("):
             self.advance()
             args = [self.type_name()]
             while self.accept_op(","):
@@ -910,4 +934,20 @@ class Parser:
                 parts.append(self.advance().text)
             self.expect_op(")")
             parts.append(")")
+        if base.lower() == "timestamp" and self.at_kw("with"):
+            # timestamp [(p)] WITH TIME ZONE
+            self.advance()
+            if not (self.accept_soft("time") and self.accept_soft("zone")):
+                raise ParseError("expected TIME ZONE after WITH")
+            parts.append(" with time zone")
         return "".join(parts)
+
+    def _row_field(self) -> str:
+        """One ROW type field: ``name type`` or bare ``type``."""
+        nxt = self.peek(1)
+        if self.peek().kind == "ident" and (
+                nxt.kind in ("ident", "kw")
+                or (nxt.kind == "op" and nxt.text not in (",", ")", "("))):
+            name = self.advance().text
+            return f"{name} {self.type_name()}"
+        return self.type_name()

@@ -1516,10 +1516,19 @@ def _constant_fraction(e: ir.Expr, fn: str) -> float:
 
 def _rescale(c: ir.Constant, target: T.Type):
     """Convert a constant's storage repr to the target column type's repr
-    (int -> scaled decimal, decimal scale change, int -> float)."""
+    (int -> scaled decimal, decimal scale change, int -> float,
+    timestamp precision change, date -> timestamp)."""
     v = c.value
     if v is None:
         return None
+    if isinstance(target, T.TimestampType):
+        # unit counts rescale like decimal scales; DATE promotes through
+        # UTC midnight
+        if c.type == T.DATE:
+            return int(v) * 86_400 * 10**target.precision
+        assert isinstance(c.type, T.TimestampType), c.type
+        dp = target.precision - c.type.precision
+        return int(v) * 10**dp if dp >= 0 else int(v) // 10**(-dp)
     if target.is_decimal:
         if c.type.is_floating or isinstance(v, float):
             # scale BEFORE integer conversion, half away from zero

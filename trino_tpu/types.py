@@ -44,7 +44,23 @@ class Type:
 
     @property
     def is_varchar(self) -> bool:
-        return self.name.startswith("varchar") or self.name.startswith("char")
+        # "varchar-kind" = dictionary-coded on device (int32 codes, values
+        # host-side). VARBINARY deliberately rides the same machinery —
+        # its dictionary stores hex encodings, decoded to bytes at the
+        # boundary (to_python/literals) — so joins/grouping/serde work
+        # unchanged (reference: VarbinaryType is its own Block type there;
+        # here the fixed-width dictionary layout is the TPU-first choice
+        # for ALL variable-width values).
+        return (self.name.startswith("varchar") or self.name.startswith("char")
+                or self.name == "varbinary")
+
+    @property
+    def is_varbinary(self) -> bool:
+        return self.name == "varbinary"
+
+    @property
+    def is_timestamp(self) -> bool:
+        return self.name.startswith("timestamp")
 
     @property
     def is_decimal(self) -> bool:
@@ -89,10 +105,40 @@ BIGINT = Type("bigint", np.dtype(np.int64))
 REAL = Type("real", np.dtype(np.float32))
 DOUBLE = Type("double", np.dtype(np.float64))
 DATE = Type("date", np.dtype(np.int32))
-# TIMESTAMP(6) — microsecond precision, the engine default (reference supports
-# p in 0..12; picosecond tails are a later round).
-TIMESTAMP = Type("timestamp(6)", np.dtype(np.int64))
 UNKNOWN = Type("unknown", None)  # type of NULL literal before coercion
+# VARBINARY: dictionary-coded like varchar; dictionary entries are HEX
+# strings of the bytes (lexicographic hex order == bytes order, so sorts
+# and range comparisons agree with the reference's unsigned-byte order).
+VARBINARY = Type("varbinary", np.dtype(np.int32), orderable=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampType(Type):
+    """timestamp(p) [with time zone]. Reference: ``spi/type/TimestampType``
+    / ``TimestampWithTimeZoneType`` (p in 0..12 there; 0..9 here — the
+    picosecond tail would not fit the int64 epoch span). Storage: int64
+    count of 10^-p second units since the epoch, UTC. The tz variant
+    stores the UTC instant; zone is rendering metadata (the reference
+    packs a zone id per value — a fixed-offset subset is supported via
+    AT TIME ZONE)."""
+
+    precision: int = 6
+    with_tz: bool = False
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def timestamp(precision: int = 6, with_tz: bool = False) -> TimestampType:
+    if not 0 <= precision <= 9:
+        raise ValueError(f"timestamp precision out of range: {precision}")
+    name = f"timestamp({precision})" + (" with time zone" if with_tz else "")
+    return TimestampType(name=name, np_dtype=np.dtype(np.int64),
+                         precision=precision, with_tz=with_tz)
+
+
+# TIMESTAMP(6) — microsecond precision, the engine default.
+TIMESTAMP = timestamp(6)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -220,6 +266,11 @@ def type_children(t: Type):
     return []
 
 
+import re as _re
+
+_TS_RE = _re.compile(r"timestamp(?:\((\d+)\))?( with time zone)?")
+
+
 def parse_type(s: str) -> Type:
     """Parse a SQL type string, e.g. ``decimal(15,2)``, ``varchar(25)``."""
     s = s.strip().lower()
@@ -235,12 +286,16 @@ def parse_type(s: str) -> Type:
         "double precision": DOUBLE,
         "date": DATE,
         "timestamp": TIMESTAMP,
-        "timestamp(6)": TIMESTAMP,
         "varchar": VARCHAR,
+        "varbinary": VARBINARY,
         "unknown": UNKNOWN,
     }
     if s in simple:
         return simple[s]
+    m = _TS_RE.fullmatch(s)
+    if m:
+        p = int(m.group(1)) if m.group(1) is not None else 6
+        return timestamp(p, with_tz=m.group(2) is not None)
     if s.startswith("decimal(") and s.endswith(")"):
         p, sc = s[len("decimal(") : -1].split(",")
         return decimal(int(p), int(sc))
@@ -333,8 +388,14 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         k = common_super_type(a.key, b.key)
         v = common_super_type(a.value, b.value)
         return map_of(k, v) if k is not None and v is not None else None
-    if {a.name, b.name} == {"date", "timestamp(6)"}:
-        return TIMESTAMP
+    if isinstance(a, TimestampType) and isinstance(b, TimestampType):
+        if a.with_tz != b.with_tz:
+            return None
+        return timestamp(max(a.precision, b.precision), a.with_tz)
+    if a == DATE and isinstance(b, TimestampType) and not b.with_tz:
+        return b
+    if b == DATE and isinstance(a, TimestampType) and not a.with_tz:
+        return a
     return None
 
 
