@@ -149,3 +149,74 @@ def test_all_null_string_column_scans(session):
     pq.write_table(table, os.path.join(fs.root, "lake", "allnull.parquet"))
     out = session.execute("select k, s from lake.allnull order by k")
     assert out.rows == [(1, None), (2, None), (3, None)]
+
+
+# ------------------------------------------------------------------- ORC
+
+
+@pytest.fixture()
+def orc_session(tmp_path):
+    s = Session({"catalog": "filesystem", "schema": "lake"})
+    s.catalogs["filesystem"] = FileSystemConnector(
+        str(tmp_path), default_format="orc")
+    return s
+
+
+def test_orc_ctas_roundtrip_and_insert(orc_session):
+    """ORC write path (lib/trino-orc role): CTAS writes .orc, scans read
+    stripes, INSERT appends — results identical to the source rows."""
+    import os
+
+    orc_session.execute("""
+        create table lake.li_orc as
+        select l_orderkey, l_quantity, l_shipdate, l_returnflag
+        from tpch.tiny.lineitem where l_orderkey < 500
+    """)
+    root = orc_session.catalogs["filesystem"].root
+    assert os.path.exists(os.path.join(root, "lake", "li_orc.orc"))
+    got = orc_session.execute(
+        "select l_returnflag, count(*), sum(l_quantity) from li_orc "
+        "group by l_returnflag order by l_returnflag").rows
+    want = orc_session.execute(
+        "select l_returnflag, count(*), sum(l_quantity) "
+        "from tpch.tiny.lineitem where l_orderkey < 500 "
+        "group by l_returnflag order by l_returnflag").rows
+    assert got == want
+    orc_session.execute(
+        "insert into li_orc values (9999, 1.00, date '1999-01-01', 'N')")
+    (n,) = orc_session.execute(
+        "select count(*) from li_orc where l_orderkey = 9999").rows[0]
+    assert n == 1
+
+
+def test_orc_multi_stripe_scan(orc_session, tmp_path):
+    """Stripes are the scan granule: a small stripe_size forces several
+    stripes; every row survives the stripe-per-split scan."""
+    import pyarrow.orc as porc
+
+    tbl = pa.table({
+        "k": pa.array(range(20000), type=pa.int64()),
+        "v": pa.array([float(i) * 0.5 for i in range(20000)]),
+    })
+    d = tmp_path / "lake"
+    d.mkdir(exist_ok=True)
+    porc.write_table(tbl, str(d / "wide.orc"), stripe_size=4096)
+    f = porc.ORCFile(str(d / "wide.orc"))
+    assert f.nstripes > 1
+    got = orc_session.execute(
+        "select count(*), min(k), max(k), sum(v) from wide").rows
+    assert got == [(20000, 0, 19999, sum(i * 0.5 for i in range(20000)))]
+
+
+def test_orc_and_parquet_coexist(orc_session):
+    """Format follows the file extension: one schema can mix both."""
+    orc_session.execute("create table lake.t_orc as select 1 a")
+    # drop to parquet default for a second table via a parquet connector
+    # bound to the same root
+    pq_conn = FileSystemConnector(
+        orc_session.catalogs["filesystem"].root, default_format="parquet")
+    orc_session.catalogs["fs2"] = pq_conn
+    orc_session.execute("create table fs2.lake.t_pq as select 2 a")
+    assert orc_session.execute(
+        "select * from lake.t_orc union all select * from fs2.lake.t_pq "
+        "order by 1").rows == [(1,), (2,)]

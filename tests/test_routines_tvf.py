@@ -1,0 +1,174 @@
+"""SQL routines (CREATE FUNCTION) + table functions + phased scheduling.
+
+Reference test-strategy analogs: TestSqlFunctions / SqlRoutineCompiler
+tests (routines must behave exactly like their inlined bodies),
+TestSequenceFunction (operator/table/), and
+TestPhasedExecutionSchedule (probe stages wait on build stages).
+"""
+import time
+
+import pytest
+
+from trino_tpu import Session
+from trino_tpu.sql.routines import RoutineError
+
+
+@pytest.fixture()
+def s():
+    return Session({"catalog": "tpch", "schema": "tiny"})
+
+
+# ---------------------------------------------------------------- routines
+
+
+def test_udf_inlines_like_handwritten_sql(s):
+    s.execute("create function disc_price(p decimal(12,2), d decimal(12,2)) "
+              "returns double return cast(p * (1 - d) as double)")
+    got = s.execute("select sum(disc_price(l_extendedprice, l_discount)) "
+                    "from lineitem where l_orderkey < 100").rows
+    want = s.execute("select sum(cast(l_extendedprice * (1 - l_discount) as double)) "
+                     "from lineitem where l_orderkey < 100").rows
+    assert got == want
+
+
+def test_udf_nested_and_early_binding(s):
+    s.execute("create function base(x bigint) returns bigint return x + 1")
+    s.execute("create function outer_fn(x bigint) returns bigint "
+              "return base(x) * 10")
+    assert s.execute("select outer_fn(4)").rows == [(50,)]
+    # early binding: redefining base does NOT change outer_fn
+    s.execute("create or replace function base(x bigint) returns bigint "
+              "return x + 100")
+    assert s.execute("select outer_fn(4)").rows == [(50,)]
+    assert s.execute("select base(4)").rows == [(104,)]
+
+
+def test_udf_validation_and_lifecycle(s):
+    with pytest.raises(Exception):
+        s.execute("create function bad(x bigint) returns bigint return y + 1")
+    s.execute("create function f1(x bigint) returns bigint return x")
+    with pytest.raises(RoutineError):
+        s.execute("create function f1(x bigint) returns bigint return x")
+    s.execute("drop function f1")
+    with pytest.raises(Exception):
+        s.execute("select f1(1)")
+    s.execute("drop function if exists f1")  # no error
+    with pytest.raises(ValueError):
+        s.execute("drop function f1")
+
+
+def test_udf_argument_coercion(s):
+    """Arguments cast to the declared parameter types (the routine's
+    signature is a contract, like the reference's routine invocation)."""
+    s.execute("create function halve(x double) returns double return x / 2")
+    assert s.execute("select halve(5)").rows == [(2.5,)]  # int -> double
+
+
+def test_udf_shared_across_server_statements():
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    coord = CoordinatorServer()
+    coord.start()
+    w = WorkerServer(coordinator_url=coord.base_url, node_id="uw0")
+    w.start()
+    try:
+        assert coord.registry.wait_for_workers(1, timeout=15.0)
+        from trino_tpu.client.remote import StatementClient
+
+        client = StatementClient(
+            coord.base_url, {"catalog": "tpch", "schema": "tiny"})
+        client.execute("create function nkey2(k bigint) returns bigint "
+                       "return k * 2")
+        _cols, rows = client.execute(
+            "select nkey2(n_nationkey) from nation order by 1 limit 3")
+        assert [r[0] for r in rows] == [0, 2, 4]
+    finally:
+        w.stop()
+        coord.stop()
+
+
+# ----------------------------------------------------------- table functions
+
+
+def test_sequence_table_function(s):
+    rows = s.execute("select count(*), min(sequential_number), "
+                     "max(sequential_number) from table(sequence(1, 100))").rows
+    assert rows == [(100, 1, 100)]
+    rows = s.execute("select * from table(sequence(start => 5, stop => 9, "
+                     "step => 2)) as t(n)").rows
+    assert rows == [(5,), (7,), (9,)]
+    # joins against real tables like any relation
+    rows = s.execute(
+        "select n_name from table(sequence(0, 2)) t join nation "
+        "on sequential_number = n_nationkey order by n_name").rows
+    assert len(rows) == 3
+
+
+def test_sequence_guards(s):
+    with pytest.raises(Exception):
+        s.execute("select * from table(sequence(1, 100000000000))")
+    with pytest.raises(Exception):
+        s.execute("select * from table(no_such_fn(1))")
+
+
+def test_connector_table_function_spi(s):
+    """A connector can provide catalog-scoped table functions (the
+    ConnectorTableFunction seam)."""
+    from trino_tpu import types as T
+
+    conn = s.catalogs["tpch"]
+
+    def duplicated(args, named):
+        return ["v"], [T.BIGINT], [(int(args[0]),), (int(args[0]),)]
+
+    orig = conn.table_function
+    conn.table_function = lambda name: duplicated if name == "dup" else None
+    try:
+        assert s.execute("select * from table(dup(7))").rows == [(7,), (7,)]
+    finally:
+        conn.table_function = orig
+
+
+# --------------------------------------------------------- phased execution
+
+
+def test_phased_execution_waits_for_join_builds():
+    """The probe-side fragment must not schedule until its leaf build
+    fragment's tasks reached FLUSHING (reference:
+    PhasedExecutionSchedule)."""
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [WorkerServer(coordinator_url=coord.base_url, node_id=f"pw{i}")
+               for i in range(2)]
+    for w in workers:
+        w.start()
+    try:
+        assert coord.registry.wait_for_workers(2, timeout=15.0)
+        sql = ("select n_name, count(*) c from customer, nation "
+               "where c_nationkey = n_nationkey group by n_name "
+               "order by c desc limit 3")
+        q = coord.submit(sql, {"catalog": "tpch", "schema": "tiny"})
+        deadline = time.time() + 60
+        while not q.state.is_terminal() and time.time() < deadline:
+            time.sleep(0.05)
+        assert q.state.get() == "FINISHED", q.failure
+        # the join fragment logged a phase wait on the nation build fragment
+        assert getattr(q, "phase_waits", []), "no phase wait recorded"
+        local = Session({"catalog": "tpch", "schema": "tiny"}).execute(sql)
+        assert [tuple(r) for r in q.rows] == [tuple(r) for r in local.rows]
+        # phasing off: same results, no waits
+        q2 = coord.submit(sql, {"catalog": "tpch", "schema": "tiny",
+                                "phased_execution": False})
+        deadline = time.time() + 60
+        while not q2.state.is_terminal() and time.time() < deadline:
+            time.sleep(0.05)
+        assert q2.state.get() == "FINISHED", q2.failure
+        assert not getattr(q2, "phase_waits", [])
+    finally:
+        for w in workers:
+            w.stop()
+        coord.stop()

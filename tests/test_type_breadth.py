@@ -249,3 +249,29 @@ def test_array_of_rows_round_trip():
     data = [[(1, "x"), (2, "y")], [], [(3, "z")]]
     col = Column.from_python(art, data)
     assert Column.to_python(col) == data
+
+
+def test_date_diff_and_add(s):
+    rows = s.execute(
+        "select date_diff('day', date '2024-01-01', date '2024-03-01'), "
+        "date_diff('week', date '2024-01-01', date '2024-03-01'), "
+        "date_diff('hour', timestamp '2024-01-01 00:00:00', "
+        "          timestamp '2024-01-02 06:30:00'), "
+        "date_diff('month', date '2024-01-31', date '2024-03-30'), "
+        "date_diff('year', date '2020-06-01', date '2024-05-31')").rows
+    assert rows == [(60, 8, 30, 1, 3)]
+    rows = s.execute(
+        "select date_add('day', 5, date '2024-02-27'), "
+        "date_add('hour', -2, timestamp '2024-01-01 01:00:00'), "
+        "date_add('month', 1, date '2024-01-31')").rows
+    assert rows == [(datetime.date(2024, 3, 3),
+                     datetime.datetime(2023, 12, 31, 23, 0),
+                     datetime.date(2024, 2, 29))]
+
+
+def test_unixtime_round_trip(s):
+    rows = s.execute(
+        "select to_unixtime(timestamp '1970-01-02 00:00:00'), "
+        "from_unixtime(86400.5)").rows
+    assert rows == [(86400.0,
+                     datetime.datetime(1970, 1, 2, 0, 0, 0, 500000))]

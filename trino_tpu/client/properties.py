@@ -52,6 +52,13 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             bool, True,
         ),
         PropertyMetadata(
+            "phased_execution",
+            "delay probe-side fragments until their leaf join-build "
+            "fragments finish executing (reference: "
+            "execution-policy=phased / PhasedExecutionSchedule)",
+            bool, True,
+        ),
+        PropertyMetadata(
             "target_result_page_rows",
             "rows per result page on the client protocol",
             int, 10_000, _positive,

@@ -14,7 +14,7 @@ class Session:
     """A query session: catalogs, session properties, and an executor."""
 
     def __init__(self, properties: Optional[Dict[str, Any]] = None, num_partitions: int = 1,
-                 identity=None, access_control=None, catalogs=None):
+                 identity=None, access_control=None, catalogs=None, udfs=None):
         from trino_tpu.client.properties import defaulted
         from trino_tpu.connector.registry import default_catalogs
         from trino_tpu.server.security import AccessControl, Identity
@@ -29,6 +29,10 @@ class Session:
         self.access_control = access_control or AccessControl()
         # active explicit transaction (exec/transaction.py), or None
         self.transaction = None
+        # SQL routines (sql/routines.py): name -> UdfDef. Server mode
+        # shares one dict across sessions (like ``catalogs``) so CREATE
+        # FUNCTION persists between statements.
+        self.udfs = udfs if udfs is not None else {}
 
     def set_property(self, name: str, value: Any) -> None:
         """SET SESSION analog: typed/validated (client/properties.py;

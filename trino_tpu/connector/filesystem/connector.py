@@ -1,10 +1,12 @@
-"""Filesystem connector: Parquet tables on local disk.
+"""Filesystem connector: Parquet and ORC tables on local disk.
 
 Reference roles collapsed into one connector: ``lib/trino-parquet``
 (``ParquetReader.java:85`` — column readers, row-group pruning by min/max
-statistics), the lakehouse connectors' table layout (``plugin/trino-hive``:
+statistics), ``lib/trino-orc`` (``OrcReader`` — stripes as the scan
+granule), the lakehouse connectors' table layout (``plugin/trino-hive``:
 a table is a directory of files), and the write path
-(``ConnectorPageSink`` → parquet files).
+(``ConnectorPageSink`` → parquet/orc files). Format follows the file
+extension; writes use the connector's default_format.
 
 TPU-first notes: columns decode straight to the engine's storage reprs —
 strings dictionary-encode (pyarrow dictionary arrays pass through without
@@ -36,6 +38,12 @@ def _pq():
     import pyarrow.parquet  # noqa: PLC0415
 
     return pyarrow.parquet
+
+
+def _porc():
+    import pyarrow.orc  # noqa: PLC0415
+
+    return pyarrow.orc
 
 
 def _type_from_arrow(at) -> T.Type:
@@ -85,13 +93,26 @@ class FileSystemConnector(spi.Connector):
     # a run of row groups), like the reference's parquet writer block size
     ROW_GROUP_SIZE = 4096
 
-    def __init__(self, root: Optional[str] = None):
-        # schema = subdirectory of root, table = <name>.parquet inside it
+    def __init__(self, root: Optional[str] = None,
+                 default_format: str = "parquet"):
+        # schema = subdirectory of root, table = <name>.<format> inside it
         self.root = root or os.path.join(os.getcwd(), "fs_catalog")
+        assert default_format in ("parquet", "orc")
+        self.default_format = default_format
 
     # ------------------------------------------------------------- layout
     def _table_path(self, schema: str, table: str) -> str:
-        return os.path.join(self.root, schema, f"{table}.parquet")
+        """Existing table file (either format), else the default-format
+        path for writes."""
+        for ext in ("parquet", "orc"):
+            p = os.path.join(self.root, schema, f"{table}.{ext}")
+            if os.path.exists(p):
+                return p
+        return os.path.join(self.root, schema, f"{table}.{self.default_format}")
+
+    @staticmethod
+    def _is_orc(path: str) -> bool:
+        return path.endswith(".orc")
 
     def list_schemas(self) -> List[str]:
         if not os.path.isdir(self.root):
@@ -105,15 +126,17 @@ class FileSystemConnector(spi.Connector):
         d = os.path.join(self.root, schema)
         if not os.path.isdir(d):
             return []
-        return sorted(
-            f[: -len(".parquet")] for f in os.listdir(d) if f.endswith(".parquet")
-        )
+        return sorted({
+            f.rsplit(".", 1)[0] for f in os.listdir(d)
+            if f.endswith(".parquet") or f.endswith(".orc")
+        })
 
     def get_table(self, schema: str, table: str) -> Optional[spi.TableMetadata]:
         path = self._table_path(schema, table)
         if not os.path.exists(path):
             return None
-        arrow_schema = _pq().read_schema(path)
+        arrow_schema = (_porc().ORCFile(path).schema if self._is_orc(path)
+                        else _pq().read_schema(path))
         cols = [
             spi.ColumnMetadata(f.name, _type_from_arrow(f.type))
             for f in arrow_schema
@@ -124,6 +147,8 @@ class FileSystemConnector(spi.Connector):
         path = self._table_path(schema, table)
         if not os.path.exists(path):
             return None
+        if self._is_orc(path):
+            return _porc().ORCFile(path).nrows
         return _pq().ParquetFile(path).metadata.num_rows
 
     # ------------------------------------------------------------- splits
@@ -131,10 +156,23 @@ class FileSystemConnector(spi.Connector):
         self, schema: str, table: str, target_splits: int, constraint=None,
         handle=None,
     ) -> List[spi.Split]:
-        """One split per row-group run; row groups whose min/max statistics
-        contradict the constraint are pruned (ParquetReader's predicate
-        evaluation on column-chunk statistics)."""
+        """One split per row-group (parquet) or stripe (orc) run; parquet
+        row groups whose min/max statistics contradict the constraint are
+        pruned (ParquetReader's predicate evaluation on column-chunk
+        statistics; pyarrow exposes no stripe statistics, so orc scans
+        every stripe — correct, just unpruned)."""
         path = self._table_path(schema, table)
+        if self._is_orc(path):
+            n_stripes = _porc().ORCFile(path).nstripes
+            keep = list(range(n_stripes))
+            if not keep:
+                return [spi.Split(table, schema, 0, 0, info=())]
+            per = max(1, (len(keep) + max(target_splits, 1) - 1)
+                      // max(target_splits, 1))
+            return [
+                spi.Split(table, schema, 0, 0, info=tuple(keep[i : i + per]))
+                for i in range(0, len(keep), per)
+            ]
         pf = _pq().ParquetFile(path)
         md = pf.metadata
         keep = [
@@ -174,6 +212,20 @@ class FileSystemConnector(spi.Connector):
     # --------------------------------------------------------------- scan
     def scan(self, split: spi.Split, columns: List[str], constraint=None) -> Dict[str, spi.ColumnData]:
         path = self._table_path(split.schema, split.table)
+        if self._is_orc(path):
+            import pyarrow as pa
+
+            f = _porc().ORCFile(path)
+            stripes = (list(split.info) if split.info is not None
+                       else list(range(f.nstripes)))
+            if not stripes:
+                tbl = f.schema.empty_table().select(list(columns))
+            else:
+                parts = [f.read_stripe(i, columns=list(columns))
+                         for i in stripes]
+                tbl = (pa.Table.from_batches(parts) if parts
+                       else f.schema.empty_table().select(list(columns)))
+            return {name: _column_data(tbl.column(name)) for name in columns}
         pf = _pq().ParquetFile(path)
         if split.info is not None:
             row_groups = list(split.info)
@@ -202,10 +254,11 @@ class FileSystemConnector(spi.Connector):
             pycol = [_coerce_py(ctype, r[i]) for r in rows]
             arrays.append(pa.array(pycol, type=at))
             fields.append(pa.field(cname, at))
-        _pq().write_table(
-            pa.table(arrays, schema=pa.schema(fields)), path,
-            row_group_size=self.ROW_GROUP_SIZE,
-        )
+        tbl = pa.table(arrays, schema=pa.schema(fields))
+        if self._is_orc(path):
+            _porc().write_table(tbl, path, stripe_size=64 * 1024)
+        else:
+            _pq().write_table(tbl, path, row_group_size=self.ROW_GROUP_SIZE)
 
     def insert_rows(self, schema: str, table: str, rows) -> int:
         """Append by rewrite (single-file tables; the multi-file append is
@@ -215,16 +268,18 @@ class FileSystemConnector(spi.Connector):
         if meta is None:
             raise KeyError(f"{self.name}.{schema}.{table} does not exist")
         path = self._table_path(schema, table)
-        old = _pq().read_table(path)
+        old = (_porc().ORCFile(path).read() if self._is_orc(path)
+               else _pq().read_table(path))
         arrays = []
         for i, cm in enumerate(meta.columns):
             at = _arrow_from_type(cm.type)
             new = pa.array([_coerce_py(cm.type, r[i]) for r in rows], type=at)
             arrays.append(pa.concat_arrays([old.column(i).combine_chunks(), new]))
-        _pq().write_table(
-            pa.table(arrays, names=[c.name for c in meta.columns]), path,
-            row_group_size=self.ROW_GROUP_SIZE,
-        )
+        tbl = pa.table(arrays, names=[c.name for c in meta.columns])
+        if self._is_orc(path):
+            _porc().write_table(tbl, path, stripe_size=64 * 1024)
+        else:
+            _pq().write_table(tbl, path, row_group_size=self.ROW_GROUP_SIZE)
         return len(rows)
 
     def drop_table(self, schema: str, table: str) -> None:

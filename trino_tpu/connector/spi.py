@@ -345,6 +345,13 @@ class Connector:
         """Physical partitioning for co-located joins, if any."""
         return None
 
+    def table_function(self, name: str):
+        """Connector-provided table function, or None (reference:
+        spi/function/table/ConnectorTableFunction). The returned callable
+        takes (positional_args, named_args) and returns (column names,
+        column types, rows)."""
+        return None
+
     # --- splits (ConnectorSplitManager) ---
     def get_splits(
         self, schema: str, table: str, target_splits: int, constraint=None,

@@ -20,6 +20,11 @@ def plan_sql(session, sql: str):
         raise ValueError("use explain_query")
     if not isinstance(stmt, ast.Query):
         return stmt  # SHOW et al, handled by run_query
+    udfs = getattr(session, "udfs", None)
+    if udfs:
+        from trino_tpu.sql.routines import expand_udfs
+
+        stmt = expand_udfs(stmt, udfs)
     root = Planner(session).plan(stmt)
     return optimize(root, session)
 
@@ -71,6 +76,29 @@ def _dispatch_statement(session, stmt) -> QueryResult:
         return _insert(session, stmt)
     if isinstance(stmt, ast.DropTable):
         return _drop_table(session, stmt)
+    if isinstance(stmt, ast.CreateFunction):
+        from trino_tpu.sql.routines import (
+            RoutineError, UdfDef, expand_udfs, validate)
+
+        name = stmt.name[-1].lower()
+        if name in session.udfs and not stmt.or_replace:
+            raise RoutineError(f"function already exists: {name}")
+        # early binding: routine calls INSIDE the body expand at creation
+        # (so validation sees a closed expression and later redefinitions
+        # of inner routines don't change this one)
+        body = expand_udfs(stmt.body, session.udfs)
+        udf = UdfDef(name, tuple(stmt.params), stmt.returns, body)
+        validate(udf)
+        session.udfs[name] = udf
+        return QueryResult(["result"], [], [("CREATE FUNCTION",)])
+    if isinstance(stmt, ast.DropFunction):
+        name = stmt.name[-1].lower()
+        if name not in session.udfs:
+            if stmt.if_exists:
+                return QueryResult(["result"], [], [("DROP FUNCTION",)])
+            raise ValueError(f"function not found: {name}")
+        del session.udfs[name]
+        return QueryResult(["result"], [], [("DROP FUNCTION",)])
     if isinstance(stmt, ast.Prepare):
         # reference: execution/PrepareTask — the statement is stored parsed;
         # parameters bind at EXECUTE time (sql/tree/Parameter)
@@ -138,6 +166,11 @@ def _dispatch_statement(session, stmt) -> QueryResult:
         return _show_columns(session, stmt)
     if not isinstance(stmt, ast.Query):
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
+    udfs = getattr(session, "udfs", None)
+    if udfs:
+        from trino_tpu.sql.routines import expand_udfs
+
+        stmt = expand_udfs(stmt, udfs)
     root = Planner(session).plan(stmt)
     root = optimize(root, session)
     page = Executor(session).execute_checked(root)

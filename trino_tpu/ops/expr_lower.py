@@ -934,6 +934,54 @@ def _lower_date_add_months(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     return LoweredVal(out, and_valid(a.valid, n.valid), None)
 
 
+def _lower_date_diff_days(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    b = lower(expr.args[1], ctx)
+    per = int(expr.args[2].value)
+    d = (b.vals.astype(jnp.int64) - a.vals.astype(jnp.int64))
+    # truncate toward zero in day units (reference diffDate semantics)
+    q = jnp.sign(d) * (jnp.abs(d) // per)
+    return LoweredVal(q.astype(jnp.int64), and_valid(a.valid, b.valid), None)
+
+
+def _lower_ts_diff_units(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    b = lower(expr.args[1], ctx)
+    per = int(expr.args[2].value)
+    d = b.vals.astype(jnp.int64) - a.vals.astype(jnp.int64)
+    q = jnp.sign(d) * (jnp.abs(d) // per)
+    return LoweredVal(q.astype(jnp.int64), and_valid(a.valid, b.valid), None)
+
+
+def _lower_months_between(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """Whole calendar months from a to b, truncating partial months, then
+    divided by the unit multiplier (12 for years) — reference
+    DateTimeFunctions.diffDate month/year semantics."""
+    a = lower(expr.args[0], ctx)
+    b = lower(expr.args[1], ctx)
+    mul = int(expr.args[2].value)
+    ya, ma, da = dt.extract_year(a.vals), dt.extract_month(a.vals), dt.extract_day(a.vals)
+    yb, mb, db = dt.extract_year(b.vals), dt.extract_month(b.vals), dt.extract_day(b.vals)
+    months = (yb - ya) * 12 + (mb - ma)
+    # partial trailing month doesn't count — but the day-of-month compare
+    # CLAMPS to each end's month length, so Jan 31 -> Feb 29 is one full
+    # month (consistent with add_months' month-end clamp and the
+    # reference's Joda-style diffDate)
+    da_in_b = jnp.minimum(da, dt.days_in_month(yb, mb))
+    db_in_a = jnp.minimum(db, dt.days_in_month(ya, ma))
+    months = months - jnp.where((months > 0) & (db < da_in_b), 1, 0)
+    months = months + jnp.where((months < 0) & (db_in_a > da), 1, 0)
+    q = jnp.sign(months) * (jnp.abs(months) // mul)
+    return LoweredVal(q.astype(jnp.int64), and_valid(a.valid, b.valid), None)
+
+
+def _lower_seconds_to_ts3(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    a = lower(expr.args[0], ctx)
+    ms = a.vals.astype(jnp.float64) * 1000.0
+    v = (jnp.sign(ms) * jnp.floor(jnp.abs(ms) + 0.5)).astype(jnp.int64)
+    return LoweredVal(v, a.valid, None)
+
+
 def _lower_date_trunc(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     unit_e = expr.args[0]
     assert isinstance(unit_e, ir.Constant) and isinstance(unit_e.value, str), (
@@ -2065,6 +2113,10 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "extract_doy": _lower_extract("doy"),
     "extract_week": _lower_extract("week"),
     "date_add_months": _lower_date_add_months,
+    "date_diff_days": _lower_date_diff_days,
+    "ts_diff_units": _lower_ts_diff_units,
+    "months_between": _lower_months_between,
+    "seconds_to_ts3": _lower_seconds_to_ts3,
     "date_trunc": _lower_date_trunc,
     "replace": _lower_replace,
     "reverse": _lower_reverse,

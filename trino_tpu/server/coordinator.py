@@ -218,7 +218,37 @@ class QueryExecution:
                 raise RuntimeError(
                     "retry_policy=TASK requires the spooled exchange: set "
                     "TRINO_TPU_SPOOL_DIR to a cluster-shared directory")
+        # Phased execution (reference: scheduler/policy/
+        # PhasedExecutionSchedule): a fragment whose JOIN BUILD side is fed
+        # by a leaf (scan-only) fragment does not schedule until that build
+        # fragment's tasks finished executing (>= FLUSHING) — probe-side
+        # tasks then never sit on workers holding memory while builds
+        # compute. Leaf-only gating is deliberate: a build fragment that is
+        # itself a consumer may park on its own output watermark before
+        # FLUSHING, and gating on it could deadlock the pipeline.
+        # wire-protocol values arrive as header STRINGS: normalize like the
+        # typed property registry would ("false"/"0" disable)
+        phased = str(self.session_properties.get(
+            "phased_execution", True)).lower() not in ("false", "0", "no")
+        by_id = {f.id: f for f in fragments}
+        build_deps: Dict[int, List[int]] = {}
         for frag in fragments:
+            deps = []
+            for node in P.walk_plan(frag.root):
+                if isinstance(node, P.JoinNode) and isinstance(
+                        node.right, RemoteSourceNode):
+                    dep = by_id.get(node.right.fragment_id)
+                    if dep is not None and not any(
+                            isinstance(n, RemoteSourceNode)
+                            for n in P.walk_plan(dep.root)):
+                        deps.append(dep.id)
+            if deps:
+                build_deps[frag.id] = deps
+        self.phase_waits = []  # (fragment, [deps]) log for tests/EXPLAIN
+        for frag in fragments:
+            if phased and not fte and frag.id in build_deps:
+                self._await_build_fragments(build_deps[frag.id])
+                self.phase_waits.append((frag.id, build_deps[frag.id]))
             if frag.partitioning == "hash":
                 # one task per key partition (hash-distributed final
                 # aggregations and co-partitioned joins): task i pulls
@@ -404,6 +434,33 @@ class QueryExecution:
         ex = FragmentExecutor(session, {}, remote_pages)
         return ex.execute_checked(root_frag.root)
 
+    PHASE_WAIT_TIMEOUT = 300.0
+
+    def _await_build_fragments(self, dep_ids) -> None:
+        """Block until every task of the given (already-scheduled) build
+        fragments reports FLUSHING or later — its body is done and its
+        output is buffered/spooled, so probe tasks can start pulling
+        immediately (reference: PhasedExecutionSchedule's stage phases)."""
+        deadline = time.monotonic() + self.PHASE_WAIT_TIMEOUT
+        for fid in dep_ids:
+            for loc in self.fragment_tasks.get(fid, ()):
+                while time.monotonic() < deadline:
+                    try:
+                        status, body, _ = wire.http_request(
+                            "GET",
+                            f"{loc.base_url}/v1/task/{loc.task_id}/status",
+                            timeout=10.0)
+                        if status < 400:
+                            state = json.loads(body).get("state")
+                            if state in ("FLUSHING", "FINISHED", "FAILED",
+                                         "CANCELED"):
+                                break
+                    except Exception:  # noqa: BLE001 — retry until deadline
+                        pass
+                    if self.state.is_terminal():
+                        return
+                    time.sleep(0.05)
+
     def _cancel_tasks(self) -> None:
         for locations in self.fragment_tasks.values():
             for loc in locations:
@@ -450,11 +507,14 @@ class CoordinatorServer:
         # statements (reference: MetadataManager's catalog handles living at
         # server scope, not query scope)
         self.catalogs = default_catalogs()
+        # shared across statements, like catalogs: CREATE FUNCTION on one
+        # query is callable from the next (reference: global function store)
+        self.udfs: Dict[str, object] = {}
 
         def _shared_catalog_session(properties):
             from trino_tpu.client.session import Session
 
-            return Session(properties, catalogs=self.catalogs)
+            return Session(properties, catalogs=self.catalogs, udfs=self.udfs)
 
         self.session_factory = session_factory or _shared_catalog_session
         self.queries: Dict[str, QueryExecution] = {}

@@ -35,17 +35,18 @@ def default_session_factory(properties):
 
 
 def shared_catalog_session_factory():
-    """Session factory bound to ONE catalog map for the whole process, so
-    stateful-connector writes persist across tasks (see
-    CoordinatorServer)."""
+    """Session factory bound to ONE catalog map (and routine store) for the
+    whole process, so stateful-connector writes and CREATE FUNCTION persist
+    across tasks (see CoordinatorServer)."""
     from trino_tpu.connector.registry import default_catalogs
 
     catalogs = default_catalogs()
+    udfs: dict = {}
 
     def factory(properties):
         from trino_tpu.client.session import Session
 
-        return Session(properties, catalogs=catalogs)
+        return Session(properties, catalogs=catalogs, udfs=udfs)
 
     return factory
 

@@ -560,6 +560,73 @@ class ExprAnalyzer:
             return ir.Call(T.BIGINT, "extract_doy", args)
         if name == "week":
             return ir.Call(T.BIGINT, "extract_week", args)
+        if name == "date_diff":
+            # date_diff(unit, from, to) -> bigint (reference:
+            # DateTimeFunctions.diffDate/diffTimestamp)
+            if len(args) != 3 or not isinstance(args[0], ir.Constant):
+                raise AnalysisError("date_diff('unit', from, to)")
+            unit = str(args[0].value).lower()
+            a, b = args[1], args[2]
+            ts_units = {"second": 1, "minute": 60, "hour": 3600,
+                        "day": 86_400, "week": 7 * 86_400}
+            date_units = {"day": 1, "week": 7}
+            both_date = a.type == T.DATE and b.type == T.DATE
+            if both_date and unit in date_units:
+                return ir.Call(T.BIGINT, "date_diff_days",
+                               (a, b, ir.Constant(T.INTEGER, date_units[unit])))
+            if unit in ts_units:
+                p = max(t.precision if isinstance(t, T.TimestampType) else 0
+                        for t in (a.type, b.type))
+                tt = T.timestamp(p)
+                return ir.Call(
+                    T.BIGINT, "ts_diff_units",
+                    (ir.Cast(tt, a), ir.Cast(tt, b),
+                     ir.Constant(T.BIGINT, ts_units[unit] * 10 ** p)))
+            if unit in ("month", "year"):
+                mul = 12 if unit == "year" else 1
+                da = a if a.type == T.DATE else ir.Cast(T.DATE, a)
+                db = b if b.type == T.DATE else ir.Cast(T.DATE, b)
+                return ir.Call(T.BIGINT, "months_between",
+                               (da, db, ir.Constant(T.INTEGER, mul)))
+            raise AnalysisError(f"date_diff: unsupported unit {unit!r}")
+        if name == "date_add":
+            # date_add(unit, value, x) (reference: DateTimeFunctions.addDate)
+            if len(args) != 3 or not isinstance(args[0], ir.Constant):
+                raise AnalysisError("date_add('unit', value, x)")
+            unit = str(args[0].value).lower()
+            n, x = args[1], args[2]
+            if unit in ("month", "year"):
+                mul = ir.Constant(T.INTEGER, 12 if unit == "year" else 1)
+                months = ir.Call(T.INTEGER, "mul", [n, mul])
+                return ir.Call(x.type, "date_add_months", (x, months))
+            ts_units = {"second": 1, "minute": 60, "hour": 3600,
+                        "day": 86_400, "week": 7 * 86_400}
+            if unit not in ts_units:
+                raise AnalysisError(f"date_add: unsupported unit {unit!r}")
+            if x.type == T.DATE:
+                if unit in ("day", "week"):
+                    days = ir.Call(T.BIGINT, "mul", [
+                        n, ir.Constant(T.INTEGER, ts_units[unit] // 86_400)])
+                    return ir.Call(T.DATE, "add", (x, days))
+                x = ir.Cast(T.timestamp(0), x)
+            if not isinstance(x.type, T.TimestampType):
+                raise AnalysisError("date_add over non-temporal value")
+            step = ir.Constant(
+                T.BIGINT, ts_units[unit] * 10 ** x.type.precision)
+            return ir.Call(x.type, "add",
+                           (x, ir.Call(T.BIGINT, "mul", [n, step])))
+        if name == "to_unixtime":
+            if len(args) != 1 or not isinstance(args[0].type, T.TimestampType):
+                raise AnalysisError("to_unixtime(timestamp)")
+            p = args[0].type.precision
+            return ir.Call(T.DOUBLE, "div",
+                           (ir.Cast(T.DOUBLE, args[0]),
+                            ir.Constant(T.DOUBLE, float(10 ** p))))
+        if name == "from_unixtime":
+            if len(args) != 1:
+                raise AnalysisError("from_unixtime(seconds)")
+            return ir.Call(T.timestamp(3), "seconds_to_ts3",
+                           (ir.Cast(T.DOUBLE, args[0]),))
         if name == "date_trunc":
             if len(args) != 2 or args[1].type != T.DATE:
                 raise AnalysisError("date_trunc(unit, date) expects a date")

@@ -245,6 +245,20 @@ class SubqueryRelation(Relation):
 
 
 @dataclasses.dataclass(frozen=True)
+class TableFunctionCall(Relation):
+    """TABLE(fn(args...)) — polymorphic table function invocation
+    (reference: sql/tree/TableFunctionInvocation + spi/function/table/)."""
+
+    name: str
+    args: Tuple[Expression, ...]
+    named_args: dict = None
+
+    def __hash__(self):  # dict field: hash by identity-relevant parts
+        return hash((self.name, self.args, tuple(sorted(
+            (self.named_args or {}).items(), key=lambda kv: kv[0]))))
+
+
+@dataclasses.dataclass(frozen=True)
 class Unnest(Relation):
     """UNNEST(e1, e2, ...) [WITH ORDINALITY] — a lateral relation whose
     argument expressions may reference columns of the preceding FROM items.
@@ -354,6 +368,24 @@ class Insert(Statement):
 
 @dataclasses.dataclass(frozen=True)
 class DropTable(Statement):
+    name: tuple
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateFunction(Statement):
+    """CREATE [OR REPLACE] FUNCTION name(p type, ...) RETURNS t RETURN expr
+    (reference: sql/tree/CreateFunction + CreateFunctionTask)."""
+
+    name: tuple
+    params: tuple  # ((name, type string), ...)
+    returns: str
+    body: Expression
+    or_replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropFunction(Statement):
     name: tuple
     if_exists: bool = False
 

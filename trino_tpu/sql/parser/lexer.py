@@ -41,8 +41,8 @@ class LexError(ValueError):
 
 
 _OPS = [
-    "<>", "!=", ">=", "<=", "||", "->", "=", "<", ">", "+", "-", "*", "/", "%",
-    "(", ")", "[", "]", ",", ".", ";", "?",
+    "<>", "!=", ">=", "<=", "||", "=>", "->", "=", "<", ">", "+", "-", "*",
+    "/", "%", "(", ")", "[", "]", ",", ".", ";", "?",
 ]
 
 

@@ -171,6 +171,38 @@ class Parser:
                 self.expect_op(")")
             return ast.Explain(self.statement(), analyze=analyze, mode=mode, fmt=fmt)
         if self.accept_kw("create"):
+            or_replace = False
+            if self.accept_kw("or"):
+                if not self.accept_soft("replace"):
+                    raise ParseError("expected REPLACE after CREATE OR")
+                or_replace = True
+            if self.accept_soft("function"):
+                # CREATE [OR REPLACE] FUNCTION name(p type, ...) RETURNS
+                # type RETURN expr (reference: CreateFunctionTask; body is
+                # a scalar SQL expression routine)
+                name = tuple(self.qualified_name())
+                self.expect_op("(")
+                params = []
+                if not self.at_op(")"):
+                    while True:
+                        pname = self.identifier()
+                        ptype = self.type_name()
+                        params.append((pname.lower(), ptype))
+                        if not self.accept_op(","):
+                            break
+                self.expect_op(")")
+                if not self.accept_soft("returns"):
+                    raise ParseError("expected RETURNS in CREATE FUNCTION")
+                rtype = self.type_name()
+                if not self.accept_soft("return"):
+                    raise ParseError("expected RETURN <expression> body")
+                body = self.expr()
+                return ast.CreateFunction(
+                    name, tuple(params), rtype, body, or_replace)
+            if or_replace:
+                # accepting-and-ignoring OR REPLACE on tables would
+                # silently change semantics
+                raise ParseError("expected FUNCTION after CREATE OR REPLACE")
             self.expect_kw("table")
             not_exists = False
             if self.accept_kw("if"):
@@ -205,6 +237,12 @@ class Parser:
                 columns = tuple(cols)
             return ast.Insert(name, columns, self.query())
         if self.accept_kw("drop"):
+            if self.accept_soft("function"):
+                if_exists = False
+                if self.accept_kw("if"):
+                    self.expect_kw("exists")
+                    if_exists = True
+                return ast.DropFunction(tuple(self.qualified_name()), if_exists)
             self.expect_kw("table")
             if_exists = False
             if self.accept_kw("if"):
@@ -494,6 +532,32 @@ class Parser:
                 left = ast.Join(jt, left, right, on=self.expr())
 
     def table_primary(self) -> ast.Relation:
+        if self.at_kw("table") and self.peek(1).text == "(":
+            # TABLE(fn(arg [, ...])) — polymorphic table function invocation
+            # (reference: grammar tableFunctionInvocation +
+            # operator/table/). Arguments may be positional or named
+            # (name => expr).
+            self.advance()
+            self.advance()  # (
+            fn = self.identifier().lower()
+            self.expect_op("(")
+            args, named = [], {}
+            if not self.at_op(")"):
+                while True:
+                    if (self.peek().kind == "ident"
+                            and self.peek(1).kind == "op"
+                            and self.peek(1).text == "=>"):
+                        n = self.advance().text.lower()
+                        self.advance()  # =>
+                        named[n] = self.expr()
+                    else:
+                        args.append(self.expr())
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            self.expect_op(")")
+            rel: ast.Relation = ast.TableFunctionCall(fn, tuple(args), named)
+            return self._maybe_aliased(rel)
         if self.at_soft("unnest") and self.peek(1).text == "(":
             self.advance()
             self.advance()  # (

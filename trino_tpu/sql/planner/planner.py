@@ -278,7 +278,33 @@ class Planner:
                 rel, RelationPlan(P.ValuesNode([], [], [()]), Scope([], outer_scope)),
                 None, None, outer_scope,
             )
+        if isinstance(rel, ast.TableFunctionCall):
+            return self._plan_table_function(rel, outer_scope)
         raise PlanningError(f"unsupported relation {type(rel).__name__}")
+
+    def _plan_table_function(self, rel: "ast.TableFunctionCall", outer_scope
+                             ) -> RelationPlan:
+        """TABLE(fn(...)) -> constant relation (reference:
+        sql/tree/TableFunctionInvocation; the processor runs at plan time —
+        arguments must be constants)."""
+        from trino_tpu.exec.table_functions import resolve
+
+        analyzer = ExprAnalyzer(Scope([], outer_scope))
+
+        def const(e):
+            c = _fold_constant(analyzer.analyze(e))
+            if c is None:
+                raise PlanningError(
+                    f"table function {rel.name} arguments must be constants")
+            return c.value
+
+        args = [const(e) for e in rel.args]
+        named = {k: const(v) for k, v in (rel.named_args or {}).items()}
+        names, types, rows = resolve(self.session, rel.name, args, named)
+        node = P.ValuesNode(list(types), list(names), rows)
+        return RelationPlan(
+            node, Scope([Field(n, t, rel.name) for n, t in zip(names, types)],
+                        outer_scope))
 
     def plan_unnest(
         self, rel: ast.Unnest, left: RelationPlan, alias, col_aliases, outer_scope
@@ -1499,6 +1525,17 @@ def _fold_constant(e: ir.Expr) -> Optional[ir.Constant]:
         # constant's type tag matches its repr — relabeling without
         # rescaling shifts values by powers of ten
         return ir.Constant(e.type, _rescale(inner, e.type))
+    if isinstance(e, ir.Call) and e.name in ("add", "sub", "mul") \
+            and len(e.args) == 2 and e.type.is_integer_kind:
+        # integer arithmetic over constants (inlined routine bodies reach
+        # constant contexts like table-function arguments)
+        a = _fold_constant(e.args[0])
+        b = _fold_constant(e.args[1])
+        if a is None or b is None or a.value is None or b.value is None:
+            return None
+        op = {"add": lambda x, y: x + y, "sub": lambda x, y: x - y,
+              "mul": lambda x, y: x * y}[e.name]
+        return ir.Constant(e.type, op(int(a.value), int(b.value)))
     return None
 
 
