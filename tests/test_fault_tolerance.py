@@ -6,6 +6,7 @@ outputs), FailureInjector.java:41-69 (keyed injection),
 FileSystemExchange.java:70 (spooled exchange files).
 """
 import os
+import time
 
 import pytest
 
@@ -173,3 +174,32 @@ def test_fte_partitioned_join_with_injected_failure(cluster):
     columns, rows = client.execute(sql)
     want = Session({"catalog": "tpch", "schema": "tiny"}).execute(sql).rows
     assert [tuple(r) for r in rows] == [tuple(w) for w in want]
+
+
+def test_speculative_execution_duplicates_straggler(cluster, monkeypatch):
+    """Speculative execution (reference: the FTE scheduler's duplicate-
+    slow-task policy): a straggling first attempt gets a concurrent second
+    attempt once siblings establish a duration baseline; the duplicate
+    wins and the query completes fast with correct rows."""
+    from trino_tpu.server.coordinator import QueryExecution
+
+    monkeypatch.setattr(QueryExecution, "SPECULATION_MIN_S", 0.5)
+    monkeypatch.setattr(QueryExecution, "SPECULATION_FACTOR", 1.5)
+    coord, _, _ = cluster
+    client = StatementClient(coord.base_url, {
+        "catalog": "tpch", "schema": "tiny",
+        "retry_policy": "TASK",
+        # slot 0's FIRST attempt of fragment 0 sleeps 60s; the speculative
+        # .a1 duplicate must win long before that
+        "slow_injection": ".0.0.a0:60",
+    })
+    t0 = time.time()
+    columns, rows = client.execute(SQL)
+    wall = time.time() - t0
+    assert [tuple(r) for r in rows] == [tuple(w) for w in _expected()]
+    assert wall < 45, f"speculation did not rescue the straggler ({wall:.1f}s)"
+    qid = sorted(coord.queries)[-1]
+    q = coord.queries[qid]
+    assert any(".0.0.a1" in t for t in q.speculative_tasks), q.speculative_tasks
+    # the winner was the speculative attempt, not the sleeping original
+    assert any(a >= 1 for t, a in q.task_attempts.items() if ".0.0." in t)

@@ -1,0 +1,190 @@
+"""MATCH_RECOGNIZE row pattern matching (host tier).
+
+Reference test-strategy analog: TestRowPatternMatching /
+operator/window/pattern tests — the classic falling/rising stock-price
+shapes, quantifier greediness + backtracking, AFTER MATCH SKIP modes,
+navigation (PREV/FIRST/LAST), CLASSIFIER()/MATCH_NUMBER(), and partition
+isolation.
+"""
+import pytest
+
+from trino_tpu import Session
+
+
+@pytest.fixture()
+def s():
+    return Session({"catalog": "tpch", "schema": "tiny"})
+
+
+STOCK = """
+(values
+  ('ACME', 1, 100), ('ACME', 2, 90), ('ACME', 3, 80), ('ACME', 4, 85),
+  ('ACME', 5, 95), ('ACME', 6, 94), ('ACME', 7, 90), ('ACME', 8, 98),
+  ('BETA', 1, 50), ('BETA', 2, 60), ('BETA', 3, 55), ('BETA', 4, 70)
+) as t(sym, day, price)
+"""
+
+
+def test_v_shape_falling_then_rising(s):
+    """The canonical V-shape: strictly falling run then strictly rising
+    run; measures navigate FIRST/LAST across variables."""
+    rows = s.execute(f"""
+      select * from {STOCK}
+      match_recognize (
+        partition by sym order by day
+        measures first(strt.day) as start_day, last(down.day) as bottom_day,
+                 last(up.price) as top_price, match_number() as mn
+        after match skip past last row
+        pattern (strt down+ up+)
+        define down as price < prev(price), up as price > prev(price)
+      ) order by sym, mn
+    """).rows
+    # ACME: 100,90,80 falling, 85,95 rising -> match 1 (start day1, bottom
+    # day3, top 95); skip past day5, then anchor day6: 94,
+    # down 90, up 98 -> match 2
+    assert rows == [
+        ("ACME", 1, 3, 95, 1), ("ACME", 6, 7, 98, 2),
+        ("BETA", 2, 3, 70, 1),
+    ]
+
+
+def test_quantifier_backtracking(s):
+    """b* must backtrack so the trailing mandatory c can match."""
+    rows = s.execute("""
+      select * from (values (1, 1), (2, 2), (3, 3), (4, 4)) as t(i, v)
+      match_recognize (
+        order by i
+        measures first(a.v) as a_v, classifier() as last_var
+        pattern (a b* c)
+        define b as v > prev(v), c as v > prev(v)
+      )
+    """).rows
+    # greedy b* would eat rows 2..4; backtracking must yield one to c
+    assert rows == [(1, "C")]
+
+
+def test_skip_to_next_row_overlapping(s):
+    rows = s.execute("""
+      select * from (values (1, 10), (2, 20), (3, 30)) as t(i, v)
+      match_recognize (
+        order by i
+        measures first(a.i) as s, last(b.i) as e
+        after match skip to next row
+        pattern (a b)
+        define b as v > prev(v)
+      ) order by s
+    """).rows
+    assert rows == [(1, 2), (2, 3)]  # overlapping matches
+
+
+def test_optional_and_undefined_variables(s):
+    """Undefined variables match any row; ? takes at most one."""
+    rows = s.execute("""
+      select * from (values (1, 5), (2, 50), (3, 6)) as t(i, v)
+      match_recognize (
+        order by i
+        measures first(a.i) as s, coalesce(last(spike.v), -1) as spike_v,
+                 last(e.i) as e
+        pattern (a spike? e)
+        define spike as v > 40
+      ) order by s
+    """).rows
+    assert rows == [(1, 50, 3)]
+
+
+def test_partition_isolation_and_prev_boundary(s):
+    """PREV never crosses a partition boundary (first row's PREV is NULL,
+    so a PREV-based DEFINE fails there)."""
+    rows = s.execute("""
+      select * from (values ('a', 1, 10), ('a', 2, 20), ('b', 1, 100),
+                            ('b', 2, 50)) as t(p, i, v)
+      match_recognize (
+        partition by p order by i
+        measures last(up.v) as topv
+        pattern (up)
+        define up as v > prev(v)
+      ) order by p
+    """).rows
+    assert rows == [("a", 20)]  # b's rows fall, and b1 can't see a2
+
+
+def test_match_recognize_over_real_table(s):
+    """Runs of increasing order totals per customer (real tpch scan
+    feeding the matcher through the engine pipeline)."""
+    rows = s.execute("""
+      select * from (
+        select o_custkey, o_orderkey, o_totalprice from orders
+        where o_custkey < 20
+      ) match_recognize (
+        partition by o_custkey order by o_orderkey
+        measures match_number() as mn, first(a.o_orderkey) as k0,
+                 last(b.o_orderkey) as k1
+        pattern (a b+)
+        define b as o_totalprice > prev(o_totalprice)
+      )
+    """).rows
+    assert rows  # matches exist at tiny scale
+    # oracle: recompute host-side
+    src = s.execute("select o_custkey, o_orderkey, o_totalprice from orders "
+                    "where o_custkey < 20 order by o_custkey, o_orderkey").rows
+    by_cust = {}
+    for c, k, p in src:
+        by_cust.setdefault(c, []).append((k, p))
+    want = []
+    for c in sorted(by_cust):
+        seq = by_cust[c]
+        i, mn = 0, 1
+        while i < len(seq) - 1:
+            j = i
+            while j + 1 < len(seq) and seq[j + 1][1] > seq[j][1]:
+                j += 1
+            if j > i:
+                want.append((c, mn, seq[i][0], seq[j][0]))
+                mn += 1
+                i = j + 1
+            else:
+                i += 1
+    assert sorted(rows) == sorted(want)
+
+
+def test_plan_time_validation(s):
+    with pytest.raises(Exception):
+        s.execute("""
+          select * from (values (1)) as t(v)
+          match_recognize (order by v measures 1 as x
+            pattern (a) define a as no_such_col > 1)
+        """)
+    with pytest.raises(Exception):
+        s.execute("""
+          select * from (values (1)) as t(v)
+          match_recognize (order by v measures 1 as x
+            pattern (a) define zz as v > 1)
+        """)
+
+
+def test_secondary_order_key_breaks_ties(s):
+    """Ties on the first ORDER BY key must fall through to the second
+    (review regression: the sort-key wrapper needs value equality)."""
+    rows = s.execute("""
+      select * from (values (1, 2, 2), (1, 3, 3), (1, 1, 1)) as t(g, seq, v)
+      match_recognize (
+        order by g, seq
+        measures first(a.v) as lo, last(b.v) as hi
+        pattern (a b+)
+        define b as v > prev(v)
+      )
+    """).rows
+    assert rows == [(1, 3)]
+
+
+def test_no_match_result_joins_cleanly(s):
+    """Zero matches must yield the canonical all-dead page (review
+    regression: zero-length arrays break downstream gathers)."""
+    rows = s.execute("""
+      select * from (
+        select * from (values (1, 5), (2, 4)) as t(g, v)
+        match_recognize (order by g measures last(up.v) as w
+                         pattern (up) define up as v > prev(v))
+      ) m join (values (1)) u(x) on m.w = u.x
+    """).rows
+    assert rows == []

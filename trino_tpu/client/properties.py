@@ -52,6 +52,13 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             bool, True,
         ),
         PropertyMetadata(
+            "slow_injection",
+            "straggler injection for speculative-execution tests: "
+            "'<task-id-substring>:<seconds>' sleeps matching tasks "
+            "(reference: FailureInjector)",
+            str, "",
+        ),
+        PropertyMetadata(
             "phased_execution",
             "delay probe-side fragments until their leaf join-build "
             "fragments finish executing (reference: "

@@ -1891,6 +1891,72 @@ class Executor:
             page = Page(out_cols, passed if page.sel is None else page.sel & passed, left.replicated)
         return page
 
+    # ----------------------------------------------------- pattern matching
+    def _exec_MatchRecognizeNode(self, node: "P.MatchRecognizeNode") -> Page:
+        """MATCH_RECOGNIZE (reference: PatternRecognitionOperator): host
+        tier only — the backtracking matcher is sequential by nature (see
+        exec/match_recognize.py). Traced tiers route queries containing it
+        through the gathered coordinator fragment."""
+        if not self.eager_tier:
+            raise NotImplementedError(
+                "MATCH_RECOGNIZE executes on the host tier")
+        from trino_tpu.exec.match_recognize import run_match_recognize
+
+        page = self.execute(node.source)
+        names = node.input_names or node.source.output_names
+        # case-insensitive resolution, matching the analyzer's (plan-time
+        # validation lowercases identifiers)
+        lnames = [n.lower() for n in names]
+        pyrows = [dict(zip(lnames, r)) for r in page.to_pylist()]
+        part_names = [lnames[c] for c in node.partition_channels]
+        parts: Dict[tuple, List[dict]] = {}
+        for r in pyrows:
+            parts.setdefault(tuple(r[n] for n in part_names), []).append(r)
+
+        class _K:
+            """Total-order sort key with SQL null placement (nulls last
+            ascending, first descending — the engine's default)."""
+
+            __slots__ = ("v", "asc")
+
+            def __init__(self, v, asc):
+                self.v, self.asc = v, asc
+
+            def __lt__(self, other):
+                a, b = self.v, other.v
+                if a is None or b is None:
+                    if a is None and b is None:
+                        return False
+                    return (a is None) != self.asc  # None last when asc
+                return (a < b) if self.asc else (b < a)
+
+            def __eq__(self, other):
+                # tuple comparison consults secondary keys only when
+                # earlier keys compare EQUAL — identity-based equality
+                # would freeze ties in input order
+                return self.v == other.v
+
+        sort_cols = [(lnames[c], asc) for c, asc, _n in node.sort_channels]
+
+        def order_key(row):
+            return tuple(_K(row[n], asc) for n, asc in sort_cols)
+
+        out_rows: List[tuple] = []
+        for key in sorted(parts, key=lambda k: tuple(map(repr, k))):
+            for mvals in run_match_recognize(
+                    parts[key], order_key, list(node.pattern),
+                    list(node.defines), list(node.measures),
+                    node.after_match):
+                out_rows.append(key + mvals)
+        if not out_rows:
+            # zero-length arrays break downstream gathers: the no-match
+            # result is the canonical 1-slot all-dead page
+            return Page.all_dead(node.output_types)
+        cols = []
+        for i, (t, _n) in enumerate(zip(node.output_types, node.output_names)):
+            cols.append(Column.from_python(t, [r[i] for r in out_rows]))
+        return Page(cols)
+
     # ------------------------------------------------------------- ordering
     def _exec_SortNode(self, node: P.SortNode) -> Page:
         page = self.execute(node.source)

@@ -1640,19 +1640,6 @@ def _lower_last_day_of_month(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     return LoweredVal(last, x.valid, None)
 
 
-def _lower_from_unixtime(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
-    a = lower(expr.args[0], ctx)
-    us = (a.vals.astype(jnp.float64) * 1e6).astype(jnp.int64)
-    return LoweredVal(us, a.valid, None)
-
-
-def _lower_to_unixtime(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
-    a = lower(expr.args[0], ctx)
-    if expr.args[0].type == T.DATE:
-        return LoweredVal(a.vals.astype(jnp.float64) * 86400.0, a.valid, None)
-    return LoweredVal(a.vals.astype(jnp.float64) / 1e6, a.valid, None)
-
-
 def _lower_bitwise(op: str):
     def fn(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
         a = lower(expr.args[0], ctx)
@@ -2155,8 +2142,6 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "day_name": _lower_day_name,
     "month_name": _lower_month_name,
     "last_day_of_month": _lower_last_day_of_month,
-    "from_unixtime": _lower_from_unixtime,
-    "to_unixtime": _lower_to_unixtime,
     "bitwise_and": _lower_bitwise("and"),
     "bitwise_or": _lower_bitwise("or"),
     "bitwise_xor": _lower_bitwise("xor"),

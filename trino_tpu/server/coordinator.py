@@ -99,6 +99,7 @@ class QueryExecution:
         # FTE bookkeeping: successful attempt index per task + retried ids
         self.task_attempts: Dict[str, int] = {}
         self.retried_tasks: List[str] = []
+        self.speculative_tasks: List[str] = []  # duplicate straggler attempts
         self.fragment_tasks: Dict[int, List[TaskLocation]] = {}
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -329,41 +330,89 @@ class QueryExecution:
         outputs persist in the spool."""
         n = len(workers)
         locations: List[Optional[TaskLocation]] = [None] * n
-        # per slot: (attempt, location-or-None, attempt deadline)
-        slots: Dict[int, tuple] = {}
+        # per slot: LIST of concurrent attempts (attempt#, loc, deadline,
+        # started) — normally one; a straggler gets a SPECULATIVE second
+        # (reference: the event-driven FTE scheduler's speculative
+        # execution — launch a duplicate of a slow task, first finish wins)
+        slots: Dict[int, list] = {}
+        top_attempt: Dict[int, int] = {}
         for wi in range(n):
-            slots[wi] = self._start_attempt(
-                frag, wi, 0, per_worker_splits, workers, consumer_counts)
+            slots[wi] = [self._start_attempt(
+                frag, wi, 0, per_worker_splits, workers, consumer_counts)]
+            top_attempt[wi] = 0
+        finished_durations: List[float] = []
+
+        def fail_all(msg):
+            for atts in slots.values():
+                for _a, other, _dl, _t in atts:
+                    self._cancel_attempt(other)
+            raise RuntimeError(msg)
+
         while slots:
             if self.state.get() == "CANCELED":
-                for _, loc, _dl in slots.values():
-                    self._cancel_attempt(loc)
-                raise RuntimeError("query was canceled")
+                fail_all("query was canceled")
             for wi in list(slots):
-                attempt, loc, deadline = slots[wi]
-                state, failure = self._poll_task(loc, deadline)
-                if state is None:
-                    continue  # still running
-                if state == "FINISHED":
-                    locations[wi] = loc
-                    self.task_attempts[loc.task_id] = attempt
-                    del slots[wi]
-                    continue
-                # failed / unreachable / timed out / canceled remotely
-                self._cancel_attempt(loc)
-                if loc is not None:
-                    self.retried_tasks.append(loc.task_id)
-                if attempt + 1 >= self.MAX_TASK_ATTEMPTS:
-                    for _, other, _dl in slots.values():
-                        self._cancel_attempt(other)
-                    raise RuntimeError(
-                        f"task {frag.id}.{wi} failed after "
-                        f"{self.MAX_TASK_ATTEMPTS} attempts: {failure}")
-                slots[wi] = self._start_attempt(
-                    frag, wi, attempt + 1, per_worker_splits, workers,
-                    consumer_counts)
+                for att in list(slots[wi]):
+                    attempt, loc, deadline, started = att
+                    state, failure = self._poll_task(loc, deadline)
+                    if state is None:
+                        continue  # still running
+                    if state == "FINISHED":
+                        locations[wi] = loc
+                        self.task_attempts[loc.task_id] = attempt
+                        finished_durations.append(time.monotonic() - started)
+                        for _a, other, _dl, _t in slots[wi]:
+                            if other is not loc:
+                                self._cancel_attempt(other)  # losers
+                        del slots[wi]
+                        break
+                    # failed / unreachable / timed out / canceled remotely
+                    self._cancel_attempt(loc)
+                    if loc is not None:
+                        self.retried_tasks.append(loc.task_id)
+                    slots[wi].remove(att)
+                    if not slots[wi]:
+                        if top_attempt[wi] + 1 >= self.MAX_TASK_ATTEMPTS:
+                            fail_all(
+                                f"task {frag.id}.{wi} failed after "
+                                f"{self.MAX_TASK_ATTEMPTS} attempts: {failure}")
+                        top_attempt[wi] += 1
+                        slots[wi] = [self._start_attempt(
+                            frag, wi, top_attempt[wi], per_worker_splits,
+                            workers, consumer_counts)]
+            # speculation: once siblings establish a duration baseline, a
+            # slot still on its FIRST running attempt past factor x median
+            # gets a duplicate on a different worker
+            if finished_durations and slots:
+                med = sorted(finished_durations)[len(finished_durations) // 2]
+                threshold = max(self.SPECULATION_MIN_S,
+                                self.SPECULATION_FACTOR * med)
+                now = time.monotonic()
+                for wi, atts in slots.items():
+                    if len(atts) != 1:
+                        continue  # already speculating (or mid-restart)
+                    attempt, loc, _dl, started = atts[0]
+                    if attempt != 0:
+                        continue  # retried slots keep their attempt budget
+                    if loc is None or now - started < threshold:
+                        continue
+                    if top_attempt[wi] + 1 >= self.MAX_TASK_ATTEMPTS:
+                        continue
+                    top_attempt[wi] += 1
+                    spec = self._start_attempt(
+                        frag, wi, top_attempt[wi], per_worker_splits,
+                        workers, consumer_counts)
+                    atts.append(spec)
+                    if spec[1] is not None:
+                        self.speculative_tasks.append(spec[1].task_id)
             time.sleep(0.05)
         return list(locations)
+
+    # speculative-execution policy: duplicate a slot's first attempt when
+    # it has run SPECULATION_FACTOR x the median sibling duration (and at
+    # least SPECULATION_MIN_S)
+    SPECULATION_MIN_S = 2.0
+    SPECULATION_FACTOR = 2.0
 
     def _start_attempt(self, frag, wi, attempt, per_worker_splits, workers,
                        consumer_counts):
@@ -377,7 +426,7 @@ class QueryExecution:
                 consumer_counts)
         except Exception:  # noqa: BLE001 — retried like a task failure
             loc = None
-        return (attempt, loc, deadline)
+        return (attempt, loc, deadline, time.monotonic())
 
     def _poll_task(self, loc: Optional[TaskLocation], deadline: float):
         """One non-blocking status check: (None, None) while running, else

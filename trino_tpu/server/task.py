@@ -153,6 +153,16 @@ class SqlTask:
             inject = str(req.session_properties.get("failure_injection") or "")
             if inject and inject in req.task_id:
                 raise RuntimeError(f"injected failure for {req.task_id}")
+            # straggler injection ("substr:seconds") — exercises the FTE
+            # scheduler's speculative execution (reference:
+            # FailureInjector's sleep mode)
+            slow = str(req.session_properties.get("slow_injection") or "")
+            if slow:
+                import time as _t
+
+                sub, _, secs = slow.partition(":")
+                if sub and sub in req.task_id:
+                    _t.sleep(float(secs or "5"))
             session = self._session_factory(req.session_properties)
             if self._try_streaming(req, session):
                 return

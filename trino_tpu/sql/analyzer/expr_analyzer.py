@@ -616,11 +616,16 @@ class ExprAnalyzer:
             return ir.Call(x.type, "add",
                            (x, ir.Call(T.BIGINT, "mul", [n, step])))
         if name == "to_unixtime":
-            if len(args) != 1 or not isinstance(args[0].type, T.TimestampType):
+            if len(args) != 1:
                 raise AnalysisError("to_unixtime(timestamp)")
-            p = args[0].type.precision
+            arg = args[0]
+            if arg.type == T.DATE:
+                arg = ir.Cast(T.timestamp(0), arg)
+            if not isinstance(arg.type, T.TimestampType):
+                raise AnalysisError("to_unixtime(timestamp)")
+            p = arg.type.precision
             return ir.Call(T.DOUBLE, "div",
-                           (ir.Cast(T.DOUBLE, args[0]),
+                           (ir.Cast(T.DOUBLE, arg),
                             ir.Constant(T.DOUBLE, float(10 ** p))))
         if name == "from_unixtime":
             if len(args) != 1:
@@ -733,10 +738,6 @@ class ExprAnalyzer:
             return ir.Call(T.varchar(), "month_name", args)
         if name == "last_day_of_month":
             return ir.Call(T.DATE, "last_day_of_month", args)
-        if name == "from_unixtime":
-            return ir.Call(T.TIMESTAMP, "from_unixtime", args)
-        if name == "to_unixtime":
-            return ir.Call(T.DOUBLE, "to_unixtime", args)
         # --- bitwise (reference: operator/scalar/BitwiseFunctions) ---
         if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
                     "bitwise_left_shift", "bitwise_right_shift"):

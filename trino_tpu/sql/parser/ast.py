@@ -259,6 +259,25 @@ class TableFunctionCall(Relation):
 
 
 @dataclasses.dataclass(frozen=True)
+class MatchRecognize(Relation):
+    """relation MATCH_RECOGNIZE (...) (reference: grammar
+    patternRecognition + sql/tree/PatternRecognitionRelation). Subset:
+    ONE ROW PER MATCH, AFTER MATCH SKIP PAST LAST ROW / TO NEXT ROW,
+    concatenation patterns with ?/*/+ quantifiers."""
+
+    input: Relation
+    partition_by: Tuple[Expression, ...] = ()
+    order_by: Tuple[Tuple[Expression, bool], ...] = ()  # (expr, ascending)
+    measures: Tuple[Tuple[Expression, str], ...] = ()
+    after_match: str = "past_last"  # past_last | next_row
+    pattern: Tuple[Tuple[str, str], ...] = ()  # (variable, quantifier)
+    defines: Tuple[Tuple[str, Expression], ...] = ()
+
+    def __hash__(self):
+        return hash((self.input, self.partition_by, self.pattern))
+
+
+@dataclasses.dataclass(frozen=True)
 class Unnest(Relation):
     """UNNEST(e1, e2, ...) [WITH ORDINALITY] — a lateral relation whose
     argument expressions may reference columns of the preceding FROM items.

@@ -585,11 +585,14 @@ class Parser:
         return self._maybe_aliased(rel)
 
     def _maybe_aliased(self, rel: ast.Relation) -> ast.Relation:
+        if self.at_soft("match_recognize") and self.peek(1).text == "(":
+            # MATCH_RECOGNIZE over the bare relation, then maybe aliased
+            return self._maybe_aliased(self._match_recognize(rel))
         alias = None
         col_aliases = None
         if self.accept_kw("as"):
             alias = self.identifier()
-        elif self.peek().kind == "ident":
+        elif self.peek().kind == "ident" and not self.at_soft("match_recognize"):
             alias = self.advance().text
         if alias is not None and self.accept_op("("):
             cols = [self.identifier()]
@@ -598,8 +601,92 @@ class Parser:
             self.expect_op(")")
             col_aliases = tuple(cols)
         if alias is not None:
-            return ast.AliasedRelation(rel, alias, col_aliases)
+            rel = ast.AliasedRelation(rel, alias, col_aliases)
+        if self.at_soft("match_recognize") and self.peek(1).text == "(":
+            # aliasedRelation MATCH_RECOGNIZE (...) [AS m] — the reference
+            # grammar's patternRecognition position
+            return self._maybe_aliased(self._match_recognize(rel))
         return rel
+
+    def _match_recognize(self, input_rel: ast.Relation) -> ast.Relation:
+        """MATCH_RECOGNIZE ( [PARTITION BY ...] [ORDER BY ...]
+        [MEASURES e AS n, ...] [ONE ROW PER MATCH]
+        [AFTER MATCH SKIP (PAST LAST ROW | TO NEXT ROW)]
+        PATTERN (A B+ C*) DEFINE A AS pred, ... )"""
+        self.advance()  # match_recognize
+        self.expect_op("(")
+        partition_by: List[ast.Expression] = []
+        order_by: List = []
+        measures: List = []
+        after_match = "past_last"
+        if self.accept_soft("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.expr())
+            while self.accept_op(","):
+                partition_by.append(self.expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.expr()
+                asc = True
+                if self.accept_kw("desc"):
+                    asc = False
+                else:
+                    self.accept_kw("asc")
+                order_by.append((e, asc))
+                if not self.accept_op(","):
+                    break
+        if self.accept_soft("measures"):
+            while True:
+                e = self.expr()
+                self.expect_kw("as")
+                measures.append((e, self.identifier()))
+                if not self.accept_op(","):
+                    break
+        if self.accept_soft("one"):
+            if not (self.accept_soft("row") and self.accept_soft("per")
+                    and self.accept_soft("match")):
+                raise ParseError("expected ONE ROW PER MATCH")
+        if self.accept_soft("after"):
+            if not (self.accept_soft("match") and self.accept_soft("skip")):
+                raise ParseError("expected AFTER MATCH SKIP")
+            if self.accept_soft("past"):
+                if not (self.accept_soft("last") and self.accept_soft("row")):
+                    raise ParseError("expected PAST LAST ROW")
+                after_match = "past_last"
+            elif self.accept_soft("to"):
+                if not (self.accept_soft("next") and self.accept_soft("row")):
+                    raise ParseError(
+                        "only SKIP PAST LAST ROW / SKIP TO NEXT ROW supported")
+                after_match = "next_row"
+            else:
+                raise ParseError("expected PAST LAST ROW or TO NEXT ROW")
+        if not self.accept_soft("pattern"):
+            raise ParseError("MATCH_RECOGNIZE requires PATTERN (...)")
+        self.expect_op("(")
+        pattern: List = []
+        while not self.at_op(")"):
+            var = self.identifier().lower()
+            quant = "1"
+            if self.at_op("*", "+", "?"):
+                quant = self.advance().text
+            pattern.append((var, quant))
+        self.expect_op(")")
+        if not pattern:
+            raise ParseError("empty PATTERN")
+        if not self.accept_soft("define"):
+            raise ParseError("MATCH_RECOGNIZE requires DEFINE")
+        defines: List = []
+        while True:
+            var = self.identifier().lower()
+            self.expect_kw("as")
+            defines.append((var, self.expr()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return ast.MatchRecognize(
+            input_rel, tuple(partition_by), tuple(order_by), tuple(measures),
+            after_match, tuple(pattern), tuple(defines))
 
     def qualified_name(self) -> List[str]:
         parts = [self.identifier()]

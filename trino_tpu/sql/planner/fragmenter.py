@@ -252,7 +252,8 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
             else:
                 node.right = right
             return node, lrep
-        if isinstance(node, (P.SortNode, P.TopNNode, P.WindowNode)):
+        if isinstance(node, (P.SortNode, P.TopNNode, P.WindowNode,
+                             P.MatchRecognizeNode)):
             src, rep = cut(node.source, fragments)
             if not rep:
                 fid = next(_frag_ids)

@@ -795,4 +795,12 @@ def prune_channels(node: P.PlanNode, needed: Set[int]) -> Tuple[P.PlanNode, Dict
                 )
             setattr(node, attr, src)
         return node, {i: i for i in keep}
+    if isinstance(node, P.MatchRecognizeNode):
+        # DEFINE/MEASURES reference input columns by NAME (host matcher):
+        # every source channel stays; MR outputs are not pruned through
+        width = len(node.source.output_types)
+        src, src_map = prune_channels(node.source, set(range(width)))
+        assert all(src_map.get(c) == c for c in range(width))
+        node.source = src
+        return node, {i: i for i in range(len(node.output_types))}
     raise NotImplementedError(f"prune_channels: {type(node).__name__}")

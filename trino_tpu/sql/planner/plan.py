@@ -423,6 +423,42 @@ class WindowNode(PlanNode):
 
 
 @dataclasses.dataclass
+class MatchRecognizeNode(PlanNode):
+    """Row pattern matching, ONE ROW PER MATCH (reference:
+    plan/PatternRecognitionNode). DEFINE/MEASURES keep their analyzed-AST
+    form: the matcher is host-tier (exec/match_recognize.py) — its
+    backtracking inner loop is the one operator family that does not
+    vectorize onto the device."""
+
+    source: PlanNode = None
+    partition_channels: List[int] = None
+    sort_channels: List[Tuple[int, bool, Optional[bool]]] = None
+    pattern: tuple = ()  # ((variable, quantifier), ...)
+    defines: tuple = ()  # ((variable, ast expr), ...)
+    measures: tuple = ()  # ((ast expr, name), ...)
+    measure_types: List[T.Type] = None
+    after_match: str = "past_last"
+    # the SCOPE names of the input (aliases applied): DEFINE/MEASURES
+    # resolve by these, not by the physical child's debug names
+    input_names: List[str] = None
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_types(self):
+        src = self.source.output_types
+        return [src[c] for c in self.partition_channels] + list(self.measure_types)
+
+    @property
+    def output_names(self):
+        names = self.input_names or self.source.output_names
+        return [names[c] for c in self.partition_channels] + [
+            n for _, n in self.measures]
+
+
+@dataclasses.dataclass
 class SortNode(PlanNode):
     source: PlanNode = None
     sort_channels: List[Tuple[int, bool, Optional[bool]]] = None  # (ch, asc, nulls_first)
