@@ -68,14 +68,14 @@ def tight_cluster():
 
 def test_oversized_query_killed_small_query_finishes(tight_cluster):
     coord, workers = tight_cluster
-    props = {"catalog": "tpch", "schema": "tiny",
-             # park the big query's tasks on their sink watermark so they
-             # stay alive (announcing memory) long enough to be killed
-             "task_output_chunk_bytes": 16 * 1024,
-             "sink_max_buffer_bytes": 32 * 1024}
+    # a JOIN fragment executes as one bulk unit (split-at-a-time
+    # streaming applies only to single-scan chains), so its executor holds
+    # multi-MB scan pages while RUNNING — far over the 64 KiB pools
+    props = {"catalog": "tpch", "schema": "tiny"}
     big = coord.submit(
-        "select l_orderkey, l_partkey, l_comment from lineitem "
-        "order by l_extendedprice, l_comment", props)
+        "select o_orderpriority, count(*) c, sum(l_quantity) q "
+        "from orders, lineitem where o_orderkey = l_orderkey "
+        "group by o_orderpriority order by o_orderpriority", props)
     deadline = time.time() + 60
     while not big.state.is_terminal() and time.time() < deadline:
         time.sleep(0.1)

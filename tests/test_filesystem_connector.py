@@ -220,3 +220,30 @@ def test_orc_and_parquet_coexist(orc_session):
     assert orc_session.execute(
         "select * from lake.t_orc union all select * from fs2.lake.t_pq "
         "order by 1").rows == [(1,), (2,)]
+
+
+def test_csv_and_json_readonly_tables(session, tmp_path):
+    """Text-format tables (hive CSV/JSON serde roles): dropped-in files
+    query like any table; writes stay on the columnar formats."""
+    d = tmp_path / "lake"
+    d.mkdir(exist_ok=True)
+    (d / "regions.csv").write_text("code,name\n1,NORTH\n2,SOUTH\n3,EAST\n")
+    (d / "events.json").write_text(
+        '{"id": 1, "kind": "click"}\n{"id": 2, "kind": "view"}\n')
+    conn = session.catalogs["filesystem"]
+    assert "regions" in conn.list_tables("lake")
+    rows = session.execute(
+        "select code, name from regions order by code").rows
+    assert rows == [(1, "NORTH"), (2, "SOUTH"), (3, "EAST")]
+    rows = session.execute(
+        "select e.kind, r.name from events e join regions r on e.id = r.code "
+        "order by e.kind").rows
+    assert rows == [("click", "NORTH"), ("view", "SOUTH")]
+
+
+def test_text_tables_are_read_only(session, tmp_path):
+    d = tmp_path / "lake"
+    d.mkdir(exist_ok=True)
+    (d / "ro.csv").write_text("a,b\n1,2\n")
+    with pytest.raises(Exception, match="read-only"):
+        session.execute("insert into ro values (3, 4)")

@@ -115,3 +115,52 @@ def test_rollup_with_limit(session):
         " group by rollup(region) order by 2 desc limit 1"
     ).rows
     assert rows == [(None, 50)]
+
+
+def test_information_schema_views():
+    """information_schema.schemata/tables/columns synthesized per catalog
+    (reference: connector/informationschema/)."""
+    from trino_tpu import Session
+
+    s = Session({"catalog": "tpch", "schema": "tiny"})
+    schemas = s.execute(
+        "select schema_name from information_schema.schemata").rows
+    assert ("tiny",) in schemas
+    tables = s.execute(
+        "select table_name from information_schema.tables "
+        "where table_schema = 'tiny' order by 1").rows
+    assert ("lineitem",) in tables and ("orders",) in tables
+    cols = s.execute(
+        "select column_name, data_type from information_schema.columns "
+        "where table_schema = 'tiny' and table_name = 'region' "
+        "order by ordinal_position").rows
+    assert cols[0] == ("r_regionkey", "bigint")
+    # joins against metadata views work like any relation
+    n = s.execute(
+        "select count(*) from information_schema.tables t "
+        "join information_schema.schemata s on t.table_schema = s.schema_name "
+        "where t.table_schema = 'tiny'").rows
+    assert n[0][0] == 8
+
+
+def test_information_schema_filtered_by_access_control():
+    """Metadata visibility follows table access: an identity that cannot
+    SELECT a table must not see it in information_schema."""
+    from trino_tpu import Session
+    from trino_tpu.server.security import (
+        Identity, RuleBasedAccessControl, TableRule)
+
+    ac = RuleBasedAccessControl([
+        TableRule(users=["restricted"], catalog="tpch", schema="tiny",
+                  table="nation", privileges=("SELECT",)),
+    ])
+    s = Session({"catalog": "tpch", "schema": "tiny"},
+                identity=Identity("restricted"), access_control=ac)
+    tables = s.execute(
+        "select table_name from information_schema.tables "
+        "where table_schema = 'tiny'").rows
+    assert tables == [("nation",)]
+    cols = s.execute(
+        "select distinct table_name from information_schema.columns "
+        "where table_schema = 'tiny'").rows
+    assert cols == [("nation",)]

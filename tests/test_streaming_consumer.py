@@ -235,3 +235,66 @@ def test_rowlocal_chain_streams_output_before_input_exhausted(patched_client, mo
     want = Session({"catalog": "tpch", "schema": "tiny"}).execute(
         "select count(*) from orders where o_totalprice > 1000").rows[0][0]
     assert total == want
+
+
+def test_scan_task_streams_split_at_a_time(monkeypatch):
+    """A scan-rooted fragment with several splits enqueues output after
+    EACH split (the per-split driver loop) — the first chunk is pullable
+    while later splits still scan."""
+    session = Session({"catalog": "tpch", "schema": "tiny",
+                       "task_output_chunk_bytes": 1 << 20,
+                       "sink_max_buffer_bytes": 64 << 20})
+    root = plan_sql(session, "select o_orderkey, o_totalprice from orders "
+                             "where o_totalprice > 1000")
+    (scan,) = [n for n in P.walk_plan(root) if isinstance(n, P.TableScanNode)]
+    conn = session.catalogs["tpch"]
+    splits = conn.get_splits("tiny", "orders", 6)
+    assert len(splits) > 1
+    enq_after_splits: List[int] = []
+    seen_splits = [0]
+
+    req = TaskRequest(
+        task_id="t_splits", query_id="q_splits", fragment_root=root.source,
+        splits={scan.id: splits}, upstream={},
+        session_properties=dict(session.properties))
+    task = SqlTask(req, session_factory=lambda p: Session(p))
+    orig_enqueue = task.output.enqueue
+
+    def recording_enqueue(pb, **kw):
+        enq_after_splits.append(seen_splits[0])
+        return orig_enqueue(pb, **kw)
+
+    task.output.enqueue = recording_enqueue
+
+    from trino_tpu.server import task as task_mod
+
+    orig_fe = task_mod.FragmentExecutor
+
+    class CountingFE(orig_fe):
+        def __init__(self, *a, **kw):
+            seen_splits[0] += 1
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(task_mod, "FragmentExecutor", CountingFE)
+    task.start()
+    deadline = time.time() + 120
+    while task.state.get() not in ("FINISHED", "FAILED") and time.time() < deadline:
+        time.sleep(0.05)
+    assert task.state.get() == "FINISHED", task.failure
+    # one executor per split, and the FIRST enqueue happened before the
+    # LAST split's executor was built: per-split pipelining
+    assert seen_splits[0] == len(splits)
+    assert enq_after_splits and enq_after_splits[0] < len(splits)
+    # row totals equal a bulk execution
+    frames, token = [], 0
+    for _ in range(1000):
+        got, token, complete, failure = task.output.poll(
+            token, 0, max_pages=100, timeout=5.0)
+        assert failure is None, failure
+        frames.extend(got)
+        if complete:
+            break
+    total = sum(deserialize_page(f).live_count() for f in frames)
+    want = Session({"catalog": "tpch", "schema": "tiny"}).execute(
+        "select count(*) from orders where o_totalprice > 1000").rows[0][0]
+    assert total == want

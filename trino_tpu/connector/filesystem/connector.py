@@ -1,4 +1,5 @@
-"""Filesystem connector: Parquet and ORC tables on local disk.
+"""Filesystem connector: Parquet/ORC (+ read-only CSV/JSON) tables on
+local disk.
 
 Reference roles collapsed into one connector: ``lib/trino-parquet``
 (``ParquetReader.java:85`` — column readers, row-group pruning by min/max
@@ -44,6 +45,9 @@ def _porc():
     import pyarrow.orc  # noqa: PLC0415
 
     return pyarrow.orc
+
+
+_EXTS = ("parquet", "orc", "csv", "json")  # csv/json are read-only tables
 
 
 def _type_from_arrow(at) -> T.Type:
@@ -102,9 +106,9 @@ class FileSystemConnector(spi.Connector):
 
     # ------------------------------------------------------------- layout
     def _table_path(self, schema: str, table: str) -> str:
-        """Existing table file (either format), else the default-format
-        path for writes."""
-        for ext in ("parquet", "orc"):
+        """Existing table file (any supported format), else the
+        default-format path for writes."""
+        for ext in _EXTS:
             p = os.path.join(self.root, schema, f"{table}.{ext}")
             if os.path.exists(p):
                 return p
@@ -113,6 +117,38 @@ class FileSystemConnector(spi.Connector):
     @staticmethod
     def _is_orc(path: str) -> bool:
         return path.endswith(".orc")
+
+    @staticmethod
+    def _text_format(path: str):
+        """'csv' / 'json' for the read-only text formats, else None
+        (reference roles: the hive connector's CSV/JSON serdes)."""
+        for fmt in ("csv", "json"):
+            if path.endswith("." + fmt):
+                return fmt
+        return None
+
+    def _read_text_table(self, path: str):
+        """Whole-file arrow table for a text-format table (small reference
+        / dimension data; columnar formats are the scan path at scale).
+        Cached by (path, mtime): plan-time schema, stats, and the scan
+        would otherwise each re-parse the file."""
+        key = (path, os.path.getmtime(path))
+        cache = getattr(self, "_text_cache", None)
+        if cache is None:
+            cache = self._text_cache = {}
+        hit = cache.get(path)
+        if hit is not None and hit[0] == key[1]:
+            return hit[1]
+        if path.endswith(".csv"):
+            import pyarrow.csv as pc
+
+            tbl = pc.read_csv(path)
+        else:
+            import pyarrow.json as pj
+
+            tbl = pj.read_json(path)
+        cache[path] = (key[1], tbl)
+        return tbl
 
     def list_schemas(self) -> List[str]:
         if not os.path.isdir(self.root):
@@ -128,15 +164,19 @@ class FileSystemConnector(spi.Connector):
             return []
         return sorted({
             f.rsplit(".", 1)[0] for f in os.listdir(d)
-            if f.endswith(".parquet") or f.endswith(".orc")
+            if f.rsplit(".", 1)[-1] in _EXTS
         })
 
     def get_table(self, schema: str, table: str) -> Optional[spi.TableMetadata]:
         path = self._table_path(schema, table)
         if not os.path.exists(path):
             return None
-        arrow_schema = (_porc().ORCFile(path).schema if self._is_orc(path)
-                        else _pq().read_schema(path))
+        if self._text_format(path):
+            arrow_schema = self._read_text_table(path).schema
+        elif self._is_orc(path):
+            arrow_schema = _porc().ORCFile(path).schema
+        else:
+            arrow_schema = _pq().read_schema(path)
         cols = [
             spi.ColumnMetadata(f.name, _type_from_arrow(f.type))
             for f in arrow_schema
@@ -147,6 +187,8 @@ class FileSystemConnector(spi.Connector):
         path = self._table_path(schema, table)
         if not os.path.exists(path):
             return None
+        if self._text_format(path):
+            return self._read_text_table(path).num_rows
         if self._is_orc(path):
             return _porc().ORCFile(path).nrows
         return _pq().ParquetFile(path).metadata.num_rows
@@ -162,6 +204,8 @@ class FileSystemConnector(spi.Connector):
         statistics; pyarrow exposes no stripe statistics, so orc scans
         every stripe — correct, just unpruned)."""
         path = self._table_path(schema, table)
+        if self._text_format(path):
+            return [spi.Split(table, schema, 0, 0, info=None)]
         if self._is_orc(path):
             n_stripes = _porc().ORCFile(path).nstripes
             keep = list(range(n_stripes))
@@ -212,6 +256,9 @@ class FileSystemConnector(spi.Connector):
     # --------------------------------------------------------------- scan
     def scan(self, split: spi.Split, columns: List[str], constraint=None) -> Dict[str, spi.ColumnData]:
         path = self._table_path(split.schema, split.table)
+        if self._text_format(path):
+            tbl = self._read_text_table(path).select(list(columns))
+            return {name: _column_data(tbl.column(name)) for name in columns}
         if self._is_orc(path):
             import pyarrow as pa
 
@@ -268,6 +315,10 @@ class FileSystemConnector(spi.Connector):
         if meta is None:
             raise KeyError(f"{self.name}.{schema}.{table} does not exist")
         path = self._table_path(schema, table)
+        if self._text_format(path):
+            raise NotImplementedError(
+                f"{self.name}: {self._text_format(path)} tables are "
+                "read-only (write to parquet/orc)")
         old = (_porc().ORCFile(path).read() if self._is_orc(path)
                else _pq().read_table(path))
         arrays = []

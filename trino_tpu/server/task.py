@@ -240,10 +240,10 @@ class SqlTask:
 
     # ------------------------------------------------------- streaming loop
     @staticmethod
-    def _streamable_source(root: P.PlanNode):
-        """The single RemoteSourceNode leaf of a streamable fragment, else
+    def _streamable_leaf(root: P.PlanNode, leaf_type):
+        """The single ``leaf_type`` leaf of a streamable fragment, else
         None. Streamable = every operator on the chain is row-local or a
-        PARTIAL aggregation: executing it per arriving chunk and
+        PARTIAL aggregation: executing it per arriving chunk/split and
         concatenating outputs is semantically identical to one bulk run
         (partial-agg outputs may legally contain multiple rows per group —
         the downstream FINAL merge makes them one). This is the
@@ -252,7 +252,7 @@ class SqlTask:
         instead of the page."""
         node = root
         while True:
-            if isinstance(node, RemoteSourceNode):
+            if isinstance(node, leaf_type):
                 return node
             if isinstance(node, (P.FilterNode, P.ProjectNode, P.CompactNode)):
                 node = node.source
@@ -261,6 +261,9 @@ class SqlTask:
                 node = node.source
                 continue
             return None
+
+    def _streamable_source(self, root: P.PlanNode):
+        return self._streamable_leaf(root, RemoteSourceNode)
 
     @staticmethod
     def _streaming_final_agg(root: P.PlanNode):
@@ -284,6 +287,57 @@ class SqlTask:
     # dominate otherwise)
     STREAM_BATCH_ROWS = 65536
 
+    def _streamable_scan(self, root: P.PlanNode):
+        """The single TableScanNode leaf of a row-local/partial-agg chain,
+        else None — the SPLIT-at-a-time driver shape (reference: the
+        driver loop processing one split per quantum, SqlTaskExecution's
+        per-split drivers)."""
+        return self._streamable_leaf(root, P.TableScanNode)
+
+    def _enqueue_out(self, out: Page, part_channels, consumer_count) -> None:
+        """Partition-aware enqueue of one output page (shared by the
+        streaming paths: per-batch chains, per-split scans, and the fold
+        path's finalization)."""
+        if out.num_rows == 0 or out.live_count() == 0:
+            return
+        chunk_rows = self._chunk_rows(out)
+        if part_channels is not None:
+            from trino_tpu.exec.memory import partition_page_host
+
+            pids = _canonical_partition_ids(out, part_channels, consumer_count)
+            parts = partition_page_host(
+                out, part_channels, consumer_count, pid=pids)
+            for pid, part in enumerate(parts):
+                for c in _chunk_pages(part.compact(), chunk_rows):
+                    self.output.enqueue_partition(pid, serialize_page(c))
+        else:
+            for c in _chunk_pages(out, chunk_rows):
+                self.output.enqueue(serialize_page(c))
+
+    def _try_split_streaming(self, req: TaskRequest, session) -> bool:
+        """Execute a scan-rooted streamable fragment ONE SPLIT AT A TIME,
+        enqueueing each split's output as it completes: consumers pull
+        split 0's rows while split 1 scans, and task memory is bounded by
+        one split instead of the whole assignment (the per-driver split
+        processing of the reference's task execution — splits are no
+        longer an all-at-once bulk scan)."""
+        scan = self._streamable_scan(req.fragment_root)
+        if scan is None or scan.id not in req.splits:
+            return False
+        splits = req.splits[scan.id]
+        if len(splits) <= 1:
+            return False  # nothing to pipeline
+        for split in splits:
+            ex = FragmentExecutor(session, {scan.id: [split]}, {})
+            self._track_executor(ex)
+            out = ex.execute_checked(req.fragment_root).compact()
+            self._enqueue_out(out, req.output_partition_channels,
+                              req.consumer_count)
+        self.state.set("FLUSHING")
+        self.output.set_complete()
+        self.state.set("FINISHED")
+        return True
+
     def _try_streaming(self, req: TaskRequest, session) -> bool:
         """Micro-batch driver loop for streamable consumer fragments: pull
         chunks from the ONE upstream, execute the fragment per batch, and
@@ -293,10 +347,14 @@ class SqlTask:
         Returns False when the fragment shape or config requires the bulk
         path (joins/final aggs; FTE spooling needs the complete output
         durable before visibility, so it stays bulk)."""
+        if spool_directory():
+            return False
+        if not req.upstream and len(req.splits) == 1:
+            return self._try_split_streaming(req, session)
         final_agg = self._streaming_final_agg(req.fragment_root)
         src = (final_agg[1] if final_agg is not None
                else self._streamable_source(req.fragment_root))
-        if src is None or spool_directory() or len(req.upstream) != 1:
+        if src is None or len(req.upstream) != 1:
             return False
         if req.splits:  # mixed scan+remote shapes are not chain-shaped
             return False
@@ -307,27 +365,9 @@ class SqlTask:
 
         client = ExchangeClient([TaskLocation(u, t, b) for u, t, b in locations])
         client.start()
-        part_channels = req.output_partition_channels
-
         def enqueue_out(out: Page) -> None:
-            """Partition-aware enqueue of one output page (shared by the
-            per-batch chain path and the fold path's finalization)."""
-            if out.num_rows == 0 or out.live_count() == 0:
-                return
-            chunk_rows = self._chunk_rows(out)
-            if part_channels is not None:
-                from trino_tpu.exec.memory import partition_page_host
-
-                pids = _canonical_partition_ids(
-                    out, part_channels, req.consumer_count)
-                parts = partition_page_host(
-                    out, part_channels, req.consumer_count, pid=pids)
-                for pid, part in enumerate(parts):
-                    for c in _chunk_pages(part.compact(), chunk_rows):
-                        self.output.enqueue_partition(pid, serialize_page(c))
-            else:
-                for c in _chunk_pages(out, chunk_rows):
-                    self.output.enqueue(serialize_page(c))
+            self._enqueue_out(out, req.output_partition_channels,
+                              req.consumer_count)
 
         def emit(batch: List[Page]) -> None:
             page = batch[0]

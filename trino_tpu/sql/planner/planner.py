@@ -404,6 +404,8 @@ class Planner:
         conn = self.catalogs.get(catalog)
         if conn is None:
             raise PlanningError(f"catalog not found: {catalog}")
+        if schema == "information_schema":
+            return self._plan_information_schema(catalog, conn, table, outer_scope)
         meta = conn.get_table(schema, table)
         if meta is None:
             raise PlanningError(f"table not found: {catalog}.{schema}.{table}")
@@ -420,6 +422,63 @@ class Planner:
             column_types=[c.type for c in meta.columns],
         )
         fields = [Field(c.name, c.type, table) for c in meta.columns]
+        return RelationPlan(node, Scope(fields, outer_scope))
+
+    def _plan_information_schema(self, catalog: str, conn, table: str,
+                                 outer_scope) -> RelationPlan:
+        """information_schema views synthesized from connector metadata
+        (reference: ``connector/informationschema/`` — schemata, tables,
+        columns per catalog). Materialized at plan time as a constant
+        relation (metadata scale)."""
+        from trino_tpu.server.security import AccessDeniedError
+
+        ac = getattr(self.session, "access_control", None)
+        identity = getattr(self.session, "identity", None)
+
+        def visible(s: str, t: str) -> bool:
+            """Metadata visibility follows table access (reference:
+            information_schema rows are filtered through access control —
+            names must not leak to identities that cannot select)."""
+            if ac is None:
+                return True
+            try:
+                ac.check_can_select(identity, catalog, s, t)
+                return True
+            except AccessDeniedError:
+                return False
+
+        if table == "schemata":
+            cols = [("catalog_name", T.varchar()), ("schema_name", T.varchar())]
+            rows = [(catalog, s) for s in conn.list_schemas()]
+        elif table == "tables":
+            cols = [("table_catalog", T.varchar()), ("table_schema", T.varchar()),
+                    ("table_name", T.varchar()), ("table_type", T.varchar())]
+            rows = [
+                (catalog, s, t, "BASE TABLE")
+                for s in conn.list_schemas()
+                for t in conn.list_tables(s)
+                if visible(s, t)
+            ]
+        elif table == "columns":
+            cols = [("table_catalog", T.varchar()), ("table_schema", T.varchar()),
+                    ("table_name", T.varchar()), ("column_name", T.varchar()),
+                    ("ordinal_position", T.BIGINT), ("data_type", T.varchar())]
+            rows = []
+            for s in conn.list_schemas():
+                for t in conn.list_tables(s):
+                    if not visible(s, t):
+                        continue
+                    meta = conn.get_table(s, t)
+                    if meta is None:
+                        continue
+                    for i, c in enumerate(meta.columns):
+                        rows.append((catalog, s, t, c.name, i + 1, str(c.type)))
+        else:
+            raise PlanningError(
+                f"information_schema has no table {table!r} "
+                "(schemata, tables, columns)")
+        node = P.ValuesNode([t for _, t in cols], [n for n, _ in cols], rows)
+        fields = [Field(n, t, table) for n, t in cols]
         return RelationPlan(node, Scope(fields, outer_scope))
 
     # ------------------------------------------------- join-order selection
