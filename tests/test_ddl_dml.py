@@ -179,3 +179,85 @@ def test_order_by_expr_after_star():
     )
     rows = s.execute("select *, a + b as s from ob order by a + b").rows
     assert rows == [(1, 2, 3), (5, 3, 8), (10, 1, 11)]
+
+
+def test_delete_with_predicate_and_null_semantics():
+    """DELETE removes rows where the predicate IS TRUE; NULL-predicate
+    rows survive (reference: sql/tree/Delete semantics)."""
+    from trino_tpu import Session
+
+    s = Session({"catalog": "memory", "schema": "default"})
+    s.execute("create table d1 (k bigint, v varchar)")
+    s.execute("insert into d1 values (1, 'a'), (2, 'b'), (3, null)")
+    assert s.execute("delete from d1 where v = 'b'").rows == [(1,)]
+    # v = 'b' is NULL for the null row -> kept
+    assert s.execute("select k from d1 order by k").rows == [(1,), (3,)]
+    assert s.execute("delete from d1").rows == [(2,)]
+    assert s.execute("select count(*) from d1").rows == [(0,)]
+
+
+def test_update_assignments_and_where():
+    from decimal import Decimal
+
+    from trino_tpu import Session
+
+    s = Session({"catalog": "memory", "schema": "default"})
+    s.execute("create table u1 (k bigint, v varchar, amt decimal(10,2))")
+    s.execute("insert into u1 values (1, 'a', 10.00), (2, 'b', 20.00), (3, 'c', 30.00)")
+    assert s.execute(
+        "update u1 set amt = amt * 2, v = 'z' where k >= 2").rows == [(2,)]
+    assert s.execute("select * from u1 order by k").rows == [
+        (1, "a", Decimal("10.00")), (2, "z", Decimal("40.00")),
+        (3, "z", Decimal("60.00"))]
+    # unconditional update touches every row
+    assert s.execute("update u1 set amt = 0.00").rows == [(3,)]
+    assert s.execute("select sum(amt) from u1").rows == [(Decimal("0.00"),)]
+
+
+def test_delete_update_sqlite(tmp_path):
+    import sqlite3
+
+    from trino_tpu import Session
+    from trino_tpu.connector.sqlite import SqliteConnector
+
+    db = str(tmp_path / "dml.sqlite")
+    con = sqlite3.connect(db)
+    con.execute("create table t (k integer, v text)")
+    con.executemany("insert into t values (?,?)", [(i, f"v{i}") for i in range(1, 6)])
+    con.commit()
+    con.close()
+    s = Session({"catalog": "sqlite", "schema": "main"})
+    s.catalogs["sqlite"] = SqliteConnector(db)
+    assert s.execute("delete from t where k > 3").rows == [(2,)]
+    assert s.execute("update t set v = 'x' where k = 1").rows == [(1,)]
+    assert s.execute("select k, v from t order by k").rows == [
+        (1, "x"), (2, "v2"), (3, "v3")]
+    # the remote database really changed
+    con = sqlite3.connect(db)
+    assert con.execute("select count(*) from t").fetchone() == (3,)
+
+
+def test_varchar_case_mixed_dictionaries():
+    """Regression: CASE mixing a string literal branch with a column
+    branch must recode onto one merged dictionary (the default branch
+    previously decoded through the literal's vocabulary)."""
+    from trino_tpu import Session
+
+    s = Session({"catalog": "memory", "schema": "default"})
+    s.execute("create table c1 (k bigint, v varchar)")
+    s.execute("insert into c1 values (1, 'a'), (3, 'c')")
+    assert s.execute(
+        "select k, case when k >= 3 then 'z' else v end from c1 "
+        "order by k").rows == [(1, "a"), (3, "z")]
+
+
+def test_update_rejects_incoercible_assignment():
+    from trino_tpu import Session
+
+    s = Session({"catalog": "memory", "schema": "default"})
+    s.execute("create table u2 (k bigint)")
+    s.execute("insert into u2 values (1)")
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="does not coerce"):
+        s.execute("update u2 set k = 'abc'")

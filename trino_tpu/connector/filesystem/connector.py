@@ -288,24 +288,33 @@ class FileSystemConnector(spi.Connector):
         return out
 
     # -------------------------------------------------------------- write
+    def _write_arrow(self, path: str, tbl) -> None:
+        """One write dispatch for both columnar formats (create/insert/
+        overwrite all funnel here)."""
+        if self._is_orc(path):
+            _porc().write_table(tbl, path, stripe_size=64 * 1024)
+        else:
+            _pq().write_table(tbl, path, row_group_size=self.ROW_GROUP_SIZE)
+
+    @staticmethod
+    def _columnize(columns, rows):
+        """[(name, type)] + python rows -> arrow table."""
+        pa = _pa()
+        arrays, fields = [], []
+        for i, (cname, ctype) in enumerate(columns):
+            at = _arrow_from_type(ctype)
+            arrays.append(pa.array(
+                [_coerce_py(ctype, r[i]) for r in rows], type=at))
+            fields.append(pa.field(cname, at))
+        return pa.table(arrays, schema=pa.schema(fields))
+
     def create_table(self, schema: str, name: str, schema_def, rows) -> None:
         pa = _pa()
         path = self._table_path(schema, name)
         if os.path.exists(path):
             raise ValueError(f"table already exists: {schema}.{name}")
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        arrays = []
-        fields = []
-        for i, (cname, ctype) in enumerate(schema_def):
-            at = _arrow_from_type(ctype)
-            pycol = [_coerce_py(ctype, r[i]) for r in rows]
-            arrays.append(pa.array(pycol, type=at))
-            fields.append(pa.field(cname, at))
-        tbl = pa.table(arrays, schema=pa.schema(fields))
-        if self._is_orc(path):
-            _porc().write_table(tbl, path, stripe_size=64 * 1024)
-        else:
-            _pq().write_table(tbl, path, row_group_size=self.ROW_GROUP_SIZE)
+        self._write_arrow(path, self._columnize(schema_def, rows))
 
     def insert_rows(self, schema: str, table: str, rows) -> int:
         """Append by rewrite (single-file tables; the multi-file append is
@@ -326,12 +335,23 @@ class FileSystemConnector(spi.Connector):
             at = _arrow_from_type(cm.type)
             new = pa.array([_coerce_py(cm.type, r[i]) for r in rows], type=at)
             arrays.append(pa.concat_arrays([old.column(i).combine_chunks(), new]))
-        tbl = pa.table(arrays, names=[c.name for c in meta.columns])
-        if self._is_orc(path):
-            _porc().write_table(tbl, path, stripe_size=64 * 1024)
-        else:
-            _pq().write_table(tbl, path, row_group_size=self.ROW_GROUP_SIZE)
+        self._write_arrow(
+            path, pa.table(arrays, names=[c.name for c in meta.columns]))
         return len(rows)
+
+    def overwrite_rows(self, schema: str, table: str, rows) -> None:
+        """Rewrite the table file with the engine-computed row set."""
+        meta = self.get_table(schema, table)
+        if meta is None:
+            raise KeyError(f"{self.name}.{schema}.{table} does not exist")
+        path = self._table_path(schema, table)
+        fmt = self._text_format(path)
+        if fmt:
+            raise NotImplementedError(
+                f"{self.name}: {fmt} tables are read-only "
+                "(write to parquet/orc)")
+        self._write_arrow(path, self._columnize(
+            [(c.name, c.type) for c in meta.columns], rows))
 
     def drop_table(self, schema: str, table: str) -> None:
         path = self._table_path(schema, table)

@@ -37,6 +37,21 @@ class MemoryConnector(spi.Connector):
         )
         self._tables[(schema, name)] = (meta, cols)
 
+    def overwrite_rows(self, schema: str, table: str, rows) -> None:
+        """Replace contents (engine-computed DELETE/UPDATE rewrite)."""
+        entry = self._tables.get((schema, table))
+        if entry is None:
+            raise KeyError(f"memory.{schema}.{table} does not exist")
+        meta, _cols = entry
+        from trino_tpu.data.page import Column
+
+        new_cols = {
+            cm.name: spi.column_data_from_column(
+                Column.from_python(cm.type, [r[i] for r in rows]))
+            for i, cm in enumerate(meta.columns)
+        }
+        self._tables[(schema, table)] = (meta, new_cols)
+
     def insert_rows(self, schema: str, table: str, rows: List[tuple]) -> int:
         """Append rows (reference: memory connector's page sink). New data
         is columnized independently and concatenated with dictionary merge."""

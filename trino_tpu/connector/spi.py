@@ -384,3 +384,12 @@ class Connector:
 
     def drop_table(self, schema: str, table: str) -> None:
         raise NotImplementedError(f"{self.name}: connector does not support DROP TABLE")
+
+    def overwrite_rows(self, schema: str, table: str, rows) -> None:
+        """Replace the table's contents with ``rows`` (engine-computed
+        DELETE/UPDATE rewrite: the engine evaluates the surviving/modified
+        row set with its full expression machinery and hands the result
+        back — the whole-table analog of the reference's row-change
+        machinery, ConnectorMetadata.beginMerge/MergeSink)."""
+        raise NotImplementedError(
+            f"{self.name}: connector does not support DELETE/UPDATE")

@@ -325,6 +325,24 @@ class SqliteConnector(spi.Connector):
             conn.commit()
         return len(rows)
 
+    def overwrite_rows(self, schema: str, table: str, rows) -> None:
+        """DELETE-all + re-insert inside one sqlite transaction (the
+        engine hands back the surviving/modified row set)."""
+        meta = self.get_table(schema, table)
+        if meta is None:
+            raise KeyError(f"sqlite.{schema}.{table} does not exist")
+        _check_ident(table)
+        conn = self._conn()
+        conn.execute(f"delete from {table}")
+        if rows:
+            ph = ", ".join("?" * len(meta.columns))
+            conn.executemany(
+                f"insert into {table} values ({ph})",
+                [tuple(_to_sql_value(c.type, v) for c, v in zip(meta.columns, r))
+                 for r in rows],
+            )
+        conn.commit()
+
     def drop_table(self, schema: str, table: str) -> None:
         _check_ident(table)
         conn = self._conn()

@@ -76,6 +76,10 @@ def _dispatch_statement(session, stmt) -> QueryResult:
         return _insert(session, stmt)
     if isinstance(stmt, ast.DropTable):
         return _drop_table(session, stmt)
+    if isinstance(stmt, ast.Delete):
+        return _delete(session, stmt)
+    if isinstance(stmt, ast.Update):
+        return _update(session, stmt)
     if isinstance(stmt, ast.CreateFunction):
         from trino_tpu.sql.routines import (
             RoutineError, UdfDef, expand_udfs, validate)
@@ -216,6 +220,18 @@ def _resolve_table_name(session, parts, write: bool = False):
     return session.catalogs[catalog], schema, table
 
 
+def _resolve_table_named(session, parts, write: bool = False):
+    """Like _resolve_table_name but also returns the resolved CATALOG NAME
+    (DML rewrites re-plan against the table and must name the same
+    catalog, never re-derive it by connector identity)."""
+    parts_l = [p.lower() for p in parts]
+    catalog = session.properties.get("catalog", "tpch")
+    if len(parts_l) == 3:
+        catalog = parts_l[0]
+    conn, schema, table = _resolve_table_name(session, parts, write=write)
+    return conn, catalog, schema, table
+
+
 def _create_table(session, stmt):
     """CREATE TABLE (reference: execution/CreateTableTask.java)."""
     from trino_tpu import types as T
@@ -312,6 +328,115 @@ def _check_insert_types(meta, named_columns, src_types):
         raise ValueError(
             f"insert column {i}: mismatched types — query produces {src}, "
             f"table expects {tgt}")
+
+
+def _delete(session, stmt):
+    """DELETE FROM t [WHERE p]: rows where p IS TRUE are removed; the KEPT
+    set (NOT p OR p IS NULL) is computed by the engine and the table
+    overwritten (reference: sql/tree/Delete; the whole-table rewrite is
+    the simple-connector analog of the row-change/merge machinery)."""
+    conn, catalog, schema, table = _resolve_table_named(
+        session, stmt.name, write=True)
+    meta = conn.get_table(schema, table)
+    if meta is None:
+        raise ValueError(f"table not found: {schema}.{table}")
+    total = conn.table_row_count(schema, table)
+    if total is None:  # stats are optional SPI surface: count via the engine
+        total = _dml_select_rows(session, catalog, schema, table, meta,
+                                 count_only=True)
+    if stmt.where is None:
+        kept = []
+    else:
+        keep_pred = ast.LogicalBinary(
+            "or", ast.Not(stmt.where), ast.IsNull(stmt.where))
+        kept = _dml_select_rows(session, catalog, schema, table, meta,
+                                where=keep_pred)
+    conn.overwrite_rows(schema, table, kept)
+    return QueryResult(["rows"], [], [(total - len(kept),)])
+
+
+def _update(session, stmt):
+    """UPDATE t SET c = e [WHERE p]: every row rewrites as
+    CASE WHEN p THEN e ELSE c END per assigned column (reference:
+    sql/tree/Update). Assignment types must COERCE to the column type
+    (widening only), matching INSERT's check."""
+    from trino_tpu import types as T
+    from trino_tpu.sql.analyzer.expr_analyzer import ExprAnalyzer
+    from trino_tpu.sql.analyzer.scope import Field, Scope
+
+    conn, catalog, schema, table = _resolve_table_named(
+        session, stmt.name, write=True)
+    meta = conn.get_table(schema, table)
+    if meta is None:
+        raise ValueError(f"table not found: {schema}.{table}")
+    assigns = {c.lower(): e for c, e in stmt.assignments}
+    col_types = {m.name: m.type for m in meta.columns}
+    scope = Scope([Field(m.name, m.type, table) for m in meta.columns], None)
+    analyzer = ExprAnalyzer(scope)
+    for c, e in assigns.items():
+        if c not in col_types:
+            raise ValueError(f"update column does not exist: {c}")
+        et = analyzer.analyze(e).type
+        target = col_types[c]
+        if et == T.UNKNOWN or T.common_super_type(et, target) == target:
+            continue
+        if et.is_decimal and target.is_decimal:
+            # store-assignment (SQL): decimal precision may NARROW — the
+            # cast's runtime DECIMAL_OVERFLOW check protects values that
+            # do not fit (amt = amt * 2 grows the static precision even
+            # though the values usually still fit)
+            continue
+        raise ValueError(
+            f"UPDATE assignment to {c}: {et} does not coerce to {target}")
+    # ONE scan computes the rewritten rows AND the match count (an extra
+    # boolean column, stripped before the overwrite)
+    rows = _dml_select_rows(session, catalog, schema, table, meta,
+                            assigns=assigns, assign_where=stmt.where,
+                            with_match_flag=stmt.where is not None)
+    if stmt.where is None:
+        updated = len(rows)
+    else:
+        updated = sum(1 for r in rows if r[-1])
+        rows = [r[:-1] for r in rows]
+    conn.overwrite_rows(schema, table, rows)
+    return QueryResult(["rows"], [], [(updated,)])
+
+
+def _dml_select_rows(session, catalog, schema, table, meta, where=None,
+                     assigns=None, assign_where=None, count_only=False,
+                     with_match_flag=False):
+    """Evaluate a rewrite SELECT built at the AST level over the target
+    table with the engine's full expression machinery: the kept rows of a
+    DELETE, the updated projection of an UPDATE (plus an optional
+    predicate-match flag column), or a row count."""
+    table_rel = ast.Table((catalog, schema, table))
+    if count_only:
+        items = (ast.SelectItem(
+            ast.FunctionCall("count", (), is_star=True), "c"),)
+    else:
+        items = []
+        for cm in meta.columns:
+            col = ast.Identifier((cm.name,))
+            e = col
+            if assigns and cm.name in assigns:
+                e = (assigns[cm.name] if assign_where is None
+                     else ast.SearchedCase(((assign_where, assigns[cm.name]),), col))
+                e = ast.Cast(e, str(cm.type))  # keep the column's type
+            items.append(ast.SelectItem(e, cm.name))
+        if with_match_flag and assign_where is not None:
+            items.append(ast.SelectItem(
+                ast.SearchedCase(
+                    ((assign_where, ast.Literal("boolean", True)),),
+                    ast.Literal("boolean", False)), "__match"))
+        items = tuple(items)
+    q = ast.Query(body=ast.QuerySpec(
+        select_items=items, distinct=False, from_=table_rel, where=where,
+        group_by=(), having=None))
+    root = Planner(session).plan(q)
+    root = optimize(root, session)
+    page = Executor(session).execute_checked(root)
+    rows = page.to_pylist()
+    return rows[0][0] if count_only else rows
 
 
 def _drop_table(session, stmt):

@@ -148,6 +148,18 @@ class TransactionalOverlay(spi.Connector):
         self._staged[(schema, table)] = (meta, new_cols)
         return len(rows)
 
+    def overwrite_rows(self, schema, table, rows):
+        self._snapshot(schema, table)
+        meta, _cols = self._staged[(schema, table)]
+        from trino_tpu.data.page import Column
+
+        new_cols = {
+            cm.name: spi.column_data_from_column(
+                Column.from_python(cm.type, [r[i] for r in rows]))
+            for i, cm in enumerate(meta.columns)
+        }
+        self._staged[(schema, table)] = (meta, new_cols)
+
     def drop_table(self, schema, table):
         if self.get_table(schema, table) is None:
             return

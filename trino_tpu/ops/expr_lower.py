@@ -1173,6 +1173,35 @@ def _lower_nullif(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     return LoweredVal(a.vals, valid, a.dictionary, hi=a.hi)
 
 
+def _unify_branch_dicts(branches):
+    """Recode dictionary-coded branch values onto ONE merged vocabulary
+    (CASE/coalesce-style multi-branch varchar results must agree on codes;
+    branch dictionaries differ whenever literals mix with columns).
+    Returns (recoded branches, merged dictionary)."""
+    merged = None
+    for v in branches:
+        if v is None or v.dictionary is None:
+            continue
+        if merged is None:
+            merged = v.dictionary
+        elif merged.values != v.dictionary.values:
+            merged = merged.merge(v.dictionary)
+    if merged is None:
+        return branches, None
+
+    def recode(v):
+        if v is None or v.dictionary is None \
+                or v.dictionary.values == merged.values:
+            return v
+        tbl = jnp.asarray(
+            np.asarray(v.dictionary.recode_table(merged), dtype=np.int32))
+        nv = jnp.where(v.vals >= 0, tbl[jnp.clip(v.vals, 0)],
+                       jnp.int32(NULL_CODE))
+        return LoweredVal(nv, v.valid, merged, children=v.children, hi=v.hi)
+
+    return [recode(v) for v in branches], merged
+
+
 def _lower_case(expr: ir.Case, ctx: LowerCtx) -> LoweredVal:
     """Searched CASE: first WHEN whose condition is TRUE wins."""
     dtype = expr.type.np_dtype
@@ -1181,16 +1210,15 @@ def _lower_case(expr: ir.Case, ctx: LowerCtx) -> LoweredVal:
     decided = jnp.zeros((ctx.num_rows,), dtype=bool)
     dictionary = None
     hi = None  # grows when any branch carries a two-limb long decimal
-    for cond_e, val_e in expr.whens:
-        c = lower(cond_e, ctx)
+    conds = [lower(c, ctx) for c, _ in expr.whens]
+    branch_vals = [lower(v, ctx) for _, v in expr.whens]
+    default_l = lower(expr.default, ctx) if expr.default is not None else None
+    if expr.type.is_varchar:
+        unified, dictionary = _unify_branch_dicts(branch_vals + [default_l])
+        branch_vals, default_l = unified[:-1], unified[-1]
+    for c, v in zip(conds, branch_vals):
         cv = c.vals if c.valid is None else c.vals & c.valid
         take = cv & ~decided
-        v = lower(val_e, ctx)
-        if v.dictionary is not None:
-            if dictionary is not None and dictionary.values != v.dictionary.values:
-                # Mixed-dictionary CASE branches need a recode pass (not yet implemented).
-                raise NotImplementedError("varchar CASE over distinct dictionaries")
-            dictionary = v.dictionary
         if v.hi is not None and hi is None:
             hi = vals.astype(jnp.int64) >> 63  # promote accumulated branches
         if hi is not None:
@@ -1201,8 +1229,8 @@ def _lower_case(expr: ir.Case, ctx: LowerCtx) -> LoweredVal:
             vals = jnp.where(take, v.vals.astype(dtype), vals)
         valid = jnp.where(take, v.valid if v.valid is not None else True, valid)
         decided = decided | take
-    if expr.default is not None:
-        d = lower(expr.default, ctx)
+    if default_l is not None:
+        d = default_l
         if d.hi is not None and hi is None:
             hi = vals.astype(jnp.int64) >> 63
         if hi is not None:

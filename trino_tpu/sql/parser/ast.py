@@ -392,6 +392,24 @@ class DropTable(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class Delete(Statement):
+    """DELETE FROM t [WHERE pred] (reference: sql/tree/Delete +
+    execution via connector row-change machinery)."""
+
+    name: tuple
+    where: Expression = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Update(Statement):
+    """UPDATE t SET c = e, ... [WHERE pred] (reference: sql/tree/Update)."""
+
+    name: tuple
+    assignments: tuple  # ((column, Expression), ...)
+    where: Expression = None
+
+
+@dataclasses.dataclass(frozen=True)
 class CreateFunction(Statement):
     """CREATE [OR REPLACE] FUNCTION name(p type, ...) RETURNS t RETURN expr
     (reference: sql/tree/CreateFunction + CreateFunctionTask)."""

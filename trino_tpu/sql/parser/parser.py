@@ -236,6 +236,23 @@ class Parser:
                 self.expect_op(")")
                 columns = tuple(cols)
             return ast.Insert(name, columns, self.query())
+        if self.accept_soft("delete"):
+            self.expect_kw("from")
+            name = tuple(self.qualified_name())
+            where = self.expr() if self.accept_kw("where") else None
+            return ast.Delete(name, where)
+        if self.accept_soft("update"):
+            name = tuple(self.qualified_name())
+            self.expect_kw("set")
+            assigns = []
+            while True:
+                col = self.identifier()
+                self.expect_op("=")
+                assigns.append((col, self.expr()))
+                if not self.accept_op(","):
+                    break
+            where = self.expr() if self.accept_kw("where") else None
+            return ast.Update(name, tuple(assigns), where)
         if self.accept_kw("drop"):
             if self.accept_soft("function"):
                 if_exists = False
