@@ -1,0 +1,34 @@
+"""Metric-docs drift gate: every metric registered in code must be
+documented in README.md's Observability table (tools/check_metric_docs.py
+wired as a tier-1 test)."""
+import os
+import subprocess
+import sys
+
+TOOL = os.path.join(os.path.dirname(__file__), "..", "tools",
+                    "check_metric_docs.py")
+
+
+def test_all_registered_metrics_documented():
+    from tools.check_metric_docs import check
+
+    missing = check()
+    assert missing == [], (
+        f"metrics registered in trino_tpu/obs/metrics.py but missing from "
+        f"README.md: {missing}")
+
+
+def test_checker_cli_runs_green():
+    proc = subprocess.run(
+        [sys.executable, TOOL], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_checker_detects_missing_metric(tmp_path):
+    """The gate actually gates: a README without the table fails."""
+    from tools.check_metric_docs import check
+
+    bare = tmp_path / "README.md"
+    bare.write_text("# no metrics documented here\n")
+    missing = check(str(bare))
+    assert "trino_tpu_query_seconds" in missing

@@ -108,6 +108,13 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 1 << 16, _positive,
         ),
         PropertyMetadata(
+            "slow_query_log_threshold_ms",
+            "queries whose wall time reaches this many milliseconds are "
+            "logged by SlowQueryLogListener with their slowest trace spans "
+            "(obs/listeners.py); overrides the listener/server default",
+            int, None, lambda v: _positive(v) if v is not None else None,
+        ),
+        PropertyMetadata(
             "failure_injection",
             "inject a task failure when this substring matches a task id, "
             "e.g. '.<fragment>.<worker>.a<attempt>' (reference: "

@@ -15,7 +15,10 @@ from trino_tpu.sql.planner.planner import Planner
 
 
 def plan_sql(session, sql: str):
-    stmt = parse_statement(sql)
+    from trino_tpu.obs import trace as tracing
+
+    with tracing.span("parse"):
+        stmt = parse_statement(sql)
     if isinstance(stmt, ast.Explain):
         raise ValueError("use explain_query")
     if not isinstance(stmt, ast.Query):
@@ -25,8 +28,10 @@ def plan_sql(session, sql: str):
         from trino_tpu.sql.routines import expand_udfs
 
         stmt = expand_udfs(stmt, udfs)
-    root = Planner(session).plan(stmt)
-    return optimize(root, session)
+    with tracing.span("analyze/plan"):
+        root = Planner(session).plan(stmt)
+    with tracing.span("optimize"):
+        return optimize(root, session)
 
 
 def run_query(session, sql: str) -> QueryResult:

@@ -1,0 +1,10 @@
+"""Observability: query-lifecycle tracing + typed metrics registry.
+
+``trace`` — per-query span tracer with W3C-style context propagation over
+the control plane (coordinator schedule -> worker task spans).
+``metrics`` — Counter/Gauge/Histogram registry behind ``/v1/metrics``.
+``listeners`` — in-tree event-listener consumers (slow-query log).
+"""
+from trino_tpu.obs import metrics, trace  # noqa: F401
+from trino_tpu.obs.metrics import REGISTRY  # noqa: F401
+from trino_tpu.obs.trace import Tracer, activate, build_tree, span  # noqa: F401

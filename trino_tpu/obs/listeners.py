@@ -1,0 +1,72 @@
+"""In-tree event-listener consumers of the observability SPI.
+
+Reference role: the slow-query variants of the reference's event-listener
+plugins (``plugin/trino-http-event-listener`` et al.) — here a logging
+listener is built directly on the span data attached to
+``QueryCompletedEvent``, the first consumer of the tracing subsystem.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from trino_tpu.server.events import EventListener, QueryCompletedEvent
+
+logger = logging.getLogger("trino_tpu.slow_query")
+
+# server-level default threshold (overridable per listener instance and per
+# query via the slow_query_log_threshold_ms session property)
+_ENV_THRESHOLD_MS = "TRINO_TPU_SLOW_QUERY_MS"
+DEFAULT_THRESHOLD_MS = 30_000
+
+
+class SlowQueryLogListener(EventListener):
+    """Logs queries whose wall time crosses a threshold, with the trace's
+    slowest spans so the log line itself answers "where did the time go"
+    (plan? schedule? the root-fragment execute? an exchange pull?). The
+    event carries the COORDINATOR-side spans; per-worker device spans live
+    in the full tree at ``GET /v1/query/{id}/trace``, which the log line's
+    query id keys into.
+
+    Threshold resolution, most specific wins: the query's
+    ``slow_query_log_threshold_ms`` session property, then this listener's
+    constructor value, then the ``TRINO_TPU_SLOW_QUERY_MS`` server
+    environment property, then the default."""
+
+    TOP_SPANS = 5
+
+    def __init__(self, threshold_ms: Optional[int] = None):
+        if threshold_ms is None:
+            try:
+                threshold_ms = int(os.environ.get(_ENV_THRESHOLD_MS, ""))
+            except ValueError:
+                # malformed env value falls back like a malformed session
+                # property does — registering the listener must not crash
+                # server startup
+                threshold_ms = DEFAULT_THRESHOLD_MS
+        self.threshold_ms = threshold_ms
+
+    def _effective_threshold_ms(self, event: QueryCompletedEvent) -> int:
+        override = event.session_properties.get("slow_query_log_threshold_ms")
+        if override is not None:
+            try:
+                return int(override)
+            except (TypeError, ValueError):
+                pass
+        return self.threshold_ms
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        threshold_ms = self._effective_threshold_ms(event)
+        if event.wall_seconds * 1000.0 < threshold_ms:
+            return
+        slowest = sorted(
+            (s for s in event.spans if s.get("durationS") is not None),
+            key=lambda s: s["durationS"], reverse=True)[: self.TOP_SPANS]
+        breakdown = ", ".join(
+            f"{s['name']}={s['durationS'] * 1000.0:.0f}ms" for s in slowest)
+        logger.warning(
+            "slow query %s (%s, %.0fms >= %dms) user=%s: %s | slowest spans: %s",
+            event.query_id, event.state, event.wall_seconds * 1000.0,
+            threshold_ms, event.user, event.sql.strip()[:200].replace("\n", " "),
+            breakdown or "none recorded")

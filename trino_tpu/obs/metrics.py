@@ -1,0 +1,308 @@
+"""Typed metrics registry + Prometheus text exposition.
+
+Reference role: airlift's ``@Managed`` counters exported through the
+JMX-to-/metrics bridge (``trino-jmx`` + MetricsResource), replaced by an
+explicit registry: every metric is DECLARED once, module-level, with a
+type, help string, and label names — so the exporter, the docs checker
+(``tools/check_metric_docs.py``), and the endpoint all read from one source
+of truth and ad-hoc string rendering can't drift.
+
+Three instrument types (the Prometheus core set the engine needs):
+
+- ``Counter`` — monotonically increasing totals (bytes exchanged, retries);
+- ``Gauge`` — point-in-time values (queries by state, worker count, uptime);
+- ``Histogram`` — fixed-bucket latency distributions with ``_bucket`` /
+  ``_sum`` / ``_count`` series (per-state query wall time).
+
+The registry is process-global (``REGISTRY``): coordinator and worker are
+separate processes, so each exports its own totals, exactly like the
+reference's per-node JMX. Server-derived gauges are refreshed from the
+owning server immediately before rendering, under ``RENDER_LOCK``
+(server/events.render_metrics), and cleared afterwards so a same-process
+worker render never re-exports another server's values.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# fixed latency buckets (seconds) — chosen to straddle the engine's range:
+# sub-10ms metadata statements through multi-minute sf100 scans
+LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0)
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote, and
+    newline must be escaped inside label values (exposition format spec)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _format_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _series(name: str, labels: Dict[str, str], value) -> str:
+    if labels:
+        inner = ",".join(
+            f'{k}="{escape_label_value(v)}"' for k, v in labels.items())
+        return f"{name}{{{inner}}} {_format_value(value)}"
+    return f"{name} {_format_value(value)}"
+
+
+class Metric:
+    """Shared shape: name, help, label names, thread-safe child map keyed
+    by label values."""
+
+    type_name = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def _labelkey(self, labelvalues: Sequence[str]) -> Tuple[str, ...]:
+        if len(labelvalues) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {labelvalues!r}")
+        return tuple(str(v) for v in labelvalues)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._children.clear()
+
+    # -- rendering ---------------------------------------------------------
+    def header(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.type_name}"]
+
+    def render(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    type_name = "counter"
+
+    def inc(self, amount: float = 1, *labelvalues) -> None:
+        key = self._labelkey(labelvalues)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0) + amount
+
+    def value(self, *labelvalues) -> float:
+        with self._lock:
+            return self._children.get(self._labelkey(labelvalues), 0)
+
+    def render(self) -> List[str]:
+        return _render_flat(self)
+
+
+class Gauge(Metric):
+    type_name = "gauge"
+
+    def set(self, value: float, *labelvalues) -> None:
+        with self._lock:
+            self._children[self._labelkey(labelvalues)] = value
+
+    def inc(self, amount: float = 1, *labelvalues) -> None:
+        key = self._labelkey(labelvalues)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0) + amount
+
+    def value(self, *labelvalues) -> float:
+        with self._lock:
+            return self._children.get(self._labelkey(labelvalues), 0)
+
+    def render(self) -> List[str]:
+        return _render_flat(self)
+
+
+def _render_flat(metric: Metric) -> List[str]:
+    """Counter/Gauge rendering: header always (the name is declared), a
+    series per touched label set. Never-touched metrics emit NO series —
+    a worker must not export the coordinator-derived gauges pinned at 0
+    (which would read as 'this node has 0 uptime / 0 workers' on
+    per-instance dashboards)."""
+    lines = metric.header()
+    with metric._lock:
+        children = dict(metric._children)
+    for key, v in sorted(children.items()):
+        lines.append(_series(metric.name, dict(zip(metric.labelnames, key)), v))
+    return lines
+
+
+class Histogram(Metric):
+    """Cumulative fixed-bucket histogram (``le`` buckets + sum + count)."""
+
+    type_name = "histogram"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = LATENCY_BUCKETS_S):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, value: float, *labelvalues) -> None:
+        key = self._labelkey(labelvalues)
+        with self._lock:
+            counts, total, n = self._children.get(
+                key, ([0] * len(self.buckets), 0.0, 0))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._children[key] = (counts, total + value, n + 1)
+
+    def snapshot(self, *labelvalues):
+        """(bucket_counts, sum, count) for one label set (tests/listeners)."""
+        with self._lock:
+            counts, total, n = self._children.get(
+                self._labelkey(labelvalues), ([0] * len(self.buckets), 0.0, 0))
+            return list(counts), total, n
+
+    def render(self) -> List[str]:
+        lines = self.header()
+        with self._lock:
+            children = {k: (list(c), t, n)
+                        for k, (c, t, n) in self._children.items()}
+        for key, (counts, total, n) in sorted(children.items()):
+            base = dict(zip(self.labelnames, key))
+            for b, c in zip(self.buckets, counts):
+                lines.append(_series(
+                    f"{self.name}_bucket", {**base, "le": _format_value(b)}, c))
+            lines.append(_series(
+                f"{self.name}_bucket", {**base, "le": "+Inf"}, n))
+            lines.append(_series(f"{self.name}_sum", base, total))
+            lines.append(_series(f"{self.name}_count", base, n))
+        return lines
+
+
+# serializes refresh+render across ALL renderers in the process — the
+# coordinator's gauge refresh (server/events.render_metrics) and any direct
+# render_registry() caller (worker /v1/metrics) — so no scrape can observe
+# a half-refreshed gauge. Reentrant: render_metrics holds it around its
+# refresh window while calling render_registry.
+RENDER_LOCK = threading.RLock()
+
+
+class MetricsRegistry:
+    """Ordered collection of declared metrics; renders the whole process's
+    exposition page."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, metric: Metric) -> Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing  # module re-imports keep the same instance
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help, labelnames=()) -> Counter:
+        return self._register(Counter(name, help, labelnames))
+
+    def gauge(self, name, help, labelnames=()) -> Gauge:
+        return self._register(Gauge(name, help, labelnames))
+
+    def histogram(self, name, help, labelnames=(),
+                  buckets=LATENCY_BUCKETS_S) -> Histogram:
+        return self._register(Histogram(name, help, labelnames, buckets))
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._metrics)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        with RENDER_LOCK:
+            lines: List[str] = []
+            for m in metrics:
+                lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()
+
+# ----------------------------------------------------------- engine metrics
+# Declared here (not at use sites) so every exported name is statically
+# discoverable: tools/check_metric_docs.py imports this module and compares
+# REGISTRY.names() against the README table.
+
+# coordinator state gauges (refreshed per render via collect callbacks —
+# see server/events.render_metrics). Names are byte-compatible with the
+# seed's hand-rolled renderer.
+QUERIES = REGISTRY.gauge(
+    "trino_tpu_queries", "tracked queries by lifecycle state", ("state",))
+QUERIES_TOTAL = REGISTRY.counter(
+    "trino_tpu_queries_total", "queries submitted since server start")
+RESULT_ROWS = REGISTRY.gauge(
+    "trino_tpu_result_rows", "result rows held by FINISHED tracked queries")
+WORKERS = REGISTRY.gauge(
+    "trino_tpu_workers", "alive workers in the discovery registry")
+UPTIME_SECONDS = REGISTRY.gauge(
+    "trino_tpu_uptime_seconds", "seconds since server start")
+
+# engine counters (process-global, incremented at the instrumented sites)
+EXCHANGE_BYTES = REGISTRY.counter(
+    "trino_tpu_exchange_bytes_total",
+    "serialized page bytes pulled from upstream task buffers")
+EXCHANGE_REQUESTS = REGISTRY.counter(
+    "trino_tpu_exchange_requests_total",
+    "exchange pull HTTP requests issued")
+EXCHANGE_RETRIES = REGISTRY.counter(
+    "trino_tpu_exchange_retries_total",
+    "exchange pull attempts retried after transient failures")
+SPOOL_READS = REGISTRY.counter(
+    "trino_tpu_spool_reads_total",
+    "task outputs served from the durable spool instead of a live buffer")
+SPOOL_BYTES = REGISTRY.counter(
+    "trino_tpu_spool_bytes_total",
+    "page bytes read from durable spool files (kept separate from "
+    "exchange bytes, which count network pulls from live buffers)")
+COMPILE_CACHE_HITS = REGISTRY.counter(
+    "trino_tpu_compile_cache_hits_total",
+    "compiled-query runs reusing an already-built XLA executable")
+COMPILE_CACHE_MISSES = REGISTRY.counter(
+    "trino_tpu_compile_cache_misses_total",
+    "compiled-query runs that traced+compiled (first run or capacity "
+    "regrowth)")
+COMPILE_SECONDS = REGISTRY.counter(
+    "trino_tpu_compile_seconds_total",
+    "wall seconds of compiled-query runs that traced+compiled (kept out "
+    "of device seconds so one-time compiles don't skew throughput math)")
+STAGING_SECONDS = REGISTRY.counter(
+    "trino_tpu_staging_seconds_total",
+    "host-side staging wall seconds (scan generation, dynamic-filter "
+    "narrowing, host->device transfer prep)")
+DEVICE_SECONDS = REGISTRY.counter(
+    "trino_tpu_device_seconds_total",
+    "device execution wall seconds (fragment bodies / compiled runs)")
+STAGED_ROWS = REGISTRY.counter(
+    "trino_tpu_staged_rows_total", "rows staged from connectors into pages")
+TASKS_TOTAL = REGISTRY.counter(
+    "trino_tpu_tasks_total", "tasks created on this node")
+
+# latency distribution per terminal state (the per-state query histogram)
+QUERY_SECONDS = REGISTRY.histogram(
+    "trino_tpu_query_seconds",
+    "query wall time by terminal state", ("state",))
+
+
+def render_registry() -> str:
+    """The whole process's exposition page (worker /v1/metrics, and the
+    body of the coordinator's after its gauges refresh)."""
+    return REGISTRY.render()

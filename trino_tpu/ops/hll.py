@@ -26,6 +26,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from trino_tpu.ops import segments as seg
 
@@ -35,8 +36,9 @@ LOG2_M = 11
 M = 1 << LOG2_M  # 2048 registers -> ~1.04/sqrt(m) = 2.3% standard error
 _ALPHA = 0.7213 / (1.0 + 1.079 / M)  # alpha_m for m >= 128
 
-_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
-_M2 = jnp.uint64(0x94D049BB133111EB)
+# numpy scalars to stay concrete if first imported under a trace
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
 
 
 def _mix64(x: jnp.ndarray) -> jnp.ndarray:

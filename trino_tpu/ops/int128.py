@@ -21,10 +21,12 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 I128 = Tuple[jnp.ndarray, jnp.ndarray]  # (hi int64, lo int64 bit pattern)
 
-_MASK32 = jnp.uint64(0xFFFFFFFF)
+# numpy scalar to stay concrete if first imported under a trace
+_MASK32 = np.uint64(0xFFFFFFFF)
 
 
 def _u(x: jnp.ndarray) -> jnp.ndarray:

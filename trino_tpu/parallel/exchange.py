@@ -29,15 +29,19 @@ from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from trino_tpu.data.page import Column, Page
 from trino_tpu.ops import ranks
 
 Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
 
-_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
-_M2 = jnp.uint64(0x94D049BB133111EB)
-_NULL_HASH = jnp.uint64(0x9E3779B97F4A7C15)
+# numpy (host) scalars, NOT jnp: a jnp scalar built at first import
+# INSIDE a traced region (shard_map lazily importing this module)
+# becomes a tracer and leaks across traces on jax 0.4.x
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+_NULL_HASH = np.uint64(0x9E3779B97F4A7C15)
 
 
 def _mix64(x: jnp.ndarray) -> jnp.ndarray:

@@ -28,6 +28,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from trino_tpu.obs import trace as tracing
 from trino_tpu.server import wire
 from trino_tpu.server.exchange_client import ExchangeClient, TaskLocation
 from trino_tpu.server.statemachine import StateMachine, query_state_machine
@@ -38,6 +39,7 @@ from trino_tpu.sql.planner.fragmenter import RemoteSourceNode, fragment_plan
 _ANNOUNCE_RE = re.compile(r"^/v1/announce/([^/]+)$")
 _RESULT_RE = re.compile(r"^/v1/statement/executing/([^/]+)/(\d+)$")
 _QUERY_RE = re.compile(r"^/v1/query/([^/]+)$")
+_TRACE_RE = re.compile(r"^/v1/query/([^/]+)/trace$")
 
 RESULT_PAGE_ROWS = 10_000
 
@@ -101,6 +103,11 @@ class QueryExecution:
         self.retried_tasks: List[str] = []
         self.speculative_tasks: List[str] = []  # duplicate straggler attempts
         self.fragment_tasks: Dict[int, List[TaskLocation]] = {}
+        # one trace per query; the trace id doubles as the propagation key
+        # stamped on worker/exchange requests (reference: the otel Tracer
+        # injected into DispatchManager + the traceparent headers of the
+        # internal HTTP clients)
+        self.tracer = tracing.Tracer()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
     def start(self) -> None:
@@ -120,62 +127,91 @@ class QueryExecution:
 
     # ------------------------------------------------------------ lifecycle
     def _run(self) -> None:
+        root_span = self.tracer.start_span(
+            "query", query_id=self.query_id, user=self.user)
         try:
-            self.state.set("PLANNING")
-            session = self.session_factory(self.session_properties)
-            from trino_tpu.server.security import Identity
-
-            session.identity = Identity(self.user)
-            from trino_tpu.exec.query import plan_sql, run_query
-            from trino_tpu.sql.parser import ast
-            from trino_tpu.sql.parser.parser import parse_statement
-
-            stmt = parse_statement(self.sql)
-            if not isinstance(stmt, ast.Query):
-                # metadata statements (SHOW …, EXPLAIN) run coordinator-local
-                result = run_query(session, self.sql)
-                self.columns, self.rows = result.column_names, result.rows
-                if isinstance(stmt, ast.SetSession):
-                    # run_query validated+coerced it on the throwaway session
-                    self.set_session[stmt.name] = session.properties[stmt.name]
-                elif isinstance(stmt, ast.ResetSession):
-                    self.reset_session.append(stmt.name)
-                self.state.set("FINISHED")
-                return
-            root = plan_sql(session, self.sql)
-            if any(
-                isinstance(n, P.TableScanNode)
-                and session.catalogs[n.catalog].coordinator_only
-                for n in P.walk_plan(root)
-            ):
-                # scans over process-local catalogs (memory) cannot be
-                # shipped to workers — execute on the coordinator's own
-                # engine (its embedded worker role)
-                result = run_query(session, self.sql)
-                self.columns, self.rows = result.column_names, result.rows
-                self.state.set("FINISHED")
-                return
-            fragments = fragment_plan(root, session)
-            self.state.set("STARTING")
-            workers = self.registry.alive()
-            if not workers:
-                raise RuntimeError("no alive workers")
-            self._schedule(session, fragments, workers)
-            self.state.set("RUNNING")
-            result_page = self._run_root_fragment(session, fragments)
-            self.state.set("FINISHING")
-            self.columns = fragments[-1].root.column_names
-            self.rows = result_page.to_pylist()
+            with tracing.activate(self.tracer, root_span.span_id):
+                self._run_lifecycle()
+            # close the trace BEFORE the terminal transition: the state
+            # machine's listeners (QueryCompletedEvent) snapshot the spans,
+            # and a query that completes on THIS thread must carry its
+            # duration by then (a cancel/kill from another thread still
+            # fires with whatever was recorded at that instant)
+            self.tracer.end_span(root_span)
             self.state.set("FINISHED")
         except Exception as e:  # noqa: BLE001 — reported through query info
             if self.failure is None:
                 # an administrative kill() may already have set the real
                 # reason; the task-cancellation fallout must not clobber it
                 self.failure = f"{e}\n{traceback.format_exc()}"
+            root_span.set("error", str(e).split("\n")[0][:300])
             self._cancel_tasks()
+            self.tracer.end_span(root_span)
             self.state.set("FAILED")
         finally:
+            self.tracer.end_span(root_span)  # idempotent safety net
+            # the latch decides: a kill()/cancel() racing this thread may
+            # already have set CANCELED/FAILED — record what actually stuck
+            root_span.set("state", self.state.get())
             self._cleanup_spool()
+
+    def _run_lifecycle(self) -> None:
+        """The coordinator half of the query, span-per-phase (reference:
+        SqlQueryExecution.start's analyze -> plan -> schedule with otel
+        spans around each)."""
+        self.state.set("PLANNING")
+        session = self.session_factory(self.session_properties)
+        from trino_tpu.server.security import Identity
+
+        session.identity = Identity(self.user)
+        from trino_tpu.exec.query import plan_sql, run_query
+        from trino_tpu.sql.parser import ast
+        from trino_tpu.sql.parser.parser import parse_statement
+
+        # statement-kind probe, unspanned: plan_sql re-parses under its own
+        # "parse" span, and two parse spans would double-attribute the time
+        stmt = parse_statement(self.sql)
+        if not isinstance(stmt, ast.Query):
+            # metadata statements (SHOW …, EXPLAIN) run coordinator-local
+            with self.tracer.span("execute/coordinator-local"):
+                result = run_query(session, self.sql)
+            self.columns, self.rows = result.column_names, result.rows
+            if isinstance(stmt, ast.SetSession):
+                # run_query validated+coerced it on the throwaway session
+                self.set_session[stmt.name] = session.properties[stmt.name]
+            elif isinstance(stmt, ast.ResetSession):
+                self.reset_session.append(stmt.name)
+            return
+        # plan_sql emits nested analyze/plan + optimize spans (ambient)
+        root = plan_sql(session, self.sql)
+        if any(
+            isinstance(n, P.TableScanNode)
+            and session.catalogs[n.catalog].coordinator_only
+            for n in P.walk_plan(root)
+        ):
+            # scans over process-local catalogs (memory) cannot be
+            # shipped to workers — execute on the coordinator's own
+            # engine (its embedded worker role)
+            with self.tracer.span("execute/coordinator-local"):
+                result = run_query(session, self.sql)
+            self.columns, self.rows = result.column_names, result.rows
+            return
+        with self.tracer.span("fragment") as sp:
+            fragments = fragment_plan(root, session)
+            sp.set("fragments", len(fragments))
+        self.state.set("STARTING")
+        workers = self.registry.alive()
+        if not workers:
+            raise RuntimeError("no alive workers")
+        with self.tracer.span("schedule") as sp:
+            sp.set("workers", len(workers))
+            self._schedule(session, fragments, workers)
+        self.state.set("RUNNING")
+        with self.tracer.span("execute/root-fragment"):
+            result_page = self._run_root_fragment(session, fragments)
+        self.state.set("FINISHING")
+        self.columns = fragments[-1].root.column_names
+        self.rows = result_page.to_pylist()
 
     def _cleanup_spool(self) -> None:
         """Drop this query's spooled task outputs (reference: exchange
@@ -309,8 +345,11 @@ class QueryExecution:
             output_partition_channels=getattr(
                 frag, "output_partition_channels", None),
         )
+        # trace-context propagation: the worker parents its task span under
+        # the coordinator's current (schedule) span via this header
         status, resp, _ = wire.http_request(
-            "POST", f"{worker['url']}/v1/task/{task_id}", req.to_bytes())
+            "POST", f"{worker['url']}/v1/task/{task_id}", req.to_bytes(),
+            headers={tracing.TRACEPARENT_HEADER: self.tracer.traceparent()})
         if status >= 400:
             raise RuntimeError(
                 f"task create failed on {worker['nodeId']}: "
@@ -477,7 +516,8 @@ class QueryExecution:
         remote_pages: Dict[int, list] = {}
         for node in P.walk_plan(root_frag.root):
             if isinstance(node, RemoteSourceNode):
-                client = ExchangeClient(self.fragment_tasks[node.fragment_id])
+                client = ExchangeClient(self.fragment_tasks[node.fragment_id],
+                                        tracer=self.tracer)
                 client.start()
                 remote_pages[node.fragment_id] = client.pages()
         ex = FragmentExecutor(session, {}, remote_pages)
@@ -580,6 +620,13 @@ class CoordinatorServer:
         from trino_tpu.server.events import EventListenerManager
 
         self.events = EventListenerManager()
+        # first in-tree SPI consumer, on by default: slow queries log with
+        # their span breakdown (threshold: slow_query_log_threshold_ms
+        # session property > TRINO_TPU_SLOW_QUERY_MS env > 30 s default;
+        # listeners are exception-isolated, so this can never fail a query)
+        from trino_tpu.obs.listeners import SlowQueryLogListener
+
+        self.events.add(SlowQueryLogListener())
         self.queries_submitted = 0
         self.start_time = time.time()
         handler = _make_handler(self)
@@ -620,10 +667,16 @@ class CoordinatorServer:
             if state not in ("FINISHED", "FAILED", "CANCELED"):
                 return
             now = time.time()
+            wall = now - created_at
+            from trino_tpu.obs import metrics as M
+
+            M.QUERY_SECONDS.observe(wall, state)
             self.events.fire_completed(
                 ev.QueryCompletedEvent(
                     query_id, user, sql, state, created_at, now,
-                    now - created_at, len(execution.rows), execution.failure,
+                    wall, len(execution.rows), execution.failure,
+                    spans=tuple(execution.tracer.to_dicts()),
+                    session_properties=dict(execution.session_properties),
                 )
             )
 
@@ -667,6 +720,66 @@ class CoordinatorServer:
     def get_query(self, query_id: str) -> Optional[QueryExecution]:
         with self._qlock:
             return self.queries.get(query_id)
+
+    def query_state_counts(self):
+        """Public metrics accessor: ``(queries-by-state counts, result rows
+        held by FINISHED queries)`` — the exporter reads this instead of
+        reaching into ``_qlock``/``queries`` privates."""
+        by_state: Dict[str, int] = {}
+        total_rows = 0
+        with self._qlock:
+            queries = list(self.queries.values())
+        for q in queries:
+            st = q.state.get()
+            by_state[st] = by_state.get(st, 0) + 1
+            if st == "FINISHED":
+                total_rows += len(q.rows)
+        return by_state, total_rows
+
+    def query_trace(self, query_id: str) -> Optional[dict]:
+        """Assemble the query's cross-process span tree: coordinator-side
+        spans merge with each worker task's span dump (pulled on demand from
+        ``GET /v1/task/{id}/spans`` — task-span collection is lazy, like the
+        reference's trace export being independent of the query path)."""
+        q = self.get_query(query_id)
+        if q is None:
+            return None
+        spans = q.tracer.to_dicts()
+        # snapshot: the query thread inserts fragments while it schedules,
+        # and a live trace poll must not die on a resizing dict
+        locations = [loc for locs in list(q.fragment_tasks.values())
+                     for loc in list(locs) if loc is not None]
+
+        def fetch(loc):
+            """One task's span dump; a gone/partitioned worker loses its
+            spans, never the whole trace. Short timeout + parallel fetch:
+            the endpoint must answer promptly even when every worker is
+            blackholed (serial 10 s timeouts would stack per task)."""
+            try:
+                status, body, _ = wire.http_request(
+                    "GET", f"{loc.base_url}/v1/task/{loc.task_id}/spans",
+                    timeout=3.0)
+                if status < 400:
+                    return json.loads(body).get("spans", ())
+            except Exception:  # noqa: BLE001
+                pass
+            return ()
+
+        if locations:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(locations))) as tp:
+                for dump in tp.map(fetch, locations):
+                    spans.extend(dump)
+        from trino_tpu.obs.trace import build_tree
+
+        return {
+            "queryId": q.query_id,
+            "traceId": q.tracer.trace_id,
+            "state": q.state.get(),
+            "spanCount": len(spans),
+            "root": build_tree(spans),
+        }
 
     def _kill_query(self, query_id: str, reason: str) -> None:
         q = self.get_query(query_id)
@@ -856,6 +969,20 @@ def _make_handler(server: CoordinatorServer):
                     q.state.wait_for_terminal(0.5)
                 self._send(200, json.dumps(
                     _result_payload(server, q, int(m.group(2)))).encode())
+                return
+            m = _TRACE_RE.match(self.path)
+            if m:
+                q = server.get_query(m.group(1))
+                if not self._authenticated(query=q):
+                    return
+                trace = (server.query_trace(m.group(1))
+                         if q is not None else None)
+                if trace is None:
+                    # covers eviction between the two lookups too: never
+                    # answer 200 with a null body
+                    self._send(404, b'{"error": "no such query"}')
+                    return
+                self._send(200, json.dumps(trace).encode())
                 return
             m = _QUERY_RE.match(self.path)
             if m:

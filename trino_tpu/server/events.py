@@ -9,14 +9,21 @@ events are plain dataclasses, and a failing listener never fails the query.
 
 The metrics side (``render_metrics``) exposes the coordinator's counters in
 the Prometheus text format — the role of the reference's JMX-to-/metrics
-bridge (``trino-jmx`` + airlift's MetricsResource).
+bridge (``trino-jmx`` + airlift's MetricsResource). Since the observability
+PR it is a thin bridge: server-derived gauges refresh from the server's
+PUBLIC accessors into the typed registry (``trino_tpu/obs/metrics.py``)
+and the registry renders the page — seed metric names unchanged, engine
+counters and histograms ride along.
 """
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import List, Mapping, Optional, Tuple
+
+logger = logging.getLogger("trino_tpu.events")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +50,15 @@ class QueryCompletedEvent:
     wall_seconds: float
     output_rows: int
     error: Optional[str] = None
+    # the query's trace, exported span records (obs/trace.py Span.to_dict)
+    # — the reference attaches QueryStats/operator summaries; here the span
+    # tree carries the same where-did-time-go data (SlowQueryLogListener
+    # is the first consumer)
+    spans: Tuple[dict, ...] = ()
+    # the session-property view the query ran with (reference:
+    # QueryContext.sessionProperties on the completed event)
+    session_properties: Mapping[str, object] = dataclasses.field(
+        default_factory=dict)
 
 
 class EventListener:
@@ -68,46 +84,62 @@ class EventListenerManager:
         with self._lock:
             self._listeners.append(listener)
 
+    def _snapshot(self) -> List[EventListener]:
+        with self._lock:
+            return list(self._listeners)
+
     def fire_created(self, event: QueryCreatedEvent) -> None:
-        for lsn in list(self._listeners):
+        for lsn in self._snapshot():
             try:
                 lsn.query_created(event)
-            except Exception:  # noqa: BLE001 — listener faults never fail queries
-                pass
+            except Exception:  # noqa: BLE001 — listener faults never fail
+                # queries, but a silently-broken listener is undiagnosable:
+                # log it (reference: EventListenerManager catches AND logs)
+                logger.exception(
+                    "event listener %s failed in query_created for %s",
+                    type(lsn).__name__, event.query_id)
 
     def fire_completed(self, event: QueryCompletedEvent) -> None:
-        for lsn in list(self._listeners):
+        for lsn in self._snapshot():
             try:
                 lsn.query_completed(event)
             except Exception:  # noqa: BLE001
-                pass
+                logger.exception(
+                    "event listener %s failed in query_completed for %s",
+                    type(lsn).__name__, event.query_id)
 
 
 def render_metrics(server) -> str:
-    """Coordinator counters in the Prometheus text exposition format."""
-    by_state: Dict[str, int] = {}
-    total_rows = 0
-    with server._qlock:
-        queries = list(server.queries.values())
-    for q in queries:
-        st = q.state.get()
-        by_state[st] = by_state.get(st, 0) + 1
-        if st == "FINISHED":
-            total_rows += len(q.rows)
-    lines = [
-        "# TYPE trino_tpu_queries gauge",
-    ]
-    for st in sorted(by_state):
-        lines.append(f'trino_tpu_queries{{state="{st}"}} {by_state[st]}')
-    lines.append("# TYPE trino_tpu_queries_total counter")
-    lines.append(f"trino_tpu_queries_total {getattr(server, 'queries_submitted', 0)}")
-    lines.append("# TYPE trino_tpu_result_rows gauge")
-    lines.append(f"trino_tpu_result_rows {total_rows}")
-    workers = server.registry.alive() if hasattr(server, "registry") else []
-    lines.append("# TYPE trino_tpu_workers gauge")
-    lines.append(f"trino_tpu_workers {len(workers)}")
-    lines.append("# TYPE trino_tpu_uptime_seconds gauge")
-    lines.append(
-        f"trino_tpu_uptime_seconds {time.time() - getattr(server, 'start_time', time.time()):.1f}"
-    )
-    return "\n".join(lines) + "\n"
+    """Coordinator metrics page: refresh the server-derived gauges from the
+    server's PUBLIC accessors (``query_state_counts`` — no reaching into
+    ``_qlock``/``queries`` privates), then render the typed registry, which
+    also carries the process-global engine counters and histograms."""
+    from trino_tpu.obs import metrics as M
+
+    gauges = (M.QUERIES, M.RESULT_ROWS, M.QUERIES_TOTAL, M.WORKERS,
+              M.UPTIME_SECONDS)
+    # RENDER_LOCK (shared with render_registry, reentrant) makes refresh-
+    # render-clear one atomic unit: concurrent scrapes — of this server,
+    # another coordinator, or a same-process worker — never observe a
+    # half-refreshed gauge
+    with M.RENDER_LOCK:
+        by_state, rows = server.query_state_counts()
+        M.QUERIES.clear()
+        for st, n in by_state.items():
+            M.QUERIES.set(n, st)
+        M.RESULT_ROWS.set(rows)
+        M.QUERIES_TOTAL.clear()
+        M.QUERIES_TOTAL.inc(getattr(server, "queries_submitted", 0))
+        alive = server.registry.alive() if hasattr(server, "registry") else []
+        M.WORKERS.set(len(alive))
+        M.UPTIME_SECONDS.set(round(
+            time.time() - getattr(server, "start_time", time.time()), 1))
+        try:
+            return M.render_registry()
+        finally:
+            for metric in gauges:
+                # clear afterwards: the process-global registry must not
+                # keep a stopped server's numbers, and a same-process
+                # worker's render must not re-export this coordinator's
+                # gauge values as its own
+                metric.clear()

@@ -14,6 +14,8 @@ from typing import List, Optional
 
 from trino_tpu.data.page import Page
 from trino_tpu.data.serde import deserialize_page
+from trino_tpu.obs import metrics as M
+from trino_tpu.obs import trace as tracing
 from trino_tpu.server import wire
 
 
@@ -42,12 +44,25 @@ class ExchangeClient:
     protocol with no extra machinery.
     """
 
-    def __init__(self, locations: List[TaskLocation], max_buffered_pages: int = 64):
+    def __init__(self, locations: List[TaskLocation], max_buffered_pages: int = 64,
+                 tracer: Optional["tracing.Tracer"] = None):
         self._locations = list(locations)
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_buffered_pages)
         self._remaining = len(self._locations)
         self._lock = threading.Lock()
         self._failure: Optional[str] = None
+        # span context is captured AT CONSTRUCTION (the consumer's thread):
+        # puller threads record their exchange spans under the span that
+        # created the client (task body / root-fragment execute). With no
+        # explicit tracer the ambient context is adopted — call sites that
+        # tests replace with fakes stay signature-compatible.
+        if tracer is None:
+            ambient = tracing.current()
+            if ambient is not None:
+                tracer = ambient[0]
+        self._tracer = tracer
+        self._parent_span_id = (
+            tracer.current_span_id() if tracer is not None else None)
         self._threads = [
             threading.Thread(target=self._pull, args=(loc,), daemon=True)
             for loc in self._locations
@@ -64,10 +79,18 @@ class ExchangeClient:
         window makes re-reads of un-acked tokens safe (reference:
         HttpPageBufferClient's Backoff); only the token advance is an ack."""
         delay = 0.2
+        trace_headers = (
+            {tracing.TRACEPARENT_HEADER:
+             self._tracer.traceparent(self._parent_span_id)}
+            if self._tracer is not None else None)
         for attempt in range(self.MAX_ATTEMPTS):
+            M.EXCHANGE_REQUESTS.inc()
+            if attempt:
+                M.EXCHANGE_RETRIES.inc()
             try:
                 status, body, headers = wire.http_request(
-                    "GET", loc.results_url(token), timeout=120.0
+                    "GET", loc.results_url(token), timeout=120.0,
+                    headers=trace_headers,
                 )
             except Exception as e:  # noqa: BLE001 — socket-level failure
                 if attempt == self.MAX_ATTEMPTS - 1:
@@ -105,9 +128,26 @@ class ExchangeClient:
             path = os.path.join(spool_dir, f"{loc.task_id}.pages")
         if not os.path.exists(path):
             return False
-        with open(path, "rb") as f:
-            body = f.read()
-        pages = wire.unframe_pages(body)
+        sp = (self._tracer.start_span(
+                  "spool/read", parent_id=self._parent_span_id,
+                  task=loc.task_id, path=path)
+              if self._tracer is not None else tracing.NOOP_SPAN)
+        try:
+            with open(path, "rb") as f:
+                body = f.read()
+            M.SPOOL_READS.inc()
+            # disk reads, NOT exchange bytes: trino_tpu_exchange_bytes_total
+            # stays a network-throughput signal
+            M.SPOOL_BYTES.inc(len(body))
+            pages = wire.unframe_pages(body)
+            sp.set("bytes", len(body))
+            sp.set("pages", len(pages))
+        except Exception as e:  # a truncated spool file must not leave the
+            sp.set("error", str(e)[:300])  # span dangling open
+            raise
+        finally:
+            if self._tracer is not None:
+                self._tracer.end_span(sp)
         for pb in pages:
             self._queue.put(deserialize_page(pb))
         # final ack to the live buffer (if the producer still exists) so it
@@ -121,15 +161,27 @@ class ExchangeClient:
 
     def _pull(self, loc: TaskLocation) -> None:
         token = 0
+        # one span per upstream location covering its whole pull stream
+        # (reference: DirectExchangeClient's per-client otel spans)
+        sp = (self._tracer.start_span(
+                  "exchange/pull", parent_id=self._parent_span_id,
+                  task=loc.task_id, buffer=loc.buffer_id)
+              if self._tracer is not None else tracing.NOOP_SPAN)
+        pulled_bytes = 0
+        pulled_pages = 0
         try:
             if self._read_spool(loc):
+                sp.set("spooled", True)
                 return
             while True:
                 body, headers = self._request_with_retry(loc, token)
                 failed = headers.get(wire.H_TASK_FAILED)
                 if failed:
                     raise RuntimeError(f"upstream task {loc.task_id} failed: {failed}")
+                M.EXCHANGE_BYTES.inc(len(body))
+                pulled_bytes += len(body)
                 for pb in wire.unframe_pages(body):
+                    pulled_pages += 1
                     self._queue.put(deserialize_page(pb))
                 token = int(headers.get(wire.H_NEXT_TOKEN, token))
                 if headers.get(wire.H_BUFFER_COMPLETE) == "true":
@@ -137,10 +189,15 @@ class ExchangeClient:
                     wire.http_request("DELETE", loc.results_url(token), timeout=10.0)
                     break
         except Exception as e:  # noqa: BLE001 — surfaced to the consumer
+            sp.set("error", str(e)[:300])
             with self._lock:
                 if self._failure is None:
                     self._failure = str(e)
         finally:
+            sp.set("bytes", pulled_bytes)
+            sp.set("pages", pulled_pages)
+            if self._tracer is not None:
+                self._tracer.end_span(sp)
             with self._lock:
                 self._remaining -= 1
             self._queue.put(None)  # wake the consumer

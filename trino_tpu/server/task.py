@@ -16,12 +16,15 @@ from __future__ import annotations
 import dataclasses
 import pickle
 import threading
+import time
 import traceback
 from typing import Dict, List, Optional
 
 from trino_tpu.data.page import Page
 from trino_tpu.data.serde import serialize_page
 from trino_tpu.exec.executor import Executor
+from trino_tpu.obs import metrics as M
+from trino_tpu.obs import trace as tracing
 from trino_tpu.server.buffer import OutputBuffer, PartitionedOutputBuffer
 from trino_tpu.server.statemachine import StateMachine, task_state_machine
 from trino_tpu.sql.planner import plan as P
@@ -74,11 +77,20 @@ class FragmentExecutor(Executor):
         # applied); dynamic-filter domains collected in THIS fragment still
         # narrow the per-split scan
         constraint = self.scan_constraint(node)
-        datas = [conn.scan(s, node.column_names, constraint=constraint) for s in splits]
-        self.scan_stats[node.id] = sum(
-            len(next(iter(d.values())).values) if d else 0 for d in datas
-        )
-        return assemble_scan_page(node.column_names, node.column_types, datas)
+        with tracing.span("device/staging", table=node.table,
+                          splits=len(splits)) as sp:
+            t0 = time.perf_counter()
+            datas = [conn.scan(s, node.column_names, constraint=constraint)
+                     for s in splits]
+            rows = sum(
+                len(next(iter(d.values())).values) if d else 0 for d in datas)
+            self.scan_stats[node.id] = rows
+            page = assemble_scan_page(node.column_names, node.column_types, datas)
+            staged = time.perf_counter() - t0
+            sp.set("staged_rows", rows)
+        M.STAGED_ROWS.inc(rows)
+        M.STAGING_SECONDS.inc(staged)
+        return page
 
     def _exec_RemoteSourceNode(self, node: RemoteSourceNode) -> Page:
         pages = self._remote_pages.get(node.fragment_id, [])
@@ -98,9 +110,17 @@ class SqlTask:
     FLUSHING = body finished, buffer still draining to consumers.
     """
 
-    def __init__(self, request: TaskRequest, session_factory):
+    def __init__(self, request: TaskRequest, session_factory,
+                 traceparent: Optional[str] = None):
         self.request = request
         self.state: StateMachine[str] = task_state_machine()
+        # worker half of the query's trace: same trace id, spans rooted
+        # under the coordinator's propagated (schedule) span; a missing
+        # header starts a detached local trace (direct task POSTs in tests)
+        ctx = tracing.parse_traceparent(traceparent)
+        self.tracer = tracing.Tracer(
+            trace_id=ctx[0] if ctx else None,
+            root_parent_id=ctx[1] if ctx else None)
         from trino_tpu.server.buffer import DEFAULT_MAX_BUFFER_BYTES
 
         sink_max = int(request.session_properties.get(
@@ -146,97 +166,113 @@ class SqlTask:
             self._thread.start()
 
     def _run(self) -> None:
+        task_span = self.tracer.start_span(
+            "task", task_id=self.request.task_id,
+            query_id=self.request.query_id)
         try:
-            req = self.request
-            # fault injection (reference: FailureInjector.java:41-69 —
-            # keyed by trace/stage/partition/attempt; here by task-id match)
-            inject = str(req.session_properties.get("failure_injection") or "")
-            if inject and inject in req.task_id:
-                raise RuntimeError(f"injected failure for {req.task_id}")
-            # straggler injection ("substr:seconds") — exercises the FTE
-            # scheduler's speculative execution (reference:
-            # FailureInjector's sleep mode)
-            slow = str(req.session_properties.get("slow_injection") or "")
-            if slow:
-                import time as _t
-
-                sub, _, secs = slow.partition(":")
-                if sub and sub in req.task_id:
-                    _t.sleep(float(secs or "5"))
-            session = self._session_factory(req.session_properties)
-            if self._try_streaming(req, session):
-                return
-            # pull all upstream fragments first (bulk-synchronous bodies:
-            # joins/final aggs/sorts need their whole input; the pull itself
-            # streams + backpressures)
-            remote_pages: Dict[int, List[Page]] = {}
-            for fid, locations in req.upstream.items():
-                from trino_tpu.server.exchange_client import ExchangeClient, TaskLocation
-
-                client = ExchangeClient([TaskLocation(u, t, b) for u, t, b in locations])
-                client.start()
-                remote_pages[fid] = client.pages()
-            ex = FragmentExecutor(session, req.splits, remote_pages)
-            self._track_executor(ex)
-            page = ex.execute_checked(req.fragment_root)
-            self._track_executor(ex)
-            from trino_tpu.exec.memory import page_bytes
-
-            page = page.compact()
-            self.flushing_bytes = page_bytes(page)  # held through the drain
-            self.state.set("FLUSHING")
-            chunk_rows = self._chunk_rows(page)
-            if req.output_partition_channels is not None:
-                # hash-partitioned shuffle producer: split the output by
-                # key hash (same splitmix64 combine as the device exchange,
-                # so every producer places a key identically) and enqueue
-                # each partition into its consumer's stream. Under FTE the
-                # per-partition streams spool FIRST (durability before
-                # visibility — retried consumers re-read partition files).
-                from trino_tpu.exec.memory import partition_page_host
-
-                pids = _canonical_partition_ids(
-                    page, req.output_partition_channels, req.consumer_count)
-                parts = partition_page_host(
-                    page, req.output_partition_channels, req.consumer_count,
-                    pid=pids)
-                part_frames = [
-                    [serialize_page(c)
-                     for c in _chunk_pages(part.compact(), chunk_rows)]
-                    for part in parts
-                ]
-                if spool_directory():
-                    self._spool_partitioned(part_frames)
-                for pid, frames in enumerate(part_frames):
-                    for pb in frames:
-                        self.output.enqueue_partition(pid, pb)
-                self.output.set_complete()
-                self.state.set("FINISHED")
-                return
-            # STREAMING output: size-bounded chunks enqueue as they
-            # serialize, so consumers pull chunk 0 while chunk 1 encodes,
-            # and the bounded buffer's watermark gives real backpressure
-            # (reference invariant SURVEY §A.6: incremental page flow).
-            # Under FTE (spool configured) the whole output spools FIRST —
-            # retried consumers must find the complete durable copy — which
-            # trades pipelining for recoverability, as the reference's FTE
-            # exchanges do.
-            if spool_directory():
-                page_frames = [
-                    serialize_page(c) for c in _chunk_pages(page, chunk_rows)
-                ]
-                self._spool(page_frames)
-                for pb in page_frames:
-                    self.output.enqueue(pb)
-            else:
-                for c in _chunk_pages(page, chunk_rows):
-                    self.output.enqueue(serialize_page(c))  # blocks at watermark
-            self.output.set_complete()
-            self.state.set("FINISHED")
+            with tracing.activate(self.tracer, task_span.span_id):
+                self._run_body()
         except Exception as e:  # noqa: BLE001 — reported through task status
             self.failure = f"{e}\n{traceback.format_exc()}"
+            task_span.set("error", str(e).split("\n")[0][:300])
             self.output.abort(str(e))
             self.state.set("FAILED")
+        finally:
+            task_span.set("state", self.state.get())
+            self.tracer.end_span(task_span)
+
+    def _run_body(self) -> None:
+        req = self.request
+        # fault injection (reference: FailureInjector.java:41-69 —
+        # keyed by trace/stage/partition/attempt; here by task-id match)
+        inject = str(req.session_properties.get("failure_injection") or "")
+        if inject and inject in req.task_id:
+            raise RuntimeError(f"injected failure for {req.task_id}")
+        # straggler injection ("substr:seconds") — exercises the FTE
+        # scheduler's speculative execution (reference:
+        # FailureInjector's sleep mode)
+        slow = str(req.session_properties.get("slow_injection") or "")
+        if slow:
+            sub, _, secs = slow.partition(":")
+            if sub and sub in req.task_id:
+                time.sleep(float(secs or "5"))
+        session = self._session_factory(req.session_properties)
+        if self._try_streaming(req, session):
+            return
+        # pull all upstream fragments first (bulk-synchronous bodies:
+        # joins/final aggs/sorts need their whole input; the pull itself
+        # streams + backpressures)
+        remote_pages: Dict[int, List[Page]] = {}
+        for fid, locations in req.upstream.items():
+            from trino_tpu.server.exchange_client import ExchangeClient, TaskLocation
+
+            client = ExchangeClient([TaskLocation(u, t, b) for u, t, b in locations])
+            client.start()
+            remote_pages[fid] = client.pages()
+        ex = FragmentExecutor(session, req.splits, remote_pages)
+        self._track_executor(ex)
+        with tracing.span("device/execute") as sp:
+            t0 = time.perf_counter()
+            page = ex.execute_checked(req.fragment_root)
+            device_s = time.perf_counter() - t0
+            sp.set("device_seconds", round(device_s, 6))
+            sp.set("staged_rows", sum(ex.scan_stats.values()))
+            sp.set("output_rows", int(page.num_rows))
+        M.DEVICE_SECONDS.inc(device_s)
+        self._track_executor(ex)
+        from trino_tpu.exec.memory import page_bytes
+
+        page = page.compact()
+        self.flushing_bytes = page_bytes(page)  # held through the drain
+        self.state.set("FLUSHING")
+        chunk_rows = self._chunk_rows(page)
+        if req.output_partition_channels is not None:
+            # hash-partitioned shuffle producer: split the output by
+            # key hash (same splitmix64 combine as the device exchange,
+            # so every producer places a key identically) and enqueue
+            # each partition into its consumer's stream. Under FTE the
+            # per-partition streams spool FIRST (durability before
+            # visibility — retried consumers re-read partition files).
+            from trino_tpu.exec.memory import partition_page_host
+
+            pids = _canonical_partition_ids(
+                page, req.output_partition_channels, req.consumer_count)
+            parts = partition_page_host(
+                page, req.output_partition_channels, req.consumer_count,
+                pid=pids)
+            part_frames = [
+                [serialize_page(c)
+                 for c in _chunk_pages(part.compact(), chunk_rows)]
+                for part in parts
+            ]
+            if spool_directory():
+                self._spool_partitioned(part_frames)
+            for pid, frames in enumerate(part_frames):
+                for pb in frames:
+                    self.output.enqueue_partition(pid, pb)
+            self.output.set_complete()
+            self.state.set("FINISHED")
+            return
+        # STREAMING output: size-bounded chunks enqueue as they
+        # serialize, so consumers pull chunk 0 while chunk 1 encodes,
+        # and the bounded buffer's watermark gives real backpressure
+        # (reference invariant SURVEY §A.6: incremental page flow).
+        # Under FTE (spool configured) the whole output spools FIRST —
+        # retried consumers must find the complete durable copy — which
+        # trades pipelining for recoverability, as the reference's FTE
+        # exchanges do.
+        if spool_directory():
+            page_frames = [
+                serialize_page(c) for c in _chunk_pages(page, chunk_rows)
+            ]
+            self._spool(page_frames)
+            for pb in page_frames:
+                self.output.enqueue(pb)
+        else:
+            for c in _chunk_pages(page, chunk_rows):
+                self.output.enqueue(serialize_page(c))  # blocks at watermark
+        self.output.set_complete()
+        self.state.set("FINISHED")
 
     # ------------------------------------------------------- streaming loop
     @staticmethod
@@ -327,12 +363,25 @@ class SqlTask:
         splits = req.splits[scan.id]
         if len(splits) <= 1:
             return False  # nothing to pipeline
-        for split in splits:
-            ex = FragmentExecutor(session, {scan.id: [split]}, {})
-            self._track_executor(ex)
-            out = ex.execute_checked(req.fragment_root).compact()
-            self._enqueue_out(out, req.output_partition_channels,
-                              req.consumer_count)
+        # the span covers the whole stage; device_seconds counts ONLY the
+        # execute calls (enqueue blocks at the output watermark, and that
+        # backpressure wait must not read as device time)
+        with tracing.span("device/execute", mode="split-streaming") as sp:
+            device_s = 0.0
+            staged_rows = 0
+            for split in splits:
+                ex = FragmentExecutor(session, {scan.id: [split]}, {})
+                self._track_executor(ex)
+                t0 = time.perf_counter()
+                out = ex.execute_checked(req.fragment_root).compact()
+                device_s += time.perf_counter() - t0
+                staged_rows += sum(ex.scan_stats.values())
+                self._enqueue_out(out, req.output_partition_channels,
+                                  req.consumer_count)
+            sp.set("device_seconds", round(device_s, 6))
+            sp.set("staged_rows", staged_rows)
+            sp.set("splits", len(splits))
+        M.DEVICE_SECONDS.inc(device_s)
         self.state.set("FLUSHING")
         self.output.set_complete()
         self.state.set("FINISHED")
@@ -365,6 +414,11 @@ class SqlTask:
 
         client = ExchangeClient([TaskLocation(u, t, b) for u, t, b in locations])
         client.start()
+        # device_clock accumulates ONLY the executor calls: the stream loop
+        # also waits on upstream pulls and output backpressure, and that
+        # wall time belongs to the exchange/pull spans, not device_seconds
+        device_clock = [0.0]
+
         def enqueue_out(out: Page) -> None:
             self._enqueue_out(out, req.output_partition_channels,
                               req.consumer_count)
@@ -375,7 +429,9 @@ class SqlTask:
                 page = Page.concat_pages(page, p)
             ex = FragmentExecutor(session, {}, {src.fragment_id: [page]})
             self._track_executor(ex)
+            t0 = time.perf_counter()
             out = ex.execute_checked(req.fragment_root).compact()
+            device_clock[0] += time.perf_counter() - t0
             enqueue_out(out)
 
         if final_agg is not None:
@@ -394,25 +450,35 @@ class SqlTask:
                     page = Page.concat_pages(running, page)
                 ex = FragmentExecutor(session, {}, {})
                 self._track_executor(ex)
+                t0 = time.perf_counter()
                 out = ex.aggregate_intermediate(node, page).compact()
                 ex.raise_errors()
+                device_clock[0] += time.perf_counter() - t0
                 return out
 
-            for page in client.iter_pages():
-                if page.num_rows == 0:
-                    continue
-                batch.append(page)
-                batch_rows += page.num_rows
-                if batch_rows >= self.STREAM_BATCH_ROWS:
+            with tracing.span("device/execute", mode="streaming-fold") as sp:
+                in_rows = 0
+                for page in client.iter_pages():
+                    if page.num_rows == 0:
+                        continue
+                    batch.append(page)
+                    batch_rows += page.num_rows
+                    in_rows += page.num_rows
+                    if batch_rows >= self.STREAM_BATCH_ROWS:
+                        running = fold(running, batch)
+                        batch, batch_rows = [], 0
+                if batch:
                     running = fold(running, batch)
-                    batch, batch_rows = [], 0
-            if batch:
-                running = fold(running, batch)
-            if running is None:
-                running = Page.all_dead(src.types)
-            ex = FragmentExecutor(session, {}, {})
-            out = ex.aggregate_final(node, running).compact()
-            ex.raise_errors()
+                if running is None:
+                    running = Page.all_dead(src.types)
+                ex = FragmentExecutor(session, {}, {})
+                t0 = time.perf_counter()
+                out = ex.aggregate_final(node, running).compact()
+                ex.raise_errors()
+                device_clock[0] += time.perf_counter() - t0
+                sp.set("device_seconds", round(device_clock[0], 6))
+                sp.set("input_rows", in_rows)
+            M.DEVICE_SECONDS.inc(device_clock[0])
             self.state.set("FLUSHING")
             enqueue_out(out)
             self.output.set_complete()
@@ -420,16 +486,22 @@ class SqlTask:
             return True
         batch: List[Page] = []
         batch_rows = 0
-        for page in client.iter_pages():
-            if page.num_rows == 0:
-                continue
-            batch.append(page)
-            batch_rows += page.num_rows
-            if batch_rows >= self.STREAM_BATCH_ROWS:
+        with tracing.span("device/execute", mode="streaming") as sp:
+            in_rows = 0
+            for page in client.iter_pages():
+                if page.num_rows == 0:
+                    continue
+                batch.append(page)
+                batch_rows += page.num_rows
+                in_rows += page.num_rows
+                if batch_rows >= self.STREAM_BATCH_ROWS:
+                    emit(batch)
+                    batch, batch_rows = [], 0
+            if batch:
                 emit(batch)
-                batch, batch_rows = [], 0
-        if batch:
-            emit(batch)
+            sp.set("device_seconds", round(device_clock[0], 6))
+            sp.set("input_rows", in_rows)
+        M.DEVICE_SECONDS.inc(device_clock[0])
         self.state.set("FLUSHING")
         self.output.set_complete()
         self.state.set("FINISHED")
@@ -566,15 +638,18 @@ class TaskManager:
         self._lock = threading.Lock()
         self._session_factory = session_factory
 
-    def create_task(self, request: TaskRequest) -> SqlTask:
+    def create_task(self, request: TaskRequest,
+                    traceparent: Optional[str] = None) -> SqlTask:
         with self._lock:
             terminal = [tid for tid, t in self._tasks.items() if t.state.is_terminal()]
             for tid in terminal[: max(0, len(terminal) - self.MAX_TASK_HISTORY)]:
                 del self._tasks[tid]
             task = self._tasks.get(request.task_id)
             if task is None:
-                task = SqlTask(request, self._session_factory)
+                task = SqlTask(request, self._session_factory,
+                               traceparent=traceparent)
                 self._tasks[request.task_id] = task
+                M.TASKS_TOTAL.inc()
         task.start()
         return task
 

@@ -20,12 +20,14 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from trino_tpu.obs import trace as tracing
 from trino_tpu.server import wire
 from trino_tpu.server.task import TaskManager, TaskRequest
 
 _RESULTS_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)/(\d+)$")
 _TASK_RE = re.compile(r"^/v1/task/([^/]+)$")
 _STATUS_RE = re.compile(r"^/v1/task/([^/]+)/status$")
+_SPANS_RE = re.compile(r"^/v1/task/([^/]+)/spans$")
 
 
 def default_session_factory(properties):
@@ -140,7 +142,12 @@ def _make_handler(server: WorkerServer):
                     self._send(401, b'{"error": "bad internal signature"}')
                     return
                 request = TaskRequest.from_bytes(body)
-                task = server.tasks.create_task(request)
+                # trace-context propagation: the coordinator's schedule span
+                # rides in on the traceparent header so this task's spans
+                # parent into the query's trace tree
+                task = server.tasks.create_task(
+                    request, traceparent=self.headers.get(
+                        tracing.TRACEPARENT_HEADER))
                 self._send(200, json.dumps(task.info()).encode())
                 return
             self._send(404)
@@ -183,6 +190,26 @@ def _make_handler(server: WorkerServer):
                     self._send(404, b'{"error": "no such task"}')
                     return
                 self._send(200, json.dumps(task.info()).encode())
+                return
+            m = _SPANS_RE.match(self.path)
+            if m:
+                if not self._authorized():
+                    return
+                task = server.tasks.get(m.group(1))
+                if task is None:
+                    self._send(404, b'{"error": "no such task"}')
+                    return
+                self._send(200, json.dumps({
+                    "taskId": task.request.task_id,
+                    "traceId": task.tracer.trace_id,
+                    "spans": task.tracer.to_dicts(),
+                }).encode())
+                return
+            if self.path == "/v1/metrics":
+                from trino_tpu.obs.metrics import render_registry
+
+                self._send(200, render_registry().encode(),
+                           "text/plain; version=0.0.4")
                 return
             if self.path == "/v1/info":
                 self._send(200, json.dumps(
