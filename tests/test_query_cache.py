@@ -1,0 +1,548 @@
+"""Query caching subsystem (trino_tpu/cache/): canonical plan keys,
+determinism analysis, result-cache mechanics (LRU/TTL/single-flight),
+connector data-version invalidation end to end through the coordinator,
+and the bounded datagen cache."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from trino_tpu.client.session import Session
+from trino_tpu.obs import metrics as M
+
+
+def _plan(sql, props=None):
+    from trino_tpu.exec.query import plan_sql
+
+    return plan_sql(Session(props or {"catalog": "tpch", "schema": "tiny"}), sql)
+
+
+# --------------------------------------------------------- canonical keys
+def test_fingerprint_stable_across_plantings():
+    """Two plantings of the same SQL allocate different plan-node ids but
+    must fingerprint identically (ids are canonicalized)."""
+    from trino_tpu.cache.plan_key import canonicalize_plan, plan_fingerprint
+
+    sql = """select l_returnflag, sum(l_quantity) from lineitem
+             where l_shipdate <= date '1998-09-02' group by l_returnflag"""
+    a, b = _plan(sql), _plan(sql)
+    ids_a = [n.id for n in _walk(a)]
+    ids_b = [n.id for n in _walk(b)]
+    assert ids_a != ids_b  # global counter moved on
+    assert canonicalize_plan(a) == canonicalize_plan(b)
+    assert plan_fingerprint(a) == plan_fingerprint(b)
+
+
+def _walk(root):
+    from trino_tpu.sql.planner import plan as P
+
+    return list(P.walk_plan(root))
+
+
+def test_fingerprint_distinguishes_literals_and_tables():
+    from trino_tpu.cache.plan_key import plan_fingerprint
+
+    base = _plan("select count(*) from orders where o_orderkey < 100")
+    other_literal = _plan("select count(*) from orders where o_orderkey < 101")
+    other_table = _plan("select count(*) from lineitem where l_orderkey < 100")
+    assert plan_fingerprint(base) != plan_fingerprint(other_literal)
+    assert plan_fingerprint(base) != plan_fingerprint(other_table)
+
+
+def test_fingerprint_changes_with_data_versions():
+    from trino_tpu.cache.plan_key import plan_fingerprint
+
+    root = _plan("select count(*) from orders")
+    v1 = [(("tpch", "tiny", "orders"), "v1")]
+    v2 = [(("tpch", "tiny", "orders"), "v2")]
+    assert plan_fingerprint(root, v1) != plan_fingerprint(root, v2)
+    assert plan_fingerprint(root, v1) == plan_fingerprint(root, list(v1))
+
+
+def test_capture_versions_immutable_and_memory():
+    from trino_tpu.cache.plan_key import capture_versions
+
+    s = Session({"catalog": "tpch", "schema": "tiny"})
+    root = _plan("select count(*) from orders")
+    assert capture_versions(s, root) == [
+        (("tpch", "tiny", "orders"), "immutable")]
+    s.execute("create table memory.default.cv (a bigint)")
+    root2 = plan_root(s, "select a from memory.default.cv")
+    before = capture_versions(s, root2)
+    s.execute("insert into memory.default.cv values (1)")
+    after = capture_versions(s, root2)
+    assert before != after
+
+
+def plan_root(session, sql):
+    from trino_tpu.exec.query import plan_sql
+
+    return plan_sql(session, sql)
+
+
+# ----------------------------------------------------------- determinism
+def _reason(sql, props=None):
+    from trino_tpu.cache.determinism import uncachable_reason
+    from trino_tpu.sql.parser.parser import parse_statement
+
+    stmt = parse_statement(sql)
+    from trino_tpu.sql.parser import ast
+
+    root = _plan(sql, props) if isinstance(stmt, ast.Query) else None
+    return uncachable_reason(stmt, root)
+
+
+def test_determinism_analysis():
+    assert _reason("select count(*) from orders") is None
+    assert _reason("select 1") is None
+    assert "random" in _reason("select random()")
+    assert "now" in _reason("select now()")
+    assert "table function" in _reason(
+        "select * from TABLE(sequence(1, 10))")
+    assert "not a SELECT" in _reason("create table memory.default.dx (a bigint)")
+    # bare niladic keyword form reaches the plan as a Call even though the
+    # AST shows only an Identifier — the plan walk must catch it
+    assert _reason("select current_date") is not None
+
+
+def test_niladic_keyword_yields_to_real_columns():
+    """A real column named `now` wins over the niladic function, and an
+    AMBIGUOUS column named `now` errors instead of silently becoming the
+    timestamp function."""
+    s = Session({"catalog": "memory", "schema": "default"})
+    s.execute("create table nn1 (now bigint)")
+    s.execute("insert into nn1 values (7)")
+    assert s.execute("select now from nn1").rows == [(7,)]
+    s.execute("create table nn2 (now bigint)")
+    s.execute("insert into nn2 values (8)")
+    with pytest.raises(Exception, match="ambiguous"):
+        s.execute("select now from nn1, nn2")
+
+
+def test_determinism_sees_through_subqueries():
+    r = _reason("select * from (select random() r from orders) t where r > 0.5")
+    assert r is not None and "random" in r
+
+
+# ------------------------------------------------------- result cache unit
+def _mk_cache(max_bytes=1 << 20):
+    from trino_tpu.cache.result_cache import ResultCache
+
+    return ResultCache(max_bytes=max_bytes)
+
+
+def test_result_cache_hit_miss_ttl():
+    c = _mk_cache()
+    kind, _ = c.begin("k1")
+    assert kind == "lead"
+    c.complete("k1", ["a"], [(1,)], ttl_ms=40)
+    assert c.begin("k1")[0] == "hit"
+    time.sleep(0.06)
+    kind, _ = c.begin("k1")  # expired -> lead again
+    assert kind == "lead"
+    c.abandon("k1")
+
+
+def test_result_cache_lru_eviction_by_bytes():
+    c = _mk_cache(max_bytes=40_000)
+    ev0 = M.RESULT_CACHE_EVICTIONS.value()
+    rows = [("x" * 100,) for _ in range(30)]  # ~6.7KB per entry (under the
+    # 10KB per-entry admission cap = max_bytes/4)
+    for i in range(10):
+        assert c.begin(f"k{i}")[0] == "lead"
+        c.complete(f"k{i}", ["a"], rows, ttl_ms=60_000)
+    assert c.cached_bytes() <= 40_000
+    assert M.RESULT_CACHE_EVICTIONS.value() > ev0
+    # most-recent entries survive, oldest evicted
+    assert c.begin("k9")[0] == "hit"
+    assert c.begin("k0")[0] == "lead"
+    c.abandon("k0")
+
+
+def test_result_cache_giant_entry_not_admitted():
+    c = _mk_cache(max_bytes=10_000)
+    assert c.begin("big")[0] == "lead"
+    c.complete("big", ["a"], [("y" * 200,) for _ in range(100)], ttl_ms=60_000)
+    assert c.begin("big")[0] == "lead"  # was never admitted
+    c.abandon("big")
+
+
+def test_result_cache_single_flight():
+    c = _mk_cache()
+    kind, _ = c.begin("sf")
+    assert kind == "lead"
+    got = []
+
+    def follower():
+        kind, flight = c.begin("sf")
+        assert kind == "wait"
+        assert flight.wait(5.0)
+        got.append(flight.value)
+
+    threads = [threading.Thread(target=follower) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    c.complete("sf", ["a"], [(42,)], ttl_ms=60_000)
+    for t in threads:
+        t.join(5.0)
+    assert got == [(["a"], [(42,)])] * 3
+
+
+def test_result_cache_abandon_wakes_followers():
+    c = _mk_cache()
+    assert c.begin("ab")[0] == "lead"
+    kind, flight = c.begin("ab")
+    assert kind == "wait"
+    c.abandon("ab")
+    assert flight.wait(5.0) and not flight.ok
+
+
+# -------------------------------------------------------- plan cache unit
+def test_plan_cache_revalidates_versions():
+    from trino_tpu.cache.result_cache import PlanCache
+
+    s = Session({"catalog": "memory", "schema": "default"})
+    s.execute("create table pc (a bigint)")
+    s.execute("insert into pc values (1)")
+    pc = PlanCache()
+    sql = "select a from pc"
+    root = plan_root(s, sql)
+    pc.put(s, sql, root)
+    hit_root, versions = pc.get(s, sql)
+    assert hit_root is root
+    assert versions == [(("memory", "default", "pc"), "v2")]  # create+insert
+    s.execute("insert into pc values (2)")  # version bump -> stale plan
+    assert pc.get(s, sql) is None
+
+
+def test_plan_cache_partitions_by_user():
+    """Plan-time access control (check_can_select inside Planner.plan)
+    must re-fire per principal: the cache key carries the user."""
+    from trino_tpu.cache.result_cache import PlanCache
+    from trino_tpu.server.security import Identity
+
+    a = Session({"catalog": "tpch", "schema": "tiny"}, identity=Identity("alice"))
+    b = Session({"catalog": "tpch", "schema": "tiny"}, identity=Identity("bob"))
+    assert PlanCache.key_for(a, "select 1") != PlanCache.key_for(b, "select 1")
+    assert PlanCache.key_for(a, "select 1") == PlanCache.key_for(a, "select 1")
+
+
+def test_result_cache_session_budget_does_not_resize_shared_cache():
+    """result_cache_max_bytes is a per-entry admission cap, never a resize
+    of the server-wide budget (one tenant must not flush the others)."""
+    c = _mk_cache(max_bytes=1 << 20)
+    assert c.begin("other")[0] == "lead"
+    c.complete("other", ["a"], [(1,)], ttl_ms=60_000)
+    assert c.begin("tiny-budget")[0] == "lead"
+    c.complete("tiny-budget", ["a"], [("x" * 500,)], ttl_ms=60_000,
+               max_bytes=64)  # entry over 64/4 -> not admitted ...
+    assert c.max_bytes == 1 << 20  # ... and the shared budget is untouched
+    assert c.begin("other")[0] == "hit"  # other tenants' entries survive
+    assert c.begin("tiny-budget")[0] == "lead"
+    c.abandon("tiny-budget")
+
+
+def test_table_functions_never_plan_cache(cluster):
+    """Table-function rows freeze into a ValuesNode at plan time, so the
+    logical-plan cache must refuse them (result cache already BYPASSes)."""
+    from trino_tpu.cache.determinism import contains_table_function
+    from trino_tpu.sql.parser.parser import parse_statement
+
+    assert contains_table_function(
+        parse_statement("select * from TABLE(sequence(1, 3))"))
+    assert not contains_table_function(
+        parse_statement("select count(*) from orders"))
+    coord, _ = cluster
+    c = _client(coord, catalog="tpch", schema="tiny")
+    ph0 = M.PLAN_CACHE_HITS.value()
+    c.execute("select * from TABLE(sequence(4, 6))")
+    c.execute("select * from TABLE(sequence(4, 6))")
+    assert M.PLAN_CACHE_HITS.value() == ph0  # repeat did not reuse the plan
+
+
+# --------------------------------------------------------- gencache bounds
+class _CD:
+    def __init__(self, n):
+        self.values = np.zeros(n, np.int64)
+        self.nulls = None
+
+
+def test_gencache_lru_eviction_and_counters():
+    from trino_tpu.connector.gencache import GenCache
+
+    calls = []
+
+    def gen(table, sf, lo, hi, cols):
+        calls.append((table, lo, hi, tuple(sorted(cols))))
+        return {c: _CD(1000) for c in cols}  # 8KB per column
+
+    h0, m0, e0 = (M.GENCACHE_HITS.value(), M.GENCACHE_MISSES.value(),
+                  M.GENCACHE_EVICTIONS.value())
+    gc = GenCache(gen, max_bytes=3 * 8_000 + 100, max_entry_bytes=1 << 20)
+    gc.generate("t", 1.0, 0, 10, ["a"])      # miss
+    gc.generate("t", 1.0, 0, 10, ["a"])      # hit
+    assert M.GENCACHE_HITS.value() - h0 == 1
+    assert M.GENCACHE_MISSES.value() - m0 == 1
+    gc.generate("t", 1.0, 10, 20, ["a"])     # miss
+    gc.generate("t", 1.0, 20, 30, ["a"])     # miss (cache full: 3 entries)
+    gc.generate("t", 1.0, 30, 40, ["a"])     # miss -> evicts LRU (0,10)
+    assert M.GENCACHE_EVICTIONS.value() - e0 >= 1
+    assert gc.cached_bytes() <= 3 * 8_000 + 100
+    n_calls = len(calls)
+    gc.generate("t", 1.0, 0, 10, ["a"])      # was evicted -> regenerates
+    assert len(calls) == n_calls + 1
+
+
+def test_gencache_accumulates_columns_per_entry():
+    from trino_tpu.connector.gencache import GenCache
+
+    def gen(table, sf, lo, hi, cols):
+        return {c: _CD(10) for c in cols}
+
+    gc = GenCache(gen)
+    gc.generate("t", 1.0, 0, 10, ["a"])
+    out = gc.generate("t", 1.0, 0, 10, ["a", "b"])  # partial miss: adds b
+    assert set(out) == {"a", "b"}
+    assert len(gc) == 1
+
+
+# ------------------------------------------- coordinator end-to-end matrix
+@pytest.fixture(scope="module")
+def cluster():
+    import tests.conftest  # noqa: F401 — cpu mesh config
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [
+        WorkerServer(coordinator_url=coord.base_url, node_id=f"cw{i}")
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    assert coord.registry.wait_for_workers(2, timeout=15.0)
+    yield coord, workers
+    for w in workers:
+        w.stop()
+    coord.stop()
+
+
+def _client(coord, **props):
+    from trino_tpu.client.remote import StatementClient
+
+    return StatementClient(coord.base_url, {
+        "catalog": "memory", "schema": "default",
+        "result_cache_enabled": "true", **props})
+
+
+def test_dml_ddl_invalidation_matrix(cluster):
+    """Cached SELECT over the memory connector must MISS after every
+    mutating statement kind; repeats in between must HIT."""
+    coord, _ = cluster
+    c = _client(coord)
+    sql = "select a, b from minv order by a"
+
+    c.execute("create table minv (a bigint, b varchar)")
+    assert c.cache_status == "BYPASS"
+    c.execute("insert into minv values (1, 'x'), (2, 'y')")
+    assert c.cache_status == "BYPASS"
+
+    def run():
+        cols, rows = c.execute(sql)
+        return [tuple(r) for r in rows], c.cache_status
+
+    rows, disp = run()
+    assert disp == "MISS" and rows == [(1, "x"), (2, "y")]
+    rows, disp = run()
+    assert disp == "HIT" and rows == [(1, "x"), (2, "y")]
+
+    c.execute("insert into minv values (3, 'z')")          # INSERT
+    rows, disp = run()
+    assert disp == "MISS" and rows == [(1, "x"), (2, "y"), (3, "z")]
+    assert run()[1] == "HIT"
+
+    c.execute("update minv set b = 'q' where a = 2")       # UPDATE
+    rows, disp = run()
+    assert disp == "MISS" and rows == [(1, "x"), (2, "q"), (3, "z")]
+    assert run()[1] == "HIT"
+
+    c.execute("delete from minv where a = 1")              # DELETE
+    rows, disp = run()
+    assert disp == "MISS" and rows == [(2, "q"), (3, "z")]
+    assert run()[1] == "HIT"
+
+    c.execute("drop table minv")                           # DROP + CTAS
+    c.execute("create table minv as select * from (values (7, 'n')) t(a, b)")
+    rows, disp = run()
+    assert disp == "MISS" and rows == [(7, "n")]
+    assert run()[1] == "HIT"
+
+
+def test_nondeterministic_queries_bypass(cluster):
+    coord, _ = cluster
+    c = _client(coord)
+    c.execute("create table ndet (a bigint)")
+    c.execute("insert into ndet values (1)")
+    b0 = M.RESULT_CACHE_BYPASSES.value()
+    c.execute("select a from ndet where random() >= 0")
+    assert c.cache_status == "BYPASS"
+    c.execute("select a, now() from ndet")
+    assert c.cache_status == "BYPASS"
+    c.execute("select * from TABLE(sequence(1, 3))")
+    assert c.cache_status == "BYPASS"
+    assert M.RESULT_CACHE_BYPASSES.value() - b0 == 3
+
+
+def test_cache_disabled_reports_bypass_without_metric(cluster):
+    coord, _ = cluster
+    c = _client(coord, result_cache_enabled="false")
+    b0 = M.RESULT_CACHE_BYPASSES.value()
+    c.execute("select 1")
+    assert c.cache_status == "BYPASS"
+    assert M.RESULT_CACHE_BYPASSES.value() == b0
+
+
+def test_concurrent_identical_queries_single_flight(cluster):
+    """One execution, N HITs: concurrent identical queries de-duplicate
+    through the flight (or serve from the fresh entry)."""
+    coord, _ = cluster
+    setup = _client(coord)
+    setup.execute("create table sfq (a bigint)")
+    setup.execute("insert into sfq values " +
+                  ", ".join(f"({i})" for i in range(500)))
+    sql = ("select count(*), sum(a), min(a), max(a) from sfq "
+           "where a % 7 <> 3")
+    h0, m0 = M.RESULT_CACHE_HITS.value(), M.RESULT_CACHE_MISSES.value()
+    results = []
+
+    def run_one():
+        c = _client(coord)
+        results.append(c.execute(sql))
+
+    threads = [threading.Thread(target=run_one) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+    assert len(results) == 4
+    assert all(r == results[0] for r in results)
+    assert M.RESULT_CACHE_MISSES.value() - m0 == 1  # exactly one execution
+    assert M.RESULT_CACHE_HITS.value() - h0 == 3
+
+
+def test_repeated_tpch_q1_hits_and_skips_execution(cluster):
+    """The acceptance path: a distributed TPC-H aggregation repeated in
+    one coordinator returns identical results, the second run reports
+    HIT, and execution is provably skipped (no schedule/execute spans,
+    no new tasks created)."""
+    coord, _ = cluster
+    from trino_tpu.client.remote import StatementClient
+
+    c = StatementClient(coord.base_url, {
+        "catalog": "tpch", "schema": "tiny", "result_cache_enabled": "true"})
+    sql = """
+        select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+               avg(l_extendedprice) as avg_price, count(*) as count_order
+        from lineitem
+        where l_shipdate <= date '1998-09-02'
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus
+    """
+    cols1, rows1 = c.execute(sql)
+    assert c.cache_status == "MISS"
+    q1 = coord.queries[sorted(coord.queries)[-1]]
+    names1 = {s["name"] for s in q1.tracer.to_dicts()}
+    assert {"schedule", "execute/root-fragment", "cache/lookup"} <= names1
+
+    tasks0 = M.TASKS_TOTAL.value()
+    cols2, rows2 = c.execute(sql)
+    assert c.cache_status == "HIT"
+    assert cols2 == cols1 and rows2 == rows1
+    assert M.TASKS_TOTAL.value() == tasks0  # no worker tasks created
+    q2 = coord.queries[sorted(coord.queries)[-1]]
+    assert q2 is not q1
+    names2 = {s["name"] for s in q2.tracer.to_dicts()}
+    assert "cache/lookup" in names2
+    assert "schedule" not in names2
+    assert "fragment" not in names2
+    assert "execute/root-fragment" not in names2
+    # plan cache also engaged: no fresh optimize on the repeat
+    assert "optimize" not in names2
+    assert q2.info()["cacheStatus"] == "HIT"
+
+
+def test_dbapi_cursor_exposes_cache_status(cluster):
+    coord, _ = cluster
+    from trino_tpu.client import dbapi
+
+    conn = dbapi.connect(coordinator_url=coord.base_url, catalog="memory",
+                         schema="default", result_cache_enabled="true")
+    cur = conn.cursor()
+    cur.execute("create table dbc (a bigint)")
+    assert cur.cache_status == "BYPASS"
+    cur.execute("insert into dbc values (5)")
+    cur.execute("select a from dbc")
+    assert cur.cache_status == "MISS"
+    cur.execute("select a from dbc")
+    assert cur.cache_status == "HIT"
+    assert cur.fetchall() == [(5,)]
+    conn.close()
+
+
+def test_cli_summary_prints_cache_status(capsys):
+    """The CLI's query summary carries the disposition (satellite: verbose
+    client surface) — driven with a stub transport, no server needed."""
+    from trino_tpu.client.cli import Console
+
+    class _Args:
+        server = "http://stub"
+        catalog = "memory"
+        schema = "default"
+
+    class _Stub:
+        cache_status = "HIT"
+
+        def execute(self, sql):
+            return ["a"], [(1,)]
+
+    console = Console.__new__(Console)
+    console.args = _Args()
+    console._client = _Stub()
+    console._session = None
+    assert console.run_statement("select a from t") == 0
+    out = capsys.readouterr().out
+    assert "[cache: HIT]" in out
+
+
+def test_udf_redefinition_invalidates_cached_plan(cluster):
+    """SQL routines inline at plan time: CREATE OR REPLACE FUNCTION must
+    not serve a plan (or result) holding the old body."""
+    coord, _ = cluster
+    c = _client(coord)
+    c.execute("create table udfc (a bigint)")
+    c.execute("insert into udfc values (10)")
+    c.execute("create function cadd(x bigint) returns bigint return x + 1")
+    _, rows = c.execute("select cadd(a) from udfc")
+    assert [tuple(r) for r in rows] == [(11,)]
+    c.execute("create or replace function cadd(x bigint) returns bigint "
+              "return x + 5")
+    _, rows = c.execute("select cadd(a) from udfc")
+    assert [tuple(r) for r in rows] == [(15,)]
+    assert c.cache_status == "MISS"  # key changed with the routine store
+
+
+def test_ttl_expiry_end_to_end(cluster):
+    coord, _ = cluster
+    c = _client(coord, result_cache_ttl_ms="150")
+    c.execute("create table ttlq (a bigint)")
+    c.execute("insert into ttlq values (1)")
+    c.execute("select a from ttlq")
+    assert c.cache_status == "MISS"
+    c.execute("select a from ttlq")
+    assert c.cache_status == "HIT"
+    time.sleep(0.25)
+    c.execute("select a from ttlq")
+    assert c.cache_status == "MISS"  # expired

@@ -1,0 +1,36 @@
+"""Session-property docs drift gate: every property registered in
+``client/properties.py`` must be documented in README.md's Session
+properties table (tools/check_session_property_docs.py wired as a tier-1
+test — the mirror of the metric-docs gate)."""
+import os
+import subprocess
+import sys
+
+TOOL = os.path.join(os.path.dirname(__file__), "..", "tools",
+                    "check_session_property_docs.py")
+
+
+def test_all_registered_properties_documented():
+    from tools.check_session_property_docs import check
+
+    missing = check()
+    assert missing == [], (
+        f"session properties registered in trino_tpu/client/properties.py "
+        f"but missing from README.md: {missing}")
+
+
+def test_checker_cli_runs_green():
+    proc = subprocess.run(
+        [sys.executable, TOOL], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_checker_detects_missing_property(tmp_path):
+    """The gate actually gates: a README without the table fails."""
+    from tools.check_session_property_docs import check
+
+    bare = tmp_path / "README.md"
+    bare.write_text("# no properties documented here\n")
+    missing = check(str(bare))
+    assert "result_cache_enabled" in missing
+    assert "retry_policy" in missing

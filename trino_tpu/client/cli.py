@@ -69,7 +69,13 @@ class Console:
             return 1
         print(format_table(columns, rows))
         dt = time.monotonic() - t0
-        print(f"({len(rows)} row{'s' if len(rows) != 1 else ''} in {dt:.2f}s)")
+        summary = f"({len(rows)} row{'s' if len(rows) != 1 else ''} in {dt:.2f}s)"
+        cache = getattr(self._client, "cache_status", None)
+        if cache:
+            # result-cache disposition from the X-Trino-Tpu-Cache header
+            # (remote runs only; embedded sessions have no cache in front)
+            summary += f" [cache: {cache}]"
+        print(summary)
         return 0
 
     def repl(self) -> int:

@@ -85,6 +85,10 @@ class Cursor:
         self._conn = conn
         self.description = None  # 7-tuples per PEP 249
         self.rowcount = -1
+        # result-cache disposition of the last execute() against a remote
+        # coordinator: "HIT" | "MISS" | "BYPASS" (None for embedded
+        # sessions, which have no coordinator cache in front of them)
+        self.cache_status: Optional[str] = None
         self._rows: List[tuple] = []
         self._pos = 0
 
@@ -94,9 +98,11 @@ class Cursor:
         sql = operation
         if parameters:
             sql = _substitute_qmarks(operation, parameters)
+        self.cache_status = None
         try:
             if self._conn._client is not None:
                 columns, rows = self._conn._client.execute(sql)
+                self.cache_status = self._conn._client.cache_status
             else:
                 res = self._conn._session.execute(sql)
                 columns, rows = res.column_names, res.rows

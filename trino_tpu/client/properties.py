@@ -115,6 +115,36 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, None, lambda v: _positive(v) if v is not None else None,
         ),
         PropertyMetadata(
+            "result_cache_enabled",
+            "serve repeated deterministic SELECTs from the coordinator "
+            "result cache (trino_tpu/cache/): keyed on the canonical "
+            "optimized plan + connector data versions, single-flighted, "
+            "disposition surfaced via the X-Trino-Tpu-Cache header",
+            bool, False,
+        ),
+        PropertyMetadata(
+            "result_cache_ttl_ms",
+            "lifetime of a result-cache entry in milliseconds; version-"
+            "based invalidation usually fires first, the TTL bounds "
+            "staleness for unversioned edge cases and reclaims dead keys",
+            int, 60_000, _positive,
+        ),
+        PropertyMetadata(
+            "result_cache_max_bytes",
+            "per-query admission budget against the coordinator result "
+            "cache: results above a quarter of min(this, the server "
+            "budget) are not cached (the server-wide LRU budget itself is "
+            "fixed at server scope — one session cannot resize it)",
+            int, 64 << 20, _positive,
+        ),
+        PropertyMetadata(
+            "logical_plan_cache_enabled",
+            "reuse cached optimized logical plans on canonical-SQL repeat "
+            "(skipping parse/analyze/plan/optimize), revalidated against "
+            "connector data versions at lookup",
+            bool, True,
+        ),
+        PropertyMetadata(
             "failure_injection",
             "inject a task failure when this substring matches a task id, "
             "e.g. '.<fragment>.<worker>.a<attempt>' (reference: "

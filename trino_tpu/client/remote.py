@@ -23,15 +23,21 @@ class StatementClient:
     def __init__(self, coordinator_url: str, session_properties: Optional[Dict[str, str]] = None):
         self.coordinator_url = coordinator_url.rstrip("/")
         self.session_properties = dict(session_properties or {})
+        # result-cache disposition of the LAST statement (HIT|MISS|BYPASS),
+        # from the X-Trino-Tpu-Cache response header; None before the
+        # coordinator has decided (or against a pre-cache server)
+        self.cache_status: Optional[str] = None
 
     def execute(self, sql: str, timeout: float = 600.0) -> Tuple[List[str], List[list]]:
         """Returns (column_names, rows)."""
         headers = {
             f"X-Trino-Session-{k}": str(v) for k, v in self.session_properties.items()
         }
-        status, body, _ = wire.http_request(
+        self.cache_status = None
+        status, body, resp_headers = wire.http_request(
             "POST", f"{self.coordinator_url}/v1/statement",
             sql.encode(), "text/plain", headers=headers)
+        self._note_cache_header(resp_headers)
         if status >= 400:
             raise RemoteQueryError(f"submit failed: {body[:500].decode(errors='replace')}")
         import json
@@ -57,7 +63,14 @@ class StatementClient:
                 return columns, rows
             if time.monotonic() > deadline:
                 raise RemoteQueryError("client timeout")
-            status, body, _ = wire.http_request("GET", next_uri, timeout=60.0)
+            status, body, resp_headers = wire.http_request(
+                "GET", next_uri, timeout=60.0)
+            self._note_cache_header(resp_headers)
             if status >= 400:
                 raise RemoteQueryError(f"poll failed: {body[:500].decode(errors='replace')}")
             payload = json.loads(body)
+
+    def _note_cache_header(self, resp_headers: Dict[str, str]) -> None:
+        for k, v in (resp_headers or {}).items():
+            if k.lower() == "x-trino-tpu-cache":
+                self.cache_status = v

@@ -49,6 +49,10 @@ class BlackHoleConnector(spi.Connector):
     def table_row_count(self, schema: str, table: str) -> Optional[int]:
         return 0 if (schema, table) in self._tables else None
 
+    def data_version(self, schema: str, table: str) -> str:
+        # scans always return zero rows regardless of writes swallowed
+        return "immutable"
+
     def get_splits(self, schema: str, table: str, target_splits: int, constraint=None,
                    handle=None) -> List[spi.Split]:
         return [spi.Split(table, schema, 0, 0)]

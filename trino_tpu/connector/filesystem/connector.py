@@ -183,6 +183,17 @@ class FileSystemConnector(spi.Connector):
         ]
         return spi.TableMetadata(schema, table, cols)
 
+    def data_version(self, schema: str, table: str) -> Optional[str]:
+        """Storage-derived version: the table file's mtime+size (the cache
+        layer's invalidation token — any rewrite changes it). Missing
+        table -> a distinct token too, so create-after-miss invalidates."""
+        path = self._table_path(schema, table)
+        try:
+            st = os.stat(path)
+        except OSError:
+            return "absent"
+        return f"{st.st_mtime_ns}:{st.st_size}"
+
     def table_row_count(self, schema: str, table: str) -> Optional[int]:
         path = self._table_path(schema, table)
         if not os.path.exists(path):

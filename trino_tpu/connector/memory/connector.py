@@ -22,6 +22,16 @@ class MemoryConnector(spi.Connector):
 
     def __init__(self):
         self._tables: Dict[Tuple[str, str], Tuple[spi.TableMetadata, Dict[str, spi.ColumnData]]] = {}
+        # monotonic per-table mutation counter (the cache-invalidation
+        # token): survives DROP so a re-created table keeps advancing
+        self._versions: Dict[Tuple[str, str], int] = {}
+
+    def _bump(self, schema: str, table: str) -> None:
+        key = (schema, table)
+        self._versions[key] = self._versions.get(key, 0) + 1
+
+    def data_version(self, schema: str, table: str) -> str:
+        return f"v{self._versions.get((schema, table), 0)}"
 
     def create_table(self, schema: str, name: str, schema_def: Sequence[Tuple[str, T.Type]], rows: List[tuple]):
         """Register a table from Python rows (None = NULL)."""
@@ -36,6 +46,7 @@ class MemoryConnector(spi.Connector):
             schema, name, [spi.ColumnMetadata(n, t) for n, t in schema_def]
         )
         self._tables[(schema, name)] = (meta, cols)
+        self._bump(schema, name)
 
     def overwrite_rows(self, schema: str, table: str, rows) -> None:
         """Replace contents (engine-computed DELETE/UPDATE rewrite)."""
@@ -51,6 +62,7 @@ class MemoryConnector(spi.Connector):
             for i, cm in enumerate(meta.columns)
         }
         self._tables[(schema, table)] = (meta, new_cols)
+        self._bump(schema, table)
 
     def insert_rows(self, schema: str, table: str, rows: List[tuple]) -> int:
         """Append rows (reference: memory connector's page sink). New data
@@ -73,10 +85,12 @@ class MemoryConnector(spi.Connector):
             new = spi.column_data_from_column(col)
             new_cols[cm.name] = spi.concat_column_data([cols[cm.name], new])
         self._tables[(schema, table)] = (meta, {**cols, **new_cols})
+        self._bump(schema, table)
         return len(rows)
 
     def drop_table(self, schema: str, table: str) -> None:
         self._tables.pop((schema, table), None)
+        self._bump(schema, table)
 
     def list_schemas(self) -> List[str]:
         return sorted({s for s, _ in self._tables} | {"default"})

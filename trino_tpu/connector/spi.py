@@ -317,6 +317,18 @@ class Connector:
         spi/connector/ConnectorMetadata getTableProperties)."""
         return None
 
+    def data_version(self, schema: str, table: str) -> Optional[str]:
+        """Cheap opaque token that changes whenever the table's DATA (or
+        existence/shape) changes — the query cache's invalidation handle
+        (trino_tpu/cache/): versions are captured into cache keys at plan
+        time, so a mutation makes the next identical query fingerprint
+        differently and stale entries miss naturally. Immutable catalogs
+        (tpch/tpcds generators) return a constant; stateful ones bump a
+        counter (memory) or derive from storage state (filesystem file
+        mtime+size). None (the default) means "unversioned": the engine
+        cannot invalidate, so queries over this table bypass the cache."""
+        return None
+
     # --- pushdown negotiation (ConnectorMetadata.apply*) ---
     # Each apply_* returns a NEW opaque table handle when the connector can
     # serve the narrowed request, or None to decline; the engine stores the

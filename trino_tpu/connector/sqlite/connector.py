@@ -106,6 +106,25 @@ class SqliteConnector(spi.Connector):
             self._local.conn = conn
         return conn
 
+    def data_version(self, schema: str, table: str):
+        """Database-file mtime+size, including the WAL sidecar: coarser
+        than per-table (any write invalidates every table's cached
+        results) but safe — in journal_mode=WAL a commit lands in the
+        ``-wal`` file and may leave the main db file untouched until
+        checkpoint, so the sidecars participate in the token."""
+        import os
+
+        parts = []
+        for suffix in ("", "-wal", "-shm"):
+            try:
+                st = os.stat(self._path + suffix)
+                parts.append(f"{st.st_mtime_ns}:{st.st_size}")
+            except OSError:
+                parts.append("absent")
+        if parts[0] == "absent":
+            return None  # no database file: unversioned
+        return "|".join(parts)
+
     # ------------------------------------------------------------ metadata
     def list_schemas(self) -> List[str]:
         return ["main"]

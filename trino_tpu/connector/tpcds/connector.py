@@ -64,6 +64,10 @@ class TpcdsConnector(spi.Connector):
     def primary_key(self, schema: str, table: str):
         return self._PRIMARY_KEYS.get(table)
 
+    def data_version(self, schema: str, table: str) -> str:
+        # generated data is a pure function of (table, scale factor)
+        return "immutable"
+
     def get_splits(
         self, schema: str, table: str, target_splits: int, constraint=None,
         handle=None,

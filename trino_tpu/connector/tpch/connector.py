@@ -77,6 +77,11 @@ class TpchConnector(spi.Connector):
     def primary_key(self, schema: str, table: str):
         return self._PRIMARY_KEYS.get(table)
 
+    def data_version(self, schema: str, table: str) -> str:
+        # generated data is a pure function of (table, scale factor):
+        # immutable per schema, so cached results never go stale
+        return "immutable"
+
     def table_partitioning(self, schema: str, table: str):
         """orders and lineitem are both generated in ORDER-index ranges
         with identical split-boundary arithmetic (get_splits), so they

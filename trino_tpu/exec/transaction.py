@@ -174,6 +174,9 @@ class TransactionalOverlay(spi.Connector):
                     self.base._tables.pop((schema, table), None)
                 else:
                     self.base._tables[(schema, table)] = st
+                # commit is a data mutation like any other: advance the
+                # base table's cache-invalidation version
+                self.base._bump(schema, table)
 
 
 _BASE_LOCK = threading.Lock()

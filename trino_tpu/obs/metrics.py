@@ -296,6 +296,47 @@ STAGED_ROWS = REGISTRY.counter(
 TASKS_TOTAL = REGISTRY.counter(
     "trino_tpu_tasks_total", "tasks created on this node")
 
+# query caching subsystem (trino_tpu/cache/): coordinator result cache,
+# logical-plan cache, and the connector-side datagen cache
+RESULT_CACHE_HITS = REGISTRY.counter(
+    "trino_tpu_result_cache_hits_total",
+    "queries answered from the coordinator result cache (including "
+    "single-flight followers served by a concurrent leader)")
+RESULT_CACHE_MISSES = REGISTRY.counter(
+    "trino_tpu_result_cache_misses_total",
+    "cache-eligible queries that executed and (re)filled the result cache")
+RESULT_CACHE_BYPASSES = REGISTRY.counter(
+    "trino_tpu_result_cache_bypasses_total",
+    "cache-enabled queries that bypassed the result cache (DML/DDL, "
+    "non-deterministic functions, table functions, unversioned tables)")
+RESULT_CACHE_EVICTIONS = REGISTRY.counter(
+    "trino_tpu_result_cache_evictions_total",
+    "result-cache entries evicted by the LRU byte budget")
+RESULT_CACHE_BYTES = REGISTRY.gauge(
+    "trino_tpu_result_cache_bytes",
+    "estimated bytes of result pages held by the coordinator result cache")
+RESULT_CACHE_SINGLE_FLIGHT_WAITS = REGISTRY.counter(
+    "trino_tpu_result_cache_single_flight_waits_total",
+    "queries that parked on a concurrent identical query's in-flight "
+    "execution instead of executing themselves")
+PLAN_CACHE_HITS = REGISTRY.counter(
+    "trino_tpu_plan_cache_hits_total",
+    "queries that reused a cached optimized logical plan (skipping "
+    "parse/analyze/plan/optimize)")
+PLAN_CACHE_MISSES = REGISTRY.counter(
+    "trino_tpu_plan_cache_misses_total",
+    "plan-cache lookups that planned from scratch (first sight, changed "
+    "session properties, or a data-version mismatch)")
+GENCACHE_HITS = REGISTRY.counter(
+    "trino_tpu_gencache_hits_total",
+    "generator scan ranges served entirely from the datagen cache")
+GENCACHE_MISSES = REGISTRY.counter(
+    "trino_tpu_gencache_misses_total",
+    "generator scan ranges that synthesized at least one column")
+GENCACHE_EVICTIONS = REGISTRY.counter(
+    "trino_tpu_gencache_evictions_total",
+    "datagen cache entries evicted by the LRU byte budget")
+
 # latency distribution per terminal state (the per-state query histogram)
 QUERY_SECONDS = REGISTRY.histogram(
     "trino_tpu_query_seconds",

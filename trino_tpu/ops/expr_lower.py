@@ -2063,7 +2063,36 @@ def _lower_map_ctor(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     )
 
 
+def _lower_random(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """random() -> double in [0, 1): host RNG, one draw per row. Under the
+    compiled tier the draws are baked at trace time (a re-run of a cached
+    executable would repeat them) — which is why the cache layer marks
+    random() uncachable rather than relying on per-run freshness."""
+    vals = jnp.asarray(np.random.random(ctx.num_rows))
+    return LoweredVal(vals, None, None)
+
+
+def _lower_now(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """now() -> timestamp(3): one instant per evaluation (the reference
+    pins now() to the query start; per-evaluation is the coarser but
+    cache-equivalent behavior — both vary across queries)."""
+    import time as _time
+
+    v = int(_time.time() * 1000)
+    return LoweredVal(_const_array(ctx, np.int64, v), None, None, abs(v))
+
+
+def _lower_current_date(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    import time as _time
+
+    days = int(_time.time() // 86_400)
+    return LoweredVal(_const_array(ctx, np.int32, days), None, None, days)
+
+
 FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
+    "random": _lower_random,
+    "now": _lower_now,
+    "current_date": _lower_current_date,
     "eq": _comparison(lambda a, b: a == b),
     "ne": _comparison(lambda a, b: a != b, negate_eq=True),
     "lt": _comparison(lambda a, b: a < b),

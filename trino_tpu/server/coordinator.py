@@ -43,6 +43,10 @@ _TRACE_RE = re.compile(r"^/v1/query/([^/]+)/trace$")
 
 RESULT_PAGE_ROWS = 10_000
 
+# sentinel returned by QueryExecution._consult_result_cache when the query
+# was answered from the result cache (columns/rows already populated)
+_SERVED_FROM_CACHE = "__served_from_cache__"
+
 
 class NodeRegistry:
     """Worker membership with announce-age liveness (discovery + failure
@@ -81,7 +85,8 @@ class QueryExecution:
     """One query's lifecycle on the coordinator."""
 
     def __init__(self, query_id: str, sql: str, session_properties: dict,
-                 registry: NodeRegistry, session_factory, user: str = "anonymous"):
+                 registry: NodeRegistry, session_factory, user: str = "anonymous",
+                 query_cache=None):
         self.query_id = query_id
         self.sql = sql
         self.user = user
@@ -89,6 +94,12 @@ class QueryExecution:
         self.state: StateMachine[str] = query_state_machine()
         self.registry = registry
         self.session_factory = session_factory
+        # server-wide QueryCache (trino_tpu/cache/) or None (caching off)
+        self.query_cache = query_cache
+        # result-cache disposition, surfaced as X-Trino-Tpu-Cache:
+        # HIT (served from cache / a concurrent leader), MISS (executed,
+        # filled the cache), BYPASS (ineligible or cache disabled)
+        self.cache_status: Optional[str] = None
         self.failure: Optional[str] = None
         self.columns: List[str] = []
         self.rows: List[tuple] = []
@@ -101,7 +112,14 @@ class QueryExecution:
         # FTE bookkeeping: successful attempt index per task + retried ids
         self.task_attempts: Dict[str, int] = {}
         self.retried_tasks: List[str] = []
-        self.speculative_tasks: List[str] = []  # duplicate straggler attempts
+        # IN-FLIGHT duplicate straggler attempts: entries are pruned when
+        # their slot resolves (the speculated task or its original
+        # completes), so long queries can't grow this without bound
+        self.speculative_tasks: List[str] = []
+        # bounded record of every speculation launched (observability/tests)
+        from collections import deque
+
+        self.speculation_history = deque(maxlen=64)
         self.fragment_tasks: Dict[int, List[TaskLocation]] = {}
         # one trace per query; the trace id doubles as the propagation key
         # stamped on worker/exchange requests (reference: the otel Tracer
@@ -164,7 +182,7 @@ class QueryExecution:
         from trino_tpu.server.security import Identity
 
         session.identity = Identity(self.user)
-        from trino_tpu.exec.query import plan_sql, run_query
+        from trino_tpu.exec.query import run_query
         from trino_tpu.sql.parser import ast
         from trino_tpu.sql.parser.parser import parse_statement
 
@@ -172,7 +190,11 @@ class QueryExecution:
         # "parse" span, and two parse spans would double-attribute the time
         stmt = parse_statement(self.sql)
         if not isinstance(stmt, ast.Query):
-            # metadata statements (SHOW …, EXPLAIN) run coordinator-local
+            # metadata statements (SHOW …, EXPLAIN) and DML/DDL run
+            # coordinator-local and always bypass the result cache — the
+            # mutation itself is what bumps the connector data versions
+            # that invalidate cached SELECTs over the touched tables
+            self.cache_status = "BYPASS"
             with self.tracer.span("execute/coordinator-local"):
                 result = run_query(session, self.sql)
             self.columns, self.rows = result.column_names, result.rows
@@ -182,8 +204,132 @@ class QueryExecution:
             elif isinstance(stmt, ast.ResetSession):
                 self.reset_session.append(stmt.name)
             return
-        # plan_sql emits nested analyze/plan + optimize spans (ambient)
+        root, versions = self._plan_query(session, stmt)
+        key = self._consult_result_cache(session, stmt, root, versions)
+        if key == _SERVED_FROM_CACHE:
+            self.state.set("FINISHING")
+            return
+        if key is None:
+            self._execute_query(session, root)
+            return
+        # result-cache leader: execute, then publish so single-flight
+        # waiters wake with the value; any failure abandons the flight
+        # (waiters re-execute themselves)
+        try:
+            self._execute_query(session, root)
+        except BaseException:
+            self.query_cache.results.abandon(key)
+            raise
+        self.query_cache.results.complete(
+            key, self.columns, self.rows,
+            ttl_ms=session.properties.get("result_cache_ttl_ms", 60_000),
+            max_bytes=session.properties.get("result_cache_max_bytes"))
+
+    def _plan_query(self, session, stmt):
+        """Optimized plan for this SELECT, through the server's logical-
+        plan cache when enabled (skipping parse/analyze/plan/optimize on
+        canonical-SQL repeat; entries revalidate against connector data
+        versions inside PlanCache.get). Table-function statements never
+        plan-cache: their rows materialize into the plan at plan time.
+
+        Returns ``(root, versions)`` — the data versions captured while
+        planning/revalidating (None when not computed), handed onward so
+        the result-cache lookup doesn't re-stat every table."""
+        from trino_tpu.cache.determinism import contains_table_function
+        from trino_tpu.cache.plan_key import capture_versions
+        from trino_tpu.exec.query import plan_sql
+        from trino_tpu.obs import metrics as M
+
+        cache = self.query_cache
+        use_plan_cache = (cache is not None and bool(
+            session.properties.get("logical_plan_cache_enabled", True))
+            and not contains_table_function(stmt))
+        if use_plan_cache:
+            hit = cache.plans.get(session, self.sql)
+            if hit is not None:
+                M.PLAN_CACHE_HITS.inc()
+                with self.tracer.span("plan-cache/hit"):
+                    pass
+                return hit
+            M.PLAN_CACHE_MISSES.inc()
+        # plan_sql emits nested parse + analyze/plan + optimize spans
         root = plan_sql(session, self.sql)
+        versions = None
+        if use_plan_cache:
+            versions = capture_versions(session, root)
+            cache.plans.put(session, self.sql, root, versions)
+        return root, versions
+
+    def _consult_result_cache(self, session, stmt, root, versions=None):
+        """One admission pass against the server result cache. Returns
+        ``_SERVED_FROM_CACHE`` (columns/rows already populated), a cache
+        key string (this query leads the flight and must complete/abandon
+        it), or None (bypass / follower fallback: execute, don't store)."""
+        from trino_tpu.cache.determinism import uncachable_reason
+        from trino_tpu.cache.plan_key import capture_versions, plan_fingerprint
+        from trino_tpu.obs import metrics as M
+
+        cache = self.query_cache
+        if cache is None or not bool(
+                session.properties.get("result_cache_enabled", False)):
+            self.cache_status = "BYPASS"
+            return None
+        reason = uncachable_reason(stmt, root)
+        if reason is None:
+            # captured at plan time (threaded through from _plan_query
+            # when it already did the capture): a later mutation bumps the
+            # version, the next identical query fingerprints differently,
+            # and the stale entry misses naturally
+            if versions is None:
+                versions = capture_versions(session, root)
+            if versions is None:
+                reason = "unversioned table"
+        with self.tracer.span("cache/lookup") as sp:
+            if reason is not None:
+                self.cache_status = "BYPASS"
+                M.RESULT_CACHE_BYPASSES.inc()
+                sp.set("disposition", "BYPASS")
+                sp.set("reason", reason)
+                return None
+            # the user partitions the key: plan-time access control must
+            # re-fire per principal, never be laundered through a cache hit
+            from trino_tpu.cache.result_cache import session_user
+
+            key = plan_fingerprint(
+                root, versions, extra=(f"user={session_user(session)}",))
+            sp.set("key", key[:16])
+            kind, payload = cache.results.begin(key)
+            if kind == "wait":
+                # single-flight: a concurrent identical query is already
+                # executing — park on its flight instead of duplicating
+                sp.set("single_flight", True)
+                M.RESULT_CACHE_SINGLE_FLIGHT_WAITS.inc()
+                done = payload.wait(timeout=600.0)
+                if done and payload.ok:
+                    kind, payload = "hit", payload.value
+                else:
+                    # the leader failed or timed out: execute ourselves,
+                    # uncached (no flight ownership to publish through)
+                    self.cache_status = "MISS"
+                    M.RESULT_CACHE_MISSES.inc()
+                    sp.set("disposition", "MISS")
+                    return None
+            if kind == "hit":
+                columns, rows = payload
+                self.cache_status = "HIT"
+                M.RESULT_CACHE_HITS.inc()
+                sp.set("disposition", "HIT")
+                sp.set("rows", len(rows))
+                self.columns, self.rows = list(columns), list(rows)
+                return _SERVED_FROM_CACHE
+            self.cache_status = "MISS"
+            M.RESULT_CACHE_MISSES.inc()
+            sp.set("disposition", "MISS")
+            return key
+
+    def _execute_query(self, session, root) -> None:
+        """Run an already-optimized SELECT plan: coordinator-local for
+        process-local catalogs, else fragment + schedule + root fragment."""
         if any(
             isinstance(n, P.TableScanNode)
             and session.catalogs[n.catalog].coordinator_only
@@ -192,9 +338,11 @@ class QueryExecution:
             # scans over process-local catalogs (memory) cannot be
             # shipped to workers — execute on the coordinator's own
             # engine (its embedded worker role)
+            from trino_tpu.exec.executor import Executor
+
             with self.tracer.span("execute/coordinator-local"):
-                result = run_query(session, self.sql)
-            self.columns, self.rows = result.column_names, result.rows
+                page = Executor(session).execute_checked(root)
+            self.columns, self.rows = list(root.column_names), page.to_pylist()
             return
         with self.tracer.span("fragment") as sp:
             fragments = fragment_plan(root, session)
@@ -385,6 +533,7 @@ class QueryExecution:
             for atts in slots.values():
                 for _a, other, _dl, _t in atts:
                     self._cancel_attempt(other)
+                    self._prune_speculative(other)
             raise RuntimeError(msg)
 
         while slots:
@@ -403,10 +552,12 @@ class QueryExecution:
                         for _a, other, _dl, _t in slots[wi]:
                             if other is not loc:
                                 self._cancel_attempt(other)  # losers
+                            self._prune_speculative(other)
                         del slots[wi]
                         break
                     # failed / unreachable / timed out / canceled remotely
                     self._cancel_attempt(loc)
+                    self._prune_speculative(loc)
                     if loc is not None:
                         self.retried_tasks.append(loc.task_id)
                     slots[wi].remove(att)
@@ -444,6 +595,7 @@ class QueryExecution:
                     atts.append(spec)
                     if spec[1] is not None:
                         self.speculative_tasks.append(spec[1].task_id)
+                        self.speculation_history.append(spec[1].task_id)
             time.sleep(0.05)
         return list(locations)
 
@@ -486,6 +638,13 @@ class QueryExecution:
         if info["state"] in ("FINISHED", "FAILED", "CANCELED"):
             return info["state"], info.get("failure")
         return None, None
+
+    def _prune_speculative(self, loc: Optional[TaskLocation]) -> None:
+        """Drop a resolved attempt from the in-flight speculation list (the
+        speculated task — or the original it duplicated — completed); the
+        bounded ``speculation_history`` keeps the record."""
+        if loc is not None and loc.task_id in self.speculative_tasks:
+            self.speculative_tasks.remove(loc.task_id)
 
     @staticmethod
     def _cancel_attempt(loc: Optional[TaskLocation]) -> None:
@@ -567,6 +726,7 @@ class QueryExecution:
             "user": self.user,
             "query": self.sql,
             "failure": (self.failure or "").split("\n")[0] or None,
+            "cacheStatus": self.cache_status,
             "fragments": {
                 str(fid): [l.task_id for l in locs]
                 for fid, locs in self.fragment_tasks.items()
@@ -606,6 +766,12 @@ class CoordinatorServer:
             return Session(properties, catalogs=self.catalogs, udfs=self.udfs)
 
         self.session_factory = session_factory or _shared_catalog_session
+        # query caching subsystem (trino_tpu/cache/): logical-plan cache +
+        # result cache shared by every query this server runs; per-query
+        # opt-in via the result_cache_enabled session property
+        from trino_tpu.cache import QueryCache
+
+        self.query_cache = QueryCache()
         self.queries: Dict[str, QueryExecution] = {}
         self._qlock = threading.Lock()
         self._qid = itertools.count(1)
@@ -651,7 +817,7 @@ class CoordinatorServer:
         query_id = f"q{time.strftime('%Y%m%d')}_{next(self._qid):05d}_{uuid.uuid4().hex[:5]}"
         execution = QueryExecution(
             query_id, sql, properties or {}, self.registry, self.session_factory,
-            user=user)
+            user=user, query_cache=self.query_cache)
         with self._qlock:
             terminal = [qid for qid, q in self.queries.items() if q.state.is_terminal()]
             for qid in terminal[: max(0, len(terminal) - self.MAX_QUERY_HISTORY)]:
@@ -815,6 +981,15 @@ def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) ->
     return payload
 
 
+CACHE_HEADER = "X-Trino-Tpu-Cache"
+
+
+def _cache_header(q: QueryExecution) -> Optional[dict]:
+    """Result-cache disposition header (HIT|MISS|BYPASS), once the query
+    has decided it (None while still queued/planning)."""
+    return {CACHE_HEADER: q.cache_status} if q.cache_status else None
+
+
 def _jsonable(v):
     import datetime
     import decimal
@@ -922,7 +1097,8 @@ def _make_handler(server: CoordinatorServer):
                     # claimed user header (no impersonation by default)
                     user = identity.user
                 q = server.submit(sql, props, user=user)
-                self._send(200, json.dumps(_result_payload(server, q, 0)).encode())
+                self._send(200, json.dumps(_result_payload(server, q, 0)).encode(),
+                           headers=_cache_header(q))
                 return
             self._send(404)
 
@@ -968,7 +1144,8 @@ def _make_handler(server: CoordinatorServer):
                 if not q.state.is_terminal():
                     q.state.wait_for_terminal(0.5)
                 self._send(200, json.dumps(
-                    _result_payload(server, q, int(m.group(2)))).encode())
+                    _result_payload(server, q, int(m.group(2)))).encode(),
+                    headers=_cache_header(q))
                 return
             m = _TRACE_RE.match(self.path)
             if m:

@@ -270,7 +270,22 @@ class ExprAnalyzer:
         if isinstance(e, ast.Literal):
             return analyze_literal(e)
         if isinstance(e, ast.Identifier):
-            ch, field, depth = self.scope.resolve(e.parts)
+            try:
+                ch, field, depth = self.scope.resolve(e.parts)
+            except AnalysisError as err:
+                # niladic datetime keywords (reference: CURRENT_DATE et al
+                # parse as parenless function invocations): a bare name
+                # matching NO column resolves as the function instead —
+                # strictly the not-found case; ambiguity errors (a real
+                # column named `now` on both join sides) must propagate
+                if (len(e.parts) == 1
+                        and e.parts[0].lower() in ("current_date",
+                                                   "current_timestamp",
+                                                   "localtimestamp", "now")
+                        and "cannot be resolved" in str(err)):
+                    return self._analyze_function(
+                        ast.FunctionCall(e.parts[0].lower(), ()))
+                raise
             if depth == 0:
                 return ir.ColumnRef(field.type, ch, field.name or "")
             if depth == 1:
@@ -655,6 +670,23 @@ class ExprAnalyzer:
             if len(args) != 2:
                 raise AnalysisError("atan2(y, x) expects 2 arguments")
             return ir.Call(T.DOUBLE, "atan2", args)
+        # --- non-deterministic functions (reference: MathFunctions.random /
+        # DateTimeFunctions.now; tagged deterministic=false there). They
+        # stay symbolic Calls — never constant-folded — so the cache
+        # layer's determinism analysis (trino_tpu/cache/determinism.py)
+        # sees them in both the AST and the optimized plan.
+        if name in ("random", "rand"):
+            if args:
+                raise AnalysisError("random() takes no arguments")
+            return ir.Call(T.DOUBLE, "random", ())
+        if name in ("now", "current_timestamp", "localtimestamp"):
+            if args:
+                raise AnalysisError(f"{name}() takes no arguments")
+            return ir.Call(T.timestamp(3), "now", ())
+        if name == "current_date":
+            if args:
+                raise AnalysisError("current_date() takes no arguments")
+            return ir.Call(T.DATE, "current_date", ())
         if name == "pi":
             import math
 
