@@ -1,0 +1,45 @@
+"""Endpoint-docs drift gate: every HTTP route served by the coordinator or
+worker must be documented in README.md's HTTP endpoints table
+(tools/check_endpoint_docs.py wired as a tier-1 test — the endpoint mirror
+of the metric-docs gate)."""
+import os
+import subprocess
+import sys
+
+TOOL = os.path.join(os.path.dirname(__file__), "..", "tools",
+                    "check_endpoint_docs.py")
+
+
+def test_all_served_endpoints_documented():
+    from tools.check_endpoint_docs import check
+
+    missing = check()
+    assert missing == [], (
+        f"endpoints served by server/coordinator.py or server/worker.py "
+        f"but missing from README.md: {missing}")
+
+
+def test_checker_cli_runs_green():
+    proc = subprocess.run(
+        [sys.executable, TOOL], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_extraction_sees_both_route_styles():
+    """The grep covers compiled route regexes AND literal path matches."""
+    from tools.check_endpoint_docs import served_endpoints
+
+    endpoints = served_endpoints()
+    assert "/v1/task/{id}/status" in endpoints  # _STATUS_RE regex
+    assert "/v1/metrics" in endpoints  # self.path == literal
+    assert "/ui" in endpoints  # self.path in (...) tuple literal
+
+
+def test_checker_detects_missing_endpoint(tmp_path):
+    """The gate actually gates: a README without the table fails."""
+    from tools.check_endpoint_docs import check
+
+    bare = tmp_path / "README.md"
+    bare.write_text("# no endpoints documented here\n")
+    missing = check(str(bare))
+    assert "/v1/statement" in missing

@@ -1,0 +1,305 @@
+"""Distributed operator-stats pipeline tests.
+
+Unit tier: OperatorStats accumulation semantics (re-execution ADDS, never
+overwrites) and the task→stage→query rollup math. Cluster tier (2 workers
+over real HTTP, the DistributedQueryRunner pattern): live ``queryStats``
+on ``GET /v1/query/{id}`` while RUNNING, distributed EXPLAIN ANALYZE on
+TPC-H Q1 with worker-sourced per-node annotations (and no coordinator-
+local re-execution), statement-protocol stats, and CLI progress/summary
+rendering."""
+import json
+import time
+
+import pytest
+
+from trino_tpu.client.remote import StatementClient
+from trino_tpu.client.session import Session
+from trino_tpu.exec.executor import Executor
+from trino_tpu.exec.operator_stats import (
+    OperatorStats, merge_operator_dicts, rollup_stages_to_query,
+    rollup_tasks_to_stage)
+from trino_tpu.exec.query import plan_sql
+from trino_tpu.server import wire
+from trino_tpu.server.coordinator import CoordinatorServer
+from trino_tpu.server.worker import WorkerServer
+
+Q1 = """
+select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+       avg(l_extendedprice) as avg_price, count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-09-02'
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+
+# ---------------------------------------------------------------- unit tier
+def test_operator_stats_accumulate_not_overwrite():
+    """Re-executing a node (as join probes / split streaming do) ADDS its
+    rows/bytes/time — the seed's ``output_rows`` overwrite is gone."""
+    session = Session({"catalog": "tpch", "schema": "tiny"})
+    root = plan_sql(session, "select r_regionkey + 1 from region")
+    ex = Executor(session)
+    ex.execute_checked(root)
+    first = {nid: (st.output_rows, st.output_bytes, st.wall_s, st.invocations)
+             for nid, st in ex.node_stats.items()}
+    assert first, "eager executor must record per-operator stats"
+    ex.execute_checked(root)  # same plan, same executor: accumulate
+    for nid, st in ex.node_stats.items():
+        rows0, bytes0, wall0, calls0 = first[nid]
+        assert st.output_rows == 2 * rows0
+        assert st.output_bytes == 2 * bytes0
+        assert st.invocations == 2 * calls0
+        assert st.wall_s > wall0
+    # input rows are charged from child outputs / connector rows
+    assert any(st.input_rows > 0 for st in ex.node_stats.values())
+    scan = [st for st in ex.node_stats.values() if st.operator == "TableScan"]
+    assert scan and scan[0].input_rows == 10  # 5 region rows x 2 executions
+
+
+def test_operator_stats_add_and_merge():
+    a = OperatorStats(7, "Join", input_rows=10, output_rows=4,
+                      output_bytes=100, wall_s=0.5, peak_bytes=1000,
+                      splits=1, invocations=1)
+    b = OperatorStats(7, "Join", input_rows=20, output_rows=6,
+                      output_bytes=300, wall_s=0.25, peak_bytes=4000,
+                      splits=2, invocations=3)
+    a.add(b)
+    assert (a.input_rows, a.output_rows, a.output_bytes) == (30, 10, 400)
+    assert a.wall_s == pytest.approx(0.75)
+    assert a.peak_bytes == 4000  # peaks max, not sum
+    assert (a.splits, a.invocations) == (3, 4)
+    # wire round trip + cross-task merge by node id
+    merged = merge_operator_dicts([[a.to_dict()], [b.to_dict()]])
+    assert set(merged) == {7}
+    assert merged[7].output_rows == 16
+
+
+def _task_entry(state, *, splits=(1, 2), rows=100, peak=1000, ops=()):
+    return {
+        "state": state,
+        "stats": {
+            "elapsedS": 1.0, "deviceS": 0.5,
+            "completedSplits": splits[0], "totalSplits": splits[1],
+            "inputRows": rows, "outputRows": rows // 10,
+            "outputBytes": rows * 8, "peakBytes": peak, "spills": 1,
+            "operatorStats": [o.to_dict() for o in ops],
+        },
+    }
+
+
+def test_task_stage_query_rollup_math():
+    op = OperatorStats(3, "TableScan", input_rows=100, output_rows=100,
+                       output_bytes=800, wall_s=0.2, splits=1, invocations=1)
+    t1 = _task_entry("FINISHED", splits=(2, 2), rows=100, peak=1000, ops=[op])
+    t2 = _task_entry("RUNNING", splits=(1, 3), rows=50, peak=5000, ops=[op])
+    stage = rollup_tasks_to_stage(0, [t1, t2])
+    assert stage["stageId"] == 0
+    assert (stage["tasks"], stage["completedTasks"]) == (2, 1)
+    assert stage["state"] == "RUNNING"  # one task still running
+    assert (stage["completedSplits"], stage["totalSplits"]) == (3, 5)
+    assert stage["inputRows"] == 150
+    assert stage["peakBytes"] == 5000  # max across tasks
+    assert stage["spills"] == 2
+    merged_ops = stage["operatorStats"]
+    assert len(merged_ops) == 1 and merged_ops[0]["inputRows"] == 200
+    other = rollup_tasks_to_stage(2, [_task_entry("FINISHED", splits=(4, 4),
+                                                  rows=10, peak=200)])
+    q = rollup_stages_to_query([stage, other])
+    assert (q["stages"], q["completedStages"]) == (2, 1)
+    assert (q["completedSplits"], q["totalSplits"]) == (7, 9)
+    assert q["totalRows"] == 160
+    assert q["peakBytes"] == 5000
+    assert q["spills"] == 3
+    # a failed task marks the stage FAILED (never "successfully finished")
+    failed = rollup_tasks_to_stage(
+        1, [_task_entry("FAILED"), _task_entry("FINISHED")])
+    assert failed["state"] == "FAILED"
+    assert rollup_stages_to_query([failed])["completedStages"] == 0
+    # scalar-only rollup skips the per-node merge (protocol polls / UI)
+    lean = rollup_tasks_to_stage(0, [t1, t2], include_operators=False)
+    assert lean["operatorStats"] == [] and lean["inputRows"] == 150
+
+
+def test_cli_progress_and_summary_rendering():
+    from trino_tpu.client.cli import render_progress, render_summary
+
+    stats = {"state": "RUNNING", "stages": 3, "completedStages": 2,
+             "totalRows": 6_000_000, "elapsedMs": 1200}
+    assert render_progress(stats) == "[RUNNING 2/3 stages, 6.0M rows, 1.2s]"
+    stats = {"state": "RUNNING", "stages": 1, "completedStages": 0,
+             "completedSplits": 3, "totalSplits": 6, "elapsedMs": 450}
+    assert render_progress(stats) == "[RUNNING 0/1 stages, 3/6 splits, 0.5s]"
+    summary = render_summary({"totalRows": 59837, "completedSplits": 2,
+                              "totalSplits": 2, "peakBytes": 2048 * 1024})
+    assert summary == " [59.8K rows processed, 2/2 splits, peak 2048KiB]"
+    assert render_summary(None) == ""
+
+
+# --------------------------------------------- in-process multi-node tier
+@pytest.fixture(scope="module")
+def cluster():
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [
+        WorkerServer(coordinator_url=coord.base_url, node_id=f"sw{i}")
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    assert coord.registry.wait_for_workers(2, timeout=15.0)
+    yield coord, workers
+    for w in workers:
+        w.stop()
+    coord.stop()
+
+
+def _drain(payload, deadline_s=120.0):
+    """Follow nextUri to a terminal payload, returning (columns, rows)."""
+    columns, rows = [], []
+    deadline = time.monotonic() + deadline_s
+    while True:
+        if "error" in payload:
+            raise RuntimeError(payload["error"]["message"])
+        if "columns" in payload:
+            columns = [c["name"] for c in payload["columns"]]
+        rows.extend(payload.get("data", []))
+        uri = payload.get("nextUri")
+        if uri is None:
+            return columns, rows
+        assert time.monotonic() < deadline
+        status, body, _ = wire.http_request("GET", uri, timeout=60.0)
+        assert status < 400
+        payload = json.loads(body)
+
+
+def test_query_stats_live_while_running_then_frozen(cluster):
+    """Acceptance: GET /v1/query/{id} returns non-empty queryStats with
+    completedSplits/totalSplits WHILE the query is RUNNING."""
+    coord, _ = cluster
+    sql = "select l_returnflag, count(*) from lineitem group by l_returnflag"
+    status, body, _ = wire.http_request(
+        "POST", f"{coord.base_url}/v1/statement", sql.encode(), "text/plain",
+        headers={"X-Trino-Session-catalog": "tpch",
+                 "X-Trino-Session-schema": "tiny",
+                 # every first-attempt task sleeps, holding the query in
+                 # RUNNING long enough to observe live stats
+                 "X-Trino-Session-slow_injection": "a0:2.0"})
+    assert status < 400
+    payload = json.loads(body)
+    qid = payload["id"]
+    live = None
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        info = wire.json_request("GET", f"{coord.base_url}/v1/query/{qid}")
+        if info["state"] == "RUNNING" and info["queryStats"]["totalSplits"]:
+            live = info
+            break
+        if info["state"] in ("FINISHED", "FAILED", "CANCELED"):
+            break
+        time.sleep(0.05)
+    assert live is not None, "never observed RUNNING queryStats"
+    qs = live["queryStats"]
+    assert qs["totalSplits"] > 0
+    assert "completedSplits" in qs and "elapsedMs" in qs
+    assert live["stageStats"], "per-stage rollup must exist while RUNNING"
+    # drain to completion; terminal stats are frozen and complete
+    _drain(payload)
+    info = wire.json_request("GET", f"{coord.base_url}/v1/query/{qid}")
+    assert info["state"] == "FINISHED"
+    qs = info["queryStats"]
+    assert qs["completedSplits"] == qs["totalSplits"] > 0
+    assert qs["totalRows"] > 0
+    stage = info["stageStats"][0]
+    assert stage["state"] == "FINISHED"
+    assert stage["operatorStats"], "stage rollup carries merged OperatorStats"
+    frozen = wire.json_request(
+        "GET", f"{coord.base_url}/v1/query/{qid}")["queryStats"]
+    assert frozen["elapsedMs"] == qs["elapsedMs"]  # terminal clock stopped
+
+
+def test_statement_protocol_carries_stats(cluster):
+    coord, _ = cluster
+    client = StatementClient(coord.base_url,
+                             {"catalog": "tpch", "schema": "tiny"})
+    seen = []
+    _, rows = client.execute("select count(*) from orders",
+                             on_stats=seen.append)
+    assert rows == [[15000]]
+    assert seen, "on_stats must fire on every protocol response"
+    stats = client.stats
+    assert stats["state"] == "FINISHED"
+    assert stats["totalSplits"] > 0
+    assert stats["completedSplits"] == stats["totalSplits"]
+    assert stats["totalRows"] > 0 and stats["elapsedMs"] >= 0
+    # DBAPI mirrors the client's final stats
+    from trino_tpu.client import dbapi
+
+    with dbapi.connect(coordinator_url=coord.base_url) as conn:
+        cur = conn.cursor()
+        cur.execute("select count(*) from region")
+        assert cur.fetchone() == (5,)
+        assert cur.stats is not None and cur.stats["state"] == "FINISHED"
+
+
+def test_distributed_explain_analyze_q1(cluster):
+    """Acceptance: distributed EXPLAIN ANALYZE on TPC-H Q1 prints
+    per-fragment, per-node rows=/wall= sourced from worker-reported
+    OperatorStats — no coordinator-local re-execution, task spans present."""
+    coord, _ = cluster
+    client = StatementClient(coord.base_url,
+                             {"catalog": "tpch", "schema": "tiny"})
+    cols, rows = client.execute("explain analyze " + Q1)
+    assert cols == ["Query Plan"]
+    text = "\n".join(r[0] for r in rows)
+    # header: wall time includes the planning breakdown
+    assert "planning" in text and "execution" in text
+    # fragmented rendering with stage totals on the source fragment header
+    assert "Fragment 0 [source] [tasks=2" in text
+    scan_line = next(l for l in text.split("\n")
+                     if "TableScan tpch.tiny.lineitem" in l)
+    assert "wall=" in scan_line and "rows=59837" in scan_line
+    assert "splits=2" in scan_line  # one split per worker, both completed
+    agg_lines = [l for l in text.split("\n") if "Aggregation" in l]
+    assert agg_lines and all("wall=" in l and "rows=" in l for l in agg_lines)
+    # worker-sourced, not coordinator re-execution: the trace has task spans
+    # and NO coordinator-local execute span
+    trace = wire.json_request(
+        "GET", f"{coord.base_url}/v1/query/{client.query_id}/trace")
+    names = set()
+    stack = [trace["root"]]
+    while stack:
+        node = stack.pop()
+        names.add(node["name"])
+        stack.extend(node["children"])
+    assert "task" in names, "worker task spans must be present"
+    assert "execute/coordinator-local" not in names
+    assert "schedule" in names and "device/execute" in names
+
+
+def test_distributed_explain_analyze_verbose(cluster):
+    coord, _ = cluster
+    client = StatementClient(coord.base_url,
+                             {"catalog": "tpch", "schema": "tiny"})
+    _, rows = client.execute(
+        "explain analyze verbose select count(*) from nation")
+    text = "\n".join(r[0] for r in rows)
+    assert "device: execute=" in text  # per-fragment device-detail line
+    assert "peak=" in text and "spills=" in text
+
+
+def test_local_explain_analyze_header_includes_planning():
+    """Satellite bugfix: the local EXPLAIN ANALYZE header accounts for
+    plan/optimize time, not just execute_checked."""
+    session = Session({"catalog": "tpch", "schema": "tiny"})
+    res = session.execute("explain analyze select count(*) from region")
+    text = "\n".join(r[0] for r in res.rows)
+    first = text.split("\n")[0]
+    assert "planning" in first and "execution" in first
+    import re as _re
+
+    m = _re.match(r"Query wall time: ([\d.]+)ms \(planning ([\d.]+)ms, "
+                  r"execution ([\d.]+)ms\)", first)
+    assert m, first
+    total, planning, execution = map(float, m.groups())
+    assert total == pytest.approx(planning + execution, abs=0.2)

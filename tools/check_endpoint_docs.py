@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Fail when an HTTP endpoint served by the cluster is missing from README.
+
+The coordinator and worker declare their routes two ways: module-level
+compiled regexes (``_STATUS_RE = re.compile(r"^/v1/task/([^/]+)/status$")``)
+and literal path comparisons inside the handlers (``self.path ==
+"/v1/metrics"``). This gate greps BOTH out of ``server/coordinator.py`` and
+``server/worker.py``, canonicalizes them to path templates (``([^/]+)`` →
+``{id}``, ``(\\d+)`` → ``{n}``), and requires each template to appear in
+README.md's HTTP endpoints table — the endpoint-surface mirror of
+``tools/check_metric_docs.py``, wired as a tier-1 test
+(tests/test_endpoint_docs.py).
+
+Usage: ``python tools/check_endpoint_docs.py [--readme PATH]`` — exit 0
+when every endpoint is documented, 1 with the missing templates otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SERVER_FILES = (
+    os.path.join("trino_tpu", "server", "coordinator.py"),
+    os.path.join("trino_tpu", "server", "worker.py"),
+)
+
+# route-regex literals: re.compile(r"^/v1/...$")
+_ROUTE_RE = re.compile(r're\.compile\(\s*r"\^(/[^"]+?)\$"\s*\)')
+# literal path matches inside handlers: self.path == "/v1/metrics",
+# self.path in ("/ui", "/ui/")
+_LITERAL_LINE_RE = re.compile(r"self\.path\s+(?:==|in)\s*(.+)")
+_PATH_STRING_RE = re.compile(r'"(/[^"\s]*)"')
+
+
+def _canonical(route_pattern: str) -> str:
+    """A route regex body → readable path template."""
+    out = route_pattern.replace(r"([^/]+)", "{id}").replace(r"(\d+)", "{n}")
+    return out.rstrip("/") or "/"
+
+
+def served_endpoints() -> list:
+    """Every canonical endpoint template the two servers route."""
+    endpoints = set()
+    for rel in SERVER_FILES:
+        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+            src = f.read()
+        for pattern in _ROUTE_RE.findall(src):
+            endpoints.add(_canonical(pattern))
+        for line in src.splitlines():
+            m = _LITERAL_LINE_RE.search(line)
+            if not m:
+                continue
+            for path in _PATH_STRING_RE.findall(m.group(1)):
+                endpoints.add(_canonical(path))
+    return sorted(endpoints)
+
+
+def documented_endpoints(readme_path: str) -> set:
+    """Path templates mentioned in the README (backticked table cells or
+    code blocks — any literal mention counts, the check is for presence)."""
+    with open(readme_path, encoding="utf-8") as f:
+        text = f.read()
+    return set(re.findall(r"(/(?:v1|ui)[^\s`)\",]*)", text))
+
+
+def check(readme_path: str | None = None) -> list:
+    """Missing endpoint templates (empty means the docs are complete)."""
+    readme_path = readme_path or os.path.join(REPO_ROOT, "README.md")
+    documented = documented_endpoints(readme_path)
+    return [e for e in served_endpoints() if e not in documented]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--readme", default=None,
+                    help="README path (default: repo root README.md)")
+    args = ap.parse_args()
+    missing = check(args.readme)
+    if missing:
+        print("HTTP endpoints served by server/coordinator.py or "
+              "server/worker.py but missing from the README:",
+              file=sys.stderr)
+        for e in missing:
+            print(f"  {e}", file=sys.stderr)
+        print("add each to the endpoint table in README.md "
+              "(## HTTP endpoints)", file=sys.stderr)
+        return 1
+    print(f"ok: all {len(served_endpoints())} served endpoints are "
+          "documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

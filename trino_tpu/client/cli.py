@@ -41,6 +41,51 @@ def _render(v) -> str:
     return str(v)
 
 
+def _si(n) -> str:
+    """Human row counts the way the reference CLI prints them: 6.0M, 1.2K."""
+    n = int(n or 0)
+    for div, suffix in ((1_000_000_000, "B"), (1_000_000, "M"), (1_000, "K")):
+        # the 0.9995 factor rolls values the one-decimal rounding would
+        # push to the next unit (999_950 -> "1.0M", never "1000.0K")
+        if n >= div * 0.9995:
+            return f"{n / div:.1f}{suffix}"
+    return str(n)
+
+
+def render_progress(stats) -> str:
+    """One live progress line from a statement-protocol ``stats`` block:
+    ``[RUNNING 2/3 stages, 6.0M rows, 1.2s]`` (reference: the CLI's
+    StatusPrinter progress bar, reduced to a line)."""
+    state = stats.get("state", "?")
+    parts = []
+    stages = stats.get("stages") or 0
+    if stages:
+        parts.append(f"{stats.get('completedStages', 0)}/{stages} stages")
+    total_splits = stats.get("totalSplits") or 0
+    if total_splits:
+        parts.append(f"{stats.get('completedSplits', 0)}/{total_splits} splits")
+    if stats.get("totalRows"):
+        parts.append(f"{_si(stats['totalRows'])} rows")
+    if stats.get("elapsedMs") is not None:
+        parts.append(f"{stats['elapsedMs'] / 1e3:.1f}s")
+    return f"[{state} {', '.join(parts)}]" if parts else f"[{state}]"
+
+
+def render_summary(stats) -> str:
+    """Final one-line stats summary appended to the row-count line."""
+    if not stats:
+        return ""
+    parts = []
+    if stats.get("totalRows"):
+        parts.append(f"{_si(stats['totalRows'])} rows processed")
+    if stats.get("totalSplits"):
+        parts.append(
+            f"{stats.get('completedSplits', 0)}/{stats['totalSplits']} splits")
+    if stats.get("peakBytes"):
+        parts.append(f"peak {stats['peakBytes'] // 1024}KiB")
+    return f" [{', '.join(parts)}]" if parts else ""
+
+
 class Console:
     def __init__(self, args):
         self.args = args
@@ -58,18 +103,43 @@ class Console:
 
     def run_statement(self, sql: str) -> int:
         t0 = time.monotonic()
+        # live progress while the coordinator reports a non-terminal state
+        # (remote runs on a tty only: a progress line inside piped output
+        # would corrupt it)
+        live = self._client is not None and sys.stderr.isatty()
+        progress_len = [0]
+
+        def on_stats(stats):
+            if not live or stats.get("state") in ("FINISHED", "FAILED",
+                                                  "CANCELED"):
+                return
+            line = render_progress(stats)
+            pad = max(0, progress_len[0] - len(line))
+            sys.stderr.write("\r" + line + " " * pad)
+            sys.stderr.flush()
+            progress_len[0] = len(line)
+
         try:
             if self._client is not None:
-                columns, rows = self._client.execute(sql)
+                # pass the progress hook only when rendering it (keeps the
+                # call compatible with minimal client stand-ins)
+                kwargs = {"on_stats": on_stats} if live else {}
+                columns, rows = self._client.execute(sql, **kwargs)
             else:
                 result = self._session.execute(sql)
                 columns, rows = result.column_names, result.rows
         except Exception as e:  # noqa: BLE001 — console surface
+            if live and progress_len[0]:
+                sys.stderr.write("\r" + " " * progress_len[0] + "\r")
             print(f"Query failed: {e}", file=sys.stderr)
             return 1
+        if live and progress_len[0]:
+            sys.stderr.write("\r" + " " * progress_len[0] + "\r")
+            sys.stderr.flush()
         print(format_table(columns, rows))
         dt = time.monotonic() - t0
         summary = f"({len(rows)} row{'s' if len(rows) != 1 else ''} in {dt:.2f}s)"
+        summary += render_summary(getattr(self._client, "stats", None))
         cache = getattr(self._client, "cache_status", None)
         if cache:
             # result-cache disposition from the X-Trino-Tpu-Cache header

@@ -89,6 +89,10 @@ class Cursor:
         # coordinator: "HIT" | "MISS" | "BYPASS" (None for embedded
         # sessions, which have no coordinator cache in front of them)
         self.cache_status: Optional[str] = None
+        # final query stats of the last execute() against a remote
+        # coordinator (the StatementStats analog: elapsedMs, splits, rows,
+        # bytes, peakBytes); None for embedded sessions
+        self.stats: Optional[dict] = None
         self._rows: List[tuple] = []
         self._pos = 0
 
@@ -99,10 +103,12 @@ class Cursor:
         if parameters:
             sql = _substitute_qmarks(operation, parameters)
         self.cache_status = None
+        self.stats = None
         try:
             if self._conn._client is not None:
                 columns, rows = self._conn._client.execute(sql)
                 self.cache_status = self._conn._client.cache_status
+                self.stats = self._conn._client.stats
             else:
                 res = self._conn._session.execute(sql)
                 columns, rows = res.column_names, res.rows

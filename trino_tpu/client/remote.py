@@ -27,13 +27,25 @@ class StatementClient:
         # from the X-Trino-Tpu-Cache response header; None before the
         # coordinator has decided (or against a pre-cache server)
         self.cache_status: Optional[str] = None
+        # the LAST statement's ``stats`` block (the StatementStats analog:
+        # state, elapsedMs, completedSplits/totalSplits, totalRows/Bytes,
+        # peakBytes, stages) — updated on every protocol response, so it is
+        # live progress while polling and the final rollup once terminal
+        self.stats: Optional[Dict] = None
+        # query id assigned by the coordinator for the last statement
+        self.query_id: Optional[str] = None
 
-    def execute(self, sql: str, timeout: float = 600.0) -> Tuple[List[str], List[list]]:
-        """Returns (column_names, rows)."""
+    def execute(self, sql: str, timeout: float = 600.0,
+                on_stats=None) -> Tuple[List[str], List[list]]:
+        """Returns (column_names, rows). ``on_stats`` (callable taking the
+        stats dict) fires after every protocol response — the hook the CLI
+        uses to render a live progress line."""
         headers = {
             f"X-Trino-Session-{k}": str(v) for k, v in self.session_properties.items()
         }
         self.cache_status = None
+        self.stats = None
+        self.query_id = None
         status, body, resp_headers = wire.http_request(
             "POST", f"{self.coordinator_url}/v1/statement",
             sql.encode(), "text/plain", headers=headers)
@@ -47,6 +59,11 @@ class StatementClient:
         rows: List[list] = []
         deadline = time.monotonic() + timeout
         while True:
+            self.query_id = payload.get("id", self.query_id)
+            if "stats" in payload:
+                self.stats = payload["stats"]
+                if on_stats is not None:
+                    on_stats(self.stats)
             if "error" in payload:
                 raise RemoteQueryError(payload["error"]["message"])
             # SET/RESET SESSION round-trip: apply to subsequent statements

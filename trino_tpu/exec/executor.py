@@ -26,6 +26,8 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.data.page import Column, Page
+from trino_tpu.exec import memory as _mem
+from trino_tpu.exec.operator_stats import OperatorStats
 from trino_tpu.ops import aggregate as agg_ops
 from trino_tpu.ops import expr_lower as L
 from trino_tpu.ops import groupby as gb
@@ -258,8 +260,21 @@ class Executor:
         self.df_apply_s = 0.0
         # rows materialized per scan plan-node id (EXPLAIN/pushdown tests)
         self.scan_stats: Dict[int, int] = {}
-        # per-operator stats by plan-node id (EXPLAIN ANALYZE)
-        self.node_stats: Dict[int, dict] = {}
+        # per-operator stats by plan-node id (EXPLAIN ANALYZE, task status):
+        # typed OperatorStats ACCUMULATED across repeated node executions
+        # (reference: OperatorContext/OperatorStats — SURVEY.md §5.1)
+        self.node_stats: Dict[int, OperatorStats] = {}
+        # rows a node produced on its LATEST execution — parents read their
+        # children's entries to charge input_rows per invocation
+        self._last_output_rows: Dict[int, int] = {}
+        # stack of accumulated child wall time: operators recursively
+        # execute their sources inside method(node), so per-operator wall
+        # must subtract the subtree's time to be EXCLUSIVE (the reference's
+        # OperatorStats semantics — summing operators then equals the query)
+        self._child_wall: List[float] = [0.0]
+        # (splits, scanned_rows) staged by the scan method that just ran,
+        # consumed by the execute() wrapper into the scan's OperatorStats
+        self._pending_scan: Dict[int, Tuple[int, int]] = {}
         # device-memory budget + spill decisions (exec/memory.py; reference:
         # lib/trino-memory-context + the spill FSMs). Property name mirrors
         # the reference's query_max_memory_per_node.
@@ -292,19 +307,40 @@ class Executor:
             return method(node)
         # per-operator profiling, always on in the eager tier (reference:
         # OperatorContext/OperatorStats via OperationTimer — SURVEY.md §5.1)
+        self._child_wall.append(0.0)
         t0 = time.perf_counter()
-        page = method(node)
-        wall = time.perf_counter() - t0
-        st = self.node_stats.setdefault(
-            node.id, {"name": type(node).__name__.replace("Node", ""), "wall_s": 0.0}
-        )
-        st["wall_s"] += wall
-        st["output_rows"] = page.live_count()  # live rows, not padded slots
+        try:
+            page = method(node)
+        finally:
+            # keep the stack balanced on error paths: the parent is still
+            # charged the subtree's time
+            wall = time.perf_counter() - t0
+            child_wall = self._child_wall.pop()
+            self._child_wall[-1] += wall
+        live = page.live_count()  # live rows, not padded slots
+        nbytes = _mem.page_bytes(page)
+        st = self.node_stats.get(node.id)
+        if st is None:
+            st = self.node_stats[node.id] = OperatorStats(
+                node.id, type(node).__name__.replace("Node", ""))
+        # accumulate, never overwrite: a node re-executed (per probe batch,
+        # per split) ADDS its rows/bytes/time, so rollups stay additive.
+        # Wall is EXCLUSIVE (children's recursive time subtracted), so the
+        # per-operator-kind metrics and rollups sum to the fragment body.
+        st.wall_s += max(0.0, wall - child_wall)
+        st.output_rows += live
+        st.output_bytes += nbytes
+        st.invocations += 1
+        st.peak_bytes = max(st.peak_bytes, nbytes)
+        st.input_rows += sum(
+            self._last_output_rows.get(s.id, 0) for s in node.sources)
+        splits, scanned = self._pending_scan.pop(node.id, (0, 0))
+        st.splits += splits
+        st.input_rows += scanned  # scans: connector rows are the input side
+        self._last_output_rows[node.id] = live
         # operator-output reservation rolls into the query's peak (the
         # LocalMemoryContext -> query-pool rollup, exact from static shapes)
-        from trino_tpu.exec import memory as mem
-
-        self.memory.observe(mem.page_bytes(page))
+        self.memory.observe(nbytes)
         return page
 
     def _narrowed_or_flag(self, col: Column, sel=None) -> Column:
@@ -360,9 +396,11 @@ class Executor:
                 node, self.dyn_domains, datas,
                 allow=getattr(self, "df_host_allow", None))
             self.df_apply_s += time.perf_counter() - t0
-        self.scan_stats[node.id] = sum(
+        scanned = sum(
             len(next(iter(d.values())).values) if d else 0 for d in datas
         )
+        self.scan_stats[node.id] = scanned
+        self._pending_scan[node.id] = (len(splits), scanned)
         return assemble_scan_page(node.column_names, node.column_types, datas)
 
     def _exec_ValuesNode(self, node: P.ValuesNode) -> Page:

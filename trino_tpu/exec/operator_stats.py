@@ -1,0 +1,191 @@
+"""Typed per-operator execution statistics + the task→stage→query rollup.
+
+Reference: ``operator/OperatorStats.java`` (one record per operator
+instance: input/output positions+bytes, wall/CPU nanos, peak memory)
+aggregated by ``TaskStats`` → ``StageStats`` → ``QueryStats``
+(``execution/QueryStats.java``), which feed the Web UI query page and
+``EXPLAIN ANALYZE``'s plan annotations (PlanPrinter stats injection).
+
+Here one ``OperatorStats`` accumulates across *repeated* executions of the
+same plan node (a node re-executed per probe batch or per split ADDS, never
+overwrites), so every rollup below is a plain sum/max and the math is
+additive by construction:
+
+- worker: ``Executor.node_stats`` (node id → OperatorStats), snapshot into
+  the task's status payload (``server/task.py``);
+- coordinator: task snapshots merge per stage (``rollup_tasks_to_stage``)
+  and stages merge per query (``rollup_stages_to_query``) inside the
+  status-polling loop (``server/coordinator.py``);
+- printers: ``format_plan`` / ``format_fragments`` annotate plan nodes from
+  a ``Dict[int, OperatorStats]`` regardless of which process produced it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List
+
+
+@dataclasses.dataclass
+class OperatorStats:
+    """Cumulative stats for one plan node (identified by plan-node id)."""
+
+    node_id: int
+    operator: str  # operator kind: "TableScan", "Join", "Aggregation", ...
+    input_rows: int = 0
+    output_rows: int = 0
+    output_bytes: int = 0
+    wall_s: float = 0.0
+    device_s: float = 0.0  # device-execute seconds attributed to this node
+    peak_bytes: int = 0  # largest single output reservation observed
+    splits: int = 0  # splits completed (scans only)
+    invocations: int = 0
+
+    def add(self, other: "OperatorStats") -> None:
+        """Fold another record for the SAME node into this one (additive
+        fields sum, peaks max) — used across tasks and across workers."""
+        self.input_rows += other.input_rows
+        self.output_rows += other.output_rows
+        self.output_bytes += other.output_bytes
+        self.wall_s += other.wall_s
+        self.device_s += other.device_s
+        self.peak_bytes = max(self.peak_bytes, other.peak_bytes)
+        self.splits += other.splits
+        self.invocations += other.invocations
+
+    def to_dict(self) -> dict:
+        return {
+            "nodeId": self.node_id,
+            "operator": self.operator,
+            "inputRows": self.input_rows,
+            "outputRows": self.output_rows,
+            "outputBytes": self.output_bytes,
+            "wallS": round(self.wall_s, 6),
+            "deviceS": round(self.device_s, 6),
+            "peakBytes": self.peak_bytes,
+            "splits": self.splits,
+            "invocations": self.invocations,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "OperatorStats":
+        return OperatorStats(
+            node_id=int(d["nodeId"]),
+            operator=str(d.get("operator", "?")),
+            input_rows=int(d.get("inputRows", 0)),
+            output_rows=int(d.get("outputRows", 0)),
+            output_bytes=int(d.get("outputBytes", 0)),
+            wall_s=float(d.get("wallS", 0.0)),
+            device_s=float(d.get("deviceS", 0.0)),
+            peak_bytes=int(d.get("peakBytes", 0)),
+            splits=int(d.get("splits", 0)),
+            invocations=int(d.get("invocations", 0)),
+        )
+
+
+def merge_operator_dicts(
+        dict_lists: Iterable[Iterable[dict]]) -> Dict[int, OperatorStats]:
+    """Merge per-task ``operatorStats`` payload lists by plan-node id —
+    tasks of one stage run the same fragment subtree, so equal node ids
+    across tasks (and across workers) are the same operator."""
+    merged: Dict[int, OperatorStats] = {}
+    for ops in dict_lists:
+        for d in ops or ():
+            st = OperatorStats.from_dict(d)
+            have = merged.get(st.node_id)
+            if have is None:
+                merged[st.node_id] = st
+            else:
+                have.add(st)
+    return merged
+
+
+def _stage_state(task_entries: List[dict]) -> str:
+    """A stage is FINISHED only when every task finished; any failed or
+    canceled task marks the whole stage (a FAILED stage must never read as
+    successfully completed)."""
+    states = [e.get("state") for e in task_entries]
+    if any(s == "FAILED" for s in states):
+        return "FAILED"
+    if any(s == "CANCELED" for s in states):
+        return "CANCELED"
+    if states and all(s == "FINISHED" for s in states):
+        return "FINISHED"
+    return "RUNNING"
+
+
+def rollup_tasks_to_stage(fragment_id: int, task_entries: List[dict],
+                          include_operators: bool = True) -> dict:
+    """One stage's rollup from its tasks' status records.
+
+    ``task_entries`` are coordinator-side records: ``{"state": str,
+    "stats": <task stats snapshot>}`` — one per task SLOT (retried or
+    speculative attempts replace the slot's record, so nothing double
+    counts). ``include_operators=False`` skips the per-node merge for
+    callers that only need the scalar summary (protocol polls, UI)."""
+    ops = merge_operator_dicts(
+        e.get("stats", {}).get("operatorStats")
+        for e in task_entries) if include_operators else {}
+    stage = {
+        "stageId": fragment_id,
+        "tasks": len(task_entries),
+        "completedTasks": sum(
+            1 for e in task_entries if e.get("state") == "FINISHED"),
+        "state": _stage_state(task_entries),
+        "completedSplits": 0,
+        "totalSplits": 0,
+        "inputRows": 0,
+        "outputRows": 0,
+        "outputBytes": 0,
+        "wallS": 0.0,
+        "deviceS": 0.0,
+        "peakBytes": 0,
+        "spills": 0,
+        "operatorStats": [ops[k].to_dict() for k in sorted(ops)],
+    }
+    for e in task_entries:
+        s = e.get("stats") or {}
+        stage["completedSplits"] += int(s.get("completedSplits", 0))
+        stage["totalSplits"] += int(s.get("totalSplits", 0))
+        stage["inputRows"] += int(s.get("inputRows", 0))
+        stage["outputRows"] += int(s.get("outputRows", 0))
+        stage["outputBytes"] += int(s.get("outputBytes", 0))
+        stage["wallS"] += float(s.get("elapsedS", 0.0))
+        stage["deviceS"] += float(s.get("deviceS", 0.0))
+        stage["peakBytes"] = max(stage["peakBytes"],
+                                 int(s.get("peakBytes", 0)))
+        stage["spills"] += int(s.get("spills", 0))
+    stage["wallS"] = round(stage["wallS"], 6)
+    stage["deviceS"] = round(stage["deviceS"], 6)
+    return stage
+
+
+def rollup_stages_to_query(stages: List[dict]) -> dict:
+    """Query-level totals from stage rollups (reference: QueryStats).
+
+    ``totalRows``/``totalBytes`` count work PROCESSED (stage input rows /
+    stage output bytes), the progress numbers a client renders; peaks max
+    across stages because stages share each worker's memory pool."""
+    q = {
+        "stages": len(stages),
+        "completedStages": sum(
+            1 for s in stages if s.get("state") == "FINISHED"),
+        "completedSplits": sum(int(s.get("completedSplits", 0)) for s in stages),
+        "totalSplits": sum(int(s.get("totalSplits", 0)) for s in stages),
+        "totalRows": sum(int(s.get("inputRows", 0)) for s in stages),
+        "totalBytes": sum(int(s.get("outputBytes", 0)) for s in stages),
+        "wallS": round(sum(float(s.get("wallS", 0.0)) for s in stages), 6),
+        "deviceS": round(sum(float(s.get("deviceS", 0.0)) for s in stages), 6),
+        "peakBytes": max(
+            [int(s.get("peakBytes", 0)) for s in stages], default=0),
+        "spills": sum(int(s.get("spills", 0)) for s in stages),
+    }
+    return q
+
+
+def wall_time_header(plan_s: float, exec_s: float) -> str:
+    """The EXPLAIN ANALYZE header line, shared by the local and distributed
+    paths: total wall includes planning so it agrees with the query-level
+    span totals."""
+    return (f"Query wall time: {(plan_s + exec_s) * 1e3:.1f}ms"
+            f" (planning {plan_s * 1e3:.1f}ms,"
+            f" execution {exec_s * 1e3:.1f}ms)")

@@ -69,7 +69,8 @@ def _bind_parameters(stmt, params):
 def _dispatch_statement(session, stmt) -> QueryResult:
     if isinstance(stmt, ast.Explain):
         if stmt.analyze:
-            text = explain_analyze(session, stmt.statement)
+            text = explain_analyze(session, stmt.statement,
+                                   verbose=stmt.verbose)
         else:
             text = explain_query(session, None, stmt.mode, stmt=stmt.statement)
         return QueryResult(["Query Plan"], [], [(line,) for line in text.split("\n")])
@@ -454,19 +455,31 @@ def _drop_table(session, stmt):
     return QueryResult(["result"], [], [("DROP TABLE",)])
 
 
-def explain_analyze(session, stmt) -> str:
+def explain_analyze(session, stmt, verbose: bool = False) -> str:
     """EXPLAIN ANALYZE: execute, then print the plan annotated with the
     executor's per-operator stats (reference: ExplainAnalyzeOperator +
-    PlanPrinter.java:183 with OperatorStats injected)."""
+    PlanPrinter.java:183 with OperatorStats injected). The header's wall
+    time covers planning AND execution, broken down so it agrees with the
+    query-level span totals (plan/optimize time used to be silently
+    dropped). ``verbose`` adds device detail: per-node bytes/peaks plus the
+    compiled tier's compile-cache disposition over this run."""
     import time as _time
 
+    from trino_tpu.obs import metrics as M
+
+    t_plan = _time.perf_counter()
     root = Planner(session).plan(stmt)
     root = optimize(root, session)
+    plan_s = _time.perf_counter() - t_plan
     ex = Executor(session)
+    hits0, misses0 = (M.COMPILE_CACHE_HITS.value(),
+                      M.COMPILE_CACHE_MISSES.value())
     t0 = _time.perf_counter()
     ex.execute_checked(root)
-    wall = _time.perf_counter() - t0
-    header = [f"Query wall time: {wall * 1e3:.1f}ms"]
+    exec_s = _time.perf_counter() - t0
+    from trino_tpu.exec.operator_stats import wall_time_header
+
+    header = [wall_time_header(plan_s, exec_s)]
     if ex.memory.budget is not None:
         header.append(
             f"Device memory budget: {ex.memory.budget // 1024}KiB,"
@@ -475,7 +488,19 @@ def explain_analyze(session, stmt) -> str:
         )
     else:
         header.append(f"Peak working set: {ex.memory.peak // 1024}KiB (no budget)")
-    return "\n".join(header) + "\n" + format_plan(root, executor=ex)
+    if verbose:
+        # the compile-cache delta is PROCESS-WIDE over this run's window
+        # (the registry has no per-query partitions): labeled as such so
+        # concurrent compiled-tier activity is never misread as this query
+        staged = sum(ex.scan_stats.values())
+        header.append(
+            f"Device detail: staged rows={staged},"
+            f" compile cache hits/misses (process-wide during run)="
+            f"{int(M.COMPILE_CACHE_HITS.value() - hits0)}/"
+            f"{int(M.COMPILE_CACHE_MISSES.value() - misses0)},"
+            f" dynamic-filter host seconds={ex.df_apply_s * 1e3:.1f}ms")
+    return "\n".join(header) + "\n" + format_plan(
+        root, executor=ex, verbose=verbose)
 
 
 def _show_tables(session, stmt):

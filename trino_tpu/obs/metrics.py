@@ -296,6 +296,18 @@ STAGED_ROWS = REGISTRY.counter(
 TASKS_TOTAL = REGISTRY.counter(
     "trino_tpu_tasks_total", "tasks created on this node")
 
+# per-operator-kind rollups, fed from each task's accumulated OperatorStats
+# at task completion (server/task.py) — the per-kernel attribution a
+# serving stack needs ("which operator ate the rows/ms on this node")
+OPERATOR_WALL_SECONDS = REGISTRY.histogram(
+    "trino_tpu_operator_wall_seconds",
+    "per-task operator wall time by operator kind, observed at task "
+    "completion", ("operator",))
+OPERATOR_ROWS = REGISTRY.counter(
+    "trino_tpu_operator_rows_total",
+    "rows output by operator kind, accumulated at task completion",
+    ("operator",))
+
 # query caching subsystem (trino_tpu/cache/): coordinator result cache,
 # logical-plan cache, and the connector-side datagen cache
 RESULT_CACHE_HITS = REGISTRY.counter(

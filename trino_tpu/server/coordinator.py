@@ -121,6 +121,20 @@ class QueryExecution:
 
         self.speculation_history = deque(maxlen=64)
         self.fragment_tasks: Dict[int, List[TaskLocation]] = {}
+        # distributed stats pipeline (reference: QueryStats/StageStats fed
+        # by TaskStatus updates): worker-reported task stats keyed by task
+        # SLOT (query.fragment.worker — retried attempts replace their
+        # slot), folded into per-stage and per-query rollups on read.
+        # Populated by the status-polling loop + the task-create response;
+        # a FINISHED attempt's record is never downgraded, so stats freeze
+        # naturally once the query reaches a terminal state.
+        self.task_stats: Dict[str, dict] = {}
+        self._tstats_lock = threading.Lock()
+        # fragments of the last distributed execution (EXPLAIN ANALYZE
+        # rendering + stage count); None for coordinator-local queries
+        self.fragments = None
+        self.created_at = time.time()
+        self.ended_at: Optional[float] = None
         # one trace per query; the trace id doubles as the propagation key
         # stamped on worker/exchange requests (reference: the otel Tracer
         # injected into DispatchManager + the traceparent headers of the
@@ -132,6 +146,7 @@ class QueryExecution:
         self._thread.start()
 
     def cancel(self) -> None:
+        self.ended_at = self.ended_at or time.time()
         if self.state.set("CANCELED"):
             self._cancel_tasks()
 
@@ -140,6 +155,7 @@ class QueryExecution:
         reason; running tasks are canceled (reference:
         QueryExecution.fail from ClusterMemoryManager's killer)."""
         self.failure = reason
+        self.ended_at = self.ended_at or time.time()
         if self.state.set("FAILED"):
             self._cancel_tasks()
 
@@ -156,8 +172,13 @@ class QueryExecution:
             # duration by then (a cancel/kill from another thread still
             # fires with whatever was recorded at that instant)
             self.tracer.end_span(root_span)
+            # stop the stats clock BEFORE the terminal transition so a poll
+            # racing the state change never reads a live elapsed time on a
+            # terminal query
+            self.ended_at = time.time()
             self.state.set("FINISHED")
         except Exception as e:  # noqa: BLE001 — reported through query info
+            self.ended_at = self.ended_at or time.time()
             if self.failure is None:
                 # an administrative kill() may already have set the real
                 # reason; the task-cancellation fallout must not clobber it
@@ -167,6 +188,7 @@ class QueryExecution:
             self.tracer.end_span(root_span)
             self.state.set("FAILED")
         finally:
+            self.ended_at = self.ended_at or time.time()
             self.tracer.end_span(root_span)  # idempotent safety net
             # the latch decides: a kill()/cancel() racing this thread may
             # already have set CANCELED/FAILED — record what actually stuck
@@ -189,6 +211,18 @@ class QueryExecution:
         # statement-kind probe, unspanned: plan_sql re-parses under its own
         # "parse" span, and two parse spans would double-attribute the time
         stmt = parse_statement(self.sql)
+        if (isinstance(stmt, ast.Explain) and stmt.analyze
+                and isinstance(stmt.statement, ast.Query)):
+            # distributed EXPLAIN ANALYZE: run the statement through the
+            # real fragment/schedule/execute path, then print the fragments
+            # annotated with the workers' rolled-up OperatorStats — no
+            # coordinator-local re-execution (reference:
+            # ExplainAnalyzeOperator consuming the stage stats it ran under)
+            self.cache_status = "BYPASS"
+            text = self._explain_analyze(session, stmt)
+            self.columns = ["Query Plan"]
+            self.rows = [(line,) for line in text.split("\n")]
+            return
         if not isinstance(stmt, ast.Query):
             # metadata statements (SHOW …, EXPLAIN) and DML/DDL run
             # coordinator-local and always bypass the result cache — the
@@ -341,12 +375,15 @@ class QueryExecution:
             from trino_tpu.exec.executor import Executor
 
             with self.tracer.span("execute/coordinator-local"):
-                page = Executor(session).execute_checked(root)
+                ex = Executor(session)
+                page = ex.execute_checked(root)
+            self._local_executor = ex  # EXPLAIN ANALYZE annotation source
             self.columns, self.rows = list(root.column_names), page.to_pylist()
             return
         with self.tracer.span("fragment") as sp:
             fragments = fragment_plan(root, session)
             sp.set("fragments", len(fragments))
+        self.fragments = fragments
         self.state.set("STARTING")
         workers = self.registry.alive()
         if not workers:
@@ -355,8 +392,13 @@ class QueryExecution:
             sp.set("workers", len(workers))
             self._schedule(session, fragments, workers)
         self.state.set("RUNNING")
+        self._start_stats_poller()
         with self.tracer.span("execute/root-fragment"):
             result_page = self._run_root_fragment(session, fragments)
+        # freeze the rollup on the workers' terminal numbers before the
+        # query leaves RUNNING (tasks are at least FLUSHING once the root
+        # fragment has drained their buffers)
+        self._sweep_task_stats()
         self.state.set("FINISHING")
         self.columns = fragments[-1].root.column_names
         self.rows = result_page.to_pylist()
@@ -377,6 +419,189 @@ class QueryExecution:
                 os.remove(path)
             except OSError:
                 pass
+
+    # ------------------------------------------------------ stats pipeline
+    def _note_task_status(self, task_id: str, info: dict) -> None:
+        """Record one task-status payload (state + worker-reported stats)
+        into the slot map the stage/query rollups read."""
+        parts = task_id.split(".")
+        try:
+            frag = int(parts[-3])
+        except (ValueError, IndexError):
+            return
+        slot = task_id.rsplit(".a", 1)[0]
+        entry = {
+            "fragment": frag,
+            "taskId": task_id,
+            "state": info.get("state") or "RUNNING",
+            "stats": info.get("stats") or {},
+        }
+
+        def progress(e):
+            s = e.get("stats") or {}
+            return (int(s.get("completedSplits", 0)),
+                    int(s.get("inputRows", 0)),
+                    int(s.get("outputRows", 0)))
+
+        with self._tstats_lock:
+            have = self.task_stats.get(slot)
+            # a FINISHED attempt's stats are authoritative for its slot —
+            # a late poll of a canceled speculative twin must not clobber
+            if (have is not None and have["state"] == "FINISHED"
+                    and entry["state"] != "FINISHED"):
+                return
+            # concurrent attempts (speculation / a retry's create response)
+            # share the slot: while neither is FINISHED, keep whichever has
+            # made MORE progress, so live numbers never regress or flicker.
+            # Dead (FAILED/CANCELED) records never win in either direction:
+            # a dead twin must not displace a live attempt's record, and a
+            # dead existing record never blocks the live retry — a stage
+            # must not read FAILED while an attempt is still running.
+            dead = ("FAILED", "CANCELED")
+            if (have is not None and have["taskId"] != task_id
+                    and entry["state"] in dead
+                    and have["state"] not in dead):
+                return
+            if (have is not None and entry["state"] != "FINISHED"
+                    and have["state"] not in dead
+                    and have["taskId"] != task_id
+                    and progress(entry) < progress(have)):
+                return
+            self.task_stats[slot] = entry
+
+    def _sweep_task_stats(self) -> None:
+        """One status sweep over every scheduled task (the coordinator's
+        status-polling loop body; also the terminal freeze). Slots already
+        frozen FINISHED are skipped, and the timeout is sub-second so one
+        unreachable worker cannot stall the live-stats cadence."""
+        with self._tstats_lock:
+            done = {e["taskId"] for e in self.task_stats.values()
+                    if e["state"] == "FINISHED"}
+        locations = [loc for locs in list(self.fragment_tasks.values())
+                     for loc in list(locs)
+                     if loc is not None and loc.task_id not in done]
+        for loc in locations:
+            try:
+                status, body, _ = wire.http_request(
+                    "GET", f"{loc.base_url}/v1/task/{loc.task_id}/status",
+                    timeout=0.8)
+                if status < 400:
+                    self._note_task_status(loc.task_id, json.loads(body))
+            except Exception:  # noqa: BLE001 — a gone worker loses its stats
+                pass
+
+    STATS_POLL_INTERVAL = 0.25
+
+    def _start_stats_poller(self) -> None:
+        """Background status poll while the query RUNs, so
+        ``GET /v1/query/{id}`` serves LIVE stage/query stats (reference:
+        ContinuousTaskStatusFetcher feeding the coordinator's stage state
+        machines)."""
+
+        def poll():
+            while not self.state.is_terminal():
+                self._sweep_task_stats()
+                time.sleep(self.STATS_POLL_INTERVAL)
+
+        self._stats_poller = threading.Thread(target=poll, daemon=True)
+        self._stats_poller.start()
+
+    def stage_stats(self, include_operators: bool = True) -> List[dict]:
+        """Per-stage rollups of the latest worker-reported task stats.
+        ``include_operators=False`` skips the per-node OperatorStats merge
+        for callers that only read the scalar summary (protocol polls,
+        UI) — O(tasks) instead of O(tasks × plan nodes)."""
+        from trino_tpu.exec.operator_stats import rollup_tasks_to_stage
+
+        with self._tstats_lock:
+            entries = [dict(e) for e in self.task_stats.values()]
+        by_frag: Dict[int, List[dict]] = {}
+        for e in entries:
+            by_frag.setdefault(e["fragment"], []).append(e)
+        return [rollup_tasks_to_stage(fid, es,
+                                      include_operators=include_operators)
+                for fid, es in sorted(by_frag.items())]
+
+    def query_stats(self, stages: Optional[List[dict]] = None) -> dict:
+        """Query-level rollup: live while RUNNING, frozen at terminal.
+        Pass precomputed ``stages`` to avoid re-rolling the task map when
+        the caller already has them (info(), the UI page)."""
+        from trino_tpu.exec.operator_stats import rollup_stages_to_query
+
+        qs = rollup_stages_to_query(
+            self.stage_stats() if stages is None else stages)
+        end = (self.ended_at
+               if self.state.is_terminal() and self.ended_at else time.time())
+        qs["elapsedMs"] = int((end - self.created_at) * 1000)
+        qs["state"] = self.state.get()
+        qs["cacheStatus"] = self.cache_status
+        qs["resultRows"] = len(self.rows)
+        return qs
+
+    def _explain_analyze(self, session, stmt) -> str:
+        """Distributed EXPLAIN ANALYZE: plan, execute through the real
+        fragment/schedule path, then render the fragments with the
+        coordinator's rolled-up per-node worker stats injected (reference:
+        PlanPrinter.textDistributedPlan with stats)."""
+        import time as _time
+
+        from trino_tpu.exec.operator_stats import (
+            merge_operator_dicts, wall_time_header)
+        from trino_tpu.sql.planner.fragmenter import format_fragments
+        from trino_tpu.sql.planner.optimizer import optimize
+        from trino_tpu.sql.planner.planner import Planner
+
+        inner = stmt.statement
+        udfs = getattr(session, "udfs", None)
+        if udfs:
+            from trino_tpu.sql.routines import expand_udfs
+
+            inner = expand_udfs(inner, udfs)
+        t_plan = _time.perf_counter()
+        with tracing.span("analyze/plan"):
+            root = Planner(session).plan(inner)
+        with tracing.span("optimize"):
+            root = optimize(root, session)
+        plan_s = _time.perf_counter() - t_plan
+        t_exec = _time.perf_counter()
+        self._execute_query(session, root)
+        exec_s = _time.perf_counter() - t_exec
+        header = [wall_time_header(plan_s, exec_s)]
+        if self.fragments is None:
+            # process-local catalogs executed on the coordinator's own
+            # engine: annotate from that executor, exactly the local path
+            from trino_tpu.sql.planner.plan import format_plan
+
+            ex = getattr(self, "_local_executor", None)
+            header.append(
+                f"Peak working set: "
+                f"{(ex.memory.peak if ex else 0) // 1024}KiB (coordinator)")
+            return "\n".join(header) + "\n" + format_plan(
+                root, executor=ex, verbose=stmt.verbose)
+        # _execute_query already swept terminal task stats before FINISHING
+        stages = self.stage_stats()
+        stage_by_id = {s["stageId"]: s for s in stages}
+        with self._tstats_lock:
+            op_lists = [e["stats"].get("operatorStats")
+                        for e in self.task_stats.values()]
+        # the root single fragment ran on the coordinator itself — its
+        # executor's stats complete the tree (that is its assigned worker,
+        # not a re-execution)
+        root_ex = getattr(self, "_root_executor", None)
+        if root_ex is not None:
+            op_lists.append(
+                [st.to_dict() for st in root_ex.node_stats.values()])
+        node_stats = merge_operator_dicts(op_lists)
+        qs = self.query_stats(stages)
+        header.append(
+            f"Stages: {len(stages)} scheduled + 1 coordinator,"
+            f" splits: {qs['completedSplits']}/{qs['totalSplits']},"
+            f" input rows: {qs['totalRows']},"
+            f" peak task memory: {qs['peakBytes'] // 1024}KiB,"
+            f" spills: {qs['spills']}")
+        return "\n".join(header) + "\n" + format_fragments(
+            self.fragments, stats=node_stats, stage_stats=stage_by_id,
+            verbose=stmt.verbose)
 
     def _schedule(self, session, fragments, workers) -> None:
         """Create one task per worker for each source fragment, splits
@@ -502,6 +727,12 @@ class QueryExecution:
             raise RuntimeError(
                 f"task create failed on {worker['nodeId']}: "
                 f"{resp[:300].decode(errors='replace')}")
+        # the create response IS a task-info payload: seed the stats slot
+        # immediately so totalSplits is known while the task still runs
+        try:
+            self._note_task_status(task_id, json.loads(resp))
+        except Exception:  # noqa: BLE001 — stats seeding is best-effort
+            pass
         return TaskLocation(worker["url"], task_id)
 
     TASK_ATTEMPT_TIMEOUT = 600.0
@@ -635,6 +866,7 @@ class QueryExecution:
         if status >= 400:
             return "FAILED", f"status {status}"
         info = json.loads(body)
+        self._note_task_status(loc.task_id, info)
         if info["state"] in ("FINISHED", "FAILED", "CANCELED"):
             return info["state"], info.get("failure")
         return None, None
@@ -680,6 +912,7 @@ class QueryExecution:
                 client.start()
                 remote_pages[node.fragment_id] = client.pages()
         ex = FragmentExecutor(session, {}, remote_pages)
+        self._root_executor = ex  # EXPLAIN ANALYZE: the root stage's stats
         return ex.execute_checked(root_frag.root)
 
     PHASE_WAIT_TIMEOUT = 300.0
@@ -699,7 +932,9 @@ class QueryExecution:
                             f"{loc.base_url}/v1/task/{loc.task_id}/status",
                             timeout=10.0)
                         if status < 400:
-                            state = json.loads(body).get("state")
+                            info = json.loads(body)
+                            self._note_task_status(loc.task_id, info)
+                            state = info.get("state")
                             if state in ("FLUSHING", "FINISHED", "FAILED",
                                          "CANCELED"):
                                 break
@@ -720,6 +955,7 @@ class QueryExecution:
                     pass
 
     def info(self) -> dict:
+        stages = self.stage_stats()
         return {
             "queryId": self.query_id,
             "state": self.state.get(),
@@ -732,6 +968,11 @@ class QueryExecution:
                 for fid, locs in self.fragment_tasks.items()
             },
             "retriedTasks": list(self.retried_tasks),
+            # live task→stage→query rollup of worker-reported OperatorStats
+            # (frozen once the query is terminal — polling stops and
+            # FINISHED slots never downgrade)
+            "queryStats": self.query_stats(stages),
+            "stageStats": stages,
         }
 
 
@@ -955,9 +1196,13 @@ class CoordinatorServer:
 
 def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) -> dict:
     state = q.state.get()
+    # summary stats ride EVERY statement response (reference: the
+    # StatementStats block of the client protocol) so clients can render
+    # live progress while polling nextUri
     payload: dict = {
         "id": q.query_id,
-        "stats": {"state": state},
+        "stats": {**q.query_stats(q.stage_stats(include_operators=False)),
+                  "state": state},
     }
     if state == "FAILED":
         payload["error"] = {"message": q.failure or "query failed"}
@@ -1011,10 +1256,20 @@ def _render_ui(server: CoordinatorServer) -> str:
         queries = sorted(server.queries.items(), reverse=True)
     for qid, q in queries[:50]:
         state = q.state.get()
+        stage_list = q.stage_stats(include_operators=False)
+        qs = q.query_stats(stage_list)
+        stages = " ".join(
+            f"f{s['stageId']}: {s['outputRows']} rows/"
+            f"{s['wallS'] * 1e3:.0f}ms"
+            for s in stage_list) or "—"
+        progress = (f"{qs['completedSplits']}/{qs['totalSplits']} splits, "
+                    f"{qs['elapsedMs'] / 1e3:.1f}s")
         rows.append(
             f"<tr><td>{html.escape(qid)}</td><td class='s {state}'>{state}</td>"
             f"<td>{html.escape(q.user)}</td>"
             f"<td><code>{html.escape(q.sql.strip()[:120])}</code></td>"
+            f"<td>{html.escape(progress)}</td>"
+            f"<td>{html.escape(stages)}</td>"
             f"<td>{len(q.retried_tasks)}</td></tr>")
     nodes = "".join(
         f"<tr><td>{html.escape(n['nodeId'])}</td>"
@@ -1033,7 +1288,8 @@ h1,h2{{color:#fff}}</style></head><body>
 (limit {rg['hardConcurrencyLimit']})</p>
 <h2>workers</h2><table><tr><th>node</th><th>url</th></tr>{nodes}</table>
 <h2>queries</h2><table>
-<tr><th>query id</th><th>state</th><th>user</th><th>query</th><th>retries</th></tr>
+<tr><th>query id</th><th>state</th><th>user</th><th>query</th>
+<th>progress</th><th>stages (rows/wall)</th><th>retries</th></tr>
 {''.join(rows)}</table></body></html>"""
 
 

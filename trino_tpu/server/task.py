@@ -23,6 +23,7 @@ from typing import Dict, List, Optional
 from trino_tpu.data.page import Page
 from trino_tpu.data.serde import serialize_page
 from trino_tpu.exec.executor import Executor
+from trino_tpu.exec.operator_stats import OperatorStats
 from trino_tpu.obs import metrics as M
 from trino_tpu.obs import trace as tracing
 from trino_tpu.server.buffer import OutputBuffer, PartitionedOutputBuffer
@@ -85,6 +86,7 @@ class FragmentExecutor(Executor):
             rows = sum(
                 len(next(iter(d.values())).values) if d else 0 for d in datas)
             self.scan_stats[node.id] = rows
+            self._pending_scan[node.id] = (len(splits), rows)
             page = assemble_scan_page(node.column_names, node.column_types, datas)
             staged = time.perf_counter() - t0
             sp.set("staged_rows", rows)
@@ -136,12 +138,76 @@ class SqlTask:
         # up into the worker announce for cluster memory management
         # (reference: QueryContext reservations -> ClusterMemoryPool)
         self.peak_memory_bytes = 0
+        # --- task-level stats (reference: TaskStats + the OperatorStats it
+        # aggregates): every retired executor folds its node_stats in here
+        # under _stats_lock, and status responses snapshot the same way —
+        # so a coordinator poll mid-execution reads a consistent rollup.
+        self.operator_stats: Dict[int, "OperatorStats"] = {}
+        self._stats_lock = threading.Lock()
+        self.total_splits = sum(len(v) for v in request.splits.values())
+        self.splits_completed = 0
+        self.device_seconds = 0.0
+        self.input_rows = 0  # connector/exchange rows entering the fragment
+        self.output_rows = 0
+        self.output_bytes = 0
+        self.spill_count = 0
+        self.started_at = time.monotonic()
+        self.ended_at: Optional[float] = None
         self._session_factory = session_factory
         self._thread = threading.Thread(target=self._run, daemon=True)
 
     def _track_executor(self, ex) -> None:
         self._live_executor = ex
         self.peak_memory_bytes = max(self.peak_memory_bytes, ex.memory.peak)
+
+    def _retire_executor(self, ex, splits: int = 0, input_rows: int = 0,
+                         device_s: float = 0.0) -> None:
+        """Fold a finished executor's per-operator stats into the task's
+        accumulated rollup (one executor per bulk body, per split, or per
+        streaming batch — accumulation keeps stats additive across all
+        three driver shapes)."""
+        import dataclasses as _dc
+
+        self._track_executor(ex)
+        with self._stats_lock:
+            for nid, st in ex.node_stats.items():
+                have = self.operator_stats.get(nid)
+                if have is None:
+                    self.operator_stats[nid] = _dc.replace(st)
+                else:
+                    have.add(st)
+            # the fragment body IS the device execution: charge its wall to
+            # the fragment root's device-seconds
+            root_st = self.operator_stats.get(self.request.fragment_root.id)
+            if root_st is not None:
+                root_st.device_s += device_s
+            self.device_seconds += device_s
+            self.splits_completed += splits
+            self.input_rows += input_rows
+            self.spill_count += len(ex.memory.spills)
+
+    def stats_snapshot(self) -> dict:
+        """Point-in-time task stats for ``GET /v1/task/{id}/status`` —
+        the wire shape the coordinator's stage/query rollup consumes."""
+        live = getattr(self, "_live_executor", None)
+        peak = max(self.peak_memory_bytes,
+                   live.memory.peak if live is not None else 0)
+        with self._stats_lock:
+            ops = [self.operator_stats[k].to_dict()
+                   for k in sorted(self.operator_stats)]
+            elapsed = (self.ended_at or time.monotonic()) - self.started_at
+            return {
+                "elapsedS": round(elapsed, 6),
+                "deviceS": round(self.device_seconds, 6),
+                "completedSplits": self.splits_completed,
+                "totalSplits": self.total_splits,
+                "inputRows": self.input_rows,
+                "outputRows": self.output_rows,
+                "outputBytes": self.output_bytes,
+                "peakBytes": peak,
+                "spills": self.spill_count,
+                "operatorStats": ops,
+            }
 
     @property
     def memory_bytes(self) -> int:
@@ -178,8 +244,21 @@ class SqlTask:
             self.output.abort(str(e))
             self.state.set("FAILED")
         finally:
+            self.ended_at = time.monotonic()
+            self._observe_operator_metrics()
             task_span.set("state", self.state.get())
             self.tracer.end_span(task_span)
+
+    def _observe_operator_metrics(self) -> None:
+        """Feed the per-operator-kind registry metrics from this task's
+        accumulated stats, once, at task completion."""
+        with self._stats_lock:
+            snapshot = [(st.operator, st.wall_s, st.output_rows)
+                        for st in self.operator_stats.values()]
+        for operator, wall_s, rows in snapshot:
+            M.OPERATOR_WALL_SECONDS.observe(wall_s, operator)
+            if rows:
+                M.OPERATOR_ROWS.inc(rows, operator)
 
     def _run_body(self) -> None:
         req = self.request
@@ -219,11 +298,19 @@ class SqlTask:
             sp.set("staged_rows", sum(ex.scan_stats.values()))
             sp.set("output_rows", int(page.num_rows))
         M.DEVICE_SECONDS.inc(device_s)
-        self._track_executor(ex)
+        remote_rows = sum(
+            p.num_rows for pages in remote_pages.values() for p in pages)
+        self._retire_executor(
+            ex, splits=self.total_splits,
+            input_rows=sum(ex.scan_stats.values()) + remote_rows,
+            device_s=device_s)
         from trino_tpu.exec.memory import page_bytes
 
         page = page.compact()
         self.flushing_bytes = page_bytes(page)  # held through the drain
+        with self._stats_lock:
+            self.output_rows += page.num_rows
+            self.output_bytes += self.flushing_bytes
         self.state.set("FLUSHING")
         chunk_rows = self._chunk_rows(page)
         if req.output_partition_channels is not None:
@@ -336,6 +423,11 @@ class SqlTask:
         path's finalization)."""
         if out.num_rows == 0 or out.live_count() == 0:
             return
+        from trino_tpu.exec.memory import page_bytes
+
+        with self._stats_lock:
+            self.output_rows += int(out.live_count())
+            self.output_bytes += page_bytes(out)
         chunk_rows = self._chunk_rows(out)
         if part_channels is not None:
             from trino_tpu.exec.memory import partition_page_host
@@ -374,8 +466,12 @@ class SqlTask:
                 self._track_executor(ex)
                 t0 = time.perf_counter()
                 out = ex.execute_checked(req.fragment_root).compact()
-                device_s += time.perf_counter() - t0
+                split_s = time.perf_counter() - t0
+                device_s += split_s
                 staged_rows += sum(ex.scan_stats.values())
+                self._retire_executor(
+                    ex, splits=1, input_rows=sum(ex.scan_stats.values()),
+                    device_s=split_s)
                 self._enqueue_out(out, req.output_partition_channels,
                                   req.consumer_count)
             sp.set("device_seconds", round(device_s, 6))
@@ -424,6 +520,7 @@ class SqlTask:
                               req.consumer_count)
 
         def emit(batch: List[Page]) -> None:
+            batch_rows = sum(p.num_rows for p in batch)
             page = batch[0]
             for p in batch[1:]:
                 page = Page.concat_pages(page, p)
@@ -431,7 +528,9 @@ class SqlTask:
             self._track_executor(ex)
             t0 = time.perf_counter()
             out = ex.execute_checked(req.fragment_root).compact()
-            device_clock[0] += time.perf_counter() - t0
+            batch_s = time.perf_counter() - t0
+            device_clock[0] += batch_s
+            self._retire_executor(ex, input_rows=batch_rows, device_s=batch_s)
             enqueue_out(out)
 
         if final_agg is not None:
@@ -442,7 +541,27 @@ class SqlTask:
             batch: List[Page] = []
             batch_rows = 0
 
+            def record_agg_stats(ex, wall_s, in_rows, out_page,
+                                 is_final=False):
+                """aggregate_intermediate/final bypass the execute() stats
+                wrapper — record the aggregation node's OperatorStats by
+                hand so fold fragments still annotate EXPLAIN ANALYZE and
+                feed the per-operator metrics. Only the finalization's rows
+                count as operator OUTPUT (intermediate folds maintain
+                internal state); every pass counts toward wall/input."""
+                from trino_tpu.exec.memory import page_bytes
+
+                st = ex.node_stats.setdefault(
+                    node.id, OperatorStats(node.id, "Aggregation"))
+                st.wall_s += wall_s
+                st.input_rows += in_rows
+                if is_final:
+                    st.output_rows += int(out_page.num_rows)
+                    st.output_bytes += page_bytes(out_page)
+                st.invocations += 1
+
             def fold(running, batch):
+                batch_rows = sum(p.num_rows for p in batch)
                 page = batch[0]
                 for p in batch[1:]:
                     page = Page.concat_pages(page, p)
@@ -453,7 +572,11 @@ class SqlTask:
                 t0 = time.perf_counter()
                 out = ex.aggregate_intermediate(node, page).compact()
                 ex.raise_errors()
-                device_clock[0] += time.perf_counter() - t0
+                fold_s = time.perf_counter() - t0
+                device_clock[0] += fold_s
+                record_agg_stats(ex, fold_s, batch_rows, out)
+                self._retire_executor(ex, input_rows=batch_rows,
+                                      device_s=fold_s)
                 return out
 
             with tracing.span("device/execute", mode="streaming-fold") as sp:
@@ -475,7 +598,11 @@ class SqlTask:
                 t0 = time.perf_counter()
                 out = ex.aggregate_final(node, running).compact()
                 ex.raise_errors()
-                device_clock[0] += time.perf_counter() - t0
+                final_s = time.perf_counter() - t0
+                device_clock[0] += final_s
+                record_agg_stats(ex, final_s, int(running.num_rows), out,
+                                 is_final=True)
+                self._retire_executor(ex, device_s=final_s)
                 sp.set("device_seconds", round(device_clock[0], 6))
                 sp.set("input_rows", in_rows)
             M.DEVICE_SECONDS.inc(device_clock[0])
@@ -563,6 +690,10 @@ class SqlTask:
             "failure": self.failure,
             "bufferedBytes": self.output.buffered_bytes,
             "memoryBytes": self.memory_bytes,
+            # worker-reported stats ride every status response — the
+            # coordinator's stage/query rollup reads them from its
+            # status-polling loop (reference: TaskStatus carrying TaskStats)
+            "stats": self.stats_snapshot(),
         }
 
 

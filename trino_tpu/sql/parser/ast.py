@@ -355,6 +355,9 @@ class Explain(Statement):
     analyze: bool = False
     mode: str = "logical"  # logical | distributed
     fmt: str = "text"
+    # EXPLAIN ANALYZE VERBOSE: add device detail (output/peak bytes,
+    # compile-cache disposition, spill counts) to the annotated plan
+    verbose: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -158,6 +158,7 @@ class Parser:
             return ast.Rollback()
         if self.accept_kw("explain"):
             analyze = bool(self.accept_kw("analyze"))
+            verbose = analyze and bool(self.accept_soft("verbose"))
             mode, fmt = "distributed", "text"
             if self.accept_op("("):
                 while True:
@@ -169,7 +170,8 @@ class Parser:
                     if not self.accept_op(","):
                         break
                 self.expect_op(")")
-            return ast.Explain(self.statement(), analyze=analyze, mode=mode, fmt=fmt)
+            return ast.Explain(self.statement(), analyze=analyze, mode=mode,
+                               fmt=fmt, verbose=verbose)
         if self.accept_kw("create"):
             or_replace = False
             if self.accept_kw("or"):

@@ -311,29 +311,54 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
     return fragments
 
 
-def format_fragments(fragments: List[PlanFragment]) -> str:
+def format_fragments(fragments: List[PlanFragment], stats=None,
+                     stage_stats=None, verbose: bool = False) -> str:
     """EXPLAIN (TYPE DISTRIBUTED) rendering (reference: PlanPrinter's
-    fragmented text plan)."""
+    fragmented text plan). With ``stats`` (plan-node id → OperatorStats,
+    the coordinator's rollup of worker-reported task stats) this renders
+    distributed EXPLAIN ANALYZE: per-node ``wall=``/``rows=`` annotations
+    sourced from the workers that actually ran each fragment. With
+    ``stage_stats`` (fragment id → stage rollup dict), each fragment header
+    carries its stage totals; ``verbose`` adds a device-detail line per
+    fragment (device seconds, output/peak bytes, spill count)."""
     lines = []
     for f in reversed(fragments):
-        lines.append(f"Fragment {f.id} [{f.partitioning}]")
-        lines.append(_format(f.root, 1))
+        head = f"Fragment {f.id} [{f.partitioning}]"
+        si = (stage_stats or {}).get(f.id)
+        if si is not None:
+            head += (f" [tasks={si['tasks']},"
+                     f" splits={si['completedSplits']}/{si['totalSplits']},"
+                     f" wall={si['wallS'] * 1e3:.1f}ms,"
+                     f" rows={si['outputRows']}]")
+        lines.append(head)
+        if verbose and si is not None:
+            lines.append(
+                f"  device: execute={si['deviceS'] * 1e3:.1f}ms,"
+                f" output={si['outputBytes'] // 1024}KiB,"
+                f" peak={si['peakBytes'] // 1024}KiB,"
+                f" spills={si['spills']}")
+        lines.append(_format(f.root, 1, stats, verbose))
         lines.append("")
     return "\n".join(lines).rstrip()
 
 
-def _format(node: P.PlanNode, indent: int) -> str:
+def _format(node: P.PlanNode, indent: int, stats=None,
+            verbose: bool = False) -> str:
     if isinstance(node, RemoteSourceNode):
         pad = "  " * indent
-        return f"{pad}- RemoteSource[{node.exchange_type}] <- Fragment {node.fragment_id}"
-    pad = "  " * indent
-    base = P.format_plan(node, indent).split("\n")
+        line = (f"{pad}- RemoteSource[{node.exchange_type}]"
+                f" <- Fragment {node.fragment_id}")
+        st = (stats or {}).get(node.id)
+        if st is not None:
+            line += f"  [wall={st.wall_s * 1e3:.1f}ms rows={st.output_rows}]"
+        return line
+    base = P.format_plan(node, indent, stats=stats, verbose=verbose).split("\n")
     out = [base[0]]
     # re-render children so RemoteSourceNodes print specially
     kids = list(node.sources)
     if kids:
         out = [base[0]]
         for k in kids:
-            out.append(_format(k, indent + 1))
+            out.append(_format(k, indent + 1, stats, verbose))
         return "\n".join(out)
     return base[0]

@@ -634,11 +634,16 @@ def uses_expansion_kernel(n: JoinNode) -> bool:
     return not n.right_unique and not n.singleton
 
 
-def format_plan(node: PlanNode, indent: int = 0, executor=None) -> str:
+def format_plan(node: PlanNode, indent: int = 0, executor=None,
+                stats=None, verbose: bool = False) -> str:
     """Text plan printer (reference: sql/planner/planprinter/PlanPrinter.java).
     With ``executor`` (a finished eager Executor), renders EXPLAIN ANALYZE:
     per-operator wall time / output rows / scan+spill detail from its stats
-    (the role of PlanPrinter's stats injection from OperatorStats)."""
+    (the role of PlanPrinter's stats injection from OperatorStats). With
+    ``stats`` (node id → OperatorStats, e.g. the coordinator's rollup of
+    worker-reported task stats), the same annotations render WITHOUT a
+    local executor — the distributed EXPLAIN ANALYZE path. ``verbose``
+    additionally prints bytes / peak reservation / split counts."""
     pad = "  " * indent
     label = type(node).__name__.replace("Node", "")
     detail = ""
@@ -675,7 +680,10 @@ def format_plan(node: PlanNode, indent: int = 0, executor=None) -> str:
     if executor is not None:
         st = executor.node_stats.get(node.id)
         if st is not None:
-            detail += f"  [wall={st['wall_s'] * 1e3:.1f}ms rows={st.get('output_rows', '?')}]"
+            detail += f"  [wall={st.wall_s * 1e3:.1f}ms rows={st.output_rows}]"
+            if verbose:
+                detail += (f" [bytes={st.output_bytes}"
+                           f" peak={st.peak_bytes}]")
         if isinstance(node, TableScanNode) and node.id in executor.scan_stats:
             detail += f" [scanned={executor.scan_stats[node.id]}]"
         for sp in executor.memory.spills:
@@ -684,9 +692,19 @@ def format_plan(node: PlanNode, indent: int = 0, executor=None) -> str:
                     f" [spilled: {sp.partitions} passes,"
                     f" {sp.projected_bytes // 1024}KiB projected]"
                 )
+    elif stats is not None:
+        st = stats.get(node.id)
+        if st is not None:
+            detail += f"  [wall={st.wall_s * 1e3:.1f}ms rows={st.output_rows}]"
+            if isinstance(node, TableScanNode) and (st.splits or st.input_rows):
+                detail += f" [scanned={st.input_rows} splits={st.splits}]"
+            if verbose:
+                detail += (f" [bytes={st.output_bytes}"
+                           f" peak={st.peak_bytes}"
+                           f" calls={st.invocations}]")
     lines = [f"{pad}- {label}{detail}"]
     for s in node.sources:
-        lines.append(format_plan(s, indent + 1, executor))
+        lines.append(format_plan(s, indent + 1, executor, stats, verbose))
     return "\n".join(lines)
 
 
