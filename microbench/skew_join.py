@@ -1,0 +1,147 @@
+"""Skewed-key repartition join microbench: adaptation on vs off.
+
+One hot key owns ``HOT_FRACTION`` of the probe side, so the hash
+exchange's static per-(shard, partition) block guess — ~2x the uniform
+share (``sql/planner/stats.exchange_capacity``) — understates the hot
+partition's real block by ~n_devices/2 and the SPMD run loop pays the
+double-and-recompile spiral until the bucket catches up. With
+``adaptive_capacity_reseed`` the send blocks are priced from the STAGED
+key histograms (``trino_tpu/adaptive/reseed.py``), the hot partition gets
+its true capacity on the first compile, and the regrowth loop never
+fires.
+
+Reports steady-state rows/sec (probe rows / wall, post-compile) and the
+capacity-recompile count for both modes; writes SKEWJOIN.json next to the
+other bench artifacts.
+
+Run: python microbench/skew_join.py [n_rows]  (CPU mesh or real TPU)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# self-locate the repo (see microbench/join_kernels.py: PYTHONPATH must
+# not be used on TPU runs)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HOT_FRACTION = 0.85
+N_DEVICES = 8
+STEADY_RUNS = 3
+
+
+# the host-platform device count must be configured BEFORE jax
+# initializes its backend — set it at import time (conftest.py pattern)
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={N_DEVICES}").strip()
+
+
+def _mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    assert len(devs) >= N_DEVICES, "need an 8-device mesh (CPU or TPU)"
+    return Mesh(np.array(devs[:N_DEVICES]), ("d",))
+
+
+def _make_tables(session, n_rows: int):
+    """Probe with one hot key owning HOT_FRACTION of the rows; build with
+    every key exactly once (an expansion join on the hot key would be
+    quadratic — the skew story here is the EXCHANGE block, as in a
+    fact-to-dimension repartition join)."""
+    from trino_tpu import types as T
+
+    rng = np.random.default_rng(7)
+    n_hot = int(n_rows * HOT_FRACTION)
+    keys = np.concatenate([
+        np.full(n_hot, 1, dtype=np.int64),
+        rng.integers(2, n_rows, size=n_rows - n_hot, dtype=np.int64),
+    ])
+    rng.shuffle(keys)
+    vals = np.arange(n_rows, dtype=np.int64)
+    mem = session.catalogs["memory"]
+    mem.create_table("sk", "probe", [("k", T.BIGINT), ("v", T.BIGINT)],
+                     list(zip(keys.tolist(), vals.tolist())))
+    build_keys = np.unique(keys)
+    mem.create_table("sk", "build", [("k", T.BIGINT), ("w", T.BIGINT)],
+                     [(int(k), int(k) * 3) for k in build_keys])
+    return len(keys)
+
+
+SQL = ("select count(*) c, sum(p.v + b.w) s "
+       "from memory.sk.probe p, memory.sk.build b where p.k = b.k")
+
+
+def _run_mode(session, mesh, n_rows: int):
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.parallel.spmd import DistributedQuery
+
+    root = plan_sql(session, SQL)
+    t0 = time.perf_counter()
+    dq = DistributedQuery.build(session, root, mesh)
+    first = dq.run().to_pylist()
+    cold_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    for _ in range(STEADY_RUNS):
+        out = dq.run().to_pylist()
+    steady_s = (time.perf_counter() - t1) / STEADY_RUNS
+    assert out == first
+    return {
+        "recompiles": dq.recompiles,
+        "cold_s": round(cold_s, 4),
+        "steady_s": round(steady_s, 4),
+        "rows_per_s": round(n_rows / steady_s, 1),
+        "result": first,
+        "xchg_hints": {k: v for k, v in dq.capacity_hints.items()
+                       if k.startswith("xchg")},
+    }
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    mesh = _mesh()
+    from trino_tpu.client.session import Session
+    from trino_tpu.sql.planner import stats as stats_mod
+
+    # force the co-partitioned path so the exchange is the story
+    stats_mod.BROADCAST_BUILD_MAX = 64
+
+    base = Session()
+    n = _make_tables(base, n_rows)
+    off = _run_mode(base, mesh, n)
+
+    on_session = Session({"adaptive_capacity_reseed": True})
+    on_session.catalogs = base.catalogs  # same tables
+    on = _run_mode(on_session, mesh, n)
+    assert on["result"] == off["result"], (on["result"], off["result"])
+
+    report = {
+        "n_rows": n,
+        "hot_fraction": HOT_FRACTION,
+        "n_devices": N_DEVICES,
+        "adaptation_off": off,
+        "adaptation_on": on,
+    }
+    print(json.dumps(report, indent=2))
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "SKEWJOIN.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"wrote {out_path}: off={off['recompiles']} recompiles "
+          f"@ {off['rows_per_s']:.0f} rows/s, on={on['recompiles']} "
+          f"recompiles @ {on['rows_per_s']:.0f} rows/s")
+
+
+if __name__ == "__main__":
+    main()

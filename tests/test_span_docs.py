@@ -1,0 +1,31 @@
+"""Tier-1 gate: every emitted span name is documented in the README
+(tools/check_span_docs.py — the tracing-vocabulary sibling of the
+metric / session-property / endpoint doc gates)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import check_span_docs  # noqa: E402
+
+
+def test_all_spans_documented():
+    missing = check_span_docs.check()
+    assert not missing, (
+        f"span names emitted in code but missing from README.md: {missing}")
+
+
+def test_scanner_finds_the_known_vocabulary():
+    """The scanner must see through every receiver shape in use —
+    ``tracing.span``, ``self.tracer.span``, ``tracer.start_span`` and the
+    conditional-name form — or the gate silently stops gating."""
+    names = set(check_span_docs.emitted_span_names())
+    # one representative per call shape
+    assert "parse" in names  # tracing.span("parse")
+    assert "query" in names  # self.tracer.start_span("query", ...)
+    assert "cache/lookup" in names  # self.tracer.span(...) with attrs
+    assert "exchange/pull" in names  # self._tracer.start_span(..., kw=...)
+    assert {"device/compile", "device/execute"} <= names  # ternary name
+    assert "plan/adapt" in names  # the adaptive re-planner's span
+    # helpers like ops/join.dense_span must NOT pollute the vocabulary
+    assert not any("dense" in n for n in names)

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Fail when a span name emitted in code is missing from the README.
+
+Mirror of ``tools/check_metric_docs.py`` / ``check_session_property_docs``
+/ ``check_endpoint_docs`` for the tracing vocabulary: spans have no
+central registry (they are emitted inline via ``tracing.span(...)`` /
+``tracer.start_span(...)``), so the source itself is scanned — every
+string literal in the FIRST argument of a span call (both arms of a
+conditional name count) must appear in README.md's span table. Wired as a
+tier-1 test (tests/test_span_docs.py) so span docs can't drift.
+
+Usage: ``python tools/check_span_docs.py [--readme PATH]`` — exit 0 when
+every span is documented, 1 with the missing names otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a span call is any `<tracing|...tracer>.span(` / `.start_span(` — the
+# receiver prefix keeps unrelated `*_span(` helpers (e.g. ops/join.py
+# dense_span) out of the vocabulary
+_CALL_RE = re.compile(
+    r"(?:tracing|[A-Za-z_][\w.]*tracer)\s*\.\s*(?:start_)?span\s*\(")
+_STRING_RE = re.compile(r"\"([^\"]+)\"|'([^']+)'")
+
+
+def _first_arg_slice(text: str, start: int) -> str:
+    """The source slice of the call's first argument: from the opening
+    paren to the first top-level comma or the closing paren."""
+    depth = 0
+    i = start
+    in_str: str | None = None
+    while i < len(text):
+        c = text[i]
+        if in_str:
+            if c == in_str and text[i - 1] != "\\":
+                in_str = None
+        elif c in "\"'":
+            in_str = c
+        elif c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+            if depth == 0:
+                return text[start : i]
+        elif c == "," and depth == 1:
+            return text[start : i]
+        i += 1
+    return text[start : i]
+
+
+def emitted_span_names(root: str | None = None) -> list:
+    """Every span name a ``tracing.span``/``tracer.start_span`` call can
+    emit (all string literals of the first argument — a conditional name
+    like ``"a" if x else "b"`` contributes both)."""
+    root = root or os.path.join(REPO_ROOT, "trino_tpu")
+    names = set()
+    for dirpath, _dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                text = f.read()
+            for m in _CALL_RE.finditer(text):
+                arg = _first_arg_slice(text, m.end() - 1)
+                for sm in _STRING_RE.finditer(arg):
+                    names.add(sm.group(1) or sm.group(2))
+    return sorted(names)
+
+
+def documented_span_names(readme_path: str) -> set:
+    """Backtick-quoted identifiers in the README (the span table uses
+    backticks, but any backticked mention counts — the check is for
+    presence)."""
+    with open(readme_path, encoding="utf-8") as f:
+        text = f.read()
+    return set(re.findall(r"`([^`\n]+)`", text))
+
+
+def check(readme_path: str | None = None) -> list:
+    """Missing span names (empty means the docs are complete)."""
+    readme_path = readme_path or os.path.join(REPO_ROOT, "README.md")
+    documented = documented_span_names(readme_path)
+    return [name for name in emitted_span_names() if name not in documented]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--readme", default=None,
+                    help="README path (default: repo root README.md)")
+    args = ap.parse_args()
+    missing = check(args.readme)
+    if missing:
+        print("span names emitted in code but missing from the README "
+              "span table:", file=sys.stderr)
+        for name in missing:
+            print(f"  {name}", file=sys.stderr)
+        print("add each to the span table in README.md (### Tracing)",
+              file=sys.stderr)
+        return 1
+    print(f"ok: all {len(emitted_span_names())} emitted span names are "
+          "documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""Adaptive query execution: runtime re-planning from the operator-stats
+spine (reference: ``sql/planner/AdaptivePlanner.java`` + the FTE adaptive
+partitioning of SURVEY §7.3).
+
+PR 3 built the distributed stats pipeline (worker-reported OperatorStats,
+task→stage→query rollups); this package makes the engine ACT on it: a
+runtime-stats provider snapshots the stage rollups at stage boundaries, and
+the adaptive re-planner rewrites **not-yet-scheduled** downstream fragments
+between stage completions —
+
+1. join-distribution switch: flip broadcast↔partitioned when a build
+   side's ACTUAL rows contradict the estimate across the
+   ``join_max_broadcast_rows`` threshold (``replanner.py``);
+2. capacity-hint reseeding: exchange sources stamp actual upstream output
+   rows (the ``TableScanNode.runtime_rows`` analog on fragment
+   boundaries), and the compiled tiers size expansion-join / hash-exchange
+   capacities from staged-truth histograms instead of static guesses —
+   killing the double-and-recompile loop (``reseed.py``);
+3. skew mitigation: hot repartition keys detected from per-partition
+   output bytes are salted — the probe producer spreads hot partitions
+   across all tasks while the build producer replicates them everywhere
+   (``replanner.py`` + ``parallel/exchange.spread_partition_ids``).
+
+Every adaptation is recorded as a versioned plan change on the query
+(``GET /v1/query/{id}`` planVersions, EXPLAIN ANALYZE ``[adapted: ...]``
+annotations, a ``plan/adapt`` span, ``trino_tpu_adaptive_*`` metrics),
+gated by the ``adaptive_*`` session properties.
+"""
+from trino_tpu.adaptive.replanner import AdaptivePlanner, PlanChange
+from trino_tpu.adaptive.runtime_stats import RuntimeStatsProvider
+
+__all__ = ["AdaptivePlanner", "PlanChange", "RuntimeStatsProvider"]

@@ -83,6 +83,10 @@ def render_summary(stats) -> str:
             f"{stats.get('completedSplits', 0)}/{stats['totalSplits']} splits")
     if stats.get("peakBytes"):
         parts.append(f"peak {stats['peakBytes'] // 1024}KiB")
+    if stats.get("adaptations"):
+        # the runtime re-planner rewrote fragments mid-query (details:
+        # planVersions on GET /v1/query/{id})
+        parts.append(f"adapted: {stats['adaptations']} plan change(s)")
     return f" [{', '.join(parts)}]" if parts else ""
 
 

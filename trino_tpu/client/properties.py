@@ -145,6 +145,42 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             bool, True,
         ),
         PropertyMetadata(
+            "adaptive_execution_enabled",
+            "re-plan not-yet-scheduled downstream fragments between stage "
+            "completions using the runtime operator-stats rollups (master "
+            "switch for trino_tpu/adaptive/; reference: AdaptivePlanner + "
+            "FTE adaptive partitioning)",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "adaptive_join_distribution",
+            "flip broadcast<->partitioned join distribution at the stage "
+            "boundary when a build side's ACTUAL rows contradict the "
+            "estimate across join_max_broadcast_rows (reference: "
+            "DetermineJoinDistributionType re-fired on runtime stats)",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "adaptive_capacity_reseed",
+            "replace static capacity-hint guesses with runtime truth: "
+            "staged-scan histograms size expansion joins and hash exchanges "
+            "at build time (compiled/SPMD tiers), and completed upstream "
+            "stage rows stamp exchange sources on the coordinator — "
+            "eliminating the double-and-recompile loop",
+            bool, False,
+        ),
+        PropertyMetadata(
+            "adaptive_skew_threshold",
+            "hot-partition ROW ratio — a partition is hot when its output "
+            "rows exceed this many times the mean of the OTHER partitions "
+            "(serialized bytes lie under compression) and a 4096-row "
+            "floor; the adaptive re-planner then salts the repartition "
+            "join: the probe producer re-runs spreading hot partitions "
+            "across all tasks while the build producer replicates them "
+            "everywhere; 0 disables skew mitigation",
+            int, 8, lambda v: None if v >= 0 else "must be >= 0",
+        ),
+        PropertyMetadata(
             "failure_injection",
             "inject a task failure when this substring matches a task id, "
             "e.g. '.<fragment>.<worker>.a<attempt>' (reference: "

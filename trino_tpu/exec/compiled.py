@@ -160,6 +160,10 @@ class CompiledQuery:
     phase1_s: float = 0.0
     df_apply_s: float = 0.0
     scan_rows: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # capacity-overflow regrowth recompiles this query has paid (the
+    # double-and-recompile loop; 0 when hints were right the first time —
+    # e.g. under adaptive_capacity_reseed)
+    recompiles: int = 0
 
     MAX_RECOMPILES = 16  # doubling buckets: 2^16x headroom over the estimate
 
@@ -273,6 +277,15 @@ class CompiledQuery:
                 df_hints[f"dfc:{n.id}"] = cap
         if capacity_hints is None:
             capacity_hints = stats.estimate_capacity_hints(session, root)
+        from trino_tpu.adaptive.reseed import apply_reseed, reseed_enabled
+
+        if reseed_enabled(session):
+            # adaptive capacity reseeding (trino_tpu/adaptive/reseed.py):
+            # the staged pages ARE the actual upstream rows — price
+            # expansion-join capacities from their key histograms instead
+            # of the static fudge-factor guesses, replacing over-allocation
+            # AND the double-and-recompile loop in one move
+            apply_reseed(session, root, staged_pages, 1, capacity_hints)
         capacity_hints.update(df_hints)
         flat_inputs: List = []
         specs: Dict[int, PageSpec] = {}
@@ -347,6 +360,7 @@ class CompiledQuery:
             grown = stats.grow_overflowed_hints(self.capacity_hints, codes, error_flags)
             if grown is not None:
                 self.capacity_hints = grown
+                self.recompiles += 1
                 self._jit()
                 continue
             raise_query_errors(codes, error_flags)

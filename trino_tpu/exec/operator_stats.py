@@ -142,6 +142,8 @@ def rollup_tasks_to_stage(fragment_id: int, task_entries: List[dict],
         "spills": 0,
         "operatorStats": [ops[k].to_dict() for k in sorted(ops)],
     }
+    part_bytes = None
+    part_rows = None
     for e in task_entries:
         s = e.get("stats") or {}
         stage["completedSplits"] += int(s.get("completedSplits", 0))
@@ -154,6 +156,25 @@ def rollup_tasks_to_stage(fragment_id: int, task_entries: List[dict],
         stage["peakBytes"] = max(stage["peakBytes"],
                                  int(s.get("peakBytes", 0)))
         stage["spills"] += int(s.get("spills", 0))
+        # per-partition output bytes sum ELEMENTWISE across tasks: every
+        # producer task contributes rows to every partition, so the stage
+        # view is the skew signal (adaptive re-planner / UI)
+        pb = s.get("partitionBytes")
+        if pb is not None:
+            if part_bytes is None:
+                part_bytes = [0] * len(pb)
+            for i, b in enumerate(pb[: len(part_bytes)]):
+                part_bytes[i] += int(b)
+        pr = s.get("partitionRows")
+        if pr is not None:
+            if part_rows is None:
+                part_rows = [0] * len(pr)
+            for i, r in enumerate(pr[: len(part_rows)]):
+                part_rows[i] += int(r)
+    if part_bytes is not None:
+        stage["partitionBytes"] = part_bytes
+    if part_rows is not None:
+        stage["partitionRows"] = part_rows
     stage["wallS"] = round(stage["wallS"], 6)
     stage["deviceS"] = round(stage["deviceS"], 6)
     return stage

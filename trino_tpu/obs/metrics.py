@@ -349,6 +349,25 @@ GENCACHE_EVICTIONS = REGISTRY.counter(
     "trino_tpu_gencache_evictions_total",
     "datagen cache entries evicted by the LRU byte budget")
 
+# adaptive execution (trino_tpu/adaptive/): runtime re-planning from the
+# operator-stats spine, recorded per applied rule at the stage boundary
+ADAPTIVE_ADAPTATIONS = REGISTRY.counter(
+    "trino_tpu_adaptive_adaptations_total",
+    "plan changes applied by the adaptive re-planner at stage boundaries",
+    ("rule",))
+ADAPTIVE_JOIN_FLIPS = REGISTRY.counter(
+    "trino_tpu_adaptive_join_flips_total",
+    "join-distribution switches (actual build rows contradicted the "
+    "estimate across join_max_broadcast_rows)", ("direction",))
+ADAPTIVE_RESEEDED_SOURCES = REGISTRY.counter(
+    "trino_tpu_adaptive_reseeded_sources_total",
+    "exchange sources stamped with actual upstream stage rows before "
+    "their consumer fragment scheduled")
+ADAPTIVE_SKEW_HOT_PARTITIONS = REGISTRY.counter(
+    "trino_tpu_adaptive_skew_hot_partitions_total",
+    "hot partitions salted by the adaptive skew mitigation (spread on "
+    "the probe producer, replicated on the build producer)")
+
 # latency distribution per terminal state (the per-state query histogram)
 QUERY_SECONDS = REGISTRY.histogram(
     "trino_tpu_query_seconds",

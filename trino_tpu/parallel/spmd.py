@@ -47,6 +47,20 @@ from trino_tpu.sql.planner import plan as P
 AXIS = "d"
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """Version-portable shard_map: ``jax.shard_map`` (0.5+, check_vma)
+    with the ``jax.experimental.shard_map`` (0.4.x, check_rep) fallback —
+    replication checking stays off either way (error flags are replicated
+    by construction, the checker can't see it)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def _gather_flat(x: jnp.ndarray) -> jnp.ndarray:
     """all_gather along the mesh axis and flatten device dim into rows."""
     g = jax.lax.all_gather(x, AXIS)  # [ndev, n, ...]
@@ -533,6 +547,9 @@ class DistributedQuery:
     # time to every run — it is query work done off-device
     phase1_s: float = 0.0
     df_apply_s: float = 0.0
+    # capacity-overflow regrowth recompiles (0 when the hints were right
+    # the first time — e.g. under adaptive_capacity_reseed)
+    recompiles: int = 0
 
     MAX_RECOMPILES = 16
 
@@ -566,6 +583,17 @@ class DistributedQuery:
         if capacity_hints is None:
             capacity_hints = stats.estimate_capacity_hints(session, root)
             capacity_hints.update(stats.estimate_exchange_hints(session, root, n_devices))
+        from trino_tpu.adaptive.reseed import (
+            apply_reseed, reseed_enabled, staged_pages_from_arrays)
+
+        if reseed_enabled(session):
+            # adaptive capacity reseeding: per-(shard, partition) key
+            # histograms of the STAGED rows price expansion joins and the
+            # hash-exchange send blocks exactly — skewed keys size their
+            # real hot partition instead of the 2x-uniform guess, so the
+            # run loop never pays a regrowth recompile
+            pages = staged_pages_from_arrays(staged_arrays, specs)
+            apply_reseed(session, root, pages, n_devices, capacity_hints)
         layout = [(nid, len(arrs)) for nid, arrs in staged_arrays.items()]
         flat_inputs: List = []
         for _, arrs in staged_arrays.items():
@@ -607,12 +635,11 @@ class DistributedQuery:
                 [f[None] for _, f in ex.errors],
             )
 
-        shard_fn = jax.shard_map(
+        shard_fn = _shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=(PSpec(AXIS),),
             out_specs=(PSpec(AXIS), PSpec(AXIS)),
-            check_vma=False,
         )
         self.fn = jax.jit(shard_fn)
 
@@ -628,6 +655,7 @@ class DistributedQuery:
             grown = stats.grow_overflowed_hints(self.capacity_hints, codes, error_flags)
             if grown is not None:
                 self.capacity_hints = grown
+                self.recompiles += 1
                 self._jit()
                 continue
             raise_query_errors(codes, error_flags)

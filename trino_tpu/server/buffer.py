@@ -150,9 +150,20 @@ class PartitionedOutputBuffer:
             OutputBuffer(1, max_buffer_bytes=max(max_buffer_bytes // partitions, 1 << 16))
             for _ in range(partitions)
         ]
+        # cumulative serialized bytes enqueued per partition (never
+        # decremented by GC), reported in task stats. NOT the skew
+        # detection signal — serde compression inverts bytes under a
+        # constant hot key, so detection runs on partitionRows; the
+        # re-planner uses this series only to cap replication cost
+        self._enqueued_bytes = [0] * partitions
 
     def enqueue_partition(self, pid: int, page_bytes: bytes, timeout: float = 300.0) -> None:
         self._parts[pid].enqueue(page_bytes, timeout=timeout)
+        self._enqueued_bytes[pid] += len(page_bytes)
+
+    @property
+    def partition_enqueued_bytes(self) -> List[int]:
+        return list(self._enqueued_bytes)
 
     def set_complete(self) -> None:
         for p in self._parts:

@@ -133,6 +133,10 @@ class QueryExecution:
         # fragments of the last distributed execution (EXPLAIN ANALYZE
         # rendering + stage count); None for coordinator-local queries
         self.fragments = None
+        # versioned plan changes applied by the adaptive re-planner
+        # (trino_tpu/adaptive/), surfaced via GET /v1/query/{id} and the
+        # EXPLAIN ANALYZE [adapted: ...] annotations
+        self.plan_versions: List[dict] = []
         self.created_at = time.time()
         self.ended_at: Optional[float] = None
         # one trace per query; the trace id doubles as the propagation key
@@ -469,14 +473,17 @@ class QueryExecution:
                 return
             self.task_stats[slot] = entry
 
-    def _sweep_task_stats(self) -> None:
+    def _sweep_task_stats(self) -> int:
         """One status sweep over every scheduled task (the coordinator's
-        status-polling loop body; also the terminal freeze). Slots already
-        frozen FINISHED are skipped, and the timeout is sub-second so one
-        unreachable worker cannot stall the live-stats cadence."""
+        status-polling loop body; also the terminal freeze). Tasks whose
+        record is already terminal — FINISHED, or FAILED/CANCELED (e.g.
+        producers the adaptive re-planner superseded) — are skipped, and
+        the timeout is sub-second so one unreachable worker cannot stall
+        the live-stats cadence. Returns the number of tasks actually
+        polled (the poller's backoff signal)."""
         with self._tstats_lock:
             done = {e["taskId"] for e in self.task_stats.values()
-                    if e["state"] == "FINISHED"}
+                    if e["state"] in ("FINISHED", "FAILED", "CANCELED")}
         locations = [loc for locs in list(self.fragment_tasks.values())
                      for loc in list(locs)
                      if loc is not None and loc.task_id not in done]
@@ -489,19 +496,32 @@ class QueryExecution:
                     self._note_task_status(loc.task_id, json.loads(body))
             except Exception:  # noqa: BLE001 — a gone worker loses its stats
                 pass
+        return len(locations)
 
     STATS_POLL_INTERVAL = 0.25
+    STATS_POLL_MAX_BACKOFF = 16.0  # x the base interval
 
     def _start_stats_poller(self) -> None:
         """Background status poll while the query RUNs, so
         ``GET /v1/query/{id}`` serves LIVE stage/query stats (reference:
         ContinuousTaskStatusFetcher feeding the coordinator's stage state
-        machines)."""
+        machines). Each sleep is JITTERED so many concurrent RUNNING
+        queries de-phase instead of hitting every worker in lockstep, and
+        a sweep that found nothing left to poll (every slot frozen
+        FINISHED — e.g. the root fragment is still draining results)
+        backs off exponentially instead of hammering workers with no-op
+        status rounds."""
 
         def poll():
+            import random
+
+            backoff = 1.0
             while not self.state.is_terminal():
-                self._sweep_task_stats()
-                time.sleep(self.STATS_POLL_INTERVAL)
+                polled = self._sweep_task_stats()
+                backoff = (min(backoff * 2.0, self.STATS_POLL_MAX_BACKOFF)
+                           if polled == 0 else 1.0)
+                time.sleep(self.STATS_POLL_INTERVAL * backoff
+                           * random.uniform(0.75, 1.25))
 
         self._stats_poller = threading.Thread(target=poll, daemon=True)
         self._stats_poller.start()
@@ -536,6 +556,9 @@ class QueryExecution:
         qs["state"] = self.state.get()
         qs["cacheStatus"] = self.cache_status
         qs["resultRows"] = len(self.rows)
+        # adaptive plan changes applied so far — rides every statement
+        # response so clients can render "[adapted: N]" live
+        qs["adaptations"] = len(self.plan_versions)
         return qs
 
     def _explain_analyze(self, session, stmt) -> str:
@@ -581,9 +604,17 @@ class QueryExecution:
         # _execute_query already swept terminal task stats before FINISHING
         stages = self.stage_stats()
         stage_by_id = {s["stageId"]: s for s in stages}
+        # fragments the adaptive re-planner superseded re-ran as COPIES
+        # with the same plan-node ids — merging both runs would double
+        # every per-node annotation, so the superseded stage's operators
+        # stay out of the merge (its own [adapted: superseded] fragment
+        # header still shows its stage totals)
+        superseded = {fid for ch in self.plan_versions
+                      for fid in ch.get("supersedes", ())}
         with self._tstats_lock:
             op_lists = [e["stats"].get("operatorStats")
-                        for e in self.task_stats.values()]
+                        for e in self.task_stats.values()
+                        if e["fragment"] not in superseded]
         # the root single fragment ran on the coordinator itself — its
         # executor's stats complete the tree (that is its assigned worker,
         # not a re-execution)
@@ -601,7 +632,7 @@ class QueryExecution:
             f" spills: {qs['spills']}")
         return "\n".join(header) + "\n" + format_fragments(
             self.fragments, stats=node_stats, stage_stats=stage_by_id,
-            verbose=stmt.verbose)
+            verbose=stmt.verbose, adapted=self._adapted_notes())
 
     def _schedule(self, session, fragments, workers) -> None:
         """Create one task per worker for each source fragment, splits
@@ -655,52 +686,153 @@ class QueryExecution:
             if deps:
                 build_deps[frag.id] = deps
         self.phase_waits = []  # (fragment, [deps]) log for tests/EXPLAIN
-        for frag in fragments:
+        # adaptive execution (trino_tpu/adaptive/): between stage
+        # completions, the re-planner may rewrite a fragment whose tasks
+        # don't exist yet — this is the stage-boundary hook of the
+        # reference's AdaptivePlanner, placed after the phased-execution
+        # build waits so completed-build actuals are available
+        adaptive = self._make_adaptive_planner(session, fragments, workers)
+        for frag in list(fragments):
             if phased and not fte and frag.id in build_deps:
                 self._await_build_fragments(build_deps[frag.id])
                 self.phase_waits.append((frag.id, build_deps[frag.id]))
-            if frag.partitioning == "hash":
-                # one task per key partition (hash-distributed final
-                # aggregations and co-partitioned joins): task i pulls
-                # buffer/partition i from every upstream producer. Under
-                # FTE these tasks retry like source tasks — their inputs
-                # are durable per-partition spool files.
-                if fte:
-                    self.fragment_tasks[frag.id] = self._run_fragment_fte(
-                        frag, [dict() for _ in workers], workers,
-                        consumer_counts)
-                else:
-                    self.fragment_tasks[frag.id] = [
-                        self._create_task(frag, wi, 0, {}, workers[wi],
-                                          consumer_counts)
-                        for wi in range(len(workers))
-                    ]
-                continue
-            if frag.partitioning != "source":
-                continue
-            # enumerate splits per scan node, interleave across workers
-            per_worker_splits: List[Dict[int, list]] = [dict() for _ in workers]
-            for node in P.walk_plan(frag.root):
-                if not isinstance(node, P.TableScanNode):
-                    continue
-                conn = session.catalogs[node.catalog]
-                splits = conn.get_splits(node.schema, node.table,
-                                         max(len(workers), 1),
-                                         constraint=node.constraint,
-                                         handle=node.table_handle)
-                for i, split in enumerate(splits):
-                    w = i % len(workers)
-                    per_worker_splits[w].setdefault(node.id, []).append(split)
+            if adaptive is not None and frag.partitioning != "single":
+                for nf in self._adapt_fragment(
+                        adaptive, frag, by_id, fragments, consumer_counts,
+                        workers):
+                    self._schedule_fragment(
+                        session, nf, workers, consumer_counts, fte)
+            self._schedule_fragment(session, frag, workers, consumer_counts,
+                                    fte)
+
+    def _schedule_fragment(self, session, frag, workers, consumer_counts,
+                           fte) -> None:
+        """Create the tasks of ONE fragment (source or hash partitioning;
+        the root single fragment executes on the coordinator instead)."""
+        if frag.partitioning == "hash":
+            # one task per key partition (hash-distributed final
+            # aggregations and co-partitioned joins): task i pulls
+            # buffer/partition i from every upstream producer. Under
+            # FTE these tasks retry like source tasks — their inputs
+            # are durable per-partition spool files.
             if fte:
                 self.fragment_tasks[frag.id] = self._run_fragment_fte(
-                    frag, per_worker_splits, workers, consumer_counts)
+                    frag, [dict() for _ in workers], workers,
+                    consumer_counts)
             else:
                 self.fragment_tasks[frag.id] = [
-                    self._create_task(
-                        frag, wi, 0, per_worker_splits[wi], workers[wi],
-                        consumer_counts)
+                    self._create_task(frag, wi, 0, {}, workers[wi],
+                                      consumer_counts)
                     for wi in range(len(workers))
                 ]
+            return
+        if frag.partitioning != "source":
+            return
+        # enumerate splits per scan node, interleave across workers
+        per_worker_splits: List[Dict[int, list]] = [dict() for _ in workers]
+        for node in P.walk_plan(frag.root):
+            if not isinstance(node, P.TableScanNode):
+                continue
+            conn = session.catalogs[node.catalog]
+            splits = conn.get_splits(node.schema, node.table,
+                                     max(len(workers), 1),
+                                     constraint=node.constraint,
+                                     handle=node.table_handle)
+            for i, split in enumerate(splits):
+                w = i % len(workers)
+                per_worker_splits[w].setdefault(node.id, []).append(split)
+        if fte:
+            self.fragment_tasks[frag.id] = self._run_fragment_fte(
+                frag, per_worker_splits, workers, consumer_counts)
+        else:
+            self.fragment_tasks[frag.id] = [
+                self._create_task(
+                    frag, wi, 0, per_worker_splits[wi], workers[wi],
+                    consumer_counts)
+                for wi in range(len(workers))
+            ]
+
+    # ------------------------------------------------- adaptive execution
+    def _make_adaptive_planner(self, session, fragments, workers):
+        """The per-query AdaptivePlanner, or None when adaptive execution
+        is off (adaptive_execution_enabled session property)."""
+        props = getattr(session, "properties", None) or {}
+        if not bool(props.get("adaptive_execution_enabled", True)):
+            return None
+        from trino_tpu.adaptive import AdaptivePlanner, RuntimeStatsProvider
+        from trino_tpu.sql.planner.fragmenter import fresh_fragment_ids
+
+        def entries():
+            with self._tstats_lock:
+                return [dict(e) for e in self.task_stats.values()]
+
+        provider = RuntimeStatsProvider(
+            entries, sweep_fn=self._sweep_task_stats,
+            expected_tasks_fn=lambda fid: len(
+                self.fragment_tasks.get(fid, ())))
+        return AdaptivePlanner(session, provider, len(workers),
+                               fresh_fragment_ids(fragments))
+
+    def _adapt_fragment(self, planner, frag, by_id, fragments,
+                        consumer_counts, workers):
+        """Run the adaptive rules against one not-yet-scheduled fragment;
+        record every applied change as a versioned plan change (info(),
+        EXPLAIN ANALYZE annotations, plan/adapt span, adaptive metrics),
+        cancel superseded producer tasks, and return the new producer
+        fragments to schedule first. Adaptation failures are recorded and
+        swallowed — a stats-driven optimization must never fail a query
+        that would have run fine unadapted — and rules are isolated from
+        each other inside the planner, so a failing rule never discards an
+        earlier rule's applied (and audited) change."""
+        from trino_tpu.obs import metrics as M
+
+        try:
+            new_frags, changes, errors = planner.adapt_fragment(frag, by_id)
+        except Exception as e:  # noqa: BLE001 — adaptivity is best-effort
+            new_frags, changes, errors = [], [], [str(e)]
+        for err in errors:
+            with self.tracer.span("plan/adapt", fragment=frag.id) as sp:
+                sp.set("error", str(err)[:300])
+        for ch in changes:
+            self.plan_versions.append(ch.to_dict())
+            with self.tracer.span("plan/adapt", fragment=ch.fragment) as sp:
+                sp.set("rule", ch.rule)
+                sp.set("version", ch.version)
+                sp.set("description", ch.description)
+            M.ADAPTIVE_ADAPTATIONS.inc(1, ch.rule)
+            if ch.rule == "join-distribution":
+                direction = ("to_partitioned"
+                             if ch.description.endswith("partitioned")
+                             else "to_broadcast")
+                M.ADAPTIVE_JOIN_FLIPS.inc(1, direction)
+            elif ch.rule == "capacity-reseed":
+                M.ADAPTIVE_RESEEDED_SOURCES.inc(
+                    len(ch.detail.get("runtimeRows", {})))
+            elif ch.rule == "skew-mitigation":
+                M.ADAPTIVE_SKEW_HOT_PARTITIONS.inc(
+                    len(ch.detail.get("hotPartitions", ())))
+            # the rewrite re-runs superseded producers with a new output
+            # shape; the originals' tasks only hold buffers nobody will
+            # pull — cancel them (their frozen stats keep the record)
+            for fid in ch.supersedes:
+                for loc in self.fragment_tasks.get(fid, ()):
+                    self._cancel_attempt(loc)
+        for nf in new_frags:
+            consumer_counts[nf.id] = len(workers)
+            fragments.insert(fragments.index(frag), nf)
+        return new_frags
+
+    def _adapted_notes(self) -> Dict[int, str]:
+        """fragment id -> change description, for the EXPLAIN ANALYZE
+        ``[adapted: ...]`` annotations."""
+        notes: Dict[int, str] = {}
+        for ch in self.plan_versions:
+            notes[ch["fragment"]] = ch["description"]
+            for fid in ch.get("newFragments", ()):
+                notes.setdefault(fid, ch["description"])
+            for fid in ch.get("supersedes", ()):
+                notes[fid] = "superseded"
+        return notes
 
     MAX_TASK_ATTEMPTS = 3
 
@@ -717,6 +849,10 @@ class QueryExecution:
             consumer_count=consumer_counts.get(frag.id, 1),
             output_partition_channels=getattr(
                 frag, "output_partition_channels", None),
+            skew_spread_partitions=getattr(
+                frag, "skew_spread_partitions", None),
+            skew_replicate_partitions=getattr(
+                frag, "skew_replicate_partitions", None),
         )
         # trace-context propagation: the worker parents its task span under
         # the coordinator's current (schedule) span via this header
@@ -968,6 +1104,9 @@ class QueryExecution:
                 for fid, locs in self.fragment_tasks.items()
             },
             "retriedTasks": list(self.retried_tasks),
+            # versioned plan changes the adaptive re-planner applied
+            # (rule, fragment, description, superseded/new fragments)
+            "planVersions": list(self.plan_versions),
             # live task→stage→query rollup of worker-reported OperatorStats
             # (frozen once the query is terminal — polling stops and
             # FINISHED slots never downgrade)

@@ -51,6 +51,13 @@ class TaskRequest:
     # i (reference: PagePartitioner.java:134-149, FIXED_HASH_DISTRIBUTION's
     # producer half). None = every consumer reads the same stream.
     output_partition_channels: Optional[List[int]] = None
+    # adaptive skew mitigation (trino_tpu/adaptive/): HOT partitions whose
+    # rows this producer spreads round-robin across all partitions (probe
+    # side of a salted repartition join) or replicates into every
+    # partition (build side) — see parallel/exchange.spread_partition_ids
+    # for the exactness argument
+    skew_spread_partitions: Optional[List[int]] = None
+    skew_replicate_partitions: Optional[List[int]] = None
 
     def to_bytes(self) -> bytes:
         return pickle.dumps(self)
@@ -150,6 +157,10 @@ class SqlTask:
         self.input_rows = 0  # connector/exchange rows entering the fragment
         self.output_rows = 0
         self.output_bytes = 0
+        # per-partition LIVE output rows (hash-partitioned producers only):
+        # the adaptive skew signal — counted pre-serialization because
+        # serde compression flattens a constant hot key to almost no bytes
+        self.partition_rows: Optional[List[int]] = None
         self.spill_count = 0
         self.started_at = time.monotonic()
         self.ended_at: Optional[float] = None
@@ -192,11 +203,16 @@ class SqlTask:
         live = getattr(self, "_live_executor", None)
         peak = max(self.peak_memory_bytes,
                    live.memory.peak if live is not None else 0)
+        # hash-partitioned producers break their output bytes down per
+        # partition — the skew signal the adaptive re-planner reads
+        part_bytes = (self.output.partition_enqueued_bytes
+                      if isinstance(self.output, PartitionedOutputBuffer)
+                      else None)
         with self._stats_lock:
             ops = [self.operator_stats[k].to_dict()
                    for k in sorted(self.operator_stats)]
             elapsed = (self.ended_at or time.monotonic()) - self.started_at
-            return {
+            snap = {
                 "elapsedS": round(elapsed, 6),
                 "deviceS": round(self.device_seconds, 6),
                 "completedSplits": self.splits_completed,
@@ -208,6 +224,11 @@ class SqlTask:
                 "spills": self.spill_count,
                 "operatorStats": ops,
             }
+            if part_bytes is not None:
+                snap["partitionBytes"] = part_bytes
+            if self.partition_rows is not None:
+                snap["partitionRows"] = list(self.partition_rows)
+            return snap
 
     @property
     def memory_bytes(self) -> int:
@@ -320,13 +341,7 @@ class SqlTask:
             # each partition into its consumer's stream. Under FTE the
             # per-partition streams spool FIRST (durability before
             # visibility — retried consumers re-read partition files).
-            from trino_tpu.exec.memory import partition_page_host
-
-            pids = _canonical_partition_ids(
-                page, req.output_partition_channels, req.consumer_count)
-            parts = partition_page_host(
-                page, req.output_partition_channels, req.consumer_count,
-                pid=pids)
+            parts = self._partition_pages(page)
             part_frames = [
                 [serialize_page(c)
                  for c in _chunk_pages(part.compact(), chunk_rows)]
@@ -417,6 +432,46 @@ class SqlTask:
         per-split drivers)."""
         return self._streamable_leaf(root, P.TableScanNode)
 
+    def _partition_pages(self, page: Page) -> List[Page]:
+        """Hash-partition one output page into consumer_count per-partition
+        pages, applying the adaptive skew salting when the re-planner
+        annotated this producer: hot partitions spread round-robin (probe
+        side) or replicate into every partition (build side) — the
+        producer half of the salted repartition join."""
+        from trino_tpu.exec.memory import partition_page_host
+
+        req = self.request
+        pids = _canonical_partition_ids(
+            page, req.output_partition_channels, req.consumer_count)
+        spread = getattr(req, "skew_spread_partitions", None)
+        if spread:
+            from trino_tpu.parallel.exchange import spread_partition_ids
+
+            # the cursor rotates ACROSS pages so a streaming producer's
+            # per-page hot rows don't all restart at partition 0
+            pids, self._spread_cursor = spread_partition_ids(
+                pids, spread, req.consumer_count,
+                start=getattr(self, "_spread_cursor", 0))
+        parts = partition_page_host(
+            page, req.output_partition_channels, req.consumer_count,
+            pid=pids)
+        replicate = getattr(req, "skew_replicate_partitions", None)
+        if replicate:
+            hot = {h: parts[h] for h in replicate if 0 <= h < len(parts)}
+            out = []
+            for q, part in enumerate(parts):
+                for h, hp in hot.items():
+                    if h != q and hp.live_count() > 0:
+                        part = Page.concat_pages(part, hp)
+                out.append(part)
+            parts = out
+        with self._stats_lock:
+            if self.partition_rows is None:
+                self.partition_rows = [0] * req.consumer_count
+            for pid, part in enumerate(parts):
+                self.partition_rows[pid] += int(part.live_count())
+        return parts
+
     def _enqueue_out(self, out: Page, part_channels, consumer_count) -> None:
         """Partition-aware enqueue of one output page (shared by the
         streaming paths: per-batch chains, per-split scans, and the fold
@@ -430,12 +485,7 @@ class SqlTask:
             self.output_bytes += page_bytes(out)
         chunk_rows = self._chunk_rows(out)
         if part_channels is not None:
-            from trino_tpu.exec.memory import partition_page_host
-
-            pids = _canonical_partition_ids(out, part_channels, consumer_count)
-            parts = partition_page_host(
-                out, part_channels, consumer_count, pid=pids)
-            for pid, part in enumerate(parts):
+            for pid, part in enumerate(self._partition_pages(out)):
                 for c in _chunk_pages(part.compact(), chunk_rows):
                     self.output.enqueue_partition(pid, serialize_page(c))
         else:

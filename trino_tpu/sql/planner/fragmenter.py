@@ -39,6 +39,11 @@ class RemoteSourceNode(P.PlanNode):
     types: List = None
     names: List[str] = None
     exchange_type: str = "gather"  # gather | broadcast | gather_states
+    # ACTUAL output rows of the producing stage, stamped at the stage
+    # boundary by the adaptive re-planner (trino_tpu/adaptive/): downstream
+    # cardinality estimation then starts from truth — the
+    # TableScanNode.runtime_rows analog on fragment boundaries.
+    runtime_rows: Optional[int] = None
 
     @property
     def output_types(self):
@@ -60,6 +65,12 @@ class PlanFragment:
     # splits its result by hash of these channels into one stream per
     # consumer (FIXED_HASH_DISTRIBUTION's PartitionedOutputOperator role)
     output_partition_channels: Optional[List[int]] = None
+    # adaptive skew mitigation (trino_tpu/adaptive/replanner.py): rows of
+    # these HOT partitions spread round-robin across all partitions
+    # (probe side) / replicate into every partition (build side) — set
+    # only on salted re-run fragments the re-planner creates
+    skew_spread_partitions: Optional[List[int]] = None
+    skew_replicate_partitions: Optional[List[int]] = None
 
 
 def _hash_distributed_final(session, node: P.AggregationNode) -> bool:
@@ -311,8 +322,67 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
     return fragments
 
 
+def fresh_fragment_ids(fragments: List[PlanFragment]):
+    """Id allocator for fragments added AFTER fragmentation (the adaptive
+    re-planner): continues past the query's own max id. The module-global
+    ``_frag_ids`` cannot be reused — a concurrent query's fragment_plan
+    resets it, and a recycled id would collide inside this query."""
+    return itertools.count(max((f.id for f in fragments), default=-1) + 1)
+
+
+def adapt_broadcast_to_partitioned(frag: PlanFragment, join: P.JoinNode,
+                                   build_root: P.PlanNode,
+                                   id_alloc) -> List[PlanFragment]:
+    """Re-fragment a broadcast join into the co-partitioned shape at the
+    stage boundary (the adaptive half of DetermineJoinDistributionType):
+    the probe subtree moves into its own key-partitioned source fragment,
+    the build re-runs as a key-partitioned source fragment (its broadcast
+    output was never pulled), and ``frag`` becomes the hash join stage.
+    Operators above the join stay in ``frag`` — they were already computed
+    per task and merged downstream, and a hash partition is just a
+    different task-partitioning of the same rows. Returns the new producer
+    fragments to schedule before ``frag``."""
+    probe = join.left
+    pfid, bfid = next(id_alloc), next(id_alloc)
+    probe_frag = PlanFragment(
+        pfid, "source", probe,
+        output_partition_channels=list(join.left_keys))
+    build_frag = PlanFragment(
+        bfid, "source", build_root,
+        output_partition_channels=list(join.right_keys))
+    join.left = RemoteSourceNode(
+        fragment_id=pfid, types=probe.output_types,
+        names=probe.output_names, exchange_type="partitioned")
+    join.right = RemoteSourceNode(
+        fragment_id=bfid, types=build_root.output_types,
+        names=build_root.output_names, exchange_type="partitioned")
+    join.distribution = "partitioned"
+    frag.partitioning = "hash"
+    return [probe_frag, build_frag]
+
+
+def adapt_partitioned_to_broadcast(frag: PlanFragment, join: P.JoinNode,
+                                   build_root: P.PlanNode,
+                                   id_alloc) -> List[PlanFragment]:
+    """Re-fragment a co-partitioned join's BUILD side into a broadcast at
+    the stage boundary (actual build rows came in far under the threshold):
+    the build re-runs as an unpartitioned source fragment whose full stream
+    every join task pulls; the probe side keeps its partitioned producers,
+    so each hash task joins its probe partition against the whole (tiny)
+    build — build-side partition skew disappears. Returns the new build
+    fragment to schedule before ``frag``."""
+    bfid = next(id_alloc)
+    build_frag = PlanFragment(bfid, "source", build_root)
+    join.right = RemoteSourceNode(
+        fragment_id=bfid, types=build_root.output_types,
+        names=build_root.output_names, exchange_type="broadcast")
+    join.distribution = "broadcast"
+    return [build_frag]
+
+
 def format_fragments(fragments: List[PlanFragment], stats=None,
-                     stage_stats=None, verbose: bool = False) -> str:
+                     stage_stats=None, verbose: bool = False,
+                     adapted=None) -> str:
     """EXPLAIN (TYPE DISTRIBUTED) rendering (reference: PlanPrinter's
     fragmented text plan). With ``stats`` (plan-node id → OperatorStats,
     the coordinator's rollup of worker-reported task stats) this renders
@@ -320,10 +390,16 @@ def format_fragments(fragments: List[PlanFragment], stats=None,
     sourced from the workers that actually ran each fragment. With
     ``stage_stats`` (fragment id → stage rollup dict), each fragment header
     carries its stage totals; ``verbose`` adds a device-detail line per
-    fragment (device seconds, output/peak bytes, spill count)."""
+    fragment (device seconds, output/peak bytes, spill count). ``adapted``
+    (fragment id → change description, from the query's versioned plan
+    changes) annotates fragments the runtime re-planner rewrote, e.g.
+    ``[adapted: broadcast->partitioned]``."""
     lines = []
     for f in reversed(fragments):
         head = f"Fragment {f.id} [{f.partitioning}]"
+        note = (adapted or {}).get(f.id)
+        if note:
+            head += f" [adapted: {note}]"
         si = (stage_stats or {}).get(f.id)
         if si is not None:
             head += (f" [tasks={si['tasks']},"

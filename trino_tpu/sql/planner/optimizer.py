@@ -223,6 +223,23 @@ def plan_dynamic_filters(node: P.PlanNode) -> None:
         node.dyn_filter_keys.append(i)
 
 
+def reoptimize_distribution(session, join: P.JoinNode, n_workers: int) -> str:
+    """Adaptive re-optimization entry point (reference: AdaptivePlanner
+    re-firing DetermineJoinDistributionType on runtime stats): the SAME
+    static distribution predicate, evaluated after the adaptive re-planner
+    stamped ``runtime_rows`` on the join's exchange sources — so the
+    runtime decision and the plan-time decision can never use different
+    rules, only different cardinalities. Returns 'partitioned' or
+    'broadcast'."""
+    from trino_tpu.sql.planner import stats
+
+    if not join.left_keys:
+        return "broadcast"  # cross join: broadcast is the only option
+    return ("partitioned"
+            if stats.join_repartitions(session, join, n_workers)
+            else "broadcast")
+
+
 def _trace_to_scan(node: P.PlanNode, channel: int):
     """Follow ``channel`` down through row-preserving/identity mappings to
     the originating scan column, or None."""

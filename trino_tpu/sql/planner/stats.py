@@ -53,15 +53,28 @@ def estimate_rows(session, node: P.PlanNode) -> int:
             return left * right
         return int(max(left, right) * JOIN_FANOUT)
     if isinstance(node, P.AggregationNode):
-        # group count <= input rows; the sort-based kernel's capacity is the
-        # input row count anyway
-        return estimate_rows(session, node.source)
+        src = estimate_rows(session, node.source)
+        if not node.group_channels:
+            return src  # global agg: the sort-based kernel's capacity is
+            # the input row count anyway
+        # group count <= min(input rows, product of group-key NDVs): the
+        # NDV cap keeps compiled group-by capacity hints (and every hint
+        # derived above an aggregation) from over-allocating to the full
+        # input row count (reference: AggregationStatsRule)
+        ndv = key_ndv(session, node.source, node.group_channels)
+        return max(1, min(src, ndv)) if ndv else src
     if isinstance(node, P.UnionNode):
         # UNION ALL output = SUM of branches (the generic max fallback
         # would under-allocate capacity hints by the branch count)
         return sum(estimate_rows(session, s) for s in node.sources_)
     srcs = node.sources
     if not srcs:
+        # exchange sources (RemoteSourceNode) stamped with actual upstream
+        # stage output rows by the adaptive re-planner start from truth —
+        # the TableScanNode.runtime_rows analog on fragment boundaries
+        rr = getattr(node, "runtime_rows", None)
+        if rr is not None:
+            return max(int(rr), 1)
         return MIN_CAPACITY
     return max(estimate_rows(session, s) for s in srcs)
 
@@ -134,21 +147,26 @@ def agg_repartitions(session, node: P.AggregationNode, n_devices: int) -> bool:
     return rows // max(n_devices, 1) > GATHER_AGG_MAX_ROWS_PER_DEVICE
 
 
+def resolved_broadcast_limit(properties) -> int:
+    """The effective join_max_broadcast_rows threshold: the session
+    property when explicitly set, else the module constant (sessions
+    materialize every default, so an untouched property defers to
+    BROADCAST_BUILD_MAX — which tests tune directly). The ONE resolution
+    both the static rule and the adaptive re-planner consult."""
+    from trino_tpu.client.properties import SYSTEM_SESSION_PROPERTIES
+
+    declared = SYSTEM_SESSION_PROPERTIES["join_max_broadcast_rows"].default
+    limit = int((properties or {}).get("join_max_broadcast_rows", declared))
+    return BROADCAST_BUILD_MAX if limit == declared else limit
+
+
 def join_repartitions(session, node: P.JoinNode, n_devices: int) -> bool:
     """True when a distributed join should co-partition both sides by key
     hash instead of broadcasting the build side (session property
     join_max_broadcast_rows; reference: join_max_broadcast_table_size)."""
     if not node.left_keys:
         return False  # cross join: broadcast is the only option
-    from trino_tpu.client.properties import SYSTEM_SESSION_PROPERTIES
-
-    declared = SYSTEM_SESSION_PROPERTIES["join_max_broadcast_rows"].default
-    props = getattr(session, "properties", None) or {}
-    limit = int(props.get("join_max_broadcast_rows", declared))
-    if limit == declared:
-        # sessions materialize every default, so an untouched property
-        # defers to the module constant (which tests tune directly)
-        limit = BROADCAST_BUILD_MAX
+    limit = resolved_broadcast_limit(getattr(session, "properties", None))
     build = estimate_rows(session, node.right)
     return build > limit
 
@@ -442,6 +460,9 @@ def estimate_live_rows(session, node: P.PlanNode) -> int:
         return estimate_live_rows(session, node.left)
     srcs = node.sources
     if not srcs:
+        rr = getattr(node, "runtime_rows", None)  # stamped exchange source
+        if rr is not None:
+            return max(int(rr), 1)
         return MIN_CAPACITY
     return max(estimate_live_rows(session, s) for s in srcs)
 
