@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Fail when a system table, column, or procedure is missing from README.
+
+Mirror of ``tools/check_metric_docs.py`` for the system catalog: every
+table and column is DECLARED in ``trino_tpu/connector/system/schemas.py``
+(the connector builds its metadata from the same dict), so doc coverage
+is a set comparison — load the schema module standalone (no jax import),
+then require:
+
+- each table's qualified name (``system.<schema>.<table>``) to appear in
+  README.md;
+- each column name to appear BACKTICKED (```col```) somewhere — column
+  names like ``state`` are ordinary words, so bare-word presence would
+  pass vacuously;
+- each registered procedure's qualified name to appear.
+
+Usage: ``python tools/check_system_table_docs.py [--readme PATH]`` — exit
+0 when everything is documented, 1 with the missing names otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_schemas():
+    """trino_tpu/connector/system/schemas.py as a standalone module FILE
+    (importing the package would pull in jax via trino_tpu/__init__)."""
+    import importlib.util
+
+    path = os.path.join(REPO_ROOT, "trino_tpu", "connector", "system",
+                        "schemas.py")
+    spec = importlib.util.spec_from_file_location(
+        "_system_schemas_standalone", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def required_names() -> list:
+    """Everything the README must mention: table names, ``table.column``
+    pairs (reported that way so the failure message is actionable), and
+    procedure names."""
+    mod = _load_schemas()
+    required = []
+    for (schema, table), columns in sorted(mod.SYSTEM_TABLES.items()):
+        required.append(("table", f"system.{schema}.{table}", None))
+        for col, _type in columns:
+            required.append(
+                ("column", f"system.{schema}.{table}", col))
+    for schema, proc in sorted(mod.SYSTEM_PROCEDURES):
+        required.append(("procedure", f"system.{schema}.{proc}", None))
+    return required
+
+
+def check(readme_path: str | None = None) -> list:
+    """Missing documentation items (empty means the docs are complete),
+    each as a human-readable string."""
+    readme_path = readme_path or os.path.join(REPO_ROOT, "README.md")
+    with open(readme_path, encoding="utf-8") as f:
+        text = f.read()
+    backticked = set(re.findall(r"`([^`\n]+)`", text))
+    missing = []
+    for kind, qualified, col in required_names():
+        if kind in ("table", "procedure"):
+            if qualified not in text:
+                missing.append(f"{kind} {qualified}")
+        else:
+            if col not in backticked:
+                missing.append(f"column {qualified}.{col} "
+                               f"(needs a backticked `{col}`)")
+    return missing
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--readme", default=None,
+                    help="README path (default: repo root README.md)")
+    args = ap.parse_args()
+    missing = check(args.readme)
+    if missing:
+        print("system tables/columns/procedures declared in "
+              "trino_tpu/connector/system/schemas.py but missing from the "
+              "README System catalog section:", file=sys.stderr)
+        for item in missing:
+            print(f"  {item}", file=sys.stderr)
+        print("document each in README.md (## System catalog)",
+              file=sys.stderr)
+        return 1
+    n_tables = len(_load_schemas().SYSTEM_TABLES)
+    print(f"ok: all {n_tables} system tables (and their columns and "
+          "procedures) are documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

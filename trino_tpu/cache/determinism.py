@@ -38,6 +38,25 @@ NONDETERMINISTIC_FUNCTIONS = frozenset({
     "current_time", "localtimestamp", "localtime", "uuid", "shuffle",
 })
 
+# the system catalog's tables materialize from LIVE coordinator state at
+# scan time (connector/system/): two evaluations of the same plan see
+# different rows by design, so any scan over it is non-deterministic —
+# caught here IN ADDITION to the connector's None data_version (belt and
+# braces: both independently keep these plans out of the result and plan
+# caches)
+LIVE_SYSTEM_CATALOG = "system"
+
+
+def scans_live_table_reason(root: P.PlanNode) -> Optional[str]:
+    """A reason string when the plan scans a live system table, else
+    None."""
+    for node in P.walk_plan(root):
+        if isinstance(node, P.TableScanNode) \
+                and node.catalog == LIVE_SYSTEM_CATALOG:
+            return (f"live system table "
+                    f"{node.catalog}.{node.schema}.{node.table}")
+    return None
+
 
 def _ast_reason(node) -> Optional[str]:
     """Generic dataclass-tree walk over the parser AST."""
@@ -119,5 +138,5 @@ def uncachable_reason(stmt, root: Optional[P.PlanNode] = None) -> Optional[str]:
     if r:
         return r
     if root is not None:
-        return _plan_reason(root)
+        return scans_live_table_reason(root) or _plan_reason(root)
     return None

@@ -181,6 +181,25 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 8, lambda v: None if v >= 0 else "must be >= 0",
         ),
         PropertyMetadata(
+            "query_max_history",
+            "completed-query records the coordinator history ring retains "
+            "for system.runtime.queries and the /ui recent-queries table "
+            "(reference: query.max-history); applied when THIS query "
+            "completes, and only ever GROWS retention — values below the "
+            "server default are clamped up (the ring is shared state; one "
+            "session must not shrink other users' history)",
+            int, 100, _positive,
+        ),
+        PropertyMetadata(
+            "query_min_expire_age_ms",
+            "minimum age in milliseconds before a completed-query record "
+            "may be evicted from the history ring even when over "
+            "query_max_history (reference: query.min-expire-age); values "
+            "below the server default are clamped up, and a hard "
+            "server-side cap still bounds the ring",
+            int, 15_000, lambda v: None if v >= 0 else "must be >= 0",
+        ),
+        PropertyMetadata(
             "failure_injection",
             "inject a task failure when this substring matches a task id, "
             "e.g. '.<fragment>.<worker>.a<attempt>' (reference: "

@@ -17,6 +17,7 @@ def default_catalogs() -> Dict[str, Connector]:
     from trino_tpu.connector.blackhole.connector import BlackHoleConnector
     from trino_tpu.connector.filesystem.connector import FileSystemConnector
     from trino_tpu.connector.memory.connector import MemoryConnector
+    from trino_tpu.connector.system.connector import SystemConnector
     from trino_tpu.connector.tpcds import TpcdsConnector
     from trino_tpu.connector.tpch import TpchConnector
 
@@ -27,6 +28,11 @@ def default_catalogs() -> Dict[str, Connector]:
         "blackhole": BlackHoleConnector(),
         # parquet-on-disk catalog; root via env (etc/catalog/*.properties role)
         "filesystem": FileSystemConnector(os.environ.get("TRINO_TPU_FS_ROOT")),
+        # runtime introspection (reference: connector/system/): tables fed
+        # live by the coordinator's LiveTableProvider; provider-less
+        # instances (standalone sessions, workers) serve empty runtime
+        # tables and this process's own metrics registry
+        "system": SystemConnector(),
     }
     # RDBMS catalog (the JDBC plugin family's analog); db file via env
     sqlite_path = os.environ.get("TRINO_TPU_SQLITE_DB")

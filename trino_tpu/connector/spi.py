@@ -278,8 +278,37 @@ def column_data_take(cd: ColumnData, idx: np.ndarray) -> ColumnData:
     )
 
 
+class LiveTableProvider:
+    """Live-row source for a connector whose tables materialize at SCAN
+    time from running-process state instead of stored pages (reference:
+    the coordinator-state feeds behind ``connector/system/``'s
+    ``QuerySystemTable``/``NodeSystemTable``). The provider contract:
+
+    - ``snapshot_rows`` returns a CONSISTENT point-in-time row list and
+      must never hold engine-wide locks while building it (snapshot the
+      registry under its lock, compute rows outside), so a query scanning
+      the live table that describes itself neither deadlocks nor observes
+      a torn state;
+    - ``procedure`` resolves a named procedure to a callable
+      ``fn(session, *args) -> message`` or None (the CALL surface).
+    """
+
+    def snapshot_rows(self, schema: str, table: str) -> List[tuple]:
+        raise NotImplementedError
+
+    def procedure(self, schema: str, name: str):
+        return None
+
+
 class Connector:
     """Reference: spi/Plugin.java -> ConnectorFactory -> Connector."""
+
+    # connectors whose schemas each hold exactly one relation named like
+    # the schema (the jmx-connector shape) declare this so the planner's
+    # two-part-name fallback (``system.metrics`` -> system.metrics.metrics)
+    # applies ONLY to them — never silently rerouting a typo'd schema name
+    # against ordinary multi-table catalogs
+    single_table_schemas = False
 
     name: str = "connector"
     # True when table state lives only in the creating process (e.g. the
@@ -363,6 +392,20 @@ class Connector:
         takes (positional_args, named_args) and returns (column names,
         column types, rows)."""
         return None
+
+    def procedure(self, schema: str, name: str):
+        """Connector-provided procedure for ``CALL catalog.schema.name(...)``
+        or None (reference: spi/procedure/Procedure + CallTask). The
+        returned callable takes ``(session, *constant_args)`` and returns
+        an optional result message."""
+        return None
+
+    def attach_live_provider(self, provider: "LiveTableProvider") -> None:
+        """Bind a LiveTableProvider to this connector (the server that owns
+        the live state injects itself after constructing its catalog map).
+        Only live-table connectors accept one."""
+        raise NotImplementedError(
+            f"{self.name}: connector does not accept a live table provider")
 
     # --- splits (ConnectorSplitManager) ---
     def get_splits(

@@ -1,0 +1,3 @@
+from trino_tpu.connector.system.connector import (  # noqa: F401
+    SYSTEM_CATALOG, SYSTEM_PROCEDURES, SYSTEM_TABLES, SystemConnector,
+    metric_sample_rows)

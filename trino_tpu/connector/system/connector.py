@@ -1,0 +1,127 @@
+"""System catalog connector: SQL-queryable runtime introspection.
+
+Reference: ``core/trino-main/.../connector/system/`` — the ``system``
+catalog whose ``system.runtime.queries/tasks/nodes`` tables are fed LIVE
+from coordinator state (``QuerySystemTable``, ``TaskSystemTable``,
+``NodeSystemTable``), plus the ``jmx`` connector's every-metric-as-a-
+relation role collapsed into ``system.metrics``. Rows materialize at SCAN
+time through a :class:`~trino_tpu.connector.spi.LiveTableProvider` the
+owning server injects (``server/system_tables.py``); without a provider
+(standalone sessions, worker processes) the runtime tables are empty and
+``system.metrics`` falls back to this process's own registry — the
+metadata surface (SHOW TABLES, information_schema) works everywhere.
+
+Cache interaction: ``data_version`` returns None (live tables are
+unversioned ⇒ plan/result caches never admit them) and the determinism
+machinery (``trino_tpu/cache/determinism.py``) additionally flags any
+``system`` scan as uncachable, so introspection queries are provably
+never served stale.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from trino_tpu import types as T
+from trino_tpu.connector import spi
+from trino_tpu.connector.system.schemas import (
+    SYSTEM_CATALOG, SYSTEM_PROCEDURES, SYSTEM_TABLES)
+
+__all__ = ["SystemConnector", "SYSTEM_CATALOG", "SYSTEM_TABLES",
+           "SYSTEM_PROCEDURES", "metric_sample_rows"]
+
+
+def metric_sample_rows() -> List[tuple]:
+    """Every touched series of this process's typed registry as
+    ``(name, type, labels, value, help)`` rows — histogram buckets expand
+    to ``_bucket``/``_sum``/``_count`` rows exactly like the Prometheus
+    exposition (obs/metrics.registry_samples)."""
+    from trino_tpu.obs.metrics import registry_samples
+
+    def render_labels(labels: Dict[str, str]) -> Optional[str]:
+        if not labels:
+            return None
+        return ",".join(f'{k}="{v}"' for k, v in labels.items())
+
+    return [
+        (name, type_name, render_labels(labels), float(value), help_text)
+        for name, type_name, labels, value, help_text in registry_samples()
+    ]
+
+
+class SystemConnector(spi.Connector):
+    name = "system"
+    # live state exists only on the process that injected the provider
+    # (the coordinator): scans must never be distributed to workers
+    coordinator_only = True
+    # the metrics schema holds exactly one relation named like the schema,
+    # so the two-part spelling ``system.metrics`` resolves through the
+    # planner's single-table-schema fallback (gated on this declaration)
+    single_table_schemas = True
+
+    def __init__(self, provider: Optional[spi.LiveTableProvider] = None):
+        self._provider = provider
+        self._metadata: Dict[tuple, spi.TableMetadata] = {}
+        for (schema, table), columns in SYSTEM_TABLES.items():
+            self._metadata[(schema, table)] = spi.TableMetadata(
+                schema, table,
+                [spi.ColumnMetadata(n, T.parse_type(t)) for n, t in columns])
+
+    # ----------------------------------------------------------- SPI hooks
+    def attach_live_provider(self, provider: spi.LiveTableProvider) -> None:
+        self._provider = provider
+
+    def procedure(self, schema: str, name: str):
+        if (schema, name) not in SYSTEM_PROCEDURES:
+            return None
+        if self._provider is None:
+            raise ValueError(
+                f"procedure system.{schema}.{name} requires a coordinator "
+                "(no live provider attached in this process)")
+        return self._provider.procedure(schema, name)
+
+    # ------------------------------------------------------------ metadata
+    def list_schemas(self) -> List[str]:
+        return sorted({s for s, _ in SYSTEM_TABLES})
+
+    def list_tables(self, schema: str) -> List[str]:
+        return sorted(t for s, t in SYSTEM_TABLES if s == schema)
+
+    def get_table(self, schema: str, table: str) -> Optional[spi.TableMetadata]:
+        return self._metadata.get((schema, table))
+
+    def data_version(self, schema: str, table: str) -> Optional[str]:
+        # live tables are unversioned BY DESIGN: the plan and result caches
+        # cannot revalidate them, so every introspection query re-snapshots
+        return None
+
+    # --------------------------------------------------------------- scan
+    def get_splits(self, schema: str, table: str, target_splits: int,
+                   constraint=None, handle=None) -> List[spi.Split]:
+        if (schema, table) not in SYSTEM_TABLES:
+            raise KeyError(f"system.{schema}.{table} does not exist")
+        # ONE split always: the snapshot happens at scan time, and a table
+        # this size (metadata scale) gains nothing from parallel scans
+        # while a multi-split scan would stitch two different instants
+        return [spi.Split(table, schema, 0, 0)]
+
+    def _rows(self, schema: str, table: str) -> List[tuple]:
+        if self._provider is not None:
+            return self._provider.snapshot_rows(schema, table)
+        if (schema, table) == ("metrics", "metrics"):
+            return metric_sample_rows()
+        return []
+
+    def scan(self, split: spi.Split, columns: List[str],
+             constraint=None) -> Dict[str, spi.ColumnData]:
+        from trino_tpu.data.page import Column
+
+        meta = self._metadata[(split.schema, split.table)]
+        rows = self._rows(split.schema, split.table)
+        index = {c.name: i for i, c in enumerate(meta.columns)}
+        out: Dict[str, spi.ColumnData] = {}
+        for c in columns:
+            i = index[c]
+            col = Column.from_python(meta.columns[i].type,
+                                     [r[i] for r in rows])
+            out[c] = spi.column_data_from_column(col)
+        return out

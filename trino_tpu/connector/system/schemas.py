@@ -1,0 +1,87 @@
+"""System-catalog table schemas — the single source of truth.
+
+Reference: ``core/trino-main/.../connector/system/`` — every system table
+declares its ``ConnectorTableMetadata`` statically (``QuerySystemTable``,
+``TaskSystemTable``, ``NodeSystemTable``) while its ROWS materialize at
+scan time from live coordinator state. Here the declarations live in a
+dependency-free module (types as strings, parsed by the connector with
+``T.parse_type``) so the docs drift gate (``tools/
+check_system_table_docs.py``) can load them without pulling in jax, the
+same standalone-file trick the metric and session-property gates use.
+
+``SYSTEM_TABLES`` maps ``(schema, table)`` to an ordered column tuple of
+``(name, type_string)``. The ``metrics`` schema follows the single-table-
+schema convention (``metrics.metrics``) so the two-part spelling
+``system.metrics`` resolves (sql/planner/planner.py's catalog fallback).
+"""
+from __future__ import annotations
+
+SYSTEM_CATALOG = "system"
+
+SYSTEM_TABLES = {
+    # every query the coordinator tracks: live (QUEUED..RUNNING) from the
+    # query registry, completed from the bounded history ring
+    ("runtime", "queries"): (
+        ("query_id", "varchar"),
+        ("state", "varchar"),
+        ("user", "varchar"),
+        ("query", "varchar"),
+        ("created_at", "double"),      # epoch seconds
+        ("ended_at", "double"),        # epoch seconds; NULL while running
+        ("elapsed_ms", "bigint"),
+        ("device_seconds", "double"),
+        ("total_splits", "bigint"),
+        ("completed_splits", "bigint"),
+        ("input_rows", "bigint"),
+        ("output_bytes", "bigint"),
+        ("peak_bytes", "bigint"),
+        ("result_rows", "bigint"),
+        ("cache_status", "varchar"),   # HIT | MISS | BYPASS; NULL early
+        ("adaptations", "bigint"),
+        ("plan_versions", "bigint"),
+        ("failure", "varchar"),
+    ),
+    # per-slot task records of live queries (worker-reported stats rollup)
+    ("runtime", "tasks"): (
+        ("query_id", "varchar"),
+        ("task_id", "varchar"),
+        ("stage_id", "bigint"),
+        ("state", "varchar"),
+        ("worker_uri", "varchar"),
+        ("total_splits", "bigint"),
+        ("completed_splits", "bigint"),
+        ("input_rows", "bigint"),
+        ("output_rows", "bigint"),
+        ("output_bytes", "bigint"),
+        ("peak_bytes", "bigint"),
+        ("elapsed_seconds", "double"),
+        ("device_seconds", "double"),
+        ("operators", "bigint"),       # distinct plan nodes with stats
+    ),
+    # discovery registry + the workers' announce payloads
+    ("runtime", "nodes"): (
+        ("node_id", "varchar"),
+        ("http_uri", "varchar"),
+        ("state", "varchar"),          # active | dead (announce aged out)
+        ("version", "varchar"),
+        ("tasks", "bigint"),
+        ("memory_used_bytes", "bigint"),
+        ("memory_limit_bytes", "bigint"),
+        ("heartbeat_age_ms", "bigint"),
+    ),
+    # every touched series of the typed metrics registry as rows — the jmx
+    # connector's role; /v1/metrics stays the Prometheus surface
+    ("metrics", "metrics"): (
+        ("name", "varchar"),
+        ("type", "varchar"),           # counter | gauge | histogram
+        ("labels", "varchar"),         # k="v",... rendered label set
+        ("value", "double"),
+        ("help", "varchar"),
+    ),
+}
+
+# procedures the system connector registers (CALL surface); listed here so
+# the docs gate can require each to be documented alongside the tables
+SYSTEM_PROCEDURES = (
+    ("runtime", "kill_query"),
+)

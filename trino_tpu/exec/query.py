@@ -128,6 +128,8 @@ def _dispatch_statement(session, stmt) -> QueryResult:
             raise ValueError(f"prepared statement not found: {stmt.name}")
         del store[stmt.name]
         return QueryResult(["result"], [], [("DEALLOCATE",)])
+    if isinstance(stmt, ast.Call):
+        return _call_procedure(session, stmt)
     if isinstance(stmt, ast.StartTransaction):
         from trino_tpu.exec import transaction as txn_mod
 
@@ -236,6 +238,48 @@ def _resolve_table_named(session, parts, write: bool = False):
         catalog = parts_l[0]
     conn, schema, table = _resolve_table_name(session, parts, write=write)
     return conn, catalog, schema, table
+
+
+def _call_procedure(session, stmt):
+    """CALL catalog.schema.procedure(args...) (reference:
+    execution/CallTask: resolve the procedure through connector metadata,
+    evaluate constant arguments, invoke). Arguments analyze against an
+    empty scope and must constant-fold — a procedure is a control-plane
+    action, not a row pipeline."""
+    from trino_tpu.sql.analyzer.expr_analyzer import ExprAnalyzer
+    from trino_tpu.sql.analyzer.scope import Scope
+    from trino_tpu.sql.planner.planner import _fold_constant
+
+    parts = [p.lower() for p in stmt.name]
+    catalog = session.properties.get("catalog", "tpch")
+    schema = session.properties.get("schema", "tiny")
+    if len(parts) == 3:
+        catalog, schema, proc = parts
+    elif len(parts) == 2:
+        schema, proc = parts
+    else:
+        (proc,) = parts
+    conn = session.catalogs.get(catalog)
+    if conn is None:
+        raise ValueError(f"catalog not found: {catalog}")
+    fn = conn.procedure(schema, proc)
+    if fn is None:
+        raise ValueError(
+            f"procedure not registered: {catalog}.{schema}.{proc}")
+    analyzer = ExprAnalyzer(Scope([], None))
+    values = []
+    for e in stmt.args:
+        c = _fold_constant(analyzer.analyze(e))
+        if c is None:
+            raise ValueError(
+                f"CALL {catalog}.{schema}.{proc}: arguments must be "
+                "constants")
+        v = c.value
+        if v is not None and c.type.is_decimal:
+            v = float(v) / (10 ** c.type.scale)
+        values.append(v)
+    message = fn(session, *values)
+    return QueryResult(["result"], [], [(message or "CALL",)])
 
 
 def _create_table(session, stmt):

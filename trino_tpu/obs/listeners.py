@@ -21,6 +21,40 @@ _ENV_THRESHOLD_MS = "TRINO_TPU_SLOW_QUERY_MS"
 DEFAULT_THRESHOLD_MS = 30_000
 
 
+class QueryLogListener(EventListener):
+    """Durable query log: one JSON line per ``QueryCompletedEvent``
+    (reference role: the file/http event-listener plugins —
+    ``plugin/trino-http-event-listener`` et al. — collapsed to append-only
+    JSONL). Each line carries the query's identity, terminal state, stats
+    summary, and failure info, so the file is greppable/jq-able query
+    history that survives coordinator restarts (the in-memory history ring
+    does not). Registered on the coordinator when ``TRINO_TPU_QUERY_LOG``
+    names a path; a write failure is confined to this listener by
+    EventListenerManager's per-listener isolation — it can never fail the
+    query."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        import json
+
+        line = json.dumps({
+            "queryId": event.query_id,
+            "user": event.user,
+            "state": event.state,
+            "query": event.sql.strip()[:2000],
+            "createTime": event.create_time,
+            "endTime": event.end_time,
+            "wallMs": round(event.wall_seconds * 1000.0, 3),
+            "outputRows": event.output_rows,
+            "error": ((event.error or "").split("\n")[0][:500] or None),
+            "spanCount": len(event.spans),
+        }, ensure_ascii=False)
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+
+
 class SlowQueryLogListener(EventListener):
     """Logs queries whose wall time crosses a threshold, with the trace's
     slowest spans so the log line itself answers "where did the time go"

@@ -85,6 +85,15 @@ class Metric:
         return [f"# HELP {self.name} {self.help}",
                 f"# TYPE {self.name} {self.type_name}"]
 
+    def samples(self) -> List[tuple]:
+        """Every touched series as ``(sample_name, labels_dict, value)``
+        — the one expansion both the text rendering and the row view
+        (``registry_samples``) consume, so they cannot diverge."""
+        with self._lock:
+            children = dict(self._children)
+        return [(self.name, dict(zip(self.labelnames, key)), float(v))
+                for key, v in sorted(children.items())]
+
     def render(self) -> List[str]:
         raise NotImplementedError
 
@@ -132,10 +141,8 @@ def _render_flat(metric: Metric) -> List[str]:
     (which would read as 'this node has 0 uptime / 0 workers' on
     per-instance dashboards)."""
     lines = metric.header()
-    with metric._lock:
-        children = dict(metric._children)
-    for key, v in sorted(children.items()):
-        lines.append(_series(metric.name, dict(zip(metric.labelnames, key)), v))
+    for name, labels, v in metric.samples():
+        lines.append(_series(name, labels, v))
     return lines
 
 
@@ -166,20 +173,29 @@ class Histogram(Metric):
                 self._labelkey(labelvalues), ([0] * len(self.buckets), 0.0, 0))
             return list(counts), total, n
 
-    def render(self) -> List[str]:
-        lines = self.header()
+    def samples(self) -> List[tuple]:
+        """Prometheus histogram expansion: one ``_bucket`` sample per
+        ``le`` bound (cumulative, +Inf = observation count) plus ``_sum``
+        and ``_count`` per label set."""
         with self._lock:
             children = {k: (list(c), t, n)
                         for k, (c, t, n) in self._children.items()}
+        out: List[tuple] = []
         for key, (counts, total, n) in sorted(children.items()):
             base = dict(zip(self.labelnames, key))
             for b, c in zip(self.buckets, counts):
-                lines.append(_series(
-                    f"{self.name}_bucket", {**base, "le": _format_value(b)}, c))
-            lines.append(_series(
-                f"{self.name}_bucket", {**base, "le": "+Inf"}, n))
-            lines.append(_series(f"{self.name}_sum", base, total))
-            lines.append(_series(f"{self.name}_count", base, n))
+                out.append((f"{self.name}_bucket",
+                            {**base, "le": _format_value(b)}, float(c)))
+            out.append((f"{self.name}_bucket", {**base, "le": "+Inf"},
+                        float(n)))
+            out.append((f"{self.name}_sum", dict(base), float(total)))
+            out.append((f"{self.name}_count", dict(base), float(n)))
+        return out
+
+    def render(self) -> List[str]:
+        lines = self.header()
+        for name, labels, v in self.samples():
+            lines.append(_series(name, labels, v))
         return lines
 
 
@@ -374,7 +390,36 @@ QUERY_SECONDS = REGISTRY.histogram(
     "query wall time by terminal state", ("state",))
 
 
+# system catalog (trino_tpu/connector/system/): coordinator query-history
+# ring occupancy + ring evictions (reference: QueryTracker's
+# query.max-history expiry)
+QUERY_HISTORY_SIZE = REGISTRY.gauge(
+    "trino_tpu_query_history_size",
+    "completed-query records held by the coordinator history ring "
+    "(system.runtime.queries coverage of finished queries)")
+QUERY_HISTORY_EVICTIONS = REGISTRY.counter(
+    "trino_tpu_query_history_evictions_total",
+    "completed-query records evicted from the coordinator history ring "
+    "(query_max_history / query_min_expire_age_ms retention)")
+
+
 def render_registry() -> str:
     """The whole process's exposition page (worker /v1/metrics, and the
     body of the coordinator's after its gauges refresh)."""
     return REGISTRY.render()
+
+
+def registry_samples() -> List[tuple]:
+    """Every touched series as ``(name, type, labels_dict, value, help)``
+    tuples — the row-shaped view of the exposition page that feeds the
+    ``system.metrics`` table (the jmx-connector role). Built from the
+    same per-metric ``samples()`` expansion the text rendering consumes,
+    so the table cannot diverge from ``/v1/metrics``."""
+    with REGISTRY._lock:
+        metrics = list(REGISTRY._metrics.values())
+    out: List[tuple] = []
+    with RENDER_LOCK:
+        for m in metrics:
+            out.extend((name, m.type_name, labels, value, m.help)
+                       for name, labels, value in m.samples())
+    return out

@@ -1039,6 +1039,26 @@ def _lower_math1(op):
     return fn
 
 
+def _lower_log10(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
+    """log10 — reference MathFunctions.log10 delegates to Math.log10,
+    which is correctly rounded on exact powers of ten. jnp.log10 lowers to
+    the ln(x)·log10(e) composition, which drifts a ULP (log10(1000) =
+    2.9999999999999996) and fails exact comparisons. Concrete (eager-tier)
+    inputs take the host np.log10 path; traced values (jit/shard_map
+    tiers) stay on-device with the jnp composition."""
+    import jax
+
+    a = _arg_double(ctx, expr.args[0])
+    if isinstance(a.vals, jax.core.Tracer):
+        return LoweredVal(jnp.log10(a.vals), a.valid, None)
+    # domain violations produce NaN/-inf like the device op — silently
+    # (numpy warns where jnp does not; NULL slots carry garbage backing
+    # values that must not spam stderr per scan batch)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = np.log10(np.asarray(a.vals))
+    return LoweredVal(jnp.asarray(out), a.valid, None)
+
+
 def _lower_log_b(ctx: LowerCtx, expr: ir.Call) -> LoweredVal:
     """log(base, x) — reference MathFunctions.log(double, double)."""
     b = _arg_double(ctx, expr.args[0])
@@ -2136,7 +2156,7 @@ FUNCTIONS: Dict[str, Callable[..., LoweredVal]] = {
     "ln": _lower_math1(jnp.log),
     "log_b": _lower_log_b,
     "log2": _lower_math1(jnp.log2),
-    "log10": _lower_math1(jnp.log10),
+    "log10": _lower_log10,
     "exp": _lower_math1(jnp.exp),
     "power": _lower_power,
     "sign": _lower_sign,

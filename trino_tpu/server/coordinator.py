@@ -58,9 +58,11 @@ class NodeRegistry:
         self._lock = threading.Lock()
         self.max_age = max_age
 
-    def announce(self, node_id: str, url: str) -> None:
+    def announce(self, node_id: str, url: str,
+                 info: Optional[dict] = None) -> None:
         with self._lock:
-            self._nodes[node_id] = {"url": url, "last_seen": time.monotonic()}
+            self._nodes[node_id] = {"url": url, "last_seen": time.monotonic(),
+                                    "info": dict(info or {})}
 
     def alive(self) -> List[dict]:
         now = time.monotonic()
@@ -69,6 +71,20 @@ class NodeRegistry:
                 {"nodeId": nid, **info}
                 for nid, info in sorted(self._nodes.items())
                 if now - info["last_seen"] <= self.max_age
+            ]
+
+    def snapshot(self) -> List[dict]:
+        """Every known node with its last announce payload and heartbeat
+        age — including DEAD entries (announce aged out), which the
+        ``system.runtime.nodes`` table surfaces instead of hiding."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {"nodeId": nid, "url": info["url"],
+                 "info": dict(info.get("info") or {}),
+                 "ageS": now - info["last_seen"],
+                 "alive": now - info["last_seen"] <= self.max_age}
+                for nid, info in sorted(self._nodes.items())
             ]
 
     def wait_for_workers(self, count: int, timeout: float = 30.0) -> bool:
@@ -208,6 +224,9 @@ class QueryExecution:
         from trino_tpu.server.security import Identity
 
         session.identity = Identity(self.user)
+        # procedures (CALL) resolve the calling query through the session:
+        # system.runtime.kill_query refuses to kill its own query
+        session.query_id = self.query_id
         from trino_tpu.exec.query import run_query
         from trino_tpu.sql.parser import ast
         from trino_tpu.sql.parser.parser import parse_statement
@@ -228,11 +247,12 @@ class QueryExecution:
             self.rows = [(line,) for line in text.split("\n")]
             return
         if not isinstance(stmt, ast.Query):
-            # metadata statements (SHOW …, EXPLAIN) and DML/DDL run
+            # metadata statements (SHOW …, EXPLAIN), CALL, and DML/DDL run
             # coordinator-local and always bypass the result cache — the
             # mutation itself is what bumps the connector data versions
             # that invalidate cached SELECTs over the touched tables
             self.cache_status = "BYPASS"
+            self.state.set("RUNNING")
             with self.tracer.span("execute/coordinator-local"):
                 result = run_query(session, self.sql)
             self.columns, self.rows = result.column_names, result.rows
@@ -373,11 +393,14 @@ class QueryExecution:
             and session.catalogs[n.catalog].coordinator_only
             for n in P.walk_plan(root)
         ):
-            # scans over process-local catalogs (memory) cannot be
+            # scans over process-local catalogs (memory, system) cannot be
             # shipped to workers — execute on the coordinator's own
-            # engine (its embedded worker role)
+            # engine (its embedded worker role). RUNNING is set so the
+            # query observes ITSELF truthfully through
+            # system.runtime.queries while its scan materializes.
             from trino_tpu.exec.executor import Executor
 
+            self.state.set("RUNNING")
             with self.tracer.span("execute/coordinator-local"):
                 ex = Executor(session)
                 page = ex.execute_checked(root)
@@ -541,6 +564,21 @@ class QueryExecution:
         return [rollup_tasks_to_stage(fid, es,
                                       include_operators=include_operators)
                 for fid, es in sorted(by_frag.items())]
+
+    def task_records(self) -> List[dict]:
+        """Per-slot task records with the assigned worker uri attached —
+        the public read surface ``system.runtime.tasks`` materializes from
+        (no caller reaches into ``task_stats``/``_tstats_lock``)."""
+        url_by_task = {
+            loc.task_id: loc.base_url
+            for locs in list(self.fragment_tasks.values())
+            for loc in list(locs) if loc is not None
+        }
+        with self._tstats_lock:
+            entries = [dict(e) for e in self.task_stats.values()]
+        for e in entries:
+            e["workerUri"] = url_by_task.get(e["taskId"])
+        return entries
 
     def query_stats(self, stages: Optional[List[dict]] = None) -> dict:
         """Query-level rollup: live while RUNNING, frozen at terminal.
@@ -1136,6 +1174,17 @@ class CoordinatorServer:
         # statements (reference: MetadataManager's catalog handles living at
         # server scope, not query scope)
         self.catalogs = default_catalogs()
+        # system catalog (trino_tpu/connector/system/): bounded completed-
+        # query history ring (QueryTracker's query.max-history analog) +
+        # the live provider that feeds system.runtime.* and system.metrics
+        # from THIS server's state at scan time
+        from trino_tpu.server.system_tables import (
+            CoordinatorSystemTables, QueryHistory)
+
+        self.history = QueryHistory()
+        if "system" in self.catalogs:
+            self.catalogs["system"].attach_live_provider(
+                CoordinatorSystemTables(self))
         # shared across statements, like catalogs: CREATE FUNCTION on one
         # query is callable from the next (reference: global function store)
         self.udfs: Dict[str, object] = {}
@@ -1173,6 +1222,15 @@ class CoordinatorServer:
         from trino_tpu.obs.listeners import SlowQueryLogListener
 
         self.events.add(SlowQueryLogListener())
+        # durable JSONL query history (obs/listeners.QueryLogListener):
+        # opt-in via env, exception-isolated like every listener
+        import os as _os
+
+        query_log_path = _os.environ.get("TRINO_TPU_QUERY_LOG")
+        if query_log_path:
+            from trino_tpu.obs.listeners import QueryLogListener
+
+            self.events.add(QueryLogListener(query_log_path))
         self.queries_submitted = 0
         self.start_time = time.time()
         handler = _make_handler(self)
@@ -1225,6 +1283,29 @@ class CoordinatorServer:
                     session_properties=dict(execution.session_properties),
                 )
             )
+            # completed-query history (system.runtime.queries coverage of
+            # finished queries): retention knobs are session-property-
+            # gated, read from THIS query's submitted properties — but the
+            # ring is SHARED server state, so a session may only GROW
+            # retention (clamped at the server defaults): otherwise any
+            # session completing one query with query_max_history=1 would
+            # wipe every other user's history
+            from trino_tpu.server.system_tables import (
+                DEFAULT_MAX_HISTORY, DEFAULT_MIN_EXPIRE_AGE_MS, query_record)
+
+            try:
+                self.history.record(
+                    query_record(execution, state=state, ended_at=now),
+                    max_history=max(DEFAULT_MAX_HISTORY, _int_property(
+                        execution.session_properties, "query_max_history",
+                        DEFAULT_MAX_HISTORY)),
+                    min_expire_age_ms=max(
+                        DEFAULT_MIN_EXPIRE_AGE_MS, _int_property(
+                            execution.session_properties,
+                            "query_min_expire_age_ms",
+                            DEFAULT_MIN_EXPIRE_AGE_MS)))
+            except Exception:  # noqa: BLE001 — history is observability,
+                pass  # never a reason to disturb the terminal transition
 
         execution.state.add_listener(fire_terminal)
         # admission is ASYNC: the submit POST returns a QUEUED payload
@@ -1374,6 +1455,15 @@ def _cache_header(q: QueryExecution) -> Optional[dict]:
     return {CACHE_HEADER: q.cache_status} if q.cache_status else None
 
 
+def _int_property(properties: dict, name: str, default: int) -> int:
+    """Integer session property from a raw (wire-string) property map —
+    malformed values fall back like the typed registry's defaults."""
+    try:
+        return int(properties.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
 def _jsonable(v):
     import datetime
     import decimal
@@ -1414,6 +1504,21 @@ def _render_ui(server: CoordinatorServer) -> str:
         f"<tr><td>{html.escape(n['nodeId'])}</td>"
         f"<td>{html.escape(n['url'])}</td></tr>"
         for n in server.registry.alive())
+    # recent queries from the completed-query history ring (the durable
+    # record: survives the live registry's pruning)
+    recent = []
+    for rec in server.history.snapshot()[:50]:
+        recent.append(
+            f"<tr><td>{html.escape(rec['queryId'])}</td>"
+            f"<td class='s {rec['state']}'>{rec['state']}</td>"
+            f"<td>{rec['elapsedMs'] / 1e3:.1f}s</td>"
+            f"<td>{rec['resultRows']}</td>"
+            f"<td>{html.escape(rec['cacheStatus'] or '—')}</td>"
+            f"<td>{rec['adaptations']}</td>"
+            f"<td><code>{html.escape((rec['query'] or '').strip()[:100])}"
+            f"</code></td></tr>")
+    recent_html = "".join(recent) or (
+        "<tr><td colspan='7'>no completed queries yet</td></tr>")
     rg = server.resource_group.info()
     return f"""<!doctype html><html><head><meta http-equiv="refresh" content="3">
 <title>trino-tpu</title><style>
@@ -1426,10 +1531,16 @@ h1,h2{{color:#fff}}</style></head><body>
 <p>resource group "{rg['name']}": {rg['running']} running, {rg['queued']} queued
 (limit {rg['hardConcurrencyLimit']})</p>
 <h2>workers</h2><table><tr><th>node</th><th>url</th></tr>{nodes}</table>
-<h2>queries</h2><table>
+<h2>queries <small>(<a href="#recent" style="color:#6ae">recent
+queries</a> · <code>select * from system.runtime.queries</code>)</small></h2>
+<table>
 <tr><th>query id</th><th>state</th><th>user</th><th>query</th>
 <th>progress</th><th>stages (rows/wall)</th><th>retries</th></tr>
-{''.join(rows)}</table></body></html>"""
+{''.join(rows)}</table>
+<h2 id="recent">recent queries</h2><table>
+<tr><th>query id</th><th>state</th><th>elapsed</th><th>rows</th>
+<th>cache</th><th>adaptations</th><th>query</th></tr>
+{recent_html}</table></body></html>"""
 
 
 def _make_handler(server: CoordinatorServer):
@@ -1462,7 +1573,7 @@ def _make_handler(server: CoordinatorServer):
                     self._send(401, b'{"error": "bad internal signature"}')
                     return
                 info = json.loads(body)
-                server.registry.announce(m.group(1), info["url"])
+                server.registry.announce(m.group(1), info["url"], info)
                 server.cluster_memory.update(m.group(1), info)
                 self._send(200, b"{}")
                 return

@@ -17,6 +17,7 @@ counters and histograms ride along.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import threading
@@ -109,19 +110,21 @@ class EventListenerManager:
                     type(lsn).__name__, event.query_id)
 
 
-def render_metrics(server) -> str:
-    """Coordinator metrics page: refresh the server-derived gauges from the
-    server's PUBLIC accessors (``query_state_counts`` — no reaching into
-    ``_qlock``/``queries`` privates), then render the typed registry, which
-    also carries the process-global engine counters and histograms."""
+@contextlib.contextmanager
+def refreshed_server_gauges(server):
+    """Refresh the server-derived gauges from the server's PUBLIC
+    accessors (``query_state_counts`` — no reaching into ``_qlock``/
+    ``queries`` privates) for the duration of the block, then clear them.
+    RENDER_LOCK (shared with render_registry, reentrant) makes refresh-
+    read-clear one atomic unit: concurrent scrapes — of this server,
+    another coordinator, or a same-process worker — never observe a
+    half-refreshed gauge. Shared by the Prometheus page
+    (``render_metrics``) and the ``system.metrics`` table snapshot
+    (server/system_tables.py)."""
     from trino_tpu.obs import metrics as M
 
     gauges = (M.QUERIES, M.RESULT_ROWS, M.QUERIES_TOTAL, M.WORKERS,
-              M.UPTIME_SECONDS)
-    # RENDER_LOCK (shared with render_registry, reentrant) makes refresh-
-    # render-clear one atomic unit: concurrent scrapes — of this server,
-    # another coordinator, or a same-process worker — never observe a
-    # half-refreshed gauge
+              M.UPTIME_SECONDS, M.QUERY_HISTORY_SIZE)
     with M.RENDER_LOCK:
         by_state, rows = server.query_state_counts()
         M.QUERIES.clear()
@@ -134,8 +137,11 @@ def render_metrics(server) -> str:
         M.WORKERS.set(len(alive))
         M.UPTIME_SECONDS.set(round(
             time.time() - getattr(server, "start_time", time.time()), 1))
+        history = getattr(server, "history", None)
+        if history is not None:
+            M.QUERY_HISTORY_SIZE.set(len(history))
         try:
-            return M.render_registry()
+            yield
         finally:
             for metric in gauges:
                 # clear afterwards: the process-global registry must not
@@ -143,3 +149,13 @@ def render_metrics(server) -> str:
                 # worker's render must not re-export this coordinator's
                 # gauge values as its own
                 metric.clear()
+
+
+def render_metrics(server) -> str:
+    """Coordinator metrics page: server-derived gauges refreshed, then the
+    typed registry renders, which also carries the process-global engine
+    counters and histograms."""
+    from trino_tpu.obs import metrics as M
+
+    with refreshed_server_gauges(server):
+        return M.render_registry()

@@ -1,0 +1,232 @@
+"""Coordinator feeds for the ``system`` catalog + the query-history ring.
+
+Reference: ``core/trino-main/.../connector/system/`` — the coordinator-
+state providers behind ``system.runtime.queries`` (``QuerySystemTable``
+reading the DispatchManager/QueryTracker), ``system.runtime.tasks``
+(``TaskSystemTable``), ``system.runtime.nodes`` (``NodeSystemTable``
+reading the discovery registry) and the ``kill_query`` procedure
+(``KillQueryProcedure``) — plus the bounded completed-query history of
+``execution/QueryTracker`` (``query.max-history`` /
+``query.min-expire-age``), which is what lets ``system.runtime.queries``
+cover FINISHED/FAILED queries after their executions are pruned.
+
+Locking contract (the tentpole's deadlock clause): every snapshot takes
+the query-registry lock only to COPY the execution list, then builds rows
+outside it — so ``SELECT * FROM system.runtime.queries`` issued while
+that very query runs scans a consistent snapshot of itself without ever
+nesting the registry lock under a per-query lock.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from trino_tpu.connector import spi
+
+# retention defaults (the query_max_history / query_min_expire_age_ms
+# session properties override per recording query)
+DEFAULT_MAX_HISTORY = 100
+DEFAULT_MIN_EXPIRE_AGE_MS = 15_000
+
+
+def query_record(execution, state: Optional[str] = None,
+                 ended_at: Optional[float] = None) -> dict:
+    """One query's row-shaped record (live executions and history entries
+    share this shape, so ``system.runtime.queries`` unions them
+    uniformly). Reads only per-query state — never the registry lock."""
+    stages = execution.stage_stats(include_operators=False)
+    qs = execution.query_stats(stages)
+    failure = (execution.failure or "").split("\n")[0] or None
+    adaptations = len(execution.plan_versions)
+    return {
+        "queryId": execution.query_id,
+        "state": state or execution.state.get(),
+        "user": execution.user,
+        "query": execution.sql,
+        "createdAt": float(execution.created_at),
+        "endedAt": (float(ended_at) if ended_at is not None
+                    else execution.ended_at),
+        "elapsedMs": int(qs.get("elapsedMs", 0)),
+        "deviceS": float(qs.get("deviceS", 0.0)),
+        "totalSplits": int(qs.get("totalSplits", 0)),
+        "completedSplits": int(qs.get("completedSplits", 0)),
+        "inputRows": int(qs.get("totalRows", 0)),
+        "outputBytes": int(qs.get("totalBytes", 0)),
+        "peakBytes": int(qs.get("peakBytes", 0)),
+        "resultRows": len(execution.rows),
+        "cacheStatus": execution.cache_status,
+        "adaptations": adaptations,
+        # the initial plan is version 1; every adaptive change adds one
+        "planVersions": adaptations + 1,
+        "failure": failure,
+    }
+
+
+def _query_row(rec: dict) -> tuple:
+    """Record dict -> system.runtime.queries row (column order must match
+    connector/system/schemas.py)."""
+    return (
+        rec["queryId"], rec["state"], rec["user"], rec["query"],
+        rec["createdAt"], rec["endedAt"], rec["elapsedMs"], rec["deviceS"],
+        rec["totalSplits"], rec["completedSplits"], rec["inputRows"],
+        rec["outputBytes"], rec["peakBytes"], rec["resultRows"],
+        rec["cacheStatus"], rec["adaptations"], rec["planVersions"],
+        rec["failure"],
+    )
+
+
+class QueryHistory:
+    """Bounded ring of completed-query records (QueryTracker's
+    ``expireQueries`` analog). Eviction honors BOTH retention knobs: the
+    ring prunes to ``max_history`` but never evicts a record younger than
+    ``min_expire_age_ms`` — a burst of short queries stays inspectable for
+    at least that long; ``HARD_CAP`` bounds memory regardless."""
+
+    HARD_CAP = 1000
+
+    def __init__(self):
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def record(self, entry: dict,
+               max_history: int = DEFAULT_MAX_HISTORY,
+               min_expire_age_ms: int = DEFAULT_MIN_EXPIRE_AGE_MS) -> None:
+        from trino_tpu.obs import metrics as M
+
+        now = time.time()
+        evicted = 0
+        with self._lock:
+            self._entries[entry["queryId"]] = entry
+            self._entries.move_to_end(entry["queryId"])
+            while len(self._entries) > self.HARD_CAP:
+                self._entries.popitem(last=False)
+                evicted += 1
+            while len(self._entries) > max(0, int(max_history)):
+                _qid, oldest = next(iter(self._entries.items()))
+                age_ms = (now - (oldest.get("endedAt") or now)) * 1000.0
+                if age_ms < min_expire_age_ms:
+                    break  # too young to expire; retry on a later record
+                self._entries.popitem(last=False)
+                evicted += 1
+        if evicted:
+            M.QUERY_HISTORY_EVICTIONS.inc(evicted)
+
+    def snapshot(self) -> List[dict]:
+        """Newest-first record list."""
+        with self._lock:
+            return list(reversed(self._entries.values()))
+
+
+class CoordinatorSystemTables(spi.LiveTableProvider):
+    """The coordinator's LiveTableProvider: materializes system-table rows
+    from live server state at scan time and serves the ``kill_query``
+    procedure through the existing administrative kill path."""
+
+    def __init__(self, server):
+        self._server = server
+
+    # ------------------------------------------------------------- tables
+    def snapshot_rows(self, schema: str, table: str) -> List[tuple]:
+        if (schema, table) == ("runtime", "queries"):
+            return self._queries_rows()
+        if (schema, table) == ("runtime", "tasks"):
+            return self._tasks_rows()
+        if (schema, table) == ("runtime", "nodes"):
+            return self._nodes_rows()
+        if (schema, table) == ("metrics", "metrics"):
+            return self._metrics_rows()
+        raise KeyError(f"system.{schema}.{table} does not exist")
+
+    def _live_executions(self) -> List:
+        # COPY under the registry lock, compute outside it (the deadlock /
+        # torn-state contract in the module docstring)
+        with self._server._qlock:
+            return list(self._server.queries.values())
+
+    def _queries_rows(self) -> List[tuple]:
+        live = self._live_executions()
+        rows = [_query_row(query_record(q)) for q in live]
+        seen = {q.query_id for q in live}
+        # completed queries whose executions were pruned from the registry
+        # survive in the history ring (live records win: fresher stats)
+        rows.extend(_query_row(rec) for rec in self._server.history.snapshot()
+                    if rec["queryId"] not in seen)
+        return rows
+
+    def _tasks_rows(self) -> List[tuple]:
+        rows = []
+        for q in self._live_executions():
+            for rec in q.task_records():
+                s = rec.get("stats") or {}
+                ops = s.get("operatorStats") or ()
+                rows.append((
+                    q.query_id, rec["taskId"], int(rec["fragment"]),
+                    rec["state"], rec.get("workerUri"),
+                    int(s.get("totalSplits", 0)),
+                    int(s.get("completedSplits", 0)),
+                    int(s.get("inputRows", 0)), int(s.get("outputRows", 0)),
+                    int(s.get("outputBytes", 0)), int(s.get("peakBytes", 0)),
+                    float(s.get("elapsedS", 0.0)),
+                    float(s.get("deviceS", 0.0)), len(ops),
+                ))
+        return rows
+
+    def _nodes_rows(self) -> List[tuple]:
+        rows = []
+        for n in self._server.registry.snapshot():
+            info = n.get("info") or {}
+            mem_limit = info.get("memoryLimit")
+            rows.append((
+                n["nodeId"], n["url"], "active" if n["alive"] else "dead",
+                info.get("version"), int(info.get("tasks", 0)),
+                int(info.get("memoryBytes", 0)),
+                int(mem_limit) if mem_limit is not None else None,
+                int(n["ageS"] * 1000.0),
+            ))
+        return rows
+
+    def _metrics_rows(self) -> List[tuple]:
+        from trino_tpu.connector.system.connector import metric_sample_rows
+        from trino_tpu.server.events import refreshed_server_gauges
+
+        with refreshed_server_gauges(self._server):
+            return metric_sample_rows()
+
+    # --------------------------------------------------------- procedures
+    def procedure(self, schema: str, name: str):
+        if (schema, name) == ("runtime", "kill_query"):
+            return self._kill_query
+        return None
+
+    def _kill_query(self, session, query_id, reason=None) -> str:
+        """CALL system.runtime.kill_query(query_id, reason): FAIL the named
+        query with the supplied reason through the administrative kill
+        path (reference: KillQueryProcedure -> DispatchManager.failQuery).
+        Refuses self-kill (the calling query's own id) and — when end-user
+        authentication is enforced — killing another user's query."""
+        query_id = str(query_id)
+        if query_id == getattr(session, "query_id", None):
+            raise ValueError(
+                "kill_query cannot kill the query that invoked it")
+        q = self._server.get_query(query_id)
+        if q is None:
+            raise ValueError(f"kill_query: query not found: {query_id}")
+        auth = getattr(self._server, "authenticator", None)
+        if auth is not None and auth.required:
+            from trino_tpu.server.security import AccessDeniedError
+
+            user = getattr(getattr(session, "identity", None), "user", None)
+            if q.user != user:
+                raise AccessDeniedError(
+                    "Access Denied: query belongs to another user")
+        if q.state.is_terminal():
+            return f"query {query_id} is already {q.state.get()}"
+        q.kill(str(reason) if reason is not None
+               else "Killed via system.runtime.kill_query")
+        return f"killed {query_id}"

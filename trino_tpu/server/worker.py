@@ -94,6 +94,8 @@ class WorkerServer:
         pings — here the worker pushes, the coordinator ages entries out)."""
         while not self._stop.is_set():
             try:
+                from trino_tpu import __version__
+
                 qmem = self.tasks.query_memory()
                 wire.json_request(
                     "PUT",
@@ -105,7 +107,10 @@ class WorkerServer:
                      # these (reference: node status -> ClusterMemoryPool)
                      "queryMemory": qmem,
                      "memoryBytes": sum(qmem.values()),
-                     "memoryLimit": self.memory_limit_bytes},
+                     "memoryLimit": self.memory_limit_bytes,
+                     # surfaced by system.runtime.nodes (reference: the
+                     # node version in NodeSystemTable rows)
+                     "version": __version__},
                     timeout=5.0,
                 )
             except Exception:  # noqa: BLE001 — coordinator may not be up yet

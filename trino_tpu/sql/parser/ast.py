@@ -471,6 +471,16 @@ class Rollback(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class Call(Statement):
+    """CALL catalog.schema.procedure(arg, ...) (reference: sql/tree/Call +
+    execution/CallTask routing to the connector procedure SPI). Arguments
+    must be constant expressions."""
+
+    name: Tuple[str, ...]
+    args: Tuple[Expression, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class SetSession(Statement):
     """SET SESSION name = value (reference: sql/tree/SetSession.java)."""
 

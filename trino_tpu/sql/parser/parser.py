@@ -150,6 +150,19 @@ class Parser:
             self.advance()
             self.accept_soft("prepare")
             return ast.Deallocate(self.identifier().lower())
+        if self.at_soft("call") and self.peek(1).kind in ("ident", "kw"):
+            # CALL catalog.schema.procedure(args...) (reference:
+            # SqlBase.g4 call rule + sql/tree/Call)
+            self.advance()
+            name = tuple(self.qualified_name())
+            self.expect_op("(")
+            args: List[ast.Expression] = []
+            if not self.at_op(")"):
+                args.append(self.expr())
+                while self.accept_op(","):
+                    args.append(self.expr())
+            self.expect_op(")")
+            return ast.Call(name, tuple(args))
         if self.at_soft("commit"):
             self.advance()
             return ast.Commit()

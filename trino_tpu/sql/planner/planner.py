@@ -407,6 +407,21 @@ class Planner:
         if schema == "information_schema":
             return self._plan_information_schema(catalog, conn, table, outer_scope)
         meta = conn.get_table(schema, table)
+        if meta is None and len(parts) == 2 and parts[0] in self.catalogs:
+            # single-table-schema convenience: a two-part name whose head
+            # is a CATALOG resolves to that catalog's schema-named-like-
+            # the-table relation — so ``system.metrics`` reaches
+            # system.metrics.metrics without a USE system. Gated on the
+            # connector DECLARING the jmx-style one-relation-per-schema
+            # convention: a typo'd schema name against an ordinary
+            # multi-table catalog must keep erroring, never silently
+            # resolve into a different catalog's data
+            alt_conn = self.catalogs[parts[0]]
+            if getattr(alt_conn, "single_table_schemas", False):
+                alt_meta = alt_conn.get_table(parts[1], parts[1])
+                if alt_meta is not None:
+                    catalog, schema, table = parts[0], parts[1], parts[1]
+                    conn, meta = alt_conn, alt_meta
         if meta is None:
             raise PlanningError(f"table not found: {catalog}.{schema}.{table}")
         # authorization seam (reference: AccessControl.checkCanSelectFromColumns
