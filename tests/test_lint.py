@@ -1,0 +1,414 @@
+"""Engine lint suite tests (tools/lint/): good/bad fixture snippets per
+rule, the ``# lint: allow(<rule>) <reason>`` suppression syntax, the
+``tools/lint.py`` runner contract (non-zero on a seeded violation), and
+the self-check that the LIVE TREE passes both analyzers clean."""
+import os
+import subprocess
+import sys
+import textwrap
+
+from tools.lint import analyze_tree, collect_suppressions
+from tools.lint import lock_discipline, tracer_leak
+
+LINT_CLI = os.path.join(os.path.dirname(__file__), "..", "tools", "lint.py")
+
+
+def _run(analyzer, tmp_path, source, filename="mod.py"):
+    f = tmp_path / filename
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return analyze_tree(analyzer.analyze, str(tmp_path))
+
+
+def _rules(violations):
+    return [v.rule for v in violations]
+
+
+# ------------------------------------------------------------ tracer leak
+
+
+def test_module_level_jnp_call_flagged(tmp_path):
+    vs = _run(tracer_leak, tmp_path, """
+        import jax.numpy as jnp
+        _MASK32 = jnp.uint64(0xFFFFFFFF)
+    """)
+    assert _rules(vs) == ["import-time-jnp"]
+    assert vs[0].line == 3
+    assert "LEAKED TRACER" in vs[0].message
+
+
+def test_jnp_call_inside_function_is_fine(tmp_path):
+    assert _run(tracer_leak, tmp_path, """
+        import jax.numpy as jnp
+
+        def kernel(x):
+            return x + jnp.uint64(1)
+    """) == []
+
+
+def test_type_alias_and_function_reference_are_fine(tmp_path):
+    """The live-tree shapes that must NOT false-positive: jnp.ndarray in
+    a type alias, jnp functions passed as objects, dtype introspection."""
+    assert _run(tracer_leak, tmp_path, """
+        from typing import Optional, Tuple
+        import jax.numpy as jnp
+
+        Lowered = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+        _TABLE = {"sqrt": jnp.sqrt, "ln": jnp.log}
+        _WIDEN = {jnp.dtype(jnp.int8): jnp.int16}
+        _MAX = jnp.iinfo(jnp.int64).max
+    """) == []
+
+
+def test_default_argument_jnp_call_flagged(tmp_path):
+    vs = _run(tracer_leak, tmp_path, """
+        import jax.numpy as jnp
+
+        def f(x, fill=jnp.zeros(3)):
+            return x
+    """)
+    assert _rules(vs) == ["import-time-jnp"]
+    assert "default argument of f" in vs[0].message
+
+
+def test_def_inside_module_level_if_body_is_fine(tmp_path):
+    """A compat-shim def nested in `if`/`try` at module level still runs
+    at call time — only its decorators/defaults evaluate at import."""
+    assert _run(tracer_leak, tmp_path, """
+        import jax.numpy as jnp
+        import sys
+
+        if sys.version_info >= (3, 9):
+            def shim(x):
+                return jnp.asarray(x)
+        else:
+            def shim(x):
+                return jnp.array(x)
+    """) == []
+
+
+def test_class_body_jnp_call_flagged(tmp_path):
+    vs = _run(tracer_leak, tmp_path, """
+        import jax.numpy as jnp
+
+        class K:
+            SENTINEL = jnp.int32(-1)
+    """)
+    assert _rules(vs) == ["import-time-jnp"]
+
+
+def test_jnp_in_repr_and_property_flagged(tmp_path):
+    vs = _run(tracer_leak, tmp_path, """
+        import jax.numpy as jnp
+
+        class Page:
+            def __repr__(self):
+                return f"Page({jnp.sum(self.cols)})"
+
+            @property
+            def total(self):
+                return jnp.sum(self.cols)
+    """)
+    assert _rules(vs) == ["jnp-in-repr", "jnp-in-repr"]
+
+
+def test_host_only_module_import_flagged(tmp_path):
+    vs = _run(tracer_leak, tmp_path, """
+        import jax.numpy as jnp
+    """, filename="trino_tpu/sql/planner/helper.py")
+    assert "jnp-in-host-module" in _rules(vs)
+
+
+def test_lazy_from_import_alias_tracked(tmp_path):
+    vs = _run(tracer_leak, tmp_path, """
+        from jax.numpy import uint64
+        X = uint64(7)
+    """)
+    assert _rules(vs) == ["import-time-jnp"]
+
+
+def test_type_checking_guarded_import_is_fine(tmp_path):
+    """`if TYPE_CHECKING:` bodies never execute at runtime — a guarded
+    jnp import in a host-only module keeps the module jax-free; the else
+    branch DOES run and stays flagged."""
+    assert _run(tracer_leak, tmp_path, """
+        from typing import TYPE_CHECKING
+        if TYPE_CHECKING:
+            import jax.numpy as jnp
+    """, filename="trino_tpu/server/helper.py") == []
+    vs = _run(tracer_leak, tmp_path, """
+        import typing
+        if typing.TYPE_CHECKING:
+            pass
+        else:
+            import jax.numpy as jnp
+    """, filename="trino_tpu/server/helper2.py")
+    assert "jnp-in-host-module" in _rules(vs)
+
+
+# -------------------------------------------------------- lock discipline
+
+
+def test_blocking_sleep_under_lock_flagged(tmp_path):
+    vs = _run(lock_discipline, tmp_path, """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """)
+    assert _rules(vs) == ["blocking-under-lock"]
+    assert "time.sleep" in vs[0].message
+
+
+def test_sleep_outside_lock_is_fine(tmp_path):
+    assert _run(lock_discipline, tmp_path, """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def ok(self):
+                with self._lock:
+                    x = 1
+                time.sleep(1.0)
+    """) == []
+
+
+def test_direct_reentry_flagged_rlock_is_fine(tmp_path):
+    vs = _run(lock_discipline, tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rlock = threading.RLock()
+
+            def bad(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+
+            def ok(self):
+                with self._rlock:
+                    with self._rlock:
+                        pass
+    """)
+    assert _rules(vs) == ["lock-reentry"]
+
+
+def test_bare_condition_reentry_is_fine(tmp_path):
+    """threading.Condition() with no lock argument wraps an RLock, so
+    same-thread nested acquisition is legal; Condition(self._lock) keeps
+    the wrapped plain Lock's non-reentrancy."""
+    vs = _run(lock_discipline, tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def ok(self):
+                with self._cv:
+                    self.helper()
+
+            def helper(self):
+                with self._cv:
+                    pass
+
+        class D:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+
+            def bad(self):
+                with self._lock:
+                    with self._cv:
+                        pass
+    """)
+    assert _rules(vs) == ["lock-reentry"]
+    assert vs[0].path.endswith("mod.py") and "self._cv" in vs[0].message
+
+
+def test_reentry_through_call_chain_flagged(tmp_path):
+    vs = _run(lock_discipline, tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def public(self):
+                with self._lock:
+                    return self.helper()
+
+            def helper(self):
+                with self._lock:
+                    return 1
+    """)
+    assert "lock-reentry" in _rules(vs)
+    [v] = [v for v in vs if "self.helper()" in v.message]
+    assert "already held" in v.message
+
+
+def test_lock_order_inversion_flagged(tmp_path):
+    vs = _run(lock_discipline, tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert _rules(vs) == ["lock-order-inversion"]
+    assert "pick one order" in vs[0].message
+
+
+def test_consistent_order_is_fine(tmp_path):
+    assert _run(lock_discipline, tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """) == []
+
+
+def test_condition_wait_under_lock_flagged_and_alias_resolved(tmp_path):
+    """Condition(self._lock) IS self._lock for discipline purposes: the
+    wait is flagged (annotate deliberate ones), and nesting the condition
+    inside its own lock is re-entry."""
+    vs = _run(lock_discipline, tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def waits(self):
+                with self._cond:
+                    self._cond.wait_for(lambda: True)
+
+            def reenters(self):
+                with self._lock:
+                    with self._cond:
+                        pass
+    """)
+    assert sorted(_rules(vs)) == ["blocking-under-lock", "lock-reentry"]
+
+
+# ------------------------------------------------------------ suppression
+
+
+def test_allow_with_reason_suppresses(tmp_path):
+    assert _run(lock_discipline, tmp_path, """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def deliberate(self):
+                with self._lock:
+                    # lint: allow(blocking-under-lock) test fixture wants this documented
+                    time.sleep(0.0)
+    """) == []
+
+
+def test_allow_without_reason_is_itself_a_violation(tmp_path):
+    vs = _run(lock_discipline, tmp_path, """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def deliberate(self):
+                with self._lock:
+                    time.sleep(0.0)  # lint: allow(blocking-under-lock)
+    """)
+    # the bare allow does NOT suppress and is reported on top
+    assert sorted(_rules(vs)) == ["allow-without-reason",
+                                  "blocking-under-lock"]
+
+
+def test_allow_wrong_rule_does_not_suppress(tmp_path):
+    vs = _run(lock_discipline, tmp_path, """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def deliberate(self):
+                with self._lock:
+                    time.sleep(0.0)  # lint: allow(import-time-jnp) wrong rule
+    """)
+    assert "blocking-under-lock" in _rules(vs)
+
+
+def test_suppression_comment_parsing_multi_rule():
+    allowed, errors = collect_suppressions(
+        "x = 1  # lint: allow(rule-a, rule-b) both fine here\n", "f.py")
+    assert allowed[1] == {"rule-a", "rule-b"}
+    assert errors == []
+
+
+# ------------------------------------------------- runner + live tree
+
+
+def test_runner_all_gates_pass_on_live_tree():
+    proc = subprocess.run(
+        [sys.executable, LINT_CLI, "--all"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all 7 gate(s) passed" in proc.stdout
+
+
+def test_runner_exits_nonzero_on_seeded_violation(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text("import jax.numpy as jnp\nX = jnp.uint64(1)\n")
+    proc = subprocess.run(
+        [sys.executable, LINT_CLI, "--gate", "tracer-leak",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "import-time-jnp" in proc.stderr
+
+
+def test_live_tree_passes_tracer_leak_clean():
+    assert tracer_leak.check() == []
+
+
+def test_live_tree_passes_lock_discipline_clean():
+    """The only allowed sites are the annotated Condition waits
+    (server/statemachine.py, server/buffer.py) — everything else holds
+    the discipline outright."""
+    assert lock_discipline.check() == []

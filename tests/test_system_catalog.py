@@ -74,10 +74,13 @@ def test_metrics_table_expands_histogram_buckets():
     assert "trino_tpu_query_seconds_bucket" in names
     assert "trino_tpu_query_seconds_sum" in names
     assert "trino_tpu_query_seconds_count" in names
+    # pin the series: earlier tests in a full run may have registered
+    # other states (FAILED, ...) first, and row order follows insertion
     bucket = next(r for r in rows
                   if r[0] == "trino_tpu_query_seconds_bucket"
-                  and 'le="+Inf"' in (r[2] or ""))
-    assert 'state="FINISHED"' in bucket[2] and bucket[3] >= 1.0
+                  and 'le="+Inf"' in (r[2] or "")
+                  and 'state="FINISHED"' in (r[2] or ""))
+    assert bucket[3] >= 1.0
 
 
 def test_query_history_ring_retention():

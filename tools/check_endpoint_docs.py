@@ -9,19 +9,22 @@ and literal path comparisons inside the handlers (``self.path ==
 ``{id}``, ``(\\d+)`` → ``{n}``), and requires each template to appear in
 README.md's HTTP endpoints table — the endpoint-surface mirror of
 ``tools/check_metric_docs.py``, wired as a tier-1 test
-(tests/test_endpoint_docs.py).
+(tests/test_endpoint_docs.py) and into ``tools/lint.py --all`` (shared
+plumbing: tools/gates.py).
 
 Usage: ``python tools/check_endpoint_docs.py [--readme PATH]`` — exit 0
 when every endpoint is documented, 1 with the missing templates otherwise.
 """
 from __future__ import annotations
 
-import argparse
 import os
 import re
 import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if __package__ in (None, ""):  # script mode: tools/ on sys.path
+    import gates
+else:  # imported as tools.check_endpoint_docs
+    from tools import gates
 
 SERVER_FILES = (
     os.path.join("trino_tpu", "server", "coordinator.py"),
@@ -46,7 +49,8 @@ def served_endpoints() -> list:
     """Every canonical endpoint template the two servers route."""
     endpoints = set()
     for rel in SERVER_FILES:
-        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+        with open(os.path.join(gates.REPO_ROOT, rel),
+                  encoding="utf-8") as f:
             src = f.read()
         for pattern in _ROUTE_RE.findall(src):
             endpoints.add(_canonical(pattern))
@@ -62,36 +66,24 @@ def served_endpoints() -> list:
 def documented_endpoints(readme_path: str) -> set:
     """Path templates mentioned in the README (backticked table cells or
     code blocks — any literal mention counts, the check is for presence)."""
-    with open(readme_path, encoding="utf-8") as f:
-        text = f.read()
+    text = gates.read_readme(readme_path)
     return set(re.findall(r"(/(?:v1|ui)[^\s`)\",]*)", text))
 
 
 def check(readme_path: str | None = None) -> list:
     """Missing endpoint templates (empty means the docs are complete)."""
-    readme_path = readme_path or os.path.join(REPO_ROOT, "README.md")
     documented = documented_endpoints(readme_path)
     return [e for e in served_endpoints() if e not in documented]
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--readme", default=None,
-                    help="README path (default: repo root README.md)")
-    args = ap.parse_args()
-    missing = check(args.readme)
-    if missing:
-        print("HTTP endpoints served by server/coordinator.py or "
-              "server/worker.py but missing from the README:",
-              file=sys.stderr)
-        for e in missing:
-            print(f"  {e}", file=sys.stderr)
-        print("add each to the endpoint table in README.md "
-              "(## HTTP endpoints)", file=sys.stderr)
-        return 1
-    print(f"ok: all {len(served_endpoints())} served endpoints are "
-          "documented")
-    return 0
+    return gates.gate_main(
+        __doc__, check,
+        "HTTP endpoints served by server/coordinator.py or "
+        "server/worker.py but missing from the README:",
+        "add each to the endpoint table in README.md (## HTTP endpoints)",
+        lambda: (f"ok: all {len(served_endpoints())} served endpoints are "
+                 "documented"))
 
 
 if __name__ == "__main__":

@@ -3,71 +3,55 @@
 
 Every exported metric is DECLARED module-level in ``trino_tpu/obs/
 metrics.py`` (the registry is the single source of truth), so doc coverage
-is a set comparison: import the module, read ``REGISTRY.names()``, and
+is a set comparison: load the module, read ``REGISTRY.names()``, and
 require each name to appear in README.md's Observability section. Wired as
-a tier-1 test (tests/test_metric_docs.py) so metric docs can't drift.
+a tier-1 test (tests/test_metric_docs.py) and into ``tools/lint.py --all``
+(shared plumbing: tools/gates.py).
 
 Usage: ``python tools/check_metric_docs.py [--readme PATH]`` — exit 0 when
 every metric is documented, 1 with the missing names otherwise.
 """
 from __future__ import annotations
 
-import argparse
-import os
 import re
 import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if __package__ in (None, ""):  # script mode: tools/ on sys.path
+    import gates
+else:  # imported as tools.check_metric_docs
+    from tools import gates
 
 
 def registered_metric_names() -> list:
-    """Names declared in trino_tpu/obs/metrics.py, loaded as a standalone
-    module FILE: importing the package would pull in jax via
-    trino_tpu/__init__ — a multi-second dependency this CI gate (and any
-    docs-only environment) doesn't need."""
-    import importlib.util
-
-    path = os.path.join(REPO_ROOT, "trino_tpu", "obs", "metrics.py")
-    spec = importlib.util.spec_from_file_location("_obs_metrics_standalone",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    """Names declared in trino_tpu/obs/metrics.py (loaded as a standalone
+    module file — no jax import; see gates.load_module_file)."""
+    mod = gates.load_module_file("trino_tpu/obs/metrics.py",
+                                 "_obs_metrics_standalone")
     return sorted(mod.REGISTRY.names())
 
 
 def documented_metric_names(readme_path: str) -> set:
     """Metric-shaped identifiers mentioned in the README (the table cells
     use backticks, but any mention counts — the check is for presence)."""
-    with open(readme_path, encoding="utf-8") as f:
-        text = f.read()
+    text = gates.read_readme(readme_path)
     return set(re.findall(r"\btrino_tpu_[a-z0-9_]+\b", text))
 
 
 def check(readme_path: str | None = None) -> list:
     """Missing metric names (empty means the docs are complete)."""
-    readme_path = readme_path or os.path.join(REPO_ROOT, "README.md")
     documented = documented_metric_names(readme_path)
     return [name for name in registered_metric_names()
             if name not in documented]
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--readme", default=None,
-                    help="README path (default: repo root README.md)")
-    args = ap.parse_args()
-    missing = check(args.readme)
-    if missing:
-        print("metrics registered in code but missing from the README "
-              "Observability table:", file=sys.stderr)
-        for name in missing:
-            print(f"  {name}", file=sys.stderr)
-        print("add each to the metric table in README.md (## Observability)",
-              file=sys.stderr)
-        return 1
-    print(f"ok: all {len(registered_metric_names())} registered metrics "
-          "are documented")
-    return 0
+    return gates.gate_main(
+        __doc__, check,
+        "metrics registered in code but missing from the README "
+        "Observability table:",
+        "add each to the metric table in README.md (## Observability)",
+        lambda: (f"ok: all {len(registered_metric_names())} registered "
+                 "metrics are documented"))
 
 
 if __name__ == "__main__":

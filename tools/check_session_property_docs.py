@@ -6,7 +6,8 @@ registry: every knob is DECLARED in ``trino_tpu/client/properties.py``
 (``SYSTEM_SESSION_PROPERTIES``), so doc coverage is a set comparison —
 load the registry, require each property name to appear in README.md
 (the "Session properties" table). Wired as a tier-1 test
-(tests/test_session_property_docs.py) so property docs can't drift.
+(tests/test_session_property_docs.py) and into ``tools/lint.py --all``
+(shared plumbing: tools/gates.py).
 
 Usage: ``python tools/check_session_property_docs.py [--readme PATH]`` —
 exit 0 when every property is documented, 1 with the missing names
@@ -14,32 +15,20 @@ otherwise.
 """
 from __future__ import annotations
 
-import argparse
-import os
 import re
 import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if __package__ in (None, ""):  # script mode: tools/ on sys.path
+    import gates
+else:  # imported as tools.check_session_property_docs
+    from tools import gates
 
 
 def registered_property_names() -> list:
-    """Names declared in trino_tpu/client/properties.py, loaded as a
-    standalone module FILE: importing the package would pull in jax via
-    trino_tpu/__init__ — a multi-second dependency this CI gate (and any
-    docs-only environment) doesn't need."""
-    import importlib.util
-
-    path = os.path.join(REPO_ROOT, "trino_tpu", "client", "properties.py")
-    spec = importlib.util.spec_from_file_location(
-        "_client_properties_standalone", path)
-    mod = importlib.util.module_from_spec(spec)
-    # dataclass processing resolves the defining module through
-    # sys.modules at class-creation time: register before exec
-    sys.modules[spec.name] = mod
-    try:
-        spec.loader.exec_module(mod)
-    finally:
-        sys.modules.pop(spec.name, None)
+    """Names declared in trino_tpu/client/properties.py (loaded as a
+    standalone module file — no jax import; see gates.load_module_file)."""
+    mod = gates.load_module_file("trino_tpu/client/properties.py",
+                                 "_client_properties_standalone")
     return sorted(mod.SYSTEM_SESSION_PROPERTIES)
 
 
@@ -47,36 +36,26 @@ def documented_property_names(readme_path: str) -> set:
     """Property-shaped identifiers mentioned in the README (the table
     cells use backticks, but any mention counts — the check is for
     presence)."""
-    with open(readme_path, encoding="utf-8") as f:
-        text = f.read()
+    text = gates.read_readme(readme_path)
     return set(re.findall(r"\b[a-z][a-z0-9_]+\b", text))
 
 
 def check(readme_path: str | None = None) -> list:
     """Missing property names (empty means the docs are complete)."""
-    readme_path = readme_path or os.path.join(REPO_ROOT, "README.md")
     documented = documented_property_names(readme_path)
     return [name for name in registered_property_names()
             if name not in documented]
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--readme", default=None,
-                    help="README path (default: repo root README.md)")
-    args = ap.parse_args()
-    missing = check(args.readme)
-    if missing:
-        print("session properties registered in code but missing from the "
-              "README Session properties table:", file=sys.stderr)
-        for name in missing:
-            print(f"  {name}", file=sys.stderr)
-        print("add each to the property table in README.md "
-              "(## Session properties)", file=sys.stderr)
-        return 1
-    print(f"ok: all {len(registered_property_names())} registered session "
-          "properties are documented")
-    return 0
+    return gates.gate_main(
+        __doc__, check,
+        "session properties registered in code but missing from the "
+        "README Session properties table:",
+        "add each to the property table in README.md "
+        "(## Session properties)",
+        lambda: (f"ok: all {len(registered_property_names())} registered "
+                 "session properties are documented"))
 
 
 if __name__ == "__main__":

@@ -7,19 +7,21 @@ central registry (they are emitted inline via ``tracing.span(...)`` /
 ``tracer.start_span(...)``), so the source itself is scanned — every
 string literal in the FIRST argument of a span call (both arms of a
 conditional name count) must appear in README.md's span table. Wired as a
-tier-1 test (tests/test_span_docs.py) so span docs can't drift.
+tier-1 test (tests/test_span_docs.py) and into ``tools/lint.py --all``
+(shared plumbing: tools/gates.py).
 
 Usage: ``python tools/check_span_docs.py [--readme PATH]`` — exit 0 when
 every span is documented, 1 with the missing names otherwise.
 """
 from __future__ import annotations
 
-import argparse
-import os
 import re
 import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if __package__ in (None, ""):  # script mode: tools/ on sys.path
+    import gates
+else:  # imported as tools.check_span_docs
+    from tools import gates
 
 # a span call is any `<tracing|...tracer>.span(` / `.start_span(` — the
 # receiver prefix keeps unrelated `*_span(` helpers (e.g. ops/join.py
@@ -58,20 +60,14 @@ def emitted_span_names(root: str | None = None) -> list:
     """Every span name a ``tracing.span``/``tracer.start_span`` call can
     emit (all string literals of the first argument — a conditional name
     like ``"a" if x else "b"`` contributes both)."""
-    root = root or os.path.join(REPO_ROOT, "trino_tpu")
     names = set()
-    for dirpath, _dirs, files in os.walk(root):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
-                text = f.read()
-            for m in _CALL_RE.finditer(text):
-                arg = _first_arg_slice(text, m.end() - 1)
-                for sm in _STRING_RE.finditer(arg):
-                    names.add(sm.group(1) or sm.group(2))
+    for path in gates.iter_source_files(root):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for m in _CALL_RE.finditer(text):
+            arg = _first_arg_slice(text, m.end() - 1)
+            for sm in _STRING_RE.finditer(arg):
+                names.add(sm.group(1) or sm.group(2))
     return sorted(names)
 
 
@@ -79,35 +75,23 @@ def documented_span_names(readme_path: str) -> set:
     """Backtick-quoted identifiers in the README (the span table uses
     backticks, but any backticked mention counts — the check is for
     presence)."""
-    with open(readme_path, encoding="utf-8") as f:
-        text = f.read()
-    return set(re.findall(r"`([^`\n]+)`", text))
+    return gates.backticked_names(gates.read_readme(readme_path))
 
 
 def check(readme_path: str | None = None) -> list:
     """Missing span names (empty means the docs are complete)."""
-    readme_path = readme_path or os.path.join(REPO_ROOT, "README.md")
     documented = documented_span_names(readme_path)
     return [name for name in emitted_span_names() if name not in documented]
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--readme", default=None,
-                    help="README path (default: repo root README.md)")
-    args = ap.parse_args()
-    missing = check(args.readme)
-    if missing:
-        print("span names emitted in code but missing from the README "
-              "span table:", file=sys.stderr)
-        for name in missing:
-            print(f"  {name}", file=sys.stderr)
-        print("add each to the span table in README.md (### Tracing)",
-              file=sys.stderr)
-        return 1
-    print(f"ok: all {len(emitted_span_names())} emitted span names are "
-          "documented")
-    return 0
+    return gates.gate_main(
+        __doc__, check,
+        "span names emitted in code but missing from the README span "
+        "table:",
+        "add each to the span table in README.md (### Tracing)",
+        lambda: (f"ok: all {len(emitted_span_names())} emitted span names "
+                 "are documented"))
 
 
 if __name__ == "__main__":

@@ -14,31 +14,25 @@ then require:
   pass vacuously;
 - each registered procedure's qualified name to appear.
 
+Wired as a tier-1 test (tests/test_system_table_docs.py) and into
+``tools/lint.py --all`` (shared plumbing: tools/gates.py).
+
 Usage: ``python tools/check_system_table_docs.py [--readme PATH]`` — exit
 0 when everything is documented, 1 with the missing names otherwise.
 """
 from __future__ import annotations
 
-import argparse
-import os
-import re
 import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if __package__ in (None, ""):  # script mode: tools/ on sys.path
+    import gates
+else:  # imported as tools.check_system_table_docs
+    from tools import gates
 
 
 def _load_schemas():
-    """trino_tpu/connector/system/schemas.py as a standalone module FILE
-    (importing the package would pull in jax via trino_tpu/__init__)."""
-    import importlib.util
-
-    path = os.path.join(REPO_ROOT, "trino_tpu", "connector", "system",
-                        "schemas.py")
-    spec = importlib.util.spec_from_file_location(
-        "_system_schemas_standalone", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    return gates.load_module_file("trino_tpu/connector/system/schemas.py",
+                                  "_system_schemas_standalone")
 
 
 def required_names() -> list:
@@ -60,10 +54,8 @@ def required_names() -> list:
 def check(readme_path: str | None = None) -> list:
     """Missing documentation items (empty means the docs are complete),
     each as a human-readable string."""
-    readme_path = readme_path or os.path.join(REPO_ROOT, "README.md")
-    with open(readme_path, encoding="utf-8") as f:
-        text = f.read()
-    backticked = set(re.findall(r"`([^`\n]+)`", text))
+    text = gates.read_readme(readme_path)
+    backticked = gates.backticked_names(text)
     missing = []
     for kind, qualified, col in required_names():
         if kind in ("table", "procedure"):
@@ -77,24 +69,15 @@ def check(readme_path: str | None = None) -> list:
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--readme", default=None,
-                    help="README path (default: repo root README.md)")
-    args = ap.parse_args()
-    missing = check(args.readme)
-    if missing:
-        print("system tables/columns/procedures declared in "
-              "trino_tpu/connector/system/schemas.py but missing from the "
-              "README System catalog section:", file=sys.stderr)
-        for item in missing:
-            print(f"  {item}", file=sys.stderr)
-        print("document each in README.md (## System catalog)",
-              file=sys.stderr)
-        return 1
-    n_tables = len(_load_schemas().SYSTEM_TABLES)
-    print(f"ok: all {n_tables} system tables (and their columns and "
-          "procedures) are documented")
-    return 0
+    return gates.gate_main(
+        __doc__, check,
+        "system tables/columns/procedures declared in "
+        "trino_tpu/connector/system/schemas.py but missing from the "
+        "README System catalog section:",
+        "document each in README.md (## System catalog)",
+        lambda: (f"ok: all {len(_load_schemas().SYSTEM_TABLES)} system "
+                 "tables (and their columns and procedures) are "
+                 "documented"))
 
 
 if __name__ == "__main__":

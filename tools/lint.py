@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Single entry point for every tier-1 static gate.
+
+``python tools/lint.py --all`` runs the two engine lint analyzers
+(``lint/tracer_leak.py``, ``lint/lock_discipline.py``) plus the five
+docs-drift gates (``check_*_docs.py``) — the full static-analysis surface
+CI enforces, registered in one place (``tools/gates.py: ALL_GATES``).
+Individual gates run with ``--gate NAME`` (repeatable); ``--list`` prints
+the registry. Exit 0 when every selected gate passes, 1 otherwise, with
+each gate's findings itemized.
+
+The plan-IR half of the static-analysis layer is NOT here: plan
+validation (``trino_tpu/sql/planner/sanity.py``) runs inside the engine
+after every optimizer pass / fragmentation / adaptive re-plan, gated by
+the ``plan_validation`` session property.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import os
+import sys
+import time
+
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if TOOLS_DIR not in sys.path:
+    sys.path.insert(0, TOOLS_DIR)
+
+import gates  # noqa: E402
+
+
+def _resolve(module_name: str):
+    """A gate module by its tools/-relative dotted name (``check_x`` or
+    ``lint.rule``); each exposes ``check() -> list of problem strings``."""
+    return importlib.import_module(module_name)
+
+
+def run_gates(names, root=None) -> int:
+    registry = {name: (mod, desc) for name, mod, desc in gates.ALL_GATES}
+    unknown = [n for n in names if n not in registry]
+    if unknown:
+        print(f"unknown gate(s): {', '.join(unknown)} — available: "
+              f"{', '.join(registry)}", file=sys.stderr)
+        return 2
+    failed = []
+    for name in names:
+        mod_name, desc = registry[name]
+        t0 = time.monotonic()
+        try:
+            check = _resolve(mod_name).check
+            # the source-tree analyzers accept an alternate root (tests
+            # seed violations in temp trees); the docs gates don't
+            accepts_root = "root" in inspect.signature(check).parameters
+            problems = check(root) if (root and accepts_root) else check()
+        except Exception as e:  # noqa: BLE001 — a crashed gate is a failure
+            problems = [f"gate crashed: {type(e).__name__}: {e}"]
+        dt = time.monotonic() - t0
+        status = "ok" if not problems else f"FAIL ({len(problems)})"
+        print(f"[{status:>9}] {name:<22} {desc}  ({dt:.2f}s)")
+        for p in problems:
+            print(f"    {p}", file=sys.stderr)
+        if problems:
+            failed.append(name)
+    if failed:
+        print(f"\n{len(failed)}/{len(names)} gate(s) failed: "
+              f"{', '.join(failed)}", file=sys.stderr)
+        return 1
+    print(f"\nall {len(names)} gate(s) passed")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--all", action="store_true",
+                    help="run every registered gate")
+    ap.add_argument("--gate", action="append", default=[],
+                    help="run one named gate (repeatable); see --list")
+    ap.add_argument("--list", action="store_true",
+                    help="print the gate registry and exit")
+    ap.add_argument("--root", default=None,
+                    help="alternate source root for the lint analyzers "
+                         "(default: trino_tpu/; docs gates ignore this)")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name, _mod, desc in gates.ALL_GATES:
+            print(f"{name:<22} {desc}")
+        return 0
+    names = ([name for name, _m, _d in gates.ALL_GATES] if args.all
+             else args.gate)
+    if not names:
+        ap.print_help()
+        return 2
+    return run_gates(names, root=args.root)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

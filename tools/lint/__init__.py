@@ -1,0 +1,133 @@
+"""Engine lint suite: AST analyzers over ``trino_tpu/`` itself.
+
+Two bug classes this engine has already paid for by hand get regression
+gates here:
+
+- :mod:`lint.tracer_leak` — module-level ``jnp.*`` evaluation at import
+  time. PR 1 fixed three of these ad hoc (``ops/int128.py``,
+  ``ops/hll.py``, ``parallel/exchange.py``: a module first imported
+  INSIDE a jit/shard_map trace binds its "constants" to tracers). Plus
+  ``jnp`` in ``__repr__``/``@property`` (called from debuggers/logging on
+  the host path) and in host-only modules that must import without
+  touching the device.
+- :mod:`lint.lock_discipline` — the intra-class lock graph over every
+  ``with self._lock`` region: nested-acquisition order inversions,
+  non-reentrant lock re-entry (directly or through a method call made
+  while holding the lock — the deadlock class PR 5's
+  ``system.runtime.queries`` snapshot-outside-the-lock design avoids),
+  and blocking calls (``time.sleep``, ``requests.*``,
+  ``.block_until_ready()``, ``wire.http_request``, condition waits) made
+  while holding a lock.
+
+Both run as tier-1 gates (tests/test_lint.py) and through
+``tools/lint.py --all`` alongside the five docs gates (tools/gates.py).
+
+Suppression syntax — intentional sites are documented, not silent::
+
+    with self._cond:
+        # lint: allow(blocking-under-lock) wait releases it
+        self._cond.wait_for(...)
+
+``# lint: allow(<rule>) <reason>`` on the flagged line (the line the
+violation is REPORTED at — here the wait call, not the ``with``) or
+alone on the line directly above suppresses that rule there. The reason
+is MANDATORY: an allow without one is itself a violation
+(``allow-without-reason``).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([\w\-, ]+)\)\s*(.*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding: the rule, where, and what — formatted the way compiler
+    diagnostics are, so editors and CI logs link straight to the line."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def collect_suppressions(text: str, path: str) -> tuple:
+    """Parse ``# lint: allow(rule[, rule]) reason`` comments.
+
+    Returns ``(allowed, errors)``: ``allowed`` maps line number -> set of
+    rule names suppressed THERE (a standalone allow-comment covers the
+    next line too); ``errors`` are ``allow-without-reason`` violations for
+    annotations missing their mandatory reason text.
+    """
+    allowed: Dict[int, Set[str]] = {}
+    errors: List[Violation] = []
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        m = _ALLOW_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        if not m.group(2).strip():
+            errors.append(Violation(
+                "allow-without-reason", path, lineno,
+                "suppression has no reason — '# lint: allow(rule) why' "
+                "documents the intent; a bare allow hides it"))
+            continue
+        allowed.setdefault(lineno, set()).update(rules)
+        # a comment-only line suppresses the statement below it
+        if line.split("#", 1)[0].strip() == "":
+            allowed.setdefault(lineno + 1, set()).update(rules)
+    return allowed, errors
+
+
+def apply_suppressions(violations: List[Violation], allowed: Dict[int, Set[str]]
+                       ) -> List[Violation]:
+    return [v for v in violations
+            if v.rule not in allowed.get(v.line, ())]
+
+
+def analyze_file(path: str, analyze) -> List[Violation]:
+    """Run one analyzer (``analyze(tree, text, path) -> [Violation]``)
+    over one file, with suppressions applied and mandatory-reason
+    enforcement."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    tree = ast.parse(text, filename=path)
+    allowed, errors = collect_suppressions(text, path)
+    return apply_suppressions(analyze(tree, text, path), allowed) + errors
+
+
+def analyze_tree(analyze, root: Optional[str] = None) -> List[Violation]:
+    """Run one analyzer over every ``.py`` file under ``trino_tpu/`` (or
+    ``root``), in deterministic path order."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        import gates
+    finally:
+        sys.path.pop(0)
+    out: List[Violation] = []
+    for path in gates.iter_source_files(root):
+        out.extend(analyze_file(path, analyze))
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def qualified_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

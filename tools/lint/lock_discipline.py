@@ -1,0 +1,286 @@
+"""Lock-discipline analyzer: the intra-class lock graph, statically.
+
+The engine holds 23 lock declarations (``threading.Lock``/``RLock``/
+``Condition``) across the coordinator, worker task state, buffers,
+caches, and the metrics registry, and the discipline that keeps them
+deadlock-free lives only in comments — PR 5's ``system.runtime.queries``
+design (snapshot the registry under the lock, BUILD ROWS OUTSIDE it)
+exists precisely because a careless nested acquisition there deadlocks a
+query observing itself. This analyzer turns that discipline into a gate.
+
+Per class it discovers every lock attribute (``self._x =
+threading.Lock()``; a ``Condition(self._lock)`` aliases the lock it
+wraps, a bare ``Condition()`` owns its own), then walks each method
+tracking the stack of locks held through ``with self._x:`` regions
+(including multi-item ``with a, b:``) and method calls made while
+holding:
+
+- ``lock-reentry`` — a NON-reentrant lock acquired while already held,
+  directly or through a chain of ``self.*`` method calls (the classic
+  "public method takes the lock, helper called under it takes it again").
+- ``lock-order-inversion`` — lock B acquired under A in one place and A
+  under B in another (cycle in the class's acquisition-order graph,
+  method-call edges included): two threads interleaving those paths
+  deadlock.
+- ``blocking-under-lock`` — ``time.sleep``, ``requests.*``,
+  ``wire.http_request``, ``.block_until_ready()``, and condition
+  ``.wait()``/``.wait_for()`` while holding a lock. Condition
+  waits RELEASE the wrapped lock and are legitimate — which is exactly
+  why they must carry a ``# lint: allow(blocking-under-lock) <reason>``
+  annotation instead of passing silently.
+
+Suppression: ``# lint: allow(<rule>) <reason>`` (see tools/lint).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Violation, analyze_tree, qualified_name
+
+# call shapes that BLOCK (network, device sync, scheduler) — holding any
+# lock across one stalls every contender for the lock's full duration
+_BLOCKING_QUALNAMES = ("time.sleep", "wire.http_request")
+_BLOCKING_PREFIXES = ("requests.",)
+_BLOCKING_METHODS = ("block_until_ready", "wait", "wait_for")
+
+
+@dataclasses.dataclass
+class _MethodFacts:
+    """What one method does with the class's locks: every acquisition
+    (lock name -> line), every blocking call / nested acquisition that
+    happened WHILE holding (already violations or graph edges), and every
+    ``self.*`` call with the locks held at that call site — held may be
+    empty: unlocked calls still propagate their callee's acquisitions
+    through the interprocedural fixpoint (a deadlock chain can pass
+    through a method that takes no lock itself)."""
+
+    acquires: Dict[str, int] = dataclasses.field(default_factory=dict)
+    edges: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list)  # (held, acquired, line)
+    calls: List[Tuple[str, Tuple[str, ...], int]] = (
+        dataclasses.field(default_factory=list))  # (method, held, line)
+    violations: List[Violation] = dataclasses.field(default_factory=list)
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """Discover the class's lock attributes.
+
+    Returns ``(kinds, canonical)``: ``kinds`` maps attr name ->
+    ``lock``/``rlock``/``condition``; ``canonical`` maps attr name -> the
+    name identifying the UNDERLYING mutex (``Condition(self._lock)`` and
+    ``self._lock`` are the same lock for reentry/ordering purposes)."""
+    kinds: Dict[str, str] = {}
+    canonical: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            continue
+        qn = qualified_name(node.value.func) if isinstance(
+            node.value, ast.Call) else None
+        if qn in ("threading.Lock", "threading.RLock"):
+            kinds[tgt.attr] = "rlock" if qn.endswith("RLock") else "lock"
+            canonical[tgt.attr] = tgt.attr
+        elif qn == "threading.Condition":
+            args = node.value.args
+            if (args and isinstance(args[0], ast.Attribute)
+                    and isinstance(args[0].value, ast.Name)
+                    and args[0].value.id == "self"):
+                # reentrancy follows the wrapped lock's own kind
+                kinds[tgt.attr] = "condition"
+                canonical[tgt.attr] = args[0].attr
+            else:
+                # a bare Condition() wraps an RLock internally: nested
+                # acquisition by the same thread is legal
+                kinds[tgt.attr] = "rlock"
+                canonical[tgt.attr] = tgt.attr
+    return kinds, canonical
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    qn = qualified_name(call.func)
+    if qn in _BLOCKING_QUALNAMES:
+        return qn
+    if qn and any(qn.startswith(p) for p in _BLOCKING_PREFIXES):
+        return qn
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in _BLOCKING_METHODS:
+        return f".{call.func.attr}()"
+    return None
+
+
+def _scan_method(fn: ast.FunctionDef, kinds: Dict[str, str],
+                 canonical: Dict[str, str], rel: str) -> _MethodFacts:
+    facts = _MethodFacts()
+
+    def walk(node: ast.AST, held: Tuple[str, ...]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # nested defs run later, on an unknown lock stack — out of
+            # scope for this intra-method walk
+            return
+        if isinstance(node, ast.With):
+            acquired: List[str] = []
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is None or attr not in kinds:
+                    walk(item.context_expr, held)
+                    continue
+                canon = canonical[attr]
+                facts.acquires.setdefault(canon, item.context_expr.lineno)
+                # an edge from EVERY held lock, not just the innermost:
+                # `with a: with b: with c:` orders a before c too, and an
+                # a/c inversion elsewhere is just as deadlock-prone
+                for h in held:
+                    facts.edges.append(
+                        (h, canon, item.context_expr.lineno))
+                if canon in held and kinds.get(canon, "lock") != "rlock":
+                    facts.violations.append(Violation(
+                        "lock-reentry", rel, item.context_expr.lineno,
+                        f"self.{attr} acquired while already held — a "
+                        "non-reentrant threading.Lock self-deadlocks "
+                        "here"))
+                acquired.append(canon)
+                held = held + (canon,)
+            for stmt in node.body:
+                walk(stmt, held)
+            return
+        if isinstance(node, ast.Call):
+            if held:
+                reason = _blocking_reason(node)
+                if reason is not None:
+                    facts.violations.append(Violation(
+                        "blocking-under-lock", rel, node.lineno,
+                        f"{reason} called while holding self."
+                        f"{held[-1]} — every contender stalls for the "
+                        "call's full duration (sleep/network/device "
+                        "sync under a lock)"))
+            # record self.* calls even with no lock held: the fixpoint
+            # must see acquisitions through unlocked intermediate hops
+            # (top holds A, calls mid — lock-free — which calls bottom,
+            # which takes A: still a self-deadlock)
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "self"):
+                facts.calls.append((func.attr, held, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in fn.body:
+        walk(stmt, ())
+    return facts
+
+
+def _analyze_class(cls: ast.ClassDef, rel: str) -> List[Violation]:
+    kinds, canonical = _lock_attrs(cls)
+    if not kinds:
+        return []
+    methods = {m.name: m for m in cls.body
+               if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    facts = {name: _scan_method(m, kinds, canonical, rel)
+             for name, m in methods.items()}
+
+    violations: List[Violation] = []
+    for f in facts.values():
+        violations.extend(f.violations)
+
+    # interprocedural: effective acquisitions of each method = its own +
+    # everything reachable through self.* calls (fixpoint over the class)
+    eff: Dict[str, Set[str]] = {n: set(f.acquires) for n, f in facts.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name, f in facts.items():
+            for callee, _held, _line in f.calls:
+                if callee in eff and not eff[callee] <= eff[name]:
+                    eff[name] |= eff[callee]
+                    changed = True
+
+    edges: List[Tuple[str, str, int, str]] = [
+        (a, b, line, "direct") for f in facts.values()
+        for (a, b, line) in f.edges]
+    for name, f in facts.items():
+        for callee, held, line in f.calls:
+            if not held or callee not in facts:
+                continue
+            for acq in eff.get(callee, ()):
+                if acq in held and kinds.get(acq, "lock") != "rlock":
+                    violations.append(Violation(
+                        "lock-reentry", rel, line,
+                        f"self.{callee}() acquires self.{acq}, which is "
+                        "already held at this call site — a "
+                        "non-reentrant threading.Lock deadlocks against "
+                        "itself through the call chain"))
+                elif acq not in held:
+                    for h in held:
+                        edges.append((h, acq, line,
+                                      f"via self.{callee}()"))
+
+    # order inversions: ANY cycle in the acquisition-order graph — the
+    # 2-cycle (a->b and b->a) and the longer chain (a->b->c->a) both
+    # deadlock when the threads interleave
+    adj: Dict[str, Dict[str, Tuple[int, str]]] = {}
+    for a, b, line, how in edges:
+        if a != b:
+            adj.setdefault(a, {}).setdefault(b, (line, how))
+    reported: Set[frozenset] = set()
+    for start in sorted(adj):
+        stack = [(start, iter(sorted(adj.get(start, ()))))]
+        on_path = [start]
+        visited = {start}
+        while stack:
+            node, it = stack[-1]
+            nxt = next(it, None)
+            if nxt is None:
+                stack.pop()
+                on_path.pop()
+                continue
+            if nxt in on_path:
+                cyc = on_path[on_path.index(nxt):]
+                key = frozenset(cyc)
+                if key not in reported:
+                    reported.add(key)
+                    line, how = adj[node][nxt]
+                    order = " -> ".join(
+                        f"self.{n}" for n in cyc + [nxt])
+                    violations.append(Violation(
+                        "lock-order-inversion", rel, line,
+                        f"acquisition-order cycle {order} (closed "
+                        f"{how} here): threads interleaving these "
+                        "paths deadlock; pick one order"))
+                continue
+            if nxt in visited:
+                continue
+            visited.add(nxt)
+            on_path.append(nxt)
+            stack.append((nxt, iter(sorted(adj.get(nxt, ())))))
+    return violations
+
+
+def analyze(tree: ast.Module, text: str, path: str) -> List[Violation]:
+    rel = path.replace("\\", "/")
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out.extend(_analyze_class(node, rel))
+    return out
+
+
+def check(root: Optional[str] = None) -> List[str]:
+    """Gate-registry surface: formatted violations for the live tree.
+    CLI: ``python tools/lint.py --gate lock-discipline``."""
+    return [v.format() for v in analyze_tree(analyze, root)]

@@ -1,0 +1,236 @@
+"""Tracer-leak analyzer: keep ``jnp`` off the import-time and host paths.
+
+The bug class (paid for in PR 1): a module-level constant like
+``_MASK32 = jnp.uint64(0xFFFFFFFF)`` is evaluated when the module is
+FIRST IMPORTED — and if that import happens inside a ``jit``/``shard_map``
+trace (lazy imports inside kernels make this easy), the "constant" binds
+to a tracer that leaks out of the trace and poisons every later use.
+``ops/int128.py``, ``ops/hll.py``, and ``parallel/exchange.py`` all hit
+it; the fix is concrete ``np.*`` host scalars. This analyzer makes the
+class unrepresentable:
+
+- ``import-time-jnp`` — any array-materializing ``jnp``/``jax.numpy``
+  CALL in code that executes at import: module body, class body,
+  decorators, function default arguments. Attribute REFERENCES are
+  host-safe (``jnp.ndarray`` in a type alias, ``jnp.sqrt`` passed as a
+  function object, ``jnp.dtype(...)``/``jnp.iinfo(...)`` introspection),
+  and function BODIES are fine — they run at call time, where tracing
+  semantics are intended.
+- ``jnp-in-repr`` — ``jnp`` use inside ``__repr__``/``__str__`` or a
+  ``@property`` body: these are called from logging, debuggers, and
+  format strings on the HOST path, where forcing device values is at best
+  a sync and at worst a leaked-tracer materialization.
+- ``jnp-in-host-module`` — any ``jnp``/``jax.numpy`` import or use inside
+  the packages that must stay importable (and runnable) without touching
+  jax at all: client/, obs/, server/, sql/, connector/, cache/,
+  adaptive/, utils/. Device code lives in ops/, exec/, parallel/, data/.
+
+Suppression: ``# lint: allow(<rule>) <reason>`` (see tools/lint).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from . import Violation, analyze_tree, qualified_name
+
+# packages under trino_tpu/ that must never import jax.numpy: the host
+# tier (planning, protocol, observability, caching) imports in
+# docs-gate/CI environments and on coordinator-only processes
+HOST_ONLY_PACKAGES = (
+    "trino_tpu/client/", "trino_tpu/obs/", "trino_tpu/server/",
+    "trino_tpu/sql/", "trino_tpu/connector/", "trino_tpu/cache/",
+    "trino_tpu/adaptive/", "trino_tpu/utils/",
+)
+
+
+# jnp attributes whose CALLS stay on the host: dtype/shape introspection
+# returns plain Python objects, never device arrays — `jnp.dtype(jnp.int8)`
+# and `jnp.iinfo(dtype).max` at module level are fine, `jnp.uint64(0)` is
+# the bug
+_HOST_SAFE_ATTRS = {
+    "dtype", "issubdtype", "iinfo", "finfo", "result_type",
+    "promote_types", "can_cast", "isdtype", "shape", "ndim",
+}
+
+
+def _is_type_checking_test(test: ast.AST) -> bool:
+    qn = qualified_name(test)
+    return qn in ("TYPE_CHECKING", "typing.TYPE_CHECKING")
+
+
+def _runtime_walk(tree: ast.Module):
+    """ast.walk, minus ``if TYPE_CHECKING:`` bodies — those never execute
+    at runtime, so imports there are jax-free by this rule's own
+    rationale (the else branch DOES run and is kept)."""
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ast.If) and _is_type_checking_test(node.test):
+            stack.extend(node.orelse)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _jnp_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to the ``jax.numpy`` MODULE (or one of its
+    members) anywhere in the file — a lazy ``import jax.numpy as jnp``
+    inside a kernel still binds the same module. Bare ``import
+    jax.numpy`` (no asname) binds ``jax``; those uses are matched by the
+    ``jax.numpy.`` qualified prefix instead, so ``jax.jit`` et al never
+    false-positive."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy" and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+            elif node.module == "jax.numpy":
+                # `from jax.numpy import uint64` — every imported name is
+                # device-typed; treat each as an alias root
+                for a in node.names:
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _jnp_uses(node: ast.AST, aliases: Set[str],
+              skip_lambda_bodies: bool = True) -> List[ast.AST]:
+    """CALL nodes under ``node`` that materialize device values from a
+    jnp alias. Only calls count: ``jnp.ndarray`` in a type alias and
+    ``_table = {"sqrt": jnp.sqrt}`` pass function/type OBJECTS around
+    without touching the device, while ``jnp.uint64(0xFF)`` (the PR 1 bug
+    shape) builds an array — a tracer, under a trace. Lambda bodies are
+    skipped in import-time contexts (they run at call time); their
+    default args still count."""
+    hits: List[ast.AST] = []
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if skip_lambda_bodies and isinstance(n, ast.Lambda):
+            stack.extend(d for d in n.args.defaults)
+            continue
+        if isinstance(n, ast.Call):
+            qn = qualified_name(n.func)
+            if qn is not None:
+                parts = qn.split(".")
+                rooted = (parts[0] in aliases
+                          or qn.startswith("jax.numpy."))
+                if rooted and parts[-1] not in _HOST_SAFE_ATTRS:
+                    hits.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return hits
+
+
+def _is_property(fn: ast.FunctionDef) -> bool:
+    for d in fn.decorator_list:
+        qn = qualified_name(d)
+        if qn in ("property", "functools.cached_property",
+                  "cached_property"):
+            return True
+    return False
+
+
+def analyze(tree: ast.Module, text: str, path: str) -> List[Violation]:
+    rel = path.replace("\\", "/")
+    violations: List[Violation] = []
+    aliases = _jnp_aliases(tree)
+
+    if any(p in rel for p in HOST_ONLY_PACKAGES):
+        for node in _runtime_walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = ([a.name for a in node.names]
+                        if isinstance(node, ast.Import)
+                        else [node.module or ""])
+                if any(m == "jax.numpy" or m.startswith("jax.numpy.")
+                       or m == "jax" for m in mods):
+                    violations.append(Violation(
+                        "jnp-in-host-module", rel, node.lineno,
+                        "host-only module imports jax.numpy — planning/"
+                        "protocol/observability code must run without a "
+                        "device (docs gates and coordinator-only "
+                        "processes import it jax-free)"))
+
+    if not aliases:
+        return violations
+
+    def flag_import_time(node: ast.AST, what: str):
+        for hit in _jnp_uses(node, aliases):
+            violations.append(Violation(
+                "import-time-jnp", rel, getattr(hit, "lineno", node.lineno),
+                f"jnp evaluated at import time ({what}) — if the first "
+                "import happens inside a jit/shard_map trace this binds a "
+                "LEAKED TRACER, not a constant; use a concrete np.* host "
+                "value (the PR 1 bug class: ops/int128.py, ops/hll.py)"))
+
+    def scan_body(body, in_class: bool):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # decorators + default args evaluate at def (import)
+                # time; the BODY runs at call time — scanned only for
+                # the repr/property host-path rule
+                for d in stmt.decorator_list:
+                    flag_import_time(d, f"decorator of {stmt.name}")
+                for d in (stmt.args.defaults
+                          + [k for k in stmt.args.kw_defaults
+                             if k is not None]):
+                    flag_import_time(d, f"default argument of {stmt.name}")
+                if in_class and (stmt.name in ("__repr__", "__str__")
+                                 or _is_property(stmt)):
+                    kind = ("property" if _is_property(stmt)
+                            else stmt.name)
+                    for hit in _jnp_uses(stmt, aliases,
+                                         skip_lambda_bodies=False):
+                        violations.append(Violation(
+                            "jnp-in-repr", rel,
+                            getattr(hit, "lineno", stmt.lineno),
+                            f"jnp used inside {kind} — repr/property "
+                            "bodies run on the host path (logging, "
+                            "debuggers, f-strings) where forcing device "
+                            "values syncs or materializes tracers"))
+            elif isinstance(stmt, ast.ClassDef):
+                for d in stmt.decorator_list:
+                    flag_import_time(d, f"decorator of {stmt.name}")
+                scan_body(stmt.body, in_class=True)
+            elif isinstance(stmt, (ast.If, ast.For, ast.While, ast.With,
+                                   ast.Try)):
+                # compound statement at import time: its NESTED BODIES
+                # stay import-time body lists (a def inside `if` is still
+                # a def — only its decorators/defaults evaluate now), its
+                # other fields (test, iter, context managers) evaluate
+                # immediately
+                for field, value in ast.iter_fields(stmt):
+                    if field in ("body", "orelse", "finalbody"):
+                        scan_body(value, in_class)
+                    elif field == "handlers":
+                        for h in value:
+                            scan_body(h.body, in_class)
+                    elif isinstance(value, ast.AST):
+                        flag_import_time(value, "module/class body")
+                    elif isinstance(value, list):
+                        for v in value:
+                            if isinstance(v, ast.AST):
+                                flag_import_time(v, "module/class body")
+            elif isinstance(stmt, ast.AnnAssign):
+                # annotations may be strings under `from __future__
+                # import annotations` — only the VALUE evaluates for sure
+                if stmt.value is not None:
+                    flag_import_time(stmt.value, "module/class body")
+            else:
+                # plain statement in a module/class body: executes at
+                # import time in full
+                flag_import_time(stmt, "module/class body")
+
+    scan_body(tree.body, in_class=False)
+    return violations
+
+
+def check(root: Optional[str] = None) -> List[str]:
+    """Gate-registry surface: formatted violations for the live tree.
+    CLI: ``python tools/lint.py --gate tracer-leak``."""
+    return [v.format() for v in analyze_tree(analyze, root)]

@@ -141,12 +141,27 @@ class AdaptivePlanner:
                     changes.append(ch)
             except Exception as e:  # noqa: BLE001 — rule-isolated
                 errors.append(f"capacity-reseed: {e}")
+        # snapshot AFTER reseeding (stamps are metadata, not structure):
+        # a structural rewrite that fails plan validation restores this —
+        # the containment contract (errored plan/adapt span + keep the
+        # pre-adaptation plan, never fail the query)
+        from trino_tpu.sql.planner.sanity import validation_enabled
+
+        validate = validation_enabled(self.session)
+        snapshot = (
+            (copy.deepcopy(frag.root), frag.partitioning)
+            if validate and has_remote_join and (join_rule_on or skew_on)
+            else None)
         if join_rule_on and has_remote_join:
             try:
                 flipped = self._maybe_flip_join(frag, by_id)
             except Exception as e:  # noqa: BLE001 — rule-isolated
                 errors.append(f"join-distribution: {e}")
                 flipped = None
+            if flipped is not None and validate:
+                flipped = self._contain_invalid(
+                    frag, by_id, snapshot, flipped,
+                    "join-distribution", errors)
             if flipped is not None:
                 frags, ch = flipped
                 new_frags.extend(frags)
@@ -156,13 +171,48 @@ class AdaptivePlanner:
         if skew_on and has_remote_join:
             try:
                 mitigated = self._maybe_mitigate_skew(frag, by_id)
-                if mitigated is not None:
-                    frags, ch = mitigated
-                    new_frags.extend(frags)
-                    changes.append(ch)
             except Exception as e:  # noqa: BLE001 — rule-isolated
                 errors.append(f"skew-mitigation: {e}")
+                mitigated = None
+            if mitigated is not None and validate:
+                mitigated = self._contain_invalid(
+                    frag, by_id, snapshot, mitigated,
+                    "skew-mitigation", errors)
+            if mitigated is not None:
+                frags, ch = mitigated
+                new_frags.extend(frags)
+                changes.append(ch)
         return new_frags, changes, errors
+
+    def _contain_invalid(self, frag, by_id, snapshot, produced, rule,
+                         errors):
+        """Validate the post-rewrite fragment graph; a PlanSanityError is
+        CONTAINED: restore the pre-adaptation plan from ``snapshot``, pull
+        the rule's new fragments back out of ``by_id``, and record the
+        error (the coordinator turns it into an errored ``plan/adapt``
+        span) — a runtime rewrite must never fail a query that would have
+        run fine unadapted. Returns ``produced`` when valid, None when
+        rolled back."""
+        from trino_tpu.sql.planner.sanity import validate_adapted
+
+        frags, _ch = produced
+        try:
+            validate_adapted(frag, frags, by_id, phase=f"adaptive:{rule}")
+        # any exception, not just PlanSanityError: a plan malformed enough
+        # to crash the walker itself (IndexError in a node property, ...)
+        # must roll back the same way — the caller swallows whatever
+        # escapes here, which would leave the half-rewritten plan live
+        except Exception as e:  # noqa: BLE001 — containment contract
+            # restore a fresh COPY: a later rule may rewrite (and fail)
+            # again, and its restore must not see this rule's mutations
+            frag.root, frag.partitioning = (
+                copy.deepcopy(snapshot[0]), snapshot[1])
+            for f in frags:
+                by_id.pop(f.id, None)
+            errors.append(f"{rule}: contained plan-validation failure "
+                          f"(pre-adaptation plan kept): {e}")
+            return None
+        return produced
 
     # --------------------------------------------- rule 2: reseed sources
     def _reseed_sources(self, frag: PlanFragment) -> Optional[PlanChange]:
@@ -221,32 +271,39 @@ class AdaptivePlanner:
             # the adaptive decision IS the planner's own rule on actuals
             prev_stamp = right.runtime_rows
             right.runtime_rows = actual
-            decision = reoptimize_distribution(
-                self.session, j, self.n_workers)
-            if (right.exchange_type == "broadcast"
-                    and decision == "partitioned"
-                    and frag.partitioning == "source"
-                    and self._scans_confined_to_probe(frag, j)):
-                build_root = copy.deepcopy(bfrag.root)
-                frags = adapt_broadcast_to_partitioned(
-                    frag, j, build_root, self.id_alloc)
-                desc = "broadcast->partitioned"
-            elif (right.exchange_type == "partitioned"
-                  and frag.partitioning == "hash"
-                  and decision == "broadcast"):
-                build_root = copy.deepcopy(bfrag.root)
-                frags = adapt_partitioned_to_broadcast(
-                    frag, j, build_root, self.id_alloc)
-                desc = "partitioned->broadcast"
-            else:
-                # actuals agree with the scheduled shape: no change — and
-                # the stamp used to decide must not leak into the plan
-                # unless the user opted into reseeding (the flip itself is
-                # always audited via its PlanChange, stamp included)
-                if not bool(self.props.get("adaptive_capacity_reseed",
-                                           False)):
-                    right.runtime_rows = prev_stamp
-                continue
+            try:
+                decision = reoptimize_distribution(
+                    self.session, j, self.n_workers)
+                if (right.exchange_type == "broadcast"
+                        and decision == "partitioned"
+                        and frag.partitioning == "source"
+                        and self._scans_confined_to_probe(frag, j)):
+                    build_root = copy.deepcopy(bfrag.root)
+                    frags = adapt_broadcast_to_partitioned(
+                        frag, j, build_root, self.id_alloc)
+                    desc = "broadcast->partitioned"
+                elif (right.exchange_type == "partitioned"
+                      and frag.partitioning == "hash"
+                      and decision == "broadcast"):
+                    build_root = copy.deepcopy(bfrag.root)
+                    frags = adapt_partitioned_to_broadcast(
+                        frag, j, build_root, self.id_alloc)
+                    desc = "partitioned->broadcast"
+                else:
+                    # actuals agree with the scheduled shape: no change —
+                    # and the stamp used to decide must not leak into the
+                    # plan unless the user opted into reseeding (the flip
+                    # itself is always audited via its PlanChange, stamp
+                    # included)
+                    if not bool(self.props.get("adaptive_capacity_reseed",
+                                               False)):
+                        right.runtime_rows = prev_stamp
+                    continue
+            except Exception:
+                # a crashed rule must not leak the stamp either: the
+                # caller records the error and the plan stays as-was
+                right.runtime_rows = prev_stamp
+                raise
             change = PlanChange(
                 version=self._next_version(), rule="join-distribution",
                 fragment=frag.id, description=desc,

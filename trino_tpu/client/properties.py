@@ -181,6 +181,17 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 8, lambda v: None if v >= 0 else "must be >= 0",
         ),
         PropertyMetadata(
+            "plan_validation",
+            "run the plan-IR sanity checker (sql/planner/sanity.py) after "
+            "initial planning, after each optimizer pass, after "
+            "fragmentation, and after every adaptive re-plan — a bad "
+            "rewrite fails loudly at plan time instead of corrupting "
+            "results (reference: PlanSanityChecker between optimizer "
+            "stages); default (unset) = AUTO: on under pytest, off "
+            "otherwise",
+            bool, None,
+        ),
+        PropertyMetadata(
             "query_max_history",
             "completed-query records the coordinator history ring retains "
             "for system.runtime.queries and the /ui recent-queries table "

@@ -384,6 +384,16 @@ ADAPTIVE_SKEW_HOT_PARTITIONS = REGISTRY.counter(
     "hot partitions salted by the adaptive skew mitigation (spread on "
     "the probe producer, replicated on the build producer)")
 
+# plan-IR sanity checking (sql/planner/sanity.py): invariant violations
+# caught at plan time, labeled by the phase family that produced the bad
+# plan (initial-plan | optimizer | fragmentation | adaptive). During
+# adaptive re-planning a failure is CONTAINED (the pre-adaptation plan is
+# kept, the query never fails), so this counter is the only loud signal.
+PLAN_VALIDATION_FAILURES = REGISTRY.counter(
+    "trino_tpu_plan_validation_failures_total",
+    "plan invariant violations raised by the plan-IR sanity checker",
+    ("phase",))
+
 # latency distribution per terminal state (the per-state query histogram)
 QUERY_SECONDS = REGISTRY.histogram(
     "trino_tpu_query_seconds",

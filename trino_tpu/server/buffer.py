@@ -60,6 +60,7 @@ class OutputBuffer:
             assert not self._complete, "enqueue after set_complete"
             # block while over the watermark (unless aborted — a dead
             # consumer must not wedge the producer forever)
+            # lint: allow(blocking-under-lock) Condition.wait_for RELEASES the lock while blocked; this IS the backpressure
             ok = self._cond.wait_for(
                 lambda: self._aborted is not None
                 or self._bytes < self._max_bytes,
@@ -108,6 +109,7 @@ class OutputBuffer:
                 raise ValueError(f"buffer id {buffer_id} out of range")
             self._acked[buffer_id] = max(self._acked[buffer_id], token)
             self._gc_locked()
+            # lint: allow(blocking-under-lock) Condition.wait_for RELEASES the lock; long-poll until a page lands
             self._cond.wait_for(
                 lambda: self._aborted or self._complete or self._base + len(self._pages) > token,
                 timeout,

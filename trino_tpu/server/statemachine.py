@@ -67,6 +67,7 @@ class StateMachine(Generic[S]):
 
     def wait_for_terminal(self, timeout: Optional[float] = None) -> S:
         with self._cond:
+            # lint: allow(blocking-under-lock) Condition.wait_for RELEASES the lock; set()/compare_and_set never block
             self._cond.wait_for(lambda: self._state in self._terminal, timeout)
             return self._state
 

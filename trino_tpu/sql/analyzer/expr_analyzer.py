@@ -61,20 +61,29 @@ def _timestamp_literal(text: str) -> ir.Constant:
     trailing offset makes it WITH TIME ZONE, normalized to UTC storage
     (reference: TimestampType literal analysis)."""
     s = text.strip().replace(" ", "T", 1) if " " in text.strip() else text.strip()
+    frac = ""
+    parse_s = s
+    dot = s.find(".")
+    if dot > 0:
+        head, tail = s[:dot], s[dot + 1:]
+        rest = ""
+        for i, c in enumerate(tail):
+            if not c.isdigit():
+                frac, rest = tail[:i], tail[i:]
+                break
+        else:
+            frac = tail
+        if frac:
+            # SQL allows 1..12 fractional digits but Python 3.10's
+            # fromisoformat accepts exactly 3 or 6 — normalize for the
+            # parse only; `frac` keeps the written digits for precision
+            # inference (and the p=9 sub-microsecond remainder below)
+            norm = frac[:6].ljust(6 if len(frac) > 3 else 3, "0")
+            parse_s = f"{head}.{norm}{rest}"
     try:
-        v = datetime.datetime.fromisoformat(s)
+        v = datetime.datetime.fromisoformat(parse_s)
     except ValueError:
         raise AnalysisError(f"invalid timestamp literal {text!r}") from None
-    frac = ""
-    if "." in s:
-        tail = s.split(".", 1)[1]
-        frac = "".join(c for c in tail if c.isdigit())
-        # fromisoformat keeps at most 6 digits; count the written ones
-        for sep in ("+", "-", "Z"):
-            i = tail.find(sep, 1)
-            if i > 0:
-                frac = "".join(c for c in tail[:i] if c.isdigit())
-                break
     p = 0 if not frac else (3 if len(frac) <= 3 else (6 if len(frac) <= 6 else 9))
     with_tz = v.tzinfo is not None
     if with_tz:

@@ -319,6 +319,11 @@ def fragment_plan(root: P.OutputNode, session=None) -> List[PlanFragment]:
             root.column_names,
         )
     fragments.append(PlanFragment(next(_frag_ids), "single", out))
+    from trino_tpu.sql.planner.sanity import (
+        validate_fragments, validation_enabled)
+
+    if validation_enabled(session):
+        validate_fragments(fragments, phase="fragmentation")
     return fragments
 
 

@@ -27,10 +27,21 @@ from trino_tpu.sql.planner.planner import combine_conjuncts, ir_conjuncts
 
 
 def optimize(root: P.OutputNode, session=None) -> P.OutputNode:
+    # plan-IR sanity checking between passes (reference: PlanSanityChecker
+    # interposed on every PlanOptimizer): a pass that breaks a channel
+    # invariant is named by the failing phase instead of corrupting rows
+    from trino_tpu.sql.planner.sanity import checker
+
+    check = checker(session)
+    check(root, "initial-plan")
     node = push_predicates(root.source, [])
+    check(node, "optimizer:push_predicates")
     node = orient_joins(node, session)
+    check(node, "optimizer:orient_joins")
     node, _ = prune_channels(node, set(range(len(node.output_types))))
+    check(node, "optimizer:prune_channels")
     node = merge_identity_projects(node)
+    check(node, "optimizer:merge_identity_projects")
     # local rewrites run as memo-resident rules to fixpoint (reference:
     # IterativeOptimizer + rule/ — the scaling path for new rewrites;
     # the passes above stay whole-tree, as PredicatePushDown does there)
@@ -38,11 +49,16 @@ def optimize(root: P.OutputNode, session=None) -> P.OutputNode:
     from trino_tpu.sql.planner.rules import DEFAULT_RULES
 
     node = IterativeOptimizer(DEFAULT_RULES).optimize(node, session)
+    check(node, "optimizer:iterative_rules")
     derive_scan_constraints(node)
     plan_dynamic_filters(node)
+    check(node, "optimizer:dynamic_filters")
     if session is not None:
         node = insert_compactions(node, session)
-    return P.OutputNode(node, root.column_names)
+        check(node, "optimizer:insert_compactions")
+    out = P.OutputNode(node, root.column_names)
+    check(out, "optimizer:output")
+    return out
 
 
 # ------------------------------------------------------- compaction pass

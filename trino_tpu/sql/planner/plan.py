@@ -245,7 +245,21 @@ class AggregationNode(PlanNode):
 
     @property
     def output_names(self):
-        return list(self.names)
+        if self.step != "partial":
+            return list(self.names)
+        # partial output carries one column PER ACCUMULATOR STATE (an avg
+        # ships (sum, count)), so names expand to match — the sanity
+        # checker's arity invariant (sql/planner/sanity.py) holds on every
+        # node, partials included
+        k = len(self.group_channels)
+        out = list(self.names[:k])
+        for name, agg in zip(self.names[k:], self.aggregates):
+            n_states = _acc_state_count(agg)
+            if n_states == 1:
+                out.append(name)
+            else:
+                out.extend(f"{name}$s{i}" for i in range(n_states))
+        return out
 
 
 def _acc_types(agg: AggregateCall, src_types) -> List[T.Type]:
