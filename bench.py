@@ -161,7 +161,11 @@ def _session_for(name: str):
     from trino_tpu import Session
 
     catalog, schema, _key = SPECS[name]
-    return Session(properties={"catalog": catalog, "schema": schema})
+    # device cache ON: the cold build populates the warm-HBM table cache,
+    # and a second build measures the warm staging path (warm_seconds) —
+    # the repeat-traffic story BENCH tracks round over round
+    return Session(properties={"catalog": catalog, "schema": schema,
+                               "device_cache_enabled": True})
 
 
 def _build(session, name: str):
@@ -375,12 +379,31 @@ def _bench_query(session, name: str):
         "seconds": round(total, 5),
         "device_seconds": round(per, 5),
         "staging_df_s": prof["staging_df_s"],
+        "cold_staging_s": round(getattr(cq, "staging_s", 0.0), 4),
         "rows_per_sec": round(prof["rows"] / total, 1),
         "input_gbytes_per_sec": round(prof["bytes"] / total / 1e9, 2),
         "device_gbytes_per_sec": round(device_bw / 1e9, 2),
         "mode": mode,
         "sanity": sanity,
     }
+    # warm staging: rebuild against the now-populated device cache and
+    # time the staging loop alone — the BENCH_r* trajectory's warm-serving
+    # signal (trino_tpu/devcache/; budget permitting this is ~0). Both
+    # keys are always set together so the per-query record shape is
+    # stable across success, failure, and budget-skip.
+    out["warm_seconds"] = None
+    out["warm_cache_hits"] = None
+    if _remaining() > 45:
+        try:
+            t0 = time.time()
+            cq2, _prof2, _ = _build(session, name)
+            out["warm_seconds"] = round(getattr(cq2, "staging_s", 0.0), 4)
+            out["warm_cache_hits"] = int(getattr(cq2, "cache_hits", 0))
+            _log(f"{name}: warm rebuild {time.time() - t0:.1f}s "
+                 f"(staging {out['warm_seconds'] * 1000:.0f}ms, "
+                 f"{out['warm_cache_hits']} cache hits)")
+        except Exception as e:  # noqa: BLE001 — warm probe must not lose the run
+            _log(f"{name}: warm rebuild failed: {str(e)[:120]}")
     _log(f"{name}: {total * 1000:.1f} ms/run ({per * 1000:.1f} device)  "
          f"{prof['rows'] / total / 1e6:.1f}M rows/s  [{mode}]")
     return out

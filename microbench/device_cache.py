@@ -1,0 +1,165 @@
+"""Device table cache microbench: cold vs warm staging on TPC-H Q3.
+
+Two parts, both through the compiled tier with the device cache ON:
+
+- **Ratio** (the PR's acceptance bound): TPC-H Q3 against the tpch
+  generator catalog — the COLD build pays the real staging pipeline
+  (column generation, phase-1 dynamic-filter pruning, host->device
+  transfer; the exact tax BENCH_r05 measured at 22.7 s for q3_sf10),
+  the WARM build must serve every scan from the warm-HBM pool: zero
+  freshly staged rows, 100% hit rate, and warm staging wall <=
+  ``WARM_RATIO_MAX`` x cold.
+- **Invalidation** (count-based, timing-free): the same q3 shape on
+  memory-connector tables; an INSERT moves the connector's
+  ``data_version`` and the next build must RE-STAGE the mutated table
+  while the untouched dimensions stay warm.
+
+Writes DEVCACHE.json next to the other bench artifacts so the BENCH_r*
+trajectory tracks warm-path wins.
+
+Run: python microbench/device_cache.py [tpch_schema]  (default sf0.2;
+CPU or TPU)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# self-locate the repo (see microbench/join_kernels.py: PYTHONPATH must
+# not be used on TPU runs)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WARM_RATIO_MAX = 0.1  # warm staging must be <= 0.1x cold (acceptance)
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+MINI_Q3 = """
+select l_orderkey, sum(l_price) as revenue, o_pri
+from customer, orders, lineitem
+where c_seg = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_day < 700
+group by l_orderkey, o_pri
+order by revenue desc limit 10
+"""
+
+
+def _build(session, sql):
+    from trino_tpu.exec.compiled import CompiledQuery
+    from trino_tpu.exec.query import plan_sql
+
+    root = plan_sql(session, sql)
+    t0 = time.perf_counter()
+    cq = CompiledQuery.build(session, root)
+    return cq, time.perf_counter() - t0
+
+
+def _ratio_part(schema: str) -> dict:
+    from trino_tpu.client.session import Session
+    from trino_tpu.devcache import DEVICE_CACHE
+
+    DEVICE_CACHE.invalidate_all()
+    session = Session({"catalog": "tpch", "schema": schema,
+                       "device_cache_enabled": True})
+    cold, cold_build_s = _build(session, Q3)
+    warm, warm_build_s = _build(session, Q3)
+    scans = len(cold.scan_rows)
+    return {
+        "tpch_schema": schema,
+        "scans": scans,
+        "staged_rows": int(sum(cold.scan_rows.values())),
+        "cold_build_s": round(cold_build_s, 4),
+        "cold_staging_s": round(cold.staging_s, 4),
+        "warm_build_s": round(warm_build_s, 4),
+        "warm_staging_s": round(warm.staging_s, 4),
+        "warm_cold_ratio": round(
+            warm.staging_s / cold.staging_s, 4) if cold.staging_s else 0.0,
+        "hit_rate": round(warm.cache_hits / scans, 4) if scans else 0.0,
+        "warm_fresh_staged_rows": warm.fresh_staged_rows,
+        "cache_bytes": DEVICE_CACHE.cached_bytes(),
+    }
+
+
+def _invalidation_part(n_lineitem: int = 200_000) -> dict:
+    from trino_tpu import types as T
+    from trino_tpu.client.session import Session
+
+    rng = np.random.default_rng(11)
+    session = Session({"catalog": "memory", "schema": "db",
+                       "device_cache_enabled": True})
+    mem = session.catalogs["memory"]
+    n_cust, n_ord = n_lineitem // 30, n_lineitem // 4
+    mem.create_table(
+        "db", "customer", [("c_custkey", T.BIGINT), ("c_seg", T.VARCHAR)],
+        [(i, "BUILDING" if i % 5 == 0 else "MACHINERY")
+         for i in range(n_cust)])
+    mem.create_table(
+        "db", "orders",
+        [("o_orderkey", T.BIGINT), ("o_custkey", T.BIGINT),
+         ("o_day", T.BIGINT), ("o_pri", T.BIGINT)],
+        [(i, int(rng.integers(0, n_cust)), int(rng.integers(0, 1000)), i % 3)
+         for i in range(n_ord)])
+    mem.create_table(
+        "db", "lineitem",
+        [("l_orderkey", T.BIGINT), ("l_price", T.BIGINT)],
+        [(int(rng.integers(0, n_ord)), int(rng.integers(1, 1000)))
+         for _ in range(n_lineitem)])
+    cold, _ = _build(session, MINI_Q3)
+    r_cold = cold.run().to_pylist()
+    warm, _ = _build(session, MINI_Q3)
+    r_warm = warm.run().to_pylist()
+    assert r_cold == r_warm, (r_cold, r_warm)
+    session.execute("insert into lineitem values (0, 1)")
+    after_dml, _ = _build(session, MINI_Q3)
+    return {
+        "warm_fresh_staged_rows": warm.fresh_staged_rows,
+        "warm_hits": warm.cache_hits,
+        "after_dml_fresh_staged_rows": after_dml.fresh_staged_rows,
+        "after_dml_hits": after_dml.cache_hits,
+        "restages_after_dml": after_dml.fresh_staged_rows > 0,
+        "dimensions_stay_warm": after_dml.cache_hits >= 1,
+    }
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    schema = sys.argv[1] if len(sys.argv) > 1 else "sf0.2"
+    ratio = _ratio_part(schema)
+    inval = _invalidation_part()
+    report = {"warm_ratio_max": WARM_RATIO_MAX, "ratio": ratio,
+              "invalidation": inval}
+    print(json.dumps(report, indent=2))
+    assert ratio["warm_fresh_staged_rows"] == 0, "warm build transferred rows"
+    assert ratio["hit_rate"] == 1.0, f"hit rate {ratio['hit_rate']} != 1.0"
+    assert ratio["warm_cold_ratio"] <= WARM_RATIO_MAX, (
+        f"warm staging {ratio['warm_staging_s']}s > "
+        f"{WARM_RATIO_MAX}x cold {ratio['cold_staging_s']}s")
+    assert inval["restages_after_dml"], "DML write did not restore a re-stage"
+    assert inval["dimensions_stay_warm"], "DML write flushed unrelated tables"
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "DEVCACHE.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"wrote {out_path}: warm/cold staging = "
+          f"{ratio['warm_staging_s']}s/{ratio['cold_staging_s']}s "
+          f"({ratio['warm_cold_ratio']}x), hit rate {ratio['hit_rate']}")
+
+
+if __name__ == "__main__":
+    main()

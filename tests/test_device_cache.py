@@ -1,0 +1,614 @@
+"""Device table cache (trino_tpu/devcache/): the warm-HBM buffer pool.
+
+Covers the PR's acceptance matrix:
+
+- warm-run proof: a second compiled build of a q3-shaped join on
+  unchanged tables performs ZERO host->device scan transfers (staged-rows
+  stats + the device/staging span), and a DML write between runs
+  restores a full re-stage of the mutated table only;
+- invalidation matrix on the memory AND filesystem connectors:
+  INSERT/UPDATE/DELETE/DROP/CTAS each move the connector data_version ->
+  entry dropped, next query re-stages (MISS then HIT);
+- single-flight: N concurrent queries staging the same table produce ONE
+  connector scan;
+- byte-budgeted LRU eviction + eviction under memory/admission pressure
+  (the revocable-tier yield);
+- the staging-accounting satellite: STAGING_SECONDS charges exactly
+  bench's staging_df_s = phase1_s + df_apply_s;
+- bypass rules (unversioned connectors, transactions, disabled);
+- cluster-memory integration (hardware-sized admission, revocable bytes)
+  and the system.runtime tables.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.client.session import Session
+from trino_tpu.devcache import (
+    DEVICE_CACHE, CacheKey, DeviceTableCache, scan_cache_key)
+from trino_tpu.obs import metrics as M
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    DEVICE_CACHE.invalidate_all()
+    yield
+    DEVICE_CACHE.invalidate_all()
+
+
+def _counters():
+    return {
+        "hits": M.DEVICE_CACHE_HITS.value(),
+        "misses": M.DEVICE_CACHE_MISSES.value(),
+        "evictions": M.DEVICE_CACHE_EVICTIONS.value(),
+        "staged_rows": M.STAGED_ROWS.value(),
+    }
+
+
+def _delta(before):
+    now = _counters()
+    return {k: now[k] - before[k] for k in before}
+
+
+def _session(**props):
+    return Session({"catalog": "memory", "schema": "db",
+                    "device_cache_enabled": True, **props})
+
+
+def _q3_tables(session, n_lineitem=1500):
+    rng = np.random.default_rng(3)
+    n_cust, n_ord = 100, 600
+    mem = session.catalogs["memory"]
+    mem.create_table(
+        "db", "customer", [("c_custkey", T.BIGINT), ("c_seg", T.VARCHAR)],
+        [(i, "BUILDING" if i % 5 == 0 else "AUTO") for i in range(n_cust)])
+    mem.create_table(
+        "db", "orders",
+        [("o_orderkey", T.BIGINT), ("o_custkey", T.BIGINT),
+         ("o_pri", T.BIGINT)],
+        [(i, int(rng.integers(0, n_cust)), i % 3) for i in range(n_ord)])
+    mem.create_table(
+        "db", "lineitem", [("l_orderkey", T.BIGINT), ("l_price", T.BIGINT)],
+        [(int(rng.integers(0, n_ord)), int(rng.integers(1, 100)))
+         for _ in range(n_lineitem)])
+
+
+Q3 = ("select l_orderkey, sum(l_price) rev, o_pri "
+      "from customer, orders, lineitem "
+      "where c_seg = 'BUILDING' and c_custkey = o_custkey "
+      "and l_orderkey = o_orderkey group by l_orderkey, o_pri "
+      "order by rev desc limit 10")
+
+
+# ------------------------------------------------------- warm-run proof
+def test_warm_compiled_build_zero_transfer_then_dml_restages():
+    """Acceptance: cold build stages everything; warm build of the SAME
+    q3-shaped join transfers ZERO rows (stats + span agree); an INSERT
+    between runs restores a full re-stage of the mutated table while the
+    untouched dimension tables stay warm."""
+    from trino_tpu.exec.compiled import CompiledQuery
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.obs import trace as tracing
+
+    s = _session()
+    _q3_tables(s)
+    before = _counters()
+    tracer = tracing.Tracer()
+    with tracer.span("cold"):
+        cold = CompiledQuery.build(s, plan_sql(s, Q3))
+    assert cold.cache_hits == 0 and cold.fresh_staged_rows > 0
+    d = _delta(before)
+    assert d["misses"] == 3 and d["staged_rows"] == cold.fresh_staged_rows
+    r_cold = cold.run().to_pylist()
+
+    before = _counters()
+    with tracer.span("warm"):
+        warm = CompiledQuery.build(s, plan_sql(s, Q3))
+    d = _delta(before)
+    # zero host->device scan transfer: stats...
+    assert warm.fresh_staged_rows == 0
+    assert warm.cache_hits == 3 and d["hits"] == 3 and d["misses"] == 0
+    assert d["staged_rows"] == 0
+    # ...and the device/staging span agrees (the wire-visible proof)
+    staging = [sp for sp in tracer.spans() if sp.name == "device/staging"]
+    assert len(staging) == 2
+    warm_span = staging[-1]
+    assert warm_span.attributes["staged_rows"] == 0
+    assert warm_span.attributes["cache_hits"] == 3
+    lookups = [sp for sp in tracer.spans()
+               if sp.name == "device-cache/lookup"]
+    assert sum(1 for sp in lookups
+               if sp.attributes.get("result") == "hit") == 3
+    assert warm.run().to_pylist() == r_cold
+
+    # a DML write between runs restores a full re-stage of lineitem
+    s.execute("insert into lineitem values (0, 7)")
+    before = _counters()
+    third = CompiledQuery.build(s, plan_sql(s, Q3))
+    d = _delta(before)
+    assert third.fresh_staged_rows > 0  # lineitem restaged from scratch
+    assert third.cache_hits == 2 and d["misses"] == 1  # dims stay warm
+    assert d["staged_rows"] == third.fresh_staged_rows
+
+
+# --------------------------------------------------- invalidation matrix
+def _warm_then(session, sql, mutate):
+    """warm entry -> mutate -> MISS then HIT (the matrix step). Returns
+    the rows observed after the mutation. The first query may itself be a
+    HIT when a previous step's post-mutation query already re-warmed the
+    table — the invariant under test is that a WARM entry is dropped by
+    the mutation."""
+    r1 = session.execute(sql).rows  # ensure present (hit or miss)
+    before = _counters()
+    r2 = session.execute(sql).rows
+    d = _delta(before)
+    assert r1 == r2 and d["hits"] >= 1 and d["misses"] == 0  # provably warm
+    mutate()
+    before = _counters()
+    r3 = session.execute(sql).rows
+    d = _delta(before)
+    assert d["misses"] >= 1, "mutation did not invalidate the warm entry"
+    # MISS then HIT: the re-staged entry serves the next run warm
+    before = _counters()
+    assert session.execute(sql).rows == r3
+    d = _delta(before)
+    assert d["hits"] >= 1 and d["misses"] == 0
+    return r3
+
+
+def test_invalidation_matrix_memory():
+    s = _session()
+    s.catalogs["memory"].create_table(
+        "db", "t", [("a", T.BIGINT), ("b", T.BIGINT)],
+        [(i, i * 2) for i in range(500)])
+    sql = "select sum(a), sum(b), count(*) from t"
+
+    rows = _warm_then(s, sql, lambda: s.execute(
+        "insert into t values (1000, 2000)"))
+    assert rows == [(124750 + 1000, 249500 + 2000, 501)]
+    rows = _warm_then(s, sql, lambda: s.execute(
+        "update t set b = 0 where a = 1000"))
+    assert rows == [(125750, 249500, 501)]
+    rows = _warm_then(s, sql, lambda: s.execute(
+        "delete from t where a >= 250"))
+    assert rows == [(31125, 62250, 250)]
+
+    # DROP + CTAS: the version counter survives the drop, so the
+    # re-created table can never serve the old entry
+    def drop_and_ctas():
+        s.execute("drop table t")
+        s.execute("create table t as select 1 a, 2 b")
+
+    rows = _warm_then(s, sql, drop_and_ctas)
+    assert rows == [(1, 2, 1)]
+
+
+def test_invalidation_matrix_filesystem(tmp_path):
+    from trino_tpu.connector.filesystem.connector import FileSystemConnector
+
+    s = Session({"catalog": "filesystem", "schema": "lake",
+                 "device_cache_enabled": True})
+    s.catalogs["filesystem"] = FileSystemConnector(str(tmp_path))
+    s.execute("create table t as select x a, x * 2 b "
+              "from table(sequence(0, 99)) t(x)")
+    sql = "select sum(a), sum(b), count(*) from t"
+
+    rows = _warm_then(s, sql, lambda: s.execute(
+        "insert into t values (1000, 2000)"))
+    assert rows == [(4950 + 1000, 9900 + 2000, 101)]
+    rows = _warm_then(s, sql, lambda: s.execute(
+        "update t set b = 0 where a = 1000"))
+    assert rows == [(5950, 9900, 101)]
+    rows = _warm_then(s, sql, lambda: s.execute(
+        "delete from t where a >= 50"))
+    assert rows == [(1225, 2450, 50)]
+
+    def drop_and_ctas():
+        s.execute("drop table t")
+        s.execute("create table t as select 7 a, 8 b")
+
+    rows = _warm_then(s, sql, drop_and_ctas)
+    assert rows == [(7, 8, 1)]
+
+
+def test_stale_version_entries_reclaimed_promptly():
+    """A mutation's next lookup drops the dead-version entry itself (HBM
+    reclaimed immediately, not at LRU age-out)."""
+    s = _session()
+    s.catalogs["memory"].create_table(
+        "db", "t", [("a", T.BIGINT)], [(i,) for i in range(100)])
+    s.execute("select sum(a) from t")
+    assert len(DEVICE_CACHE) == 1
+    bytes_v1 = DEVICE_CACHE.cached_bytes()
+    assert bytes_v1 > 0
+    s.execute("insert into t values (1)")
+    before = _counters()
+    s.execute("select sum(a) from t")
+    assert len(DEVICE_CACHE) == 1  # v2 entry replaced v1, not stacked
+    assert _delta(before)["evictions"] >= 1
+
+
+# --------------------------------------------------------- single-flight
+def test_single_flight_concurrent_staging():
+    """N concurrent queries over the same cold table produce ONE connector
+    scan (one transfer): followers park on the leader's flight."""
+    s = _session()
+    mem = s.catalogs["memory"]
+    mem.create_table("db", "t", [("a", T.BIGINT)],
+                     [(i,) for i in range(10_000)])
+    scans = []
+    real_scan = mem.scan
+
+    def slow_scan(split, columns, constraint=None):
+        scans.append(split.table)
+        time.sleep(0.1)  # hold the flight open so followers queue
+        return real_scan(split, columns, constraint=constraint)
+
+    mem.scan = slow_scan
+    before = _counters()
+    results, errors = [], []
+
+    def run():
+        try:
+            results.append(_clone_session(s).execute(
+                "select sum(a) from t").rows)
+        except Exception as e:  # noqa: BLE001 — surfaced via the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=run) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert results == [[(49995000,)]] * 4
+    assert scans == ["t"], f"expected one staging scan, saw {scans}"
+    d = _delta(before)
+    assert d["misses"] == 1 and d["hits"] == 3
+
+
+def _clone_session(s):
+    """Same catalogs (the server-mode sharing shape), fresh Session."""
+    return Session({"catalog": "memory", "schema": "db",
+                    "device_cache_enabled": True}, catalogs=s.catalogs)
+
+
+# ------------------------------------------------------ budget/pressure
+def test_lru_eviction_under_byte_budget():
+    cache = DeviceTableCache(max_bytes=1000)
+
+    def key(i, version="v1"):
+        return CacheKey("c", "s", f"t{i}", version, "sig", "table", 1)
+
+    def load(nbytes):
+        return lambda: (object(), 10, nbytes, 1)
+
+    e0 = M.DEVICE_CACHE_EVICTIONS.value()
+    cache.lookup_or_stage(key(0), load(400))
+    cache.lookup_or_stage(key(1), load(400))
+    assert cache.cached_bytes() == 800 and len(cache) == 2
+    cache.lookup_or_stage(key(2), load(400))  # evicts t0 (LRU)
+    assert cache.cached_bytes() == 800 and len(cache) == 2
+    assert M.DEVICE_CACHE_EVICTIONS.value() - e0 == 1
+    _ent, disp = cache.lookup_or_stage(key(0), load(400))
+    assert disp == "miss"  # t0 was the victim
+    # an entry above the whole budget is served but never retained
+    cache.lookup_or_stage(key(9), load(5000))
+    assert cache.cached_bytes() <= 1000
+    _ent, disp = cache.lookup_or_stage(key(9), load(5000))
+    assert disp == "miss"
+    # the session admission cap tightens per-entry admission only
+    cache2 = DeviceTableCache(max_bytes=1000)
+    cache2.lookup_or_stage(key(5), load(600), admit_bytes=500)
+    assert len(cache2) == 0  # over the session cap: not retained
+    # ...and a tenant's tight cap can never FLUSH other tenants' warm
+    # tables: eviction always targets the shared server budget
+    cache2.lookup_or_stage(key(6), load(400))
+    cache2.lookup_or_stage(key(7), load(400))
+    cache2.lookup_or_stage(key(8), load(100), admit_bytes=150)
+    assert cache2.cached_bytes() == 900 and len(cache2) == 3
+
+
+def test_single_flight_follower_bypasses_stuck_leader():
+    """A follower that outwaits FLIGHT_WAIT_S stages privately instead of
+    hanging behind a wedged leader forever."""
+    cache = DeviceTableCache(max_bytes=10_000)
+    cache.FLIGHT_WAIT_S = 0.05
+    key = CacheKey("c", "s", "t", "v1", "sig", "table", 1)
+    release = threading.Event()
+
+    def stuck_loader():
+        release.wait(10.0)  # the wedged connector read
+        return object(), 1, 100, 1
+
+    leader = threading.Thread(
+        target=lambda: cache.lookup_or_stage(key, stuck_loader))
+    leader.start()
+    time.sleep(0.05)  # let the leader take the flight
+    t0 = time.time()
+    ent, disp = cache.lookup_or_stage(key, lambda: ("mine", 1, 100, 1))
+    assert disp == "miss" and ent.value == "mine"
+    assert time.time() - t0 < 5.0  # bypassed, not parked behind the leader
+    release.set()
+    leader.join(timeout=10.0)
+
+
+def test_cache_yields_to_query_under_spill_pressure():
+    """The revocable-tier contract: a query whose working set exceeds its
+    budget reclaims warm-table HBM before partitioning its spill."""
+    from trino_tpu.exec.memory import MemoryContext
+
+    s = _session()
+    s.catalogs["memory"].create_table(
+        "db", "t", [("a", T.BIGINT)], [(i,) for i in range(1000)])
+    s.execute("select sum(a) from t")
+    assert DEVICE_CACHE.cached_bytes() > 0
+    e0 = M.DEVICE_CACHE_EVICTIONS.value()
+    ctx = MemoryContext(budget_bytes=1024)
+    parts = ctx.spill_partitions(1 << 20)  # far over budget: pressure
+    assert parts > 1
+    assert DEVICE_CACHE.cached_bytes() == 0  # cache yielded everything
+    assert M.DEVICE_CACHE_EVICTIONS.value() > e0
+
+
+def test_worker_pool_yield_math():
+    """yield_bytes frees at least the requested overage, LRU first."""
+    cache = DeviceTableCache(max_bytes=10_000)
+    for i in range(5):
+        cache.lookup_or_stage(
+            CacheKey("c", "s", f"t{i}", "v1", "sig", "table", 1),
+            lambda: (object(), 1, 1000, 1))
+    assert cache.cached_bytes() == 5000
+    freed = cache.yield_bytes(1500)
+    assert freed == 2000 and cache.cached_bytes() == 3000
+    # remaining entries are the MRU ones
+    left = {e["table"] for e in cache.snapshot()}
+    assert left == {"t2", "t3", "t4"}
+
+
+# --------------------------------------------------- accounting satellite
+def test_staging_seconds_accounting():
+    """Satellite: STAGING_SECONDS charges exactly bench's staging_df_s
+    definition — phase1_s + df_apply_s (the drift the old code had:
+    phase1_s + staging wall, with df_apply_s never added)."""
+    from trino_tpu.exec.compiled import CompiledQuery
+    from trino_tpu.exec.query import plan_sql
+
+    s = _session()
+    _q3_tables(s)
+    before = M.STAGING_SECONDS.value()
+    cq = CompiledQuery.build(s, plan_sql(s, Q3))
+    delta = M.STAGING_SECONDS.value() - before
+    assert delta == pytest.approx(cq.phase1_s + cq.df_apply_s, abs=1e-9)
+
+
+# ----------------------------------------------------------- bypass rules
+def test_bypass_rules():
+    # disabled sessions never touch the cache
+    s_off = Session({"catalog": "memory", "schema": "db"})
+    s_off.catalogs["memory"].create_table(
+        "db", "t", [("a", T.BIGINT)], [(1,)])
+    before = _counters()
+    s_off.execute("select a from t")
+    d = _delta(before)
+    assert d["hits"] == d["misses"] == 0 and len(DEVICE_CACHE) == 0
+
+    # unversioned connectors (the live system catalog) always bypass
+    s = _session()
+    before = _counters()
+    s.execute("select count(*) from system.metrics.metrics")
+    d = _delta(before)
+    assert d["hits"] == d["misses"] == 0 and len(DEVICE_CACHE) == 0
+
+    # active transactions bypass (overlay state is unversioned)
+    s.catalogs["memory"].create_table(
+        "db", "tx", [("a", T.BIGINT)], [(1,), (2,)])
+    s.execute("start transaction")
+    before = _counters()
+    assert s.execute("select sum(a) from tx").rows == [(3,)]
+    d = _delta(before)
+    assert d["hits"] == d["misses"] == 0 and len(DEVICE_CACHE) == 0
+    s.execute("rollback")
+
+
+def test_private_catalogs_never_alias():
+    """Two sessions with PRIVATE memory catalogs hold same-named tables at
+    the same version counter — the per-instance connector token keeps
+    their entries apart."""
+    s1 = _session()
+    s2 = _session()  # fresh default catalogs: a different connector
+    s1.catalogs["memory"].create_table(
+        "db", "t", [("a", T.BIGINT)], [(1,)])
+    s2.catalogs["memory"].create_table(
+        "db", "t", [("a", T.BIGINT)], [(42,)])
+    assert s1.execute("select a from t").rows == [(1,)]
+    assert s2.execute("select a from t").rows == [(42,)]  # not s1's page
+    assert len(DEVICE_CACHE) == 2
+
+
+def test_signature_partitions_projection_and_constraint():
+    s = _session()
+    s.catalogs["memory"].create_table(
+        "db", "t", [("a", T.BIGINT), ("b", T.BIGINT)],
+        [(i, i * 2) for i in range(100)])
+    s.execute("select a from t")
+    s.execute("select a, b from t")  # wider projection: its own entry
+    s.execute("select a from t where a < 10")  # pushed constraint differs
+    assert len(DEVICE_CACHE) >= 2
+    sigs = {(e["table"], e["signature"]) for e in DEVICE_CACHE.snapshot()}
+    assert len(sigs) == len(DEVICE_CACHE)
+
+
+# ------------------------------------------------------------ SPMD tier
+def test_spmd_sharded_staging_warm():
+    import jax
+
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.parallel.spmd import stage_sharded_scans
+
+    s = _session()
+    s.catalogs["memory"].create_table(
+        "db", "t", [("a", T.BIGINT)], [(i,) for i in range(1000)])
+    mem = s.catalogs["memory"]
+    calls = []
+    real_scan = mem.scan
+    mem.scan = lambda *a, **k: (calls.append(1), real_scan(*a, **k))[1]
+    root = plan_sql(s, "select sum(a) from t")
+    n_dev = min(8, len(jax.devices()))
+    staged1, specs1 = stage_sharded_scans(s, root, n_dev)
+    cold_calls = len(calls)
+    assert cold_calls >= 1
+    root2 = plan_sql(s, "select sum(a) from t")
+    staged2, specs2 = stage_sharded_scans(s, root2, n_dev)
+    assert len(calls) == cold_calls  # zero connector work on the warm run
+    (k1,) = staged1.keys()
+    (k2,) = staged2.keys()
+    assert all(a is b for a, b in zip(staged1[k1], staged2[k2]))
+    # a DIFFERENT mesh width is a different shard: it must re-stage
+    stage_sharded_scans(s, plan_sql(s, "select sum(a) from t"), 1)
+    assert len(calls) > cold_calls
+
+
+# ---------------------------------------------------------- worker tier
+def test_fragment_executor_split_scans_warm():
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.server.task import FragmentExecutor
+    from trino_tpu.sql.planner import plan as P
+
+    s = _session()
+    mem = s.catalogs["memory"]
+    mem.create_table("db", "t", [("a", T.BIGINT)],
+                     [(i,) for i in range(1000)])
+    root = plan_sql(s, "select sum(a) from t")
+    (scan,) = [n for n in P.walk_plan(root)
+               if isinstance(n, P.TableScanNode)]
+    splits = mem.get_splits("db", "t", 2)
+    calls = []
+    real_scan = mem.scan
+    mem.scan = lambda *a, **k: (calls.append(1), real_scan(*a, **k))[1]
+
+    ex1 = FragmentExecutor(s, {scan.id: splits}, {})
+    p1 = ex1.execute(scan)
+    assert ex1.scan_cache[scan.id] == "miss"
+    cold_calls = len(calls)
+    ex2 = FragmentExecutor(s, {scan.id: splits}, {})
+    p2 = ex2.execute(scan)
+    assert ex2.scan_cache[scan.id] == "hit"
+    assert len(calls) == cold_calls  # no connector work: warm split set
+    assert p2 is p1  # the identical resident page
+    # a different split assignment is a different shard key
+    ex3 = FragmentExecutor(s, {scan.id: splits[:1]}, {})
+    ex3.execute(scan)
+    assert ex3.scan_cache[scan.id] == "miss"
+
+
+# ---------------------------------------- cluster memory + system tables
+def test_cluster_memory_hardware_sizing_and_revocable():
+    from trino_tpu.server.cluster_memory import ClusterMemoryManager
+
+    kills = []
+    m = ClusterMemoryManager(kill=lambda q, r: kills.append(q))
+    # no configured limit + no announced capacity = unlimited (CPU mesh)
+    m.update("w0", {"queryMemory": {}, "memoryBytes": 0,
+                    "memoryLimit": None})
+    assert m.effective_limit() is None and m.has_headroom()
+    # announced HBM sizes admission from real hardware
+    m.update("w0", {"queryMemory": {"q": 900}, "memoryBytes": 900,
+                    "memoryLimit": None, "deviceMemoryBytes": 1000,
+                    "deviceCacheBytes": 400})
+    # partial discovery (one worker cannot report HBM) must NOT produce
+    # an understated ceiling: admission falls back to unlimited
+    m.update("w1", {"queryMemory": {}, "memoryBytes": 0,
+                    "memoryLimit": None})
+    assert m.effective_limit() is None and m.has_headroom()
+    m.update("w1", {"queryMemory": {}, "memoryBytes": 0,
+                    "memoryLimit": None, "deviceMemoryBytes": 1000})
+    assert m.effective_limit() == 2000
+    assert m.revocable_bytes() == 400
+    assert m.has_headroom()  # cache bytes never count against headroom
+    # a single query's spill PROJECTION beyond one node's HBM is clamped
+    # at that node's capacity: it cannot consume the other node's headroom
+    m.update("w0", {"queryMemory": {"q": 64_000}, "memoryBytes": 64_000,
+                    "memoryLimit": None, "deviceMemoryBytes": 1000,
+                    "deviceCacheBytes": 400})
+    assert m.has_headroom()  # clamped to 1000 of 2000: w1 still has room
+    m.update("w1", {"queryMemory": {"q2": 1200}, "memoryBytes": 1200,
+                    "memoryLimit": None, "deviceMemoryBytes": 1000})
+    assert not m.has_headroom()  # both nodes saturated (1000 + 1000)
+    # a configured cluster limit wins over announced capacity (and gates
+    # on RAW reservations — the operator chose the ceiling deliberately)
+    m.cluster_limit_bytes = 100_000
+    assert m.effective_limit() == 100_000 and m.has_headroom()
+    m.cluster_limit_bytes = 5000
+    assert not m.has_headroom()  # 65200 raw reserved >= 5000
+    assert not kills  # admission pressure alone never kills
+
+
+def test_nodes_table_shows_device_memory_and_cache():
+    import types as pytypes
+
+    from trino_tpu.server.coordinator import NodeRegistry
+    from trino_tpu.server.system_tables import CoordinatorSystemTables
+
+    reg = NodeRegistry()
+    reg.announce("w0", "http://x", {
+        "tasks": 1, "memoryBytes": 10, "memoryLimit": 100,
+        "deviceMemoryBytes": 16 << 30, "deviceCacheBytes": 12345,
+        "version": "t"})
+    reg.announce("w1", "http://y", {"tasks": 0, "memoryBytes": 0,
+                                    "memoryLimit": None})
+    tables = CoordinatorSystemTables(
+        pytypes.SimpleNamespace(registry=reg))
+    rows = {r[0]: r for r in tables.snapshot_rows("runtime", "nodes")}
+    assert rows["w0"][7] == 16 << 30 and rows["w0"][8] == 12345
+    assert rows["w1"][7] is None and rows["w1"][8] == 0
+
+
+def test_device_cache_system_table():
+    s = _session()
+    s.catalogs["memory"].create_table(
+        "db", "t", [("a", T.BIGINT)], [(i,) for i in range(64)])
+    s.execute("select sum(a) from t")
+    s.execute("select sum(a) from t")
+    rows = s.execute(
+        "select catalog, schema_name, table_name, shard, entry_bytes, "
+        "rows, hits from system.runtime.device_cache").rows
+    assert ("memory", "db", "t") == rows[0][:3]
+    assert rows[0][3] == "table"
+    assert rows[0][4] > 0 and rows[0][5] == 64 and rows[0][6] == 1
+
+
+def test_worker_announce_carries_device_fields():
+    """The worker announce loop ships deviceMemoryBytes/deviceCacheBytes
+    and sheds cache when queries + warm tables overflow the pool."""
+    from trino_tpu import devcache
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    cell = devcache.cache._device_memory_cell
+    saved = list(cell)
+    cell[:] = [4 << 30]  # pretend the backend reported 4 GiB
+    coord = CoordinatorServer()
+    coord.start()
+    w = WorkerServer(coordinator_url=coord.base_url, node_id="devcw")
+    w.start()
+    try:
+        assert coord.registry.wait_for_workers(1, timeout=15.0)
+        deadline = time.monotonic() + 10.0
+        info = {}
+        while time.monotonic() < deadline:
+            snap = {n["nodeId"]: n for n in coord.registry.snapshot()}
+            info = snap.get("devcw", {}).get("info", {})
+            if "deviceMemoryBytes" in info:
+                break
+            time.sleep(0.05)
+        assert info.get("deviceMemoryBytes") == 4 << 30
+        assert "deviceCacheBytes" in info
+        assert coord.cluster_memory.effective_limit() == 4 << 30
+    finally:
+        cell[:] = saved
+        w.stop()
+        coord.stop()

@@ -87,6 +87,9 @@ def render_summary(stats) -> str:
         # the runtime re-planner rewrote fragments mid-query (details:
         # planVersions on GET /v1/query/{id})
         parts.append(f"adapted: {stats['adaptations']} plan change(s)")
+    if stats.get("deviceCacheHits"):
+        # scans served warm from the device table cache (zero transfer)
+        parts.append(f"warm scans: {stats['deviceCacheHits']}")
     return f" [{', '.join(parts)}]" if parts else ""
 
 

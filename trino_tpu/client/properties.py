@@ -145,6 +145,23 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             bool, True,
         ),
         PropertyMetadata(
+            "device_cache_enabled",
+            "serve repeated table stagings from the device-resident table "
+            "cache (trino_tpu/devcache/): staged scan pages stay warm in "
+            "device memory keyed by connector data_version, so an "
+            "unchanged table's second query pays zero host->device scan "
+            "transfer; unversioned connectors always bypass",
+            bool, False,
+        ),
+        PropertyMetadata(
+            "device_cache_max_bytes",
+            "per-staging admission cap against the device table cache: "
+            "entries above min(this, the server-wide budget) are staged "
+            "but not retained (the shared budget itself is fixed at "
+            "process scope — one session cannot resize it)",
+            int, 1 << 30, _positive,
+        ),
+        PropertyMetadata(
             "adaptive_execution_enabled",
             "re-plan not-yet-scheduled downstream fragments between stage "
             "completions using the runtime operator-stats rollups (master "

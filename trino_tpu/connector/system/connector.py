@@ -27,7 +27,24 @@ from trino_tpu.connector.system.schemas import (
     SYSTEM_CATALOG, SYSTEM_PROCEDURES, SYSTEM_TABLES)
 
 __all__ = ["SystemConnector", "SYSTEM_CATALOG", "SYSTEM_TABLES",
-           "SYSTEM_PROCEDURES", "metric_sample_rows"]
+           "SYSTEM_PROCEDURES", "device_cache_rows", "metric_sample_rows"]
+
+
+def device_cache_rows() -> List[tuple]:
+    """THIS process's device-table-cache entries as
+    ``system.runtime.device_cache`` rows (column order:
+    connector/system/schemas.py). The pool is process-global, so the
+    coordinator provider and the providerless fallback (a standalone
+    session, or a worker inspecting itself) share this one
+    materializer."""
+    from trino_tpu.devcache import DEVICE_CACHE
+
+    return [
+        (e["catalog"], e["schema"], e["table"], e["version"], e["shard"],
+         e["signature"], int(e["bytes"]), int(e["rows"]), int(e["hits"]),
+         float(e["createdAt"]), float(e["lastUsedAt"]))
+        for e in DEVICE_CACHE.snapshot()
+    ]
 
 
 def metric_sample_rows() -> List[tuple]:
@@ -109,6 +126,10 @@ class SystemConnector(spi.Connector):
             return self._provider.snapshot_rows(schema, table)
         if (schema, table) == ("metrics", "metrics"):
             return metric_sample_rows()
+        if (schema, table) == ("runtime", "device_cache"):
+            # the cache pool is process-global: even without a live
+            # provider a session can inspect its own process's entries
+            return device_cache_rows()
         return []
 
     def scan(self, split: spi.Split, columns: List[str],

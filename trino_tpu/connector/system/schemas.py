@@ -67,7 +67,26 @@ SYSTEM_TABLES = {
         ("tasks", "bigint"),
         ("memory_used_bytes", "bigint"),
         ("memory_limit_bytes", "bigint"),
+        ("device_memory_bytes", "bigint"),  # announced HBM capacity; NULL
+                                            # when not discoverable (CPU)
+        ("device_cache_bytes", "bigint"),   # warm-table bytes (revocable)
         ("heartbeat_age_ms", "bigint"),
+    ),
+    # the device table cache (trino_tpu/devcache/): one row per resident
+    # warm-HBM entry of THIS process's pool (the coordinator's when a
+    # provider is attached; any process can inspect its own)
+    ("runtime", "device_cache"): (
+        ("catalog", "varchar"),
+        ("schema_name", "varchar"),
+        ("table_name", "varchar"),
+        ("data_version", "varchar"),
+        ("shard", "varchar"),          # table | splits:N:... | spmd:N
+        ("signature", "varchar"),      # projection/pruning digest
+        ("entry_bytes", "bigint"),
+        ("rows", "bigint"),
+        ("hits", "bigint"),
+        ("created_at", "double"),      # epoch seconds
+        ("last_used_at", "double"),
     ),
     # every touched series of the typed metrics registry as rows — the jmx
     # connector's role; /v1/metrics stays the Prometheus surface

@@ -1,0 +1,22 @@
+"""Device-resident table cache (warm-HBM buffer pool).
+
+Public surface: the process-global :data:`DEVICE_CACHE` pool, the key
+constructors consulted by the three staging tiers (eager/compiled scans
+in ``exec/executor.py``, worker fragment scans in ``server/task.py``,
+SPMD sharded staging in ``parallel/spmd.py``), and the device-memory
+capacity probe the worker announce payload ships to the coordinator's
+``ClusterMemoryManager``.
+"""
+from trino_tpu.devcache.cache import (
+    DEVICE_CACHE, CacheEntry, CacheKey, DeviceTableCache,
+    device_memory_bytes, instance_token)
+from trino_tpu.devcache.keys import (
+    admit_budget, cache_enabled, cached_stage, scan_cache_key,
+    scan_signature, splits_shard)
+
+__all__ = [
+    "DEVICE_CACHE", "CacheEntry", "CacheKey", "DeviceTableCache",
+    "admit_budget", "cache_enabled", "cached_stage",
+    "device_memory_bytes", "instance_token", "scan_cache_key",
+    "scan_signature", "splits_shard",
+]

@@ -1,0 +1,373 @@
+"""Warm-HBM device table cache: the worker-side buffer pool.
+
+Reference role: the classical buffer pool (and Trino's split/page caching
+proposals) redesigned for the staged-execution model: the unit of caching
+is a fully staged DEVICE artifact — an assembled scan ``Page`` (eager /
+compiled tiers), a per-split worker page, or the stacked shard arrays of
+an SPMD scan — so a warm query skips the whole host pipeline (connector
+scan, dynamic-domain pruning, dictionary merge, host->device transfer),
+which BENCH_r05 measured as the engine's single biggest loss (q3_sf10:
+22.7 s staging vs 1.17 s device execution).
+
+Correctness comes from the connector SPI's ``data_version()`` token
+(trino_tpu/connector/spi.py): the version rides inside every cache key,
+so any INSERT/UPDATE/DELETE/DROP/CTAS changes the key and the stale entry
+can never be served again (lookup additionally drops same-table entries
+whose version moved, reclaiming their HBM immediately). Unversioned
+connectors (``data_version() is None`` — e.g. the live ``system``
+catalog, or a transaction overlay) bypass the cache entirely.
+
+Memory discipline: the cache is the cluster's REVOCABLE tier.
+
+- byte-budgeted LRU (budget sized from real device memory when
+  discoverable, see :func:`device_memory_bytes`);
+- ``yield_bytes`` sheds entries under pressure — called by the spill
+  decision (exec/memory.py: a query about to spill reclaims cache HBM
+  first) and by the worker announce loop when the node's pool is over
+  its limit, BEFORE the coordinator's low-memory killer would consider
+  killing a query;
+- admission is SINGLE-FLIGHT: concurrent queries staging the same table
+  produce one transfer — followers park on the leader's flight and are
+  served the same entry (the request-coalescing role of any serving
+  cache, same shape as cache/result_cache.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+# the single-flight holder is shared with the result cache — ONE
+# implementation of the wait/resolve protocol in the tree (its payload
+# field is generic: here it carries the CacheEntry)
+from trino_tpu.cache.result_cache import _Flight
+from trino_tpu.obs import metrics as M
+
+# fallback budget when device memory is not discoverable (CPU test meshes)
+DEFAULT_DEVICE_CACHE_BYTES = 256 << 20
+# fraction of discovered device memory the cache may hold: running
+# queries own the rest (the cache yields even that share under pressure)
+DEVICE_MEMORY_FRACTION = 4  # budget = HBM / 4
+
+_device_memory_cell: List = []  # lazily computed once per process
+
+
+def device_memory_bytes() -> Optional[int]:
+    """This process's per-device accelerator memory capacity (HBM bytes),
+    or None when not discoverable. Sources, in order: the
+    ``TRINO_TPU_DEVICE_MEMORY_BYTES`` env override, then the backend's
+    ``memory_stats()['bytes_limit']`` (real TPU/GPU devices report it;
+    CPU test meshes do not). Computed once and cached — the worker
+    announce loop reads it every heartbeat."""
+    if _device_memory_cell:
+        return _device_memory_cell[0]
+    cap: Optional[int] = None
+    env = os.environ.get("TRINO_TPU_DEVICE_MEMORY_BYTES")
+    if env:
+        try:
+            cap = int(env)
+        except ValueError:
+            cap = None
+    if cap is None:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            if stats and stats.get("bytes_limit"):
+                cap = int(stats["bytes_limit"])
+        except Exception:  # noqa: BLE001 — no backend / no stats on CPU
+            cap = None
+    _device_memory_cell.append(cap)
+    return cap
+
+
+def _default_budget() -> int:
+    env = os.environ.get("TRINO_TPU_DEVICE_CACHE_BYTES")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    cap = device_memory_bytes()
+    if cap:
+        return max(cap // DEVICE_MEMORY_FRACTION, 64 << 20)
+    return DEFAULT_DEVICE_CACHE_BYTES
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheKey:
+    """Identity of one staged device artifact. ``signature`` digests the
+    projection, pushdown handle, effective constraint, and the host-applied
+    dynamic domains (trino_tpu/devcache/keys.py); ``shard`` distinguishes
+    staging shapes of the same table (whole-table vs a worker task's split
+    set vs an SPMD mesh width); ``conn_token`` pins process-local
+    connectors (the memory connector's version counter is instance state —
+    two sessions' private catalogs must never alias)."""
+
+    catalog: str
+    schema: str
+    table: str
+    data_version: str
+    signature: str
+    shard: str
+    conn_token: int = 0
+
+    def table_id(self) -> Tuple[str, str, str, int]:
+        return (self.catalog, self.schema, self.table, self.conn_token)
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One resident entry: ``value`` is the tier-specific staged artifact
+    (Page, or (arrays, spec, rows) for SPMD), ``rows`` the live staged
+    rows it holds, ``nbytes`` its exact device bytes."""
+
+    key: CacheKey
+    value: object
+    rows: int
+    nbytes: int
+    splits: int = 0
+    hits: int = 0
+    created_at: float = 0.0
+    last_used_at: float = 0.0
+
+
+
+
+class DeviceTableCache:
+    """Byte-budgeted LRU of staged device tables with single-flight
+    admission and version-based invalidation."""
+
+    # followers give a slow leader this long before re-staging themselves
+    # (a TPU cold compile through a tunnel can take minutes; staging alone
+    # is tens of seconds at sf10)
+    FLIGHT_WAIT_S = 600.0
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self._max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[CacheKey, CacheEntry]" = OrderedDict()
+        self._bytes = 0
+        self._flights: Dict[CacheKey, _Flight] = {}
+        # table_id -> resident keys: keeps the per-lookup stale-version
+        # sweep O(entries-for-this-table), not O(all entries) under the
+        # global lock (worker split-set shards accumulate many keys)
+        self._by_table: Dict[tuple, set] = {}
+
+    # ---------------------------------------------------------- inspection
+    @property
+    def max_bytes(self) -> int:
+        if self._max_bytes is None:
+            self._max_bytes = _default_budget()
+        return self._max_bytes
+
+    def cached_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> List[dict]:
+        """Row-shaped entry list (system.runtime.device_cache), MRU
+        first."""
+        with self._lock:
+            entries = list(reversed(self._entries.values()))
+        return [
+            {
+                "catalog": e.key.catalog,
+                "schema": e.key.schema,
+                "table": e.key.table,
+                "version": e.key.data_version,
+                "shard": e.key.shard,
+                "signature": e.key.signature,
+                "bytes": e.nbytes,
+                "rows": e.rows,
+                "hits": e.hits,
+                "createdAt": e.created_at,
+                "lastUsedAt": e.last_used_at,
+            }
+            for e in entries
+        ]
+
+    # ----------------------------------------------------------- lifecycle
+    def lookup_or_stage(
+        self, key: CacheKey, loader: Callable[[], Tuple[object, int, int, int]],
+        admit_bytes: Optional[int] = None,
+    ) -> Tuple[CacheEntry, str]:
+        """``(entry, "hit"|"miss")``. ``loader() -> (value, rows, nbytes,
+        splits)`` runs OUTSIDE the cache lock (staging is the slow path);
+        concurrent callers of the same key single-flight: exactly one
+        loader runs, followers are served its entry as hits (they paid no
+        transfer). A failed leader wakes followers empty-handed and they
+        race again."""
+        while True:
+            with self._lock:
+                self._drop_stale_locked(key)
+                ent = self._entries.get(key)
+                if ent is not None:
+                    self._entries.move_to_end(key)
+                    ent.hits += 1
+                    ent.last_used_at = time.time()
+                    M.DEVICE_CACHE_HITS.inc()
+                    return ent, "hit"
+                flight = self._flights.get(key)
+                if flight is None:
+                    flight = self._flights[key] = _Flight()
+                    lead = True
+                else:
+                    lead = False
+            if not lead:
+                if not flight.wait(self.FLIGHT_WAIT_S):
+                    # the leader is alive but STUCK (e.g. blocked in a
+                    # connector read): bypass the pool and stage privately
+                    # rather than hanging every query on that table behind
+                    # one wedged staging
+                    value, rows, nbytes, splits = loader()
+                    now = time.time()
+                    M.DEVICE_CACHE_MISSES.inc()
+                    return CacheEntry(key, value, rows, int(nbytes), splits,
+                                      created_at=now, last_used_at=now), "miss"
+                if flight.ok and flight.value is not None:
+                    ent = flight.value
+                    with self._lock:
+                        ent.hits += 1
+                        ent.last_used_at = time.time()
+                    M.DEVICE_CACHE_HITS.inc()
+                    return ent, "hit"
+                continue  # leader failed: race for leadership
+            try:
+                value, rows, nbytes, splits = loader()
+            except BaseException:
+                with self._lock:
+                    flight = self._flights.pop(key, None)
+                if flight is not None:
+                    flight._resolve(None, ok=False)
+                raise
+            now = time.time()
+            ent = CacheEntry(key, value, rows, int(nbytes), splits,
+                             created_at=now, last_used_at=now)
+            self._admit(ent, admit_bytes)
+            with self._lock:
+                flight = self._flights.pop(key, None)
+            if flight is not None:
+                flight._resolve(ent, ok=True)
+            M.DEVICE_CACHE_MISSES.inc()
+            return ent, "miss"
+
+    def _admit(self, ent: CacheEntry, admit_bytes: Optional[int]) -> None:
+        """Admit under the budget. The session's ``admit_bytes`` is a
+        PER-ENTRY size filter only — over-cap entries are returned to the
+        caller but not retained; the eviction loop always targets the
+        shared server-wide budget, so one tenant's tight cap can never
+        flush other tenants' warm tables."""
+        cap = (self.max_bytes if admit_bytes is None
+               else min(self.max_bytes, int(admit_bytes)))
+        if ent.nbytes > cap:
+            return
+        with self._lock:
+            self._remove_locked(ent.key)
+            while self._bytes + ent.nbytes > self.max_bytes and self._entries:
+                self._evict_lru_locked()
+            self._entries[ent.key] = ent
+            self._bytes += ent.nbytes
+            self._by_table.setdefault(ent.key.table_id(), set()).add(ent.key)
+            M.DEVICE_CACHE_BYTES.set(self._bytes)
+
+    def _remove_locked(self, key: CacheKey) -> Optional[CacheEntry]:
+        ent = self._entries.pop(key, None)
+        if ent is None:
+            return None
+        self._bytes -= ent.nbytes
+        keys = self._by_table.get(key.table_id())
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                del self._by_table[key.table_id()]
+        return ent
+
+    def _evict_lru_locked(self) -> int:
+        victim_key = next(iter(self._entries))
+        victim = self._remove_locked(victim_key)
+        M.DEVICE_CACHE_EVICTIONS.inc()
+        M.DEVICE_CACHE_BYTES.set(self._bytes)
+        return victim.nbytes
+
+    def _drop_stale_locked(self, key: CacheKey) -> None:
+        """Drop every entry of the same table whose data_version differs
+        from the version the caller just observed: a mutation moved the
+        version, so those arrays can never be served again — reclaim
+        their HBM now instead of waiting for LRU age-out."""
+        keys = self._by_table.get(key.table_id())
+        if not keys:
+            return
+        stale = [k for k in keys if k.data_version != key.data_version]
+        for k in stale:
+            self._remove_locked(k)
+            M.DEVICE_CACHE_EVICTIONS.inc()
+        if stale:
+            M.DEVICE_CACHE_BYTES.set(self._bytes)
+
+    # ------------------------------------------------------------ pressure
+    def yield_bytes(self, nbytes: int) -> int:
+        """Revocable-tier contract: shed at least ``nbytes`` of cached
+        tables (LRU-first) for a running query's benefit; returns the
+        bytes actually freed. Never blocks on staging flights."""
+        if nbytes <= 0:
+            return 0
+        freed = 0
+        with self._lock:
+            while freed < nbytes and self._entries:
+                freed += self._evict_lru_locked()
+        return freed
+
+    def evict_to(self, target_bytes: int) -> int:
+        """Evict LRU entries until the cache holds at most
+        ``target_bytes``; returns bytes freed."""
+        freed = 0
+        with self._lock:
+            while self._bytes > max(0, int(target_bytes)) and self._entries:
+                freed += self._evict_lru_locked()
+        return freed
+
+    def invalidate_all(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_table.clear()
+            self._bytes = 0
+            M.DEVICE_CACHE_BYTES.set(0)
+
+
+# the process-wide pool: coordinator-local execution, the compiled tier,
+# and every task on a worker share one budget (one device per process)
+DEVICE_CACHE = DeviceTableCache()
+
+
+# --------------------------------------------------- connector identity
+# Process-local connectors (coordinator_only: the memory connector, whose
+# version counter is instance state) get a per-instance token so two
+# sessions' PRIVATE catalog maps never alias in the cache. Monotonic ids
+# (never reused, unlike id()) via a weak map: a collected connector's
+# entries become unreachable keys and age out by LRU.
+_conn_tokens: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_conn_token_lock = threading.Lock()
+_conn_token_next = [1]
+
+
+def instance_token(conn) -> int:
+    """0 for connectors whose data_version is globally meaningful (file
+    state, immutable generators); a unique per-instance token for
+    process-local ones."""
+    if not getattr(conn, "coordinator_only", False):
+        return 0
+    with _conn_token_lock:
+        tok = _conn_tokens.get(conn)
+        if tok is None:
+            tok = _conn_tokens[conn] = _conn_token_next[0]
+            _conn_token_next[0] += 1
+        return tok

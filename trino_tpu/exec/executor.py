@@ -260,6 +260,10 @@ class Executor:
         self.df_apply_s = 0.0
         # rows materialized per scan plan-node id (EXPLAIN/pushdown tests)
         self.scan_stats: Dict[int, int] = {}
+        # device-cache disposition per scan plan-node id ("hit" | "miss" |
+        # "bypass"): a "hit" staged ZERO host->device bytes — callers use
+        # this to keep staged-rows accounting honest (trino_tpu/devcache/)
+        self.scan_cache: Dict[int, str] = {}
         # per-operator stats by plan-node id (EXPLAIN ANALYZE, task status):
         # typed OperatorStats ACCUMULATED across repeated node executions
         # (reference: OperatorContext/OperatorStats — SURVEY.md §5.1)
@@ -384,24 +388,51 @@ class Executor:
     def scan_constraint(self, node: P.TableScanNode):
         return scan_constraint_with(node, self.dyn_domains)
 
+    def _host_applied_domains(self, node: P.TableScanNode) -> Dict:
+        """The dynamic domains this executor will physically apply at the
+        scan (the host-pruning subset) — part of the cache signature: two
+        executors with the same constraint but different applied sets
+        stage DIFFERENT pages (trino_tpu/devcache/keys.py)."""
+        if not self.apply_df_host:
+            return {}
+        dyn = dynamic_domain_map(node, self.dyn_domains)
+        allow = getattr(self, "df_host_allow", None)
+        if allow is not None:
+            dyn = {c: d for c, d in dyn.items() if allow(node, c, d)}
+        return dyn
+
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
+        from trino_tpu import devcache
+
         conn = self.session.catalogs[node.catalog]
         constraint = self.scan_constraint(node)
-        splits = conn.get_splits(node.schema, node.table, 1, constraint=constraint,
-                                 handle=node.table_handle)
-        datas = [conn.scan(s, node.column_names, constraint=constraint) for s in splits]
-        if self.apply_df_host:
-            t0 = time.perf_counter()
-            datas = apply_dynamic_domains(
-                node, self.dyn_domains, datas,
-                allow=getattr(self, "df_host_allow", None))
-            self.df_apply_s += time.perf_counter() - t0
-        scanned = sum(
-            len(next(iter(d.values())).values) if d else 0 for d in datas
-        )
-        self.scan_stats[node.id] = scanned
-        self._pending_scan[node.id] = (len(splits), scanned)
-        return assemble_scan_page(node.column_names, node.column_types, datas)
+
+        def load():
+            splits = conn.get_splits(
+                node.schema, node.table, 1, constraint=constraint,
+                handle=node.table_handle)
+            datas = [conn.scan(s, node.column_names, constraint=constraint)
+                     for s in splits]
+            if self.apply_df_host:
+                t0 = time.perf_counter()
+                datas = apply_dynamic_domains(
+                    node, self.dyn_domains, datas,
+                    allow=getattr(self, "df_host_allow", None))
+                self.df_apply_s += time.perf_counter() - t0
+            scanned = sum(
+                len(next(iter(d.values())).values) if d else 0 for d in datas
+            )
+            page = assemble_scan_page(
+                node.column_names, node.column_types, datas)
+            return page, scanned, _mem.page_bytes(page), len(splits)
+
+        ent, disposition = devcache.cached_stage(
+            self.session, node, constraint,
+            self._host_applied_domains(node), "table", load)
+        self.scan_cache[node.id] = disposition
+        self.scan_stats[node.id] = ent.rows
+        self._pending_scan[node.id] = (ent.splits, ent.rows)
+        return ent.value
 
     def _exec_ValuesNode(self, node: P.ValuesNode) -> Page:
         cols = [

@@ -66,6 +66,16 @@ class MemoryContext:
         parts = 1
         while parts < self.MAX_SPILL_PARTITIONS and projected_bytes // parts > self.budget:
             parts *= 2
+        # the device table cache is the REVOCABLE tier: a query about to
+        # pay a spill reclaims warm-table HBM first, so cached tables
+        # yield to running work instead of competing with it. The yield is
+        # sized to the PER-PASS working set — what will actually be
+        # resident once the join runs partitioned — never the raw
+        # projection (a 64 GB projection over an 8 GB budget must not
+        # flush a whole warm cache its passes will never displace).
+        from trino_tpu.devcache import DEVICE_CACHE
+
+        DEVICE_CACHE.yield_bytes(projected_bytes // parts)
         return parts
 
     def record_spill(self, node_id: int, kind: str, partitions: int, projected: int) -> None:

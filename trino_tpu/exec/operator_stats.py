@@ -140,6 +140,8 @@ def rollup_tasks_to_stage(fragment_id: int, task_entries: List[dict],
         "deviceS": 0.0,
         "peakBytes": 0,
         "spills": 0,
+        "deviceCacheHits": 0,
+        "deviceCacheMisses": 0,
         "operatorStats": [ops[k].to_dict() for k in sorted(ops)],
     }
     part_bytes = None
@@ -156,6 +158,8 @@ def rollup_tasks_to_stage(fragment_id: int, task_entries: List[dict],
         stage["peakBytes"] = max(stage["peakBytes"],
                                  int(s.get("peakBytes", 0)))
         stage["spills"] += int(s.get("spills", 0))
+        stage["deviceCacheHits"] += int(s.get("deviceCacheHits", 0))
+        stage["deviceCacheMisses"] += int(s.get("deviceCacheMisses", 0))
         # per-partition output bytes sum ELEMENTWISE across tasks: every
         # producer task contributes rows to every partition, so the stage
         # view is the skew signal (adaptive re-planner / UI)
@@ -199,6 +203,12 @@ def rollup_stages_to_query(stages: List[dict]) -> dict:
         "peakBytes": max(
             [int(s.get("peakBytes", 0)) for s in stages], default=0),
         "spills": sum(int(s.get("spills", 0)) for s in stages),
+        # warm-HBM serving signal: scans served from the device table
+        # cache vs scans that paid a host->device transfer
+        "deviceCacheHits": sum(
+            int(s.get("deviceCacheHits", 0)) for s in stages),
+        "deviceCacheMisses": sum(
+            int(s.get("deviceCacheMisses", 0)) for s in stages),
     }
     return q
 

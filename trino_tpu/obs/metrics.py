@@ -302,8 +302,11 @@ COMPILE_SECONDS = REGISTRY.counter(
     "of device seconds so one-time compiles don't skew throughput math)")
 STAGING_SECONDS = REGISTRY.counter(
     "trino_tpu_staging_seconds_total",
-    "host-side staging wall seconds (scan generation, dynamic-filter "
-    "narrowing, host->device transfer prep)")
+    "host-side staging seconds charged to queries: the compiled tier "
+    "charges dynamic-filter resolution + host domain application "
+    "(bench's staging_df_s — the host work a run repeats without the "
+    "device cache); the worker tier charges the per-split scan+assemble "
+    "wall of FRESH stagings (device-cache hits charge nothing)")
 DEVICE_SECONDS = REGISTRY.counter(
     "trino_tpu_device_seconds_total",
     "device execution wall seconds (fragment bodies / compiled runs)")
@@ -364,6 +367,26 @@ GENCACHE_MISSES = REGISTRY.counter(
 GENCACHE_EVICTIONS = REGISTRY.counter(
     "trino_tpu_gencache_evictions_total",
     "datagen cache entries evicted by the LRU byte budget")
+
+# device table cache (trino_tpu/devcache/): warm-HBM buffer pool of staged
+# scan artifacts, keyed by connector data_version — the repeat-traffic
+# staging killer. Evictions count LRU budget pressure, revocable-tier
+# yields to running queries, AND stale-version drops after DML.
+DEVICE_CACHE_HITS = REGISTRY.counter(
+    "trino_tpu_device_cache_hits_total",
+    "table stagings served from the device cache (including single-flight "
+    "followers served by a concurrent leader's transfer)")
+DEVICE_CACHE_MISSES = REGISTRY.counter(
+    "trino_tpu_device_cache_misses_total",
+    "cache-eligible table stagings that transferred host pages to device "
+    "and (budget permitting) filled the cache")
+DEVICE_CACHE_EVICTIONS = REGISTRY.counter(
+    "trino_tpu_device_cache_evictions_total",
+    "device-cache entries dropped (LRU byte budget, revocable-tier yield "
+    "to a running query, or a stale data_version after DML)")
+DEVICE_CACHE_BYTES = REGISTRY.gauge(
+    "trino_tpu_device_cache_bytes",
+    "device bytes held by the warm-HBM table cache (the revocable tier)")
 
 # adaptive execution (trino_tpu/adaptive/): runtime re-planning from the
 # operator-stats spine, recorded per applied rule at the stage boundary

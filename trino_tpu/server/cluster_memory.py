@@ -49,6 +49,14 @@ class ClusterMemoryManager:
                 "queryMemory": dict(payload.get("queryMemory") or {}),
                 "memoryBytes": int(payload.get("memoryBytes") or 0),
                 "memoryLimit": payload.get("memoryLimit"),
+                # real accelerator capacity (HBM bytes) when the worker
+                # could discover it — sizes admission from hardware
+                # instead of a flat default (trino_tpu/devcache/)
+                "deviceMemoryBytes": payload.get("deviceMemoryBytes"),
+                # warm-table bytes the worker holds in its device cache:
+                # REVOCABLE (the worker sheds them under pressure), so
+                # admission never counts them against headroom
+                "deviceCacheBytes": int(payload.get("deviceCacheBytes") or 0),
                 "at": time.monotonic(),
             }
         self._maybe_kill()
@@ -67,12 +75,58 @@ class ClusterMemoryManager:
         with self._lock:
             return sum(i["memoryBytes"] for i in self._nodes.values())
 
+    def device_capacity_total(self) -> Optional[int]:
+        """Sum of worker-announced accelerator capacities (HBM bytes), or
+        None unless EVERY tracked worker announced one — a partial sum
+        would understate the cluster and spuriously refuse admission on
+        mixed fleets (some workers cannot discover their capacity)."""
+        with self._lock:
+            caps = [i.get("deviceMemoryBytes") for i in self._nodes.values()]
+        if not caps or any(not c for c in caps):
+            return None
+        return sum(int(c) for c in caps)
+
+    def revocable_bytes(self) -> int:
+        """Cluster-wide device-cache bytes — reclaimable on demand (the
+        workers' warm-HBM table caches yield to running queries)."""
+        with self._lock:
+            return sum(int(i.get("deviceCacheBytes") or 0)
+                       for i in self._nodes.values())
+
+    def effective_limit(self) -> Optional[int]:
+        """The admission ceiling: the configured cluster limit when set,
+        else the REAL announced hardware capacity (reference role:
+        query.max-memory sized by ops guesswork, replaced by the workers'
+        own HBM reports); None = unlimited (nothing known)."""
+        if self.cluster_limit_bytes is not None:
+            return self.cluster_limit_bytes
+        return self.device_capacity_total()
+
     def has_headroom(self) -> bool:
-        """Dispatch gate: admit new work only under the cluster limit
-        (reference: ClusterMemoryManager's query.max-memory admission)."""
-        if self.cluster_limit_bytes is None:
+        """Dispatch gate: admit new work only under the effective limit
+        (reference: ClusterMemoryManager's query.max-memory admission).
+        Device-cache bytes never count against headroom — they are the
+        revocable tier and yield before a query would be refused. When the
+        limit is hardware-derived, each node's counted reservation is
+        CLAMPED at that node's announced capacity: reservations are
+        projected peaks (a spilling join reports its pre-partition
+        projection, exec/memory.py), and a single projection beyond one
+        node's HBM must not consume the whole cluster's headroom. Blocked
+        dispatch queues (coordinator waits for headroom); reservations
+        decay when task bodies finish."""
+        limit = self.effective_limit()
+        if limit is None:
             return True
-        return self.cluster_reserved() < self.cluster_limit_bytes
+        if self.cluster_limit_bytes is not None:
+            # the operator chose this ceiling deliberately: gate on raw
+            # reservations exactly as configured
+            return self.cluster_reserved() < limit
+        with self._lock:
+            reserved = sum(
+                min(int(i["memoryBytes"]),
+                    int(i.get("deviceMemoryBytes") or 0) or i["memoryBytes"])
+                for i in self._nodes.values())
+        return reserved < limit
 
     # -------------------------------------------------------------- killer
     def _maybe_kill(self) -> None:

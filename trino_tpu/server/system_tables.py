@@ -139,6 +139,10 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             return self._tasks_rows()
         if (schema, table) == ("runtime", "nodes"):
             return self._nodes_rows()
+        if (schema, table) == ("runtime", "device_cache"):
+            from trino_tpu.connector.system.connector import device_cache_rows
+
+            return device_cache_rows()
         if (schema, table) == ("metrics", "metrics"):
             return self._metrics_rows()
         raise KeyError(f"system.{schema}.{table} does not exist")
@@ -182,11 +186,14 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
         for n in self._server.registry.snapshot():
             info = n.get("info") or {}
             mem_limit = info.get("memoryLimit")
+            dev_mem = info.get("deviceMemoryBytes")
             rows.append((
                 n["nodeId"], n["url"], "active" if n["alive"] else "dead",
                 info.get("version"), int(info.get("tasks", 0)),
                 int(info.get("memoryBytes", 0)),
                 int(mem_limit) if mem_limit is not None else None,
+                int(dev_mem) if dev_mem is not None else None,
+                int(info.get("deviceCacheBytes") or 0),
                 int(n["ageS"] * 1000.0),
             ))
         return rows

@@ -94,9 +94,27 @@ class WorkerServer:
         pings — here the worker pushes, the coordinator ages entries out)."""
         while not self._stop.is_set():
             try:
-                from trino_tpu import __version__
+                from trino_tpu import __version__, devcache
 
                 qmem = self.tasks.query_memory()
+                if self.memory_limit_bytes is not None:
+                    # the device table cache is this node's REVOCABLE
+                    # tier: when queries + warm tables overflow the pool,
+                    # shed cache FIRST — before the coordinator's
+                    # low-memory killer would ever consider a query.
+                    # Scoped to the band where the cache IS the overflow
+                    # (queries alone fit the pool): reservations are
+                    # projected peaks, so a huge spilling join reports
+                    # more than the pool while its partitioned passes
+                    # stay under budget — eviction there cures nothing
+                    # and the spill path's per-pass yield (exec/memory)
+                    # already handles the real pressure.
+                    q_total = sum(qmem.values())
+                    over = (q_total
+                            + devcache.DEVICE_CACHE.cached_bytes()
+                            - self.memory_limit_bytes)
+                    if over > 0 and q_total < self.memory_limit_bytes:
+                        devcache.DEVICE_CACHE.yield_bytes(over)
                 wire.json_request(
                     "PUT",
                     f"{self.coordinator_url}/v1/announce/{self.node_id}",
@@ -108,6 +126,12 @@ class WorkerServer:
                      "queryMemory": qmem,
                      "memoryBytes": sum(qmem.values()),
                      "memoryLimit": self.memory_limit_bytes,
+                     # real accelerator capacity + warm-cache occupancy:
+                     # admission sizes from hardware, the cache reads as
+                     # revocable (server/cluster_memory.py)
+                     "deviceMemoryBytes": devcache.device_memory_bytes(),
+                     "deviceCacheBytes":
+                         devcache.DEVICE_CACHE.cached_bytes(),
                      # surfaced by system.runtime.nodes (reference: the
                      # node version in NodeSystemTable rows)
                      "version": __version__},
