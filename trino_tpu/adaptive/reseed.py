@@ -197,25 +197,67 @@ def _exchange_block_capacity(k: _SideKeys, n_devices: int) -> int:
     return _pow2(max(worst, _MIN_XCHG_CAP))
 
 
+_JTILE_MIN = 512
+_JTILE_MAX = 8192
+
+
+def _merge_tile_hint(probe: _SideKeys, build: _SideKeys) -> int:
+    """Build-window rows per probe block for the Pallas tiled merge
+    (``jtile:<join id>``), priced from the SAME staged key histograms
+    that size the capacities: a probe block of B sorted keys spans about
+    ``B * nb/np * max_multiplicity`` build slots, so skewed builds get
+    wider DMA windows up front instead of paying extra window iterations
+    per block. Rounded to the kernel's 128-lane granularity via pow2,
+    clamped to [512, 8192] (VMEM double-buffer budget)."""
+    from trino_tpu.ops.merge_pallas import BLOCK_PROBE
+
+    bh = build.hash[build.live]
+    n_probe = max(int(probe.sel.sum()), 1)
+    if len(bh) == 0:
+        return _JTILE_MIN
+    _, counts = np.unique(bh, return_counts=True)
+    mult = int(counts.max())
+    est = BLOCK_PROBE * len(bh) * mult // n_probe
+    return min(max(_pow2(max(est, 1)), _JTILE_MIN), _JTILE_MAX)
+
+
 def reseed_capacity_hints(session, root: P.PlanNode,
                           staged: Dict[int, object],
                           n_devices: int = 1) -> Dict[str, int]:
     """Capacity hints priced from the staged scan pages (actual rows/keys)
     for every expansion join and hash exchange whose keys trace to staged
-    columns. Returns only the keys it could compute — callers ``update()``
-    them over the static guesses."""
+    columns, plus ``jtile:*`` merge-window hints for the fused join
+    tier's Pallas kernel. Returns only the keys it could compute —
+    callers ``update()`` them over the static guesses."""
     from trino_tpu.sql.planner import stats
 
+    # jtile hints are consumed ONLY by the opt-in Pallas merge kernel —
+    # don't pay the per-join host histogram passes when nothing reads them
+    props = getattr(session, "properties", None) or {}
+    price_jtile = bool(props.get("fused_join_pallas"))
     hints: Dict[str, int] = {}
     for n in P.walk_plan(root):
         if isinstance(n, P.JoinNode):
+            # one histogram pass per side, computed lazily and shared by
+            # every hint family (capacity, exchange block, merge tile)
+            sides: List = []
+
+            def side_keys(n=n, sides=sides):
+                if not sides:
+                    sides.append((_side_keys(staged, n.left, n.left_keys),
+                                  _side_keys(staged, n.right, n.right_keys)))
+                return sides[0]
+
             partitioned = bool(
                 n_devices > 1 and n.left_keys
                 and stats.join_repartitions(session, n, n_devices))
+            if price_jtile and n.left_keys:
+                probe, build = side_keys()
+                if probe is not None and build is not None:
+                    hints[f"jtile:{n.id}"] = _merge_tile_hint(probe, build)
             if P.uses_expansion_kernel(n):
                 if n.left_keys:
-                    probe = _side_keys(staged, n.left, n.left_keys)
-                    build = _side_keys(staged, n.right, n.right_keys)
+                    probe, build = side_keys()
                     if probe is not None and build is not None:
                         hints[f"join:{n.id}"] = _expansion_capacity(
                             n, probe, build, n_devices, partitioned)
@@ -228,14 +270,13 @@ def reseed_capacity_hints(session, root: P.PlanNode,
                         hints[f"join:{n.id}"] = _pow2(
                             max(per * rrows, _MIN_CAP))
             if partitioned:
-                lk = _side_keys(staged, n.left, n.left_keys)
-                rk = _side_keys(staged, n.right, n.right_keys)
-                if lk is not None:
+                probe, build = side_keys()
+                if probe is not None:
                     hints[f"xchgl:{n.id}"] = _exchange_block_capacity(
-                        lk, n_devices)
-                if rk is not None:
+                        probe, n_devices)
+                if build is not None:
                     hints[f"xchgr:{n.id}"] = _exchange_block_capacity(
-                        rk, n_devices)
+                        build, n_devices)
         elif isinstance(n, P.AggregationNode) and n.step == "single" \
                 and n_devices > 1 and n.group_channels:
             if stats.agg_repartitions(session, n, n_devices):

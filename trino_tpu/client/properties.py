@@ -162,6 +162,37 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 1 << 30, _positive,
         ),
         PropertyMetadata(
+            "fused_join_enabled",
+            "run N:1 lookup joins and semi/anti membership through the "
+            "fused sort-merge tier (ops/fused_join.py): build and probe "
+            "keys sort TOGETHER in one compiled region — no SortedBuild "
+            "intermediate, no separate build sort; dense integer-keyed "
+            "builds keep the direct-address fast path either way (the "
+            "cost gate, see README 'Join kernels')",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "fused_join_pallas",
+            "run the merge step of sorted-build joins as the Pallas tiled "
+            "two-pointer merge kernel (ops/merge_pallas.py) when its "
+            "contract holds (single int32 key, sentinel provably "
+            "unreachable); OPT-IN: unset/false keeps the XLA rank merge "
+            "(the kernel graduates to a default after a hardware bench "
+            "round validates it); true engages it — compiled on TPU, "
+            "interpret mode elsewhere (test meshes)",
+            bool, None,
+        ),
+        PropertyMetadata(
+            "exchange_overlap_blocks",
+            "split the probe side of SPMD partitioned joins into this many "
+            "double-buffered send blocks so the ICI all-to-all of block "
+            "k+1 overlaps join compute on block k "
+            "(parallel/exchange.repartition_page_overlapped); results are "
+            "bit-identical to the unoverlapped exchange; 0 or 1 disables "
+            "the pipeline (one exchange-then-compute barrier)",
+            int, 0, lambda v: None if v >= 0 else "must be >= 0",
+        ),
+        PropertyMetadata(
             "adaptive_execution_enabled",
             "re-plan not-yet-scheduled downstream fragments between stage "
             "completions using the runtime operator-stats rollups (master "

@@ -11,12 +11,12 @@ from trino_tpu.devcache.cache import (
     DEVICE_CACHE, CacheEntry, CacheKey, DeviceTableCache,
     device_memory_bytes, instance_token)
 from trino_tpu.devcache.keys import (
-    admit_budget, cache_enabled, cached_stage, scan_cache_key,
+    admit_budget, cache_enabled, cached_build, cached_stage, scan_cache_key,
     scan_signature, splits_shard)
 
 __all__ = [
     "DEVICE_CACHE", "CacheEntry", "CacheKey", "DeviceTableCache",
-    "admit_budget", "cache_enabled", "cached_stage",
+    "admit_budget", "cache_enabled", "cached_build", "cached_stage",
     "device_memory_bytes", "instance_token", "scan_cache_key",
     "scan_signature", "splits_shard",
 ]

@@ -141,6 +141,47 @@ def cached_stage(session, node, constraint, applied_domains, shard, loader):
     return ent, disposition
 
 
+def cached_build(session, node, constraint, applied_domains, key_channels,
+                 key_dtypes: str, loader):
+    """Device-cached SORTED BUILD artifact for a join whose build side is a
+    bare versioned table scan: the ops/join.py ``SortedBuild`` (sorted key
+    columns + row permutation + live flags, all device arrays) keyed by
+    the scan's staging signature PLUS the join-key signature (key channels
+    and their post-alignment physical dtypes — the probe side's dtype
+    participates in alignment, so two probes of different widths need two
+    artifacts). A warm repeated join skips the build-side sort entirely.
+
+    Same revocable-tier pool and accounting as staged scans
+    (:data:`~trino_tpu.devcache.cache.DEVICE_CACHE`); build hits count
+    under ``trino_tpu_device_cache_build_hits_total`` (and, like any pool
+    hit, the general hit counter). Returns ``(SortedBuild, disposition)``
+    — or ``(None, "bypass")`` WITHOUT running ``loader`` when the key is
+    not cacheable, so callers can keep the (cheaper) fully-fused path for
+    uncacheable builds instead of paying a separate build sort.
+
+    ``loader() -> (SortedBuild, rows, nbytes, splits)``.
+    """
+    from trino_tpu.devcache.cache import DEVICE_CACHE
+    from trino_tpu.obs import metrics as M
+    from trino_tpu.obs import trace as tracing
+
+    shard = "build:" + ",".join(str(c) for c in key_channels) \
+        + ":" + key_dtypes
+    key = scan_cache_key(session, node, constraint, applied_domains,
+                         shard=shard)
+    if key is None:
+        return None, "bypass"
+    with tracing.span("device-cache/lookup", table=node.table) as sp:
+        ent, disposition = DEVICE_CACHE.lookup_or_stage(
+            key, loader, admit_bytes=admit_budget(session))
+        sp.set("result", disposition)
+        sp.set("bytes", ent.nbytes)
+        sp.set("artifact", "sorted-build")
+    if disposition == "hit":
+        M.DEVICE_CACHE_BUILD_HITS.inc()
+    return ent.value, disposition
+
+
 def scan_cache_key(session, node, constraint,
                    applied_domains: Optional[Dict] = None,
                    shard: Optional[str] = "table") -> Optional[CacheKey]:

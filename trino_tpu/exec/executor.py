@@ -28,8 +28,10 @@ from trino_tpu import types as T
 from trino_tpu.data.page import Column, Page
 from trino_tpu.exec import memory as _mem
 from trino_tpu.exec.operator_stats import OperatorStats
+from trino_tpu.obs import metrics as M
 from trino_tpu.ops import aggregate as agg_ops
 from trino_tpu.ops import expr_lower as L
+from trino_tpu.ops import fused_join as fused_ops
 from trino_tpu.ops import groupby as gb
 from trino_tpu.ops import join as join_ops
 from trino_tpu.ops import ranks as ranks_ops
@@ -1877,22 +1879,155 @@ class Executor:
             return None
         return bc, pc, ds[0], ds[1]
 
+    # ------------------------------------------------------ fused join tier
+    def _fused_join_enabled(self) -> bool:
+        props = getattr(self.session, "properties", None) or {}
+        return bool(props.get("fused_join_enabled", True))
+
+    def _pallas_merge_mode(self) -> Optional[bool]:
+        """None = don't use the Pallas merge kernel; False = compiled mode
+        (real TPU); True = interpret mode (CPU test meshes). The kernel is
+        OPT-IN (property explicitly true): unset keeps the XLA rank merge
+        until a hardware bench round validates the Mosaic compile —
+        microbench/join_kernels.py carries the kernel case on TPU."""
+        props = getattr(self.session, "properties", None) or {}
+        v = props.get("fused_join_pallas")
+        if not v:
+            return None
+        from trino_tpu.ops import merge_pallas
+
+        if not merge_pallas.pallas_available():
+            return None  # no pallas on this jax install: XLA fallback
+        try:
+            return jax.default_backend() != "tpu"
+        except Exception:  # noqa: BLE001 — no backend yet
+            return True
+
+    def _merge_sentinel_safe(self, node: P.JoinNode, left: Page, right: Page,
+                             build_keys) -> bool:
+        """The FULL Pallas merge contract: a single int32 key (the
+        kernel's only lane dtype) whose PROVEN value range keeps the
+        dtype's max (the dead-row sentinel and the kernel's pad value)
+        unreachable by any live key. Checking the whole contract here
+        keeps the ``merge-pallas`` selection metric truthful — the
+        kernel's own guard would otherwise degrade silently to XLA after
+        the tier was already counted."""
+        if len(node.right_keys) != 1 or len(build_keys) != 1:
+            return False
+        bc = right.columns[node.right_keys[0]]
+        pc = left.columns[node.left_keys[0]]
+        if bc.hi is not None or pc.hi is not None:
+            return False
+        if bc.type.is_varchar or pc.type.is_varchar:
+            return False
+        dt = build_keys[0][0].dtype
+        if dt != jnp.int32:
+            return False
+        return (bc.vrange is not None and pc.vrange is not None
+                and max(int(bc.vrange[1]), int(pc.vrange[1]))
+                < jnp.iinfo(dt).max)
+
+    def _cached_sorted_build(self, node: P.JoinNode, right: Page, build_keys):
+        """SortedBuild served by the device build cache, or None. Eager
+        tier only (traced tiers sort in-program — their artifact is the
+        compiled executable itself); the build side must be a bare
+        versioned TableScanNode so the artifact's identity is provable
+        from the scan signature + join-key signature."""
+        if not self.eager_tier:
+            return None
+        scan = node.right
+        if not isinstance(scan, P.TableScanNode):
+            return None
+        from trino_tpu import devcache
+
+        constraint = scan_constraint_with(scan, self.dyn_domains)
+        dtypes = ",".join(str(v.dtype) for v, _ in build_keys)
+
+        def load():
+            build = join_ops.build_side(build_keys, right.sel)
+            arrays = list(build.cols) + [build.rows, build.live]
+            nbytes = sum(int(a.size) * a.dtype.itemsize for a in arrays)
+            return build, int(build.n), nbytes, 0
+
+        built, _disposition = devcache.cached_build(
+            self.session, scan, constraint,
+            self._host_applied_domains(scan), tuple(node.right_keys),
+            dtypes, load)
+        return built
+
+    def _merge_sorted_tier(self, node: P.JoinNode, left: Page, right: Page,
+                           build, build_keys, probe_keys, record: bool = True):
+        """(rows, matched) by merging probes against an already-sorted
+        build — the Pallas tiled merge when its contract holds, the XLA
+        rank merge otherwise. ``record=False`` skips the selection metric
+        (the overlapped exchange calls this once per send block but the
+        selection is one join)."""
+        pallas_interp = self._pallas_merge_mode()
+        use_pallas = (pallas_interp is not None
+                      and self._merge_sentinel_safe(node, left, right,
+                                                    build_keys))
+        if record:
+            M.FUSED_JOIN_SELECTIONS.inc(
+                1, "merge-pallas" if use_pallas else "merge-sorted")
+        return fused_ops.merge_sorted_build(
+            build, probe_keys,
+            use_pallas=use_pallas,
+            pallas_block_build=self.capacity_hints.get(
+                f"jtile:{node.id}", 2048),
+            pallas_interpret=bool(pallas_interp),
+        )
+
+    def _sortmerge_probe(self, node: P.JoinNode, left: Page, right: Page):
+        """(build_row_idx, matched) for the N:1 lookup join when the dense
+        direct-address table does not apply: the fused sort-merge tier
+        (ops/fused_join.py — one combined sort, no SortedBuild
+        intermediate) behind the cost gate, with two special build-side
+        shapes routed to the merge tier instead (a presorted key skips all
+        build work; a device-cached sorted build skips the build sort on
+        every warm join); legacy build_side + probe_unique when the tier
+        is disabled."""
+        build_keys, probe_keys = self._join_keys_aligned(
+            left, right, node.left_keys, node.right_keys
+        )
+        presorted = self._build_presorted(right, node.right_keys)
+        if self._fused_join_enabled():
+            cached = None if presorted else self._cached_sorted_build(
+                node, right, build_keys)
+            if presorted or cached is not None:
+                build = cached if cached is not None else join_ops.build_side(
+                    build_keys, right.sel, presorted=True)
+                return self._merge_sorted_tier(
+                    node, left, right, build, build_keys, probe_keys)
+            M.FUSED_JOIN_SELECTIONS.inc(1, "fused")
+            return fused_ops.fused_probe_unique(
+                build_keys, right.sel, probe_keys)
+        M.FUSED_JOIN_SELECTIONS.inc(1, "legacy")
+        build = join_ops.build_side(build_keys, right.sel, presorted=presorted)
+        return join_ops.probe_unique(build, probe_keys)
+
     def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         dense = self._dense_join_cols(node, left, right)
         if dense is not None:
+            # cost gate: dense-keyed builds keep the direct-address fast
+            # path (KERNELS_r05: one scatter + one bounded gather beats
+            # any sort formulation when the key range is dense)
+            M.FUSED_JOIN_SELECTIONS.inc(1, "dense")
             bc, pc, lo, span = dense
             table = join_ops.dense_unique_table(
                 _col_to_lowered(bc), right.sel, lo, span)
             rows, matched = join_ops.dense_probe_unique(
                 table, _col_to_lowered(pc), lo)
         else:
-            build_keys, probe_keys = self._join_keys_aligned(
-                left, right, node.left_keys, node.right_keys
-            )
-            build = join_ops.build_side(
-                build_keys, right.sel,
-                presorted=self._build_presorted(right, node.right_keys))
-            rows, matched = join_ops.probe_unique(build, probe_keys)
+            rows, matched = self._sortmerge_probe(node, left, right)
+        return self._assemble_lookup_output(node, left, right, rows, matched)
+
+    def _assemble_lookup_output(self, node: P.JoinNode, left: Page,
+                                right: Page, rows, matched) -> Page:
+        """Projection half of the lookup join: gather build payloads at the
+        matched rows and apply join-type/filter semantics. ROW-LOCAL in the
+        probe (each output row depends only on its probe row and the whole
+        build) — the property the overlapped SPMD exchange relies on to
+        consume probe blocks independently (parallel/spmd.py)."""
         out_cols = list(left.columns)
         out_cols.extend(self._gather_right_cols(right.columns, rows, matched))
         if node.join_type == "inner":
@@ -1917,6 +2052,7 @@ class Executor:
     def semi_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
         dense = self._dense_join_cols(node, left, right)
         if dense is not None:
+            M.FUSED_JOIN_SELECTIONS.inc(1, "dense")
             bc, pc, lo, span = dense
             hit = join_ops.dense_membership(
                 _col_to_lowered(bc), right.sel, _col_to_lowered(pc), lo, span)
@@ -1926,9 +2062,27 @@ class Executor:
         build_keys, probe_keys = self._join_keys_aligned(
             left, right, node.left_keys, node.right_keys
         )
-        hit = join_ops.membership(
-            build_keys, right.sel, probe_keys,
-            presorted=self._build_presorted(right, node.right_keys))
+        presorted = self._build_presorted(right, node.right_keys)
+        if self._fused_join_enabled():
+            # same tier gate as the lookup join: presorted/device-cached
+            # sorted builds take the merge tier, everything else fuses
+            # build+probe into one combined sort (duplicates on the build
+            # side are fine for membership — any live equal row flags)
+            cached = None if presorted else self._cached_sorted_build(
+                node, right, build_keys)
+            if presorted or cached is not None:
+                build = cached if cached is not None else join_ops.build_side(
+                    build_keys, right.sel, presorted=True)
+                _rows, hit = self._merge_sorted_tier(
+                    node, left, right, build, build_keys, probe_keys)
+            else:
+                M.FUSED_JOIN_SELECTIONS.inc(1, "fused")
+                hit = fused_ops.fused_membership(
+                    build_keys, right.sel, probe_keys)
+        else:
+            M.FUSED_JOIN_SELECTIONS.inc(1, "legacy")
+            hit = join_ops.membership(
+                build_keys, right.sel, probe_keys, presorted=presorted)
         keep = hit if node.join_type == "semi" else ~hit
         sel = keep if left.sel is None else left.sel & keep
         return Page(left.columns, sel, left.replicated)

@@ -446,20 +446,20 @@ class HostEvaluator:
     def _eval_JoinNode(self, node: P.JoinNode) -> HPage:
         if node.singleton or not node.left_keys:
             raise Unsupported("cross/singleton join")
-        if node.join_type not in ("inner", "semi"):
+        if node.join_type not in ("inner", "semi", "anti", "left"):
             raise Unsupported(f"{node.join_type} join")
         left = self.eval(node.left)
         right = self.eval(node.right)
         lk = self._combined_key(left, node.left_keys, right, node.right_keys)
         lkey, rkey = lk
-        if node.join_type == "semi":
+        if node.join_type in ("semi", "anti"):
             if node.filter is not None:
                 raise Unsupported("filtered semi join")
-            keep = np.isin(lkey.values, rkey.live_values())
+            hit = np.isin(lkey.values, rkey.live_values())
             if lkey.nulls is not None:
-                keep &= ~lkey.nulls
-            return left.take(keep)
-        # inner M:N sort-merge expansion
+                hit &= ~lkey.nulls
+            return left.take(hit if node.join_type == "semi" else ~hit)
+        # inner/left M:N sort-merge expansion
         l_idx, r_idx = _inner_match(lkey, rkey)
         joined = HPage(
             [c.take(l_idx) for c in left.cols] + [c.take(r_idx) for c in right.cols]
@@ -472,7 +472,32 @@ class HostEvaluator:
             if valid is not None:
                 mask &= valid
             joined = joined.take(mask)
-        return joined
+            l_idx = l_idx[mask]
+        if node.join_type != "left":
+            return joined
+        # left outer: probe rows with no (filter-passing) match emit once
+        # with NULL build columns
+        matched = np.zeros(left.num_rows, bool)
+        matched[l_idx] = True
+        tail_idx = np.flatnonzero(~matched)
+        tail_cols = [c.take(tail_idx) for c in left.cols] + [
+            HCol(c.type, np.zeros(len(tail_idx), dtype=np.asarray(c.values).dtype),
+                 np.ones(len(tail_idx), bool), c.exact)
+            for c in right.cols
+        ]
+        out = []
+        for jc, tc in zip(joined.cols, tail_cols):
+            nulls = None
+            if jc.nulls is not None or tc.nulls is not None:
+                nulls = np.concatenate([
+                    jc.nulls if jc.nulls is not None
+                    else np.zeros(len(jc.values), bool),
+                    tc.nulls if tc.nulls is not None
+                    else np.zeros(len(tc.values), bool),
+                ])
+            out.append(HCol(jc.type, np.concatenate([jc.values, tc.values]),
+                            nulls, jc.exact and tc.exact))
+        return HPage(out)
 
     def _combined_key(self, left: HPage, lchs, right: HPage, rchs):
         """Reduce (possibly multi-column) join keys to one comparable array

@@ -387,6 +387,27 @@ DEVICE_CACHE_EVICTIONS = REGISTRY.counter(
 DEVICE_CACHE_BYTES = REGISTRY.gauge(
     "trino_tpu_device_cache_bytes",
     "device bytes held by the warm-HBM table cache (the revocable tier)")
+DEVICE_CACHE_BUILD_HITS = REGISTRY.counter(
+    "trino_tpu_device_cache_build_hits_total",
+    "joins served a SORTED build-side artifact from the device cache (the "
+    "warm repeated join skipped the build sort entirely; these also count "
+    "in the general device-cache hit counter — the artifacts share the "
+    "revocable-tier pool and byte budget)")
+# fused sort-merge join tier (ops/fused_join.py): kernel selections per
+# join execution, labeled by the tier the cost gate chose
+FUSED_JOIN_SELECTIONS = REGISTRY.counter(
+    "trino_tpu_fused_join_selections_total",
+    "join kernel selections by the fused-tier cost gate (tier = dense | "
+    "fused | merge-sorted | merge-pallas | legacy); in the compiled/SPMD "
+    "tiers a selection is counted per program TRACE, not per cached-"
+    "executable run", ("tier",))
+# overlapped ICI exchange (parallel/exchange.py): double-buffered send
+# blocks pipelined against join compute in the SPMD tier
+EXCHANGE_OVERLAPPED = REGISTRY.counter(
+    "trino_tpu_exchange_overlapped_total",
+    "probe-side exchanges compiled as double-buffered send-block pipelines "
+    "(all-to-all of block k+1 overlapped with join compute on block k); "
+    "counted per program trace, not per run", ("blocks",))
 
 # adaptive execution (trino_tpu/adaptive/): runtime re-planning from the
 # operator-stats spine, recorded per applied rule at the stage boundary

@@ -316,25 +316,43 @@ def dense_probe_unique(
     return jnp.maximum(slot - 1, 0), matched
 
 
-def dense_membership(
-    build_key: Lowered, build_sel: Optional[jnp.ndarray],
-    probe_key: Lowered, lo: int, span: int,
+def dense_membership_table(
+    build_key: Lowered, build_sel: Optional[jnp.ndarray], lo: int, span: int,
 ) -> jnp.ndarray:
-    """Semi-join membership via a boolean LUT (build duplicates are fine:
-    True is idempotent, so the non-unique scatter-set is deterministic)."""
+    """Build half of the dense membership test: the boolean LUT (build
+    duplicates are fine: True is idempotent, so the non-unique scatter-set
+    is deterministic). Split out so callers probing many pages against ONE
+    build (the overlapped per-block exchange) scatter the table once."""
     bvals, bvalid = build_key
     live = (jnp.ones((bvals.shape[0],), bool) if build_sel is None
             else build_sel)
     if bvalid is not None:
         live = live & bvalid
     idx = jnp.where(live, bvals.astype(jnp.int64) - lo, span)
-    lut = jnp.zeros((span,), bool).at[idx].set(True, mode="drop")
+    return jnp.zeros((span,), bool).at[idx].set(True, mode="drop")
+
+
+def dense_membership_probe(
+    lut: jnp.ndarray, probe_key: Lowered, lo: int,
+) -> jnp.ndarray:
+    """Probe half of the dense membership test: one bounded gather."""
+    span = lut.shape[0]
     pvals, pvalid = probe_key
     v = pvals.astype(jnp.int64)
     hit = (v >= lo) & (v < lo + span) & lut[jnp.clip(v - lo, 0, span - 1)]
     if pvalid is not None:
         hit = hit & pvalid
     return hit
+
+
+def dense_membership(
+    build_key: Lowered, build_sel: Optional[jnp.ndarray],
+    probe_key: Lowered, lo: int, span: int,
+) -> jnp.ndarray:
+    """Semi-join membership via a boolean LUT (one scatter, one bounded
+    gather)."""
+    lut = dense_membership_table(build_key, build_sel, lo, span)
+    return dense_membership_probe(lut, probe_key, lo)
 
 
 def gather_columns(

@@ -1,0 +1,165 @@
+"""Pallas tiled two-pointer merge: sorted probe blocks vs sorted build.
+
+The inner step of the fused sort–merge join where XLA's fusion gives up:
+ranking a sorted probe vector against a sorted build vector is a MERGE —
+each probe block only ever touches the narrow build window its key range
+spans — but XLA has no lowering for that access pattern. ``lax.sort`` of
+the concatenation re-touches both sides at full width, and
+``jnp.searchsorted`` lowers to log2(nb) dependent random-gather passes
+(~7 ns/element on v5e, the measured random-access floor). This kernel
+expresses the merge directly:
+
+- the probe splits into sorted blocks of ``BLOCK_PROBE`` keys (grid);
+- per block, the covering build window ``[start, end)`` is known BEFORE
+  the kernel runs from a searchsorted over only the G block BOUNDARY
+  keys (G = np/BLOCK_PROBE, thousands — the log2 passes are trivial at
+  that width; the per-element floor never applies), fed in through
+  scalar prefetch;
+- the kernel walks the window in ``block_build``-sized chunks DMA'd
+  HBM->VMEM double-buffered (chunk k+1 transfers while chunk k
+  compares), accumulating per probe key its rank (count of smaller
+  build keys) and an equality flag with plain VPU compares.
+
+Output per probe slot: the matched build RANK (index into the sorted
+build), or -1 — exactly what the projection gather consumes.
+
+Contract (enforced by the caller, ops/fused_join.merge_sorted_build):
+int32 keys whose value range proves INT32_MAX unreachable (the pad
+sentinel can then never equal a live probe key), and a build already
+sorted ascending with dead rows as a sentinel tail.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK_PROBE = 1024  # probe keys per grid step (8 sublanes x 128 lanes)
+_PAD = np.int32(np.iinfo(np.int32).max)
+
+
+def pallas_available() -> bool:
+    try:
+        from jax.experimental import pallas as pl  # noqa: F401
+        from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+        return True
+    except Exception:  # noqa: BLE001 — no pallas on this backend/version
+        return False
+
+
+def _kernel(wstart_ref, nwin_ref, probe_ref, build_hbm, out_ref,
+            bwin, sem, *, block_build: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    g = pl.program_id(0)
+    s0 = wstart_ref[g]
+    nw = nwin_ref[g]
+    pk = probe_ref[0, :]  # (BLOCK_PROBE,) int32, sorted
+    sub = block_build // 128
+
+    def window_dma(slot, w):
+        return pltpu.make_async_copy(
+            build_hbm.at[pl.ds((s0 + w * block_build) // 128, sub), :],
+            bwin.at[slot],
+            sem.at[slot],
+        )
+
+    @pl.when(nw > 0)
+    def _():
+        window_dma(0, 0).start()
+
+    def body(w, carry):
+        acc_lt, acc_eq = carry
+        slot = jax.lax.rem(w, jnp.int32(2))
+
+        @pl.when(w + 1 < nw)
+        def _():
+            window_dma(jax.lax.rem(w + 1, jnp.int32(2)), w + 1).start()
+
+        window_dma(slot, w).wait()
+        bw = bwin[slot].reshape(1, block_build)  # sorted chunk
+        pkc = pk[:, None]  # (BLOCK_PROBE, 1)
+        acc_lt = acc_lt + jnp.sum(bw < pkc, axis=1, dtype=jnp.int32)
+        acc_eq = acc_eq | jnp.any(bw == pkc, axis=1)
+        return acc_lt, acc_eq
+
+    zero = jnp.zeros((pk.shape[0],), jnp.int32)
+    acc_lt, acc_eq = jax.lax.fori_loop(
+        0, nw, body, (zero, jnp.zeros((pk.shape[0],), bool))
+    )
+    out_ref[0, :] = jnp.where(acc_eq, s0 + acc_lt, jnp.int32(-1))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_build", "interpret"))
+def merge_unique_sorted(
+    build_sorted: jnp.ndarray,
+    probe_sorted: jnp.ndarray,
+    *,
+    block_build: int = 2048,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Per SORTED probe key: matched build rank or -1. Both inputs int32
+    and ascending; build dead rows must be an INT32_MAX-sentinel tail
+    (they then never equal a live probe key — the caller proved the
+    sentinel unreachable from the column's value range)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    assert build_sorted.dtype == jnp.int32 and probe_sorted.dtype == jnp.int32
+    nb = build_sorted.shape[0]
+    np_ = probe_sorted.shape[0]
+    block_build = max(128, (block_build // 128) * 128)
+    if np_ == 0 or nb == 0:
+        return jnp.full((np_,), -1, jnp.int32)
+    # pad probe to a whole number of blocks with the last (max) key: pad
+    # slots compute garbage that the final slice drops, and they cannot
+    # widen any block's build window (they equal the block max)
+    g = -(-np_ // BLOCK_PROBE)
+    probe_pad = jnp.concatenate([
+        probe_sorted,
+        jnp.broadcast_to(probe_sorted[-1:], (g * BLOCK_PROBE - np_,)),
+    ]).reshape(g, BLOCK_PROBE)
+    # pad build with the sentinel so every window DMA stays in bounds:
+    # window starts align DOWN to 128 and run a whole number of
+    # block_build chunks past the covering range
+    nb_pad = (-(-nb // block_build) + 2) * block_build
+    build_pad = jnp.concatenate([
+        build_sorted, jnp.full((nb_pad - nb,), _PAD, jnp.int32)
+    ])
+    # covering build window per block from its BOUNDARY keys only (G keys
+    # — searchsorted's log2 random-gather passes are trivial at this
+    # width; ops/ranks.py bans it for per-ELEMENT ranking, not this)
+    starts = jnp.searchsorted(build_pad, probe_pad[:, 0], side="left")
+    ends = jnp.searchsorted(build_pad, probe_pad[:, -1], side="right")
+    wstart = ((starts // 128) * 128).astype(jnp.int32)
+    nwin = (-(-(ends.astype(jnp.int32) - wstart) // block_build)).astype(jnp.int32)
+    # hard in-bounds clamp: a probe key equal to the pad sentinel would
+    # push ``ends`` to nb_pad and the alignment slack one window past the
+    # buffer — windows beyond nb_pad hold nothing real, so clamping never
+    # changes a rank or a match
+    nwin = jnp.minimum(nwin, (jnp.int32(nb_pad) - wstart) // block_build)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_PROBE), lambda i, *_: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # build stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_PROBE), lambda i, *_: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_build // 128, 128), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_build=block_build),
+        out_shape=jax.ShapeDtypeStruct((g, BLOCK_PROBE), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(wstart, nwin, probe_pad, build_pad.reshape(nb_pad // 128, 128))
+    return out.reshape(-1)[:np_]

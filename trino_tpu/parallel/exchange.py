@@ -92,6 +92,20 @@ def spread_partition_ids(pid: np.ndarray, hot_partitions, n_parts: int,
     return pid, (start + len(idx)) % n_parts
 
 
+def page_partition_ids(page: Page, key_channels: List[int],
+                       n_devices: int) -> jnp.ndarray:
+    """Partition ids for a page's key columns — hoisted out of
+    :func:`repartition_page` so callers that route the SAME page more than
+    once (the overlapped per-block exchange, the adaptive salting path)
+    hash it exactly once and reuse the array."""
+    keys = [
+        (page.columns[c].values,
+         None if page.columns[c].nulls is None else ~page.columns[c].nulls)
+        for c in key_channels
+    ]
+    return partition_ids(keys, n_devices)
+
+
 def repartition_page(
     page: Page,
     key_channels: List[int],
@@ -103,17 +117,56 @@ def repartition_page(
 
     Returns (received_page [n_devices*capacity rows, sharded], overflow_flag).
     Dead rows (sel False) are not sent; received pad slots carry sel False.
+    Callers that route the same page more than once hash it once via
+    :func:`page_partition_ids` + :func:`repartition_by_pid` (the
+    overlapped per-block exchange does this internally).
     """
     for c in page.columns:
         if c.hi is not None or c.type.is_nested:
             raise NotImplementedError(
                 "device hash exchange over long-decimal/nested columns")
-    keys = [
-        (page.columns[c].values, None if page.columns[c].nulls is None else ~page.columns[c].nulls)
-        for c in key_channels
-    ]
-    pid = partition_ids(keys, n_devices)
+    pid = page_partition_ids(page, key_channels, n_devices)
     return repartition_by_pid(page, pid, n_devices, capacity, axis)
+
+
+def _send_plan(page: Page, pid: jnp.ndarray, n_devices: int):
+    """(order, starts, counts): the routing plan shared by the one-shot
+    exchange and the overlapped per-block exchange — rows sorted by
+    partition id (dead rows last) and each partition's [start, count)
+    range in sorted space (merge ranks, no search)."""
+    n = page.num_rows
+    live = page.sel if page.sel is not None else jnp.ones((n,), bool)
+    pid = jnp.where(live, pid, jnp.int32(n_devices))  # dead rows sort last
+    order = ranks.argsort32(pid)
+    pid_sorted = pid[order]
+    starts, counts = ranks.sorted_ranks(
+        [pid_sorted], [jnp.arange(n_devices, dtype=jnp.int32)]
+    )
+    return order, starts, counts
+
+
+def _xchg_block(page: Page, order, starts, counts, lo: int, cap: int,
+                n_devices: int, axis: str) -> Page:
+    """Exchange send-slot range [lo, lo+cap) of every partition: gather
+    the block's rows, ``all_to_all`` them across the mesh axis, and
+    assemble the received page (pad slots dead)."""
+    n = page.num_rows
+    j = lo + jnp.arange(cap, dtype=jnp.int32)
+    slot_idx = jnp.clip(starts[:, None] + j[None, :], 0, n - 1)  # [ndev, cap]
+    send_live = j[None, :] < counts[:, None]
+    rows = order[slot_idx]  # original row index per send slot
+
+    def xchg(a: jnp.ndarray) -> jnp.ndarray:
+        recv = jax.lax.all_to_all(a, axis, split_axis=0, concat_axis=0, tiled=False)
+        return recv.reshape((n_devices * cap,) + recv.shape[2:])
+
+    out_cols = []
+    for c in page.columns:
+        vals = xchg(c.values[rows])
+        nulls = xchg(c.nulls[rows]) if c.nulls is not None else None
+        out_cols.append(Column(c.type, vals, nulls, c.dictionary, c.vrange))
+    sel = xchg(send_live)
+    return Page(out_cols, sel, replicated=False)
 
 
 def repartition_by_pid(
@@ -128,29 +181,88 @@ def repartition_by_pid(
     (FIXED_HASH_DISTRIBUTION) and the range exchange used by the sharded
     distributed sort (the reference's range-partitioned MergeOperator
     pipeline, redesigned as splitter-routed all_to_all)."""
-    n = page.num_rows
-    live = page.sel if page.sel is not None else jnp.ones((n,), bool)
-    pid = jnp.where(live, pid, jnp.int32(n_devices))  # dead rows sort last
-    order = ranks.argsort32(pid)
-    pid_sorted = pid[order]
-    # per-partition [start, count) in sorted space (merge ranks, no search)
-    starts, counts = ranks.sorted_ranks(
-        [pid_sorted], [jnp.arange(n_devices, dtype=jnp.int32)]
-    )
+    order, starts, counts = _send_plan(page, pid, n_devices)
     overflow = jnp.any(counts > capacity)
-    j = jnp.arange(capacity, dtype=jnp.int32)
-    slot_idx = jnp.clip(starts[:, None] + j[None, :], 0, n - 1)  # [ndev, cap]
-    send_live = j[None, :] < counts[:, None]
-    rows = order[slot_idx]  # original row index per send slot
+    out = _xchg_block(page, order, starts, counts, 0, capacity,
+                      n_devices, axis)
+    return out, overflow
 
-    def xchg(a: jnp.ndarray) -> jnp.ndarray:
-        recv = jax.lax.all_to_all(a, axis, split_axis=0, concat_axis=0, tiled=False)
-        return recv.reshape((n_devices * capacity,) + recv.shape[2:])
 
-    out_cols = []
+def repartition_page_overlapped(
+    page: Page,
+    key_channels: List[int],
+    n_devices: int,
+    capacity: int,
+    axis: str,
+    n_blocks: int,
+    consume,
+) -> Tuple[Page, jnp.ndarray]:
+    """Hash-repartition with the send buffer split into ``n_blocks``
+    double-buffered blocks, each consumed as it lands: the ``all_to_all``
+    for block k+1 is ISSUED (in program order) before ``consume`` runs on
+    block k, so XLA's async collective scheduler overlaps the ICI
+    transfer with compute — the exchange-then-compute barrier of the
+    one-shot path becomes a pipeline.
+
+    ``consume(received_block_page) -> Page`` must be ROW-LOCAL (each
+    output row a function of its input row plus replicated state — the
+    probe side of a lookup/semi join against an already-exchanged build).
+    Under that contract the assembled result is BIT-IDENTICAL to
+    ``consume(repartition_page(...))``: per-block outputs restack from
+    block-major to the one-shot path's device-major row order before
+    concatenation (a static transpose, no data-dependent movement).
+
+    The effective capacity rounds up to a whole number of blocks;
+    returns (assembled_page, overflow_flag).
+    """
     for c in page.columns:
-        vals = xchg(c.values[rows])
-        nulls = xchg(c.nulls[rows]) if c.nulls is not None else None
-        out_cols.append(Column(c.type, vals, nulls, c.dictionary, c.vrange))
-    sel = xchg(send_live)
-    return Page(out_cols, sel, replicated=False), overflow
+        if c.hi is not None or c.type.is_nested:
+            raise NotImplementedError(
+                "device hash exchange over long-decimal/nested columns")
+    n_blocks = max(int(n_blocks), 1)
+    bcap = -(-capacity // n_blocks)
+    pid = page_partition_ids(page, key_channels, n_devices)
+    order, starts, counts = _send_plan(page, pid, n_devices)
+    overflow = jnp.any(counts > bcap * n_blocks)
+    out_pages: List[Page] = []
+    prev = _xchg_block(page, order, starts, counts, 0, bcap, n_devices, axis)
+    for b in range(1, n_blocks):
+        # issue block b's collectives BEFORE consuming block b-1: the
+        # program-order gap is what the latency-hiding scheduler fills
+        nxt = _xchg_block(page, order, starts, counts, b * bcap, bcap,
+                          n_devices, axis)
+        out_pages.append(consume(prev))
+        prev = nxt
+    out_pages.append(consume(prev))
+    return _restack_blocks(out_pages, n_devices, bcap), overflow
+
+
+def _restack_blocks(pages: List[Page], n_devices: int, bcap: int) -> Page:
+    """Reorder per-block consume outputs (block-major) into the one-shot
+    exchange's row order (device-major): rows [b][dev][slot] transpose to
+    [dev][b][slot] and flatten — device d's region is then its blocks in
+    order, exactly the unoverlapped layout."""
+    n_blocks = len(pages)
+    if n_blocks == 1:
+        return pages[0]
+
+    def restack(arrays: List[jnp.ndarray]) -> jnp.ndarray:
+        stacked = jnp.stack([
+            a.reshape((n_devices, bcap) + a.shape[1:]) for a in arrays
+        ])  # [blocks, ndev, bcap, ...]
+        moved = jnp.moveaxis(stacked, 0, 1)  # [ndev, blocks, bcap, ...]
+        return moved.reshape((n_devices * n_blocks * bcap,) + moved.shape[3:])
+
+    first = pages[0]
+    out_cols = []
+    for ci, c in enumerate(first.columns):
+        vals = restack([p.columns[ci].values for p in pages])
+        nulls = (restack([p.columns[ci].nulls for p in pages])
+                 if c.nulls is not None else None)
+        hi = (restack([p.columns[ci].hi for p in pages])
+              if c.hi is not None else None)
+        out_cols.append(Column(c.type, vals, nulls, c.dictionary, c.vrange,
+                               hi=hi))
+    sel = (restack([p.sel for p in pages])
+           if first.sel is not None else None)
+    return Page(out_cols, sel, replicated=first.replicated)

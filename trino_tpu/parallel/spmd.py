@@ -38,7 +38,7 @@ from trino_tpu import types as T
 from trino_tpu.connector import spi as spi_mod
 from trino_tpu.data.page import Column, Page
 from trino_tpu.data import page as page_mod
-from trino_tpu.exec.executor import Executor, QueryError
+from trino_tpu.exec.executor import Executor, QueryError, _col_to_lowered
 from trino_tpu.exec.page_tree import ColSpec, PageSpec, flatten_page, unflatten_page
 from trino_tpu.ops import aggregate as agg_ops
 from trino_tpu.ops import groupby as gb
@@ -117,13 +117,7 @@ class SpmdExecutor(Executor):
     def _repartition(self, page: Page, key_channels, hint_key: str) -> Page:
         from trino_tpu.parallel import exchange
 
-        if any(c.hi is not None for c in page.columns):
-            # the device exchange has no limb lanes: degrade to low words
-            # with the deferred overflow check (Executor._narrowed_or_flag)
-            page = Page(
-                [self._narrowed_or_flag(c, page.sel) for c in page.columns],
-                page.sel, page.replicated, live_prefix=page.live_prefix,
-            )
+        page = self._narrowed_for_exchange(page)
         capacity = self.hint_capacity(hint_key, None)
         out, overflow = exchange.repartition_page(
             page, key_channels, self.n_devices, capacity, AXIS
@@ -173,7 +167,118 @@ class SpmdExecutor(Executor):
         return Page(out.columns, out.sel, replicated=True)
 
     # -------------------------------------------------- distributed joins
+    def _overlap_blocks(self) -> int:
+        props = getattr(self.session, "properties", None) or {}
+        return int(props.get("exchange_overlap_blocks", 0) or 0)
+
+    def _narrowed_for_exchange(self, page: Page) -> Page:
+        """Two-limb columns degrade to low words with the deferred
+        overflow check before any device exchange (no limb lanes)."""
+        if not any(c.hi is not None for c in page.columns):
+            return page
+        return Page(
+            [self._narrowed_or_flag(c, page.sel) for c in page.columns],
+            page.sel, page.replicated, live_prefix=page.live_prefix,
+        )
+
+    def _overlapped_join(self, node: P.JoinNode, left: Page, right: Page,
+                         semi: bool) -> Optional[Page]:
+        """Partitioned lookup/semi join with the PROBE-side exchange
+        pipelined against join compute: the build side co-partitions
+        first (it must be complete before any probe row can match), then
+        the probe side ships in ``exchange_overlap_blocks`` double-
+        buffered send blocks — the ``all_to_all`` for block k+1 issues
+        before the join kernel consumes block k, so ICI transfer and
+        compute overlap instead of running as exchange-then-compute
+        phases. Build artifacts (the dense table or the sorted build) are
+        hoisted OUT of the per-block consume, so the per-block work is
+        pure probe. Bit-identical to the unoverlapped path: the consume
+        is row-local and the block outputs restack to the one-shot row
+        order (exchange._restack_blocks). Returns None when the pipeline
+        doesn't apply (disabled, broadcast distribution, replicated
+        inputs)."""
+        from trino_tpu.obs import metrics as M
+        from trino_tpu.obs import trace as tracing
+        from trino_tpu.ops import join as join_ops
+        from trino_tpu.parallel import exchange
+        from trino_tpu.sql.planner import stats
+
+        blocks = self._overlap_blocks()
+        if blocks <= 1 or left.replicated or right.replicated:
+            return None
+        if not self._fused_join_enabled():
+            # the per-block consume rides the fused module's merge tier;
+            # disabling the fused tier must disable the pipeline too (the
+            # kill switch covers ALL new join-kernel code paths)
+            return None
+        if not stats.join_repartitions(self.session, node, self.n_devices):
+            return None
+        right2 = self._repartition(right, node.right_keys, f"xchgr:{node.id}")
+        left = self._narrowed_for_exchange(left)
+        capacity = self.hint_capacity(f"xchgl:{node.id}", None)
+        # ---- build artifacts, hoisted out of the per-block consume (the
+        # per-block work must be pure probe: one dense table / membership
+        # LUT / sorted build, shared by every block)
+        dense = self._dense_join_cols(node, left, right2)
+        table = lut = build = None
+        if dense is not None:
+            bc, pc, lo, span = dense
+            if semi:
+                lut = join_ops.dense_membership_table(
+                    _col_to_lowered(bc), right2.sel, lo, span)
+            else:
+                table = join_ops.dense_unique_table(
+                    _col_to_lowered(bc), right2.sel, lo, span)
+            M.FUSED_JOIN_SELECTIONS.inc(1, "dense")
+        else:
+            bk, _pk = self._join_keys_aligned(
+                left, right2, node.left_keys, node.right_keys)
+            build = join_ops.build_side(
+                bk, right2.sel,
+                presorted=self._build_presorted(right2, node.right_keys))
+        recorded = [False]  # first consume records the merge-tier selection
+
+        def consume(lp: Page) -> Page:
+            if dense is not None:
+                bc, pc, lo, span = dense
+                plowered = _col_to_lowered(lp.columns[node.left_keys[0]])
+                if semi:
+                    hit = join_ops.dense_membership_probe(lut, plowered, lo)
+                else:
+                    rows, matched = join_ops.dense_probe_unique(
+                        table, plowered, lo)
+            else:
+                bkeys, pkeys = self._join_keys_aligned(
+                    lp, right2, node.left_keys, node.right_keys)
+                # the tier selection is counted ONCE per join (first
+                # block), not once per send block
+                rows, matched = self._merge_sorted_tier(
+                    node, lp, right2, build, bkeys, pkeys,
+                    record=not recorded[0])
+                recorded[0] = True
+                if semi:
+                    hit = matched
+            if semi:
+                keep = hit if node.join_type == "semi" else ~hit
+                sel = keep if lp.sel is None else lp.sel & keep
+                return Page(lp.columns, sel, lp.replicated)
+            return self._assemble_lookup_output(
+                node, lp, right2, rows, matched)
+
+        with tracing.span("exchange/overlap") as sp:
+            sp.set("blocks", blocks)
+            sp.set("join", node.id)
+            out, overflow = exchange.repartition_page_overlapped(
+                left, node.left_keys, self.n_devices, capacity, AXIS,
+                blocks, consume)
+        self.errors.append((f"CAPACITY_EXCEEDED:xchgl:{node.id}", overflow))
+        M.EXCHANGE_OVERLAPPED.inc(1, str(blocks))
+        return out
+
     def lookup_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        out = self._overlapped_join(node, left, right, semi=False)
+        if out is not None:
+            return out
         rp = self._join_repartitioned(node, left, right)
         if rp is not None:
             return Executor.lookup_join(self, node, *rp)
@@ -181,6 +286,9 @@ class SpmdExecutor(Executor):
         return super().lookup_join(node, left, gather_page(right))
 
     def semi_join(self, node: P.JoinNode, left: Page, right: Page) -> Page:
+        out = self._overlapped_join(node, left, right, semi=True)
+        if out is not None:
+            return out
         rp = self._join_repartitioned(node, left, right)
         if rp is not None:
             return Executor.semi_join(self, node, *rp)

@@ -467,9 +467,20 @@ class SqlTask:
         producer half of the salted repartition join."""
         from trino_tpu.exec.memory import partition_page_host
 
+        import numpy as np
+
         req = self.request
+        # ONE hash pass per page: the pid array is computed once (with the
+        # per-dictionary vocab hashes cached across a streaming producer's
+        # pages) and reused by the salting spread, the partitioning
+        # re-send, AND the skew-detection accounting below — previously
+        # the accounting re-walked every partition page (N live_count
+        # passes) after the hash pass
+        if not hasattr(self, "_vocab_hash_cache"):
+            self._vocab_hash_cache = {}
         pids = _canonical_partition_ids(
-            page, req.output_partition_channels, req.consumer_count)
+            page, req.output_partition_channels, req.consumer_count,
+            vocab_cache=self._vocab_hash_cache)
         spread = getattr(req, "skew_spread_partitions", None)
         if spread:
             from trino_tpu.parallel.exchange import spread_partition_ids
@@ -492,11 +503,19 @@ class SqlTask:
                         part = Page.concat_pages(part, hp)
                 out.append(part)
             parts = out
+        # detection accounting straight off the (post-spread) pid array:
+        # one bincount, and replicated hot-partition copies no longer
+        # inflate the skew signal the re-planner reads
+        n = page.num_rows
+        live = (np.ones(n, bool) if page.sel is None
+                else np.asarray(page.sel).astype(bool))
+        counts = np.bincount(np.asarray(pids)[live],
+                             minlength=req.consumer_count)
         with self._stats_lock:
             if self.partition_rows is None:
                 self.partition_rows = [0] * req.consumer_count
-            for pid, part in enumerate(parts):
-                self.partition_rows[pid] += int(part.live_count())
+            for pid in range(req.consumer_count):
+                self.partition_rows[pid] += int(counts[pid])
         return parts
 
     def _enqueue_out(self, out: Page, part_channels, consumer_count) -> None:
@@ -784,7 +803,11 @@ def _chunk_pages(page: Page, chunk_rows: int):
         yield page.slice_rows(lo, min(n, lo + chunk_rows))
 
 
-def _canonical_partition_ids(page: Page, channels, parts: int):
+_VOCAB_CACHE_MAX = 8  # distinct vocabularies a producer realistically shares
+
+
+def _canonical_partition_ids(page: Page, channels, parts: int,
+                             vocab_cache=None):
     """Per-row partition ids that agree ACROSS producer processes.
 
     partition_page_host's value hash is dictionary-scoped for varchar
@@ -792,26 +815,48 @@ def _canonical_partition_ids(page: Page, channels, parts: int):
     (one process, one dictionary) but would split equal string keys across
     FINAL tasks here. Varchar columns therefore hash their canonical UTF-8
     string per vocab entry (blake2b-8) and map codes through that table;
-    other columns keep the shared splitmix64 value hash."""
+    other columns keep the shared splitmix64 value hash.
+
+    ``vocab_cache`` (optional dict) memoizes the per-vocabulary hash
+    table across a producer's pages — streaming producers share one
+    dictionary across hundreds of pages, and re-blake2b-ing the whole
+    vocabulary per page was the dominant per-call hash cost. Entries hold
+    a strong reference to their Dictionary so the id key can never be
+    reused by a different vocabulary; the cache is capped (FIFO) so
+    producers whose pages carry PER-PAGE dictionaries cannot grow it or
+    pin vocabularies unboundedly."""
     import hashlib
 
     import numpy as np
 
     from trino_tpu.exec.memory import _NULL_HASH, _mix64_np
 
+    def _vocab_hashes(d):
+        if vocab_cache is not None:
+            hit = vocab_cache.get(id(d))
+            if hit is not None and hit[0] is d:
+                return hit[1]
+        table = np.array(
+            [
+                int.from_bytes(
+                    hashlib.blake2b(v.encode(), digest_size=8).digest(),
+                    "little")
+                for v in d.values
+            ] or [0],
+            dtype=np.uint64,
+        )
+        if vocab_cache is not None:
+            while len(vocab_cache) >= _VOCAB_CACHE_MAX:
+                vocab_cache.pop(next(iter(vocab_cache)))
+            vocab_cache[id(d)] = (d, table)
+        return table
+
     n = page.num_rows
     h = np.zeros(n, np.uint64)
     for ch in channels:
         col = page.columns[ch]
         if col.type.is_varchar and col.dictionary is not None:
-            vocab_hash = np.array(
-                [
-                    int.from_bytes(
-                        hashlib.blake2b(v.encode(), digest_size=8).digest(), "little")
-                    for v in col.dictionary.values
-                ] or [0],
-                dtype=np.uint64,
-            )
+            vocab_hash = _vocab_hashes(col.dictionary)
             codes = np.asarray(col.values)
             k = vocab_hash[np.clip(codes, 0, len(vocab_hash) - 1)]
             k = np.where(codes < 0, np.uint64(_NULL_HASH), k)
