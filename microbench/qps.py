@@ -1,0 +1,273 @@
+"""QPS microbench: the serving-path trajectory (queries per second).
+
+Every BENCH_r* round measures single-query throughput; heavy traffic is
+queries per SECOND. This bench boots a real coordinator + N workers in
+one process (the DistributedQueryRunner idiom the test suite uses),
+drives C concurrent DBAPI clients over a mixed serving workload, and
+measures the two control-plane configurations ISSUE 10 ships:
+
+- **serving ON** — prepared point lookups through PREPARE/EXECUTE (the
+  parameterized plan caches once; every EXECUTE is bind + run) with the
+  short-query fast path enabled (single-stage plans run
+  coordinator-local, zero task HTTP round-trips);
+- **serving OFF** — the same statements as plain SQL with literals
+  substituted client-side, fast path disabled: every request pays
+  parse/analyze/plan/optimize + fragment/schedule/exchange.
+
+Workload mix (per client, round-robin):
+- ``point``   — prepared point lookup on ``orders`` (the serving shape);
+- ``cached``  — a repeated aggregate with the result cache on (HIT path);
+- ``uncached``— an aggregate over a shifting predicate (MISS every time).
+
+Emits ``QPS_r01.json`` next to the other bench artifacts: per-config
+qps + p50/p95/p99 latency per workload class, the per-path breakdown
+(fast-path vs distributed counts from the coordinator's own metrics),
+and the ON/OFF speedup on the point mix.
+
+Run:    python microbench/qps.py [--clients C] [--requests N] [--workers W]
+Check:  python microbench/qps.py --check [--min-speedup X]
+        (tier-1 quick mode, small N, CPU-runnable: asserts the serving
+        config clears ``min_speedup`` x on the point-lookup mix)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POINT_SQL = ("select o_orderkey, o_totalprice, o_orderstatus "
+             "from orders where o_orderkey = ?")
+CACHED_SQL = ("select o_orderstatus, count(*), sum(o_totalprice) "
+              "from orders group by o_orderstatus order by o_orderstatus")
+UNCACHED_SQL = ("select count(*), max(o_totalprice) from orders "
+                "where o_orderkey > {k}")
+
+# Point keys are UNIQUE per request (client*stride + sequence): a repeated
+# key would be a result-cache HIT in both configurations, which measures
+# the cache, not the control path. Unique keys force a genuine execution
+# every time — the ON config's win is exactly the prepared-plan reuse +
+# fast path the ISSUE bounds. (Key presence does not change the cost: the
+# scan+filter runs either way; a separate known-present probe validates
+# results before measurement.)
+KNOWN_PRESENT_KEY = 7  # exists at every tpch scale
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def _latency_summary(lat_s) -> dict:
+    s = sorted(lat_s)
+    return {
+        "requests": len(s),
+        "p50_ms": round(_percentile(s, 0.50) * 1e3, 3),
+        "p95_ms": round(_percentile(s, 0.95) * 1e3, 3),
+        "p99_ms": round(_percentile(s, 0.99) * 1e3, 3),
+        "mean_ms": round(sum(s) / len(s) * 1e3, 3) if s else 0.0,
+    }
+
+
+def run_config(coord_url: str, serving_on: bool, clients: int,
+               requests_per_client: int, mix=("point", "point", "cached",
+                                              "uncached", "point")) -> dict:
+    """One measured configuration: C threads, each its own DBAPI
+    connection, round-robin over the workload mix. Returns the stats
+    block (qps, latency summaries per class, failure count)."""
+    from trino_tpu.client import dbapi
+    from trino_tpu.obs import metrics as M
+
+    props = {
+        # the warm DATA path (PR 2 result cache + PR 7 device cache) is on
+        # in BOTH configurations — this bench isolates the CONTROL path
+        # (prepared plans + fast path), composing with the caches the way
+        # a serving deployment would run
+        "result_cache_enabled": "true",
+        "device_cache_enabled": "true",
+        "short_query_fast_path": "true" if serving_on else "false",
+    }
+    # warmup: compile the executor/worker paths for every statement shape
+    # so the measurement sees steady-state serving, not jit compiles —
+    # and validate the point shape returns the known-present row
+    warm = dbapi.connect(coordinator_url=coord_url, **props).cursor()
+    if serving_on:
+        warm.execute(POINT_SQL, (KNOWN_PRESENT_KEY,))
+    else:
+        warm.execute(POINT_SQL.replace("?", str(KNOWN_PRESENT_KEY)))
+    assert warm.rowcount == 1, "point probe must hit a known row"
+    warm.execute(CACHED_SQL)
+    warm.execute(UNCACHED_SQL.format(k=0))
+
+    fast0 = M.FAST_PATH_QUERIES.value("fast-path")
+    dist0 = M.FAST_PATH_QUERIES.value("distributed")
+    latencies = {"point": [], "cached": [], "uncached": []}
+    lat_lock = threading.Lock()
+    failures = []
+
+    def client_loop(ci: int):
+        cur = dbapi.connect(coordinator_url=coord_url, **props).cursor()
+        for r in range(requests_per_client):
+            kind = mix[(ci + r) % len(mix)]
+            t0 = time.perf_counter()
+            try:
+                # keys are unique per request AND offset per CONFIG: the
+                # result cache is shared server state with a 60s TTL, so
+                # reusing the OFF run's keys would serve the ON run's
+                # "uncached"/"point" classes as cross-config cache HITs —
+                # measuring the cache instead of the control path
+                base = 2_000_000 if serving_on else 1_000_000
+                if kind == "point":
+                    k = base + ci * 100_000 + r  # unique per request
+                    if serving_on:
+                        cur.execute(POINT_SQL, (k,))
+                    else:
+                        # both-off baseline: literal substitution, no
+                        # PREPARE round-trip, plan cache misses on every
+                        # distinct key (the pre-PR serving reality)
+                        cur.execute(POINT_SQL.replace("?", str(k)))
+                elif kind == "cached":
+                    cur.execute(CACHED_SQL)
+                else:
+                    cur.execute(UNCACHED_SQL.format(
+                        k=base + (ci * 131 + r) % 997))
+            except Exception as e:  # noqa: BLE001 — counted, not fatal
+                failures.append(f"{kind}: {e}")
+                continue
+            dt = time.perf_counter() - t0
+            with lat_lock:
+                latencies[kind].append(dt)
+
+    threads = [threading.Thread(target=client_loop, args=(ci,))
+               for ci in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    total = sum(len(v) for v in latencies.values())
+    return {
+        "serving_on": serving_on,
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "wall_s": round(wall, 3),
+        "qps": round(total / wall, 2) if wall > 0 else 0.0,
+        "failures": len(failures),
+        "failure_samples": failures[:3],
+        "paths": {
+            "fast_path": int(M.FAST_PATH_QUERIES.value("fast-path") - fast0),
+            "distributed": int(
+                M.FAST_PATH_QUERIES.value("distributed") - dist0),
+        },
+        "latency": {k: _latency_summary(v) for k, v in latencies.items()},
+    }
+
+
+def run_point_only(coord_url: str, serving_on: bool, clients: int,
+                   requests_per_client: int) -> dict:
+    """The acceptance mix: point lookups only (the serving shape the
+    ISSUE's >=Nx bound is defined over)."""
+    return run_config(coord_url, serving_on, clients, requests_per_client,
+                      mix=("point",))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=50,
+                    help="requests per client per configuration")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--check", action="store_true",
+                    help="quick tier-1 mode: small N, assert speedup")
+    ap.add_argument("--min-speedup", type=float, default=None,
+                    help="required ON/OFF qps ratio on the point mix "
+                    "(default: 3.0, or 2.0 under --check for CI headroom)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    min_speedup = args.min_speedup if args.min_speedup is not None else (
+        2.0 if args.check else 3.0)
+    if args.check:
+        args.clients, args.requests = 2, 20
+
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [WorkerServer(coordinator_url=coord.base_url,
+                            node_id=f"qps{i}") for i in range(args.workers)]
+    for w in workers:
+        w.start()
+    assert coord.registry.wait_for_workers(args.workers, timeout=30.0)
+
+    try:
+        print(f"# point-lookup mix: {args.clients} clients x "
+              f"{args.requests} requests per config", flush=True)
+        off_point = run_point_only(coord.base_url, False, args.clients,
+                                   args.requests)
+        print(f"  serving OFF: {off_point['qps']} qps "
+              f"(p50 {off_point['latency']['point']['p50_ms']}ms)",
+              flush=True)
+        on_point = run_point_only(coord.base_url, True, args.clients,
+                                  args.requests)
+        print(f"  serving ON : {on_point['qps']} qps "
+              f"(p50 {on_point['latency']['point']['p50_ms']}ms, "
+              f"fast-path {on_point['paths']['fast_path']})", flush=True)
+        speedup = (on_point["qps"] / off_point["qps"]
+                   if off_point["qps"] > 0 else float("inf"))
+        print(f"  speedup: {speedup:.2f}x (required {min_speedup}x)",
+              flush=True)
+
+        result = {
+            "bench": "qps",
+            "round": 1,
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+            "workers": args.workers,
+            "point_mix": {"off": off_point, "on": on_point,
+                          "speedup": round(speedup, 3),
+                          "min_speedup": min_speedup},
+        }
+        if not args.check:
+            # full mode adds the mixed workload (cached/uncached classes)
+            print("# mixed workload", flush=True)
+            off_mix = run_config(coord.base_url, False, args.clients,
+                                 args.requests)
+            on_mix = run_config(coord.base_url, True, args.clients,
+                                args.requests)
+            print(f"  mixed OFF: {off_mix['qps']} qps | "
+                  f"ON: {on_mix['qps']} qps", flush=True)
+            result["mixed"] = {"off": off_mix, "on": on_mix}
+
+        failures = off_point["failures"] + on_point["failures"]
+        ok = speedup >= min_speedup and failures == 0
+        result["ok"] = bool(ok)
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "QPS_r01.json")
+        if args.check and args.out is None:
+            out = None  # quick mode never clobbers the recorded round
+        if out:
+            with open(out, "w") as f:
+                json.dump(result, f, indent=2)
+            print(f"wrote {out}", flush=True)
+        if not ok:
+            print(f"FAIL: speedup {speedup:.2f}x < {min_speedup}x "
+                  f"or failures={failures}", file=sys.stderr)
+            return 1
+        print("OK", flush=True)
+        return 0
+    finally:
+        for w in workers:
+            w.stop()
+        coord.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,405 @@
+"""PREPARE/EXECUTE/DEALLOCATE: the serving control path (ISSUE 10).
+
+Covers the tentpole contracts end to end against a real coordinator +
+workers cluster:
+
+- parse round-trip for all three statements;
+- the plan-cache single-entry-many-bindings proof: the second EXECUTE of
+  a prepared point query performs ZERO parse/analyze/plan/optimize work
+  (absent spans + plan-cache hit), while every binding still gets its own
+  correct rows;
+- the result cache keys on the BOUND values (per-binding HIT/MISS
+  matrix) and invalidates on DML exactly like unprepared queries;
+- bind-arity and non-constant errors; type-incompatible bindings fail
+  loudly at analysis;
+- a concurrent EXECUTE storm;
+- the DBAPI qmark route (PREPARE once, EXECUTE per binding) and
+  executemany over one prepared plan;
+- the system.runtime.prepared_statements live table and the new metrics.
+"""
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+import tests.conftest  # noqa: F401 — cpu mesh config
+from trino_tpu.obs import metrics as M
+from trino_tpu.sql.parser import ast
+from trino_tpu.sql.parser.parser import parse_statement
+
+
+# ------------------------------------------------------------------ parsing
+def test_parse_prepare_execute_deallocate():
+    p = parse_statement("PREPARE q1 FROM select a from t where a = ?")
+    assert isinstance(p, ast.Prepare) and p.name == "q1"
+    assert isinstance(p.statement, ast.Query)
+
+    e = parse_statement("EXECUTE q1 USING 7, 'x'")
+    assert isinstance(e, ast.ExecutePrepared) and e.name == "q1"
+    assert len(e.params) == 2
+
+    e2 = parse_statement("execute q1")
+    assert isinstance(e2, ast.ExecutePrepared) and e2.params == ()
+
+    d = parse_statement("DEALLOCATE PREPARE q1")
+    assert isinstance(d, ast.Deallocate) and d.name == "q1"
+    d2 = parse_statement("deallocate q1")
+    assert isinstance(d2, ast.Deallocate)
+
+
+def test_parse_parameter_indexes_count_left_to_right():
+    p = parse_statement(
+        "prepare q from select * from t where a = ? and b between ? and ?")
+    from trino_tpu.server.prepared import count_parameters
+
+    assert count_parameters(p.statement) == 3
+
+
+# ------------------------------------------------------------- local engine
+def test_local_session_bind_arity_both_directions():
+    from trino_tpu.client.session import Session
+
+    s = Session({"catalog": "memory", "schema": "default"})
+    s.execute("create table pt (a bigint, b varchar)")
+    s.execute("insert into pt values (1, 'x'), (2, 'y')")
+    s.execute("prepare p1 from select b from pt where a = ?")
+    assert s.execute("execute p1 using 2").rows == [("y",)]
+    with pytest.raises(Exception, match="parameter"):
+        s.execute("execute p1")  # too few
+    with pytest.raises(Exception, match="parameter"):
+        s.execute("execute p1 using 1, 2")  # too many
+
+
+# ------------------------------------------------------------ cluster fixture
+@pytest.fixture(scope="module")
+def cluster():
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [
+        WorkerServer(coordinator_url=coord.base_url, node_id=f"pw{i}")
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    assert coord.registry.wait_for_workers(2, timeout=15.0)
+    yield coord, workers
+    for w in workers:
+        w.stop()
+    coord.stop()
+
+
+def _client(coord, **props):
+    from trino_tpu.client.remote import StatementClient
+
+    return StatementClient(coord.base_url, {
+        "catalog": "tpch", "schema": "tiny", **props})
+
+
+def _last_query(coord):
+    return coord.queries[sorted(coord.queries)[-1]]
+
+
+def _span_names(q):
+    return {s["name"] for s in q.tracer.to_dicts()}
+
+
+# ------------------------------------------------- the zero-plan-work proof
+def test_second_execute_skips_parse_analyze_plan(cluster):
+    """The acceptance path: one plan-cache entry serves every binding —
+    the second (and third) EXECUTE shows NO parse/analyze/plan/optimize
+    spans, only prepare/bind + plan-cache/hit, and still returns the
+    correct per-binding rows."""
+    coord, _ = cluster
+    c = _client(coord)
+    c.execute("PREPARE zp FROM "
+              "select o_orderkey, o_totalprice from orders "
+              "where o_orderkey = ?")
+    assert c.prepared_statements["zp"].startswith("select o_orderkey")
+
+    h0, m0 = M.PLAN_CACHE_HITS.value(), M.PLAN_CACHE_MISSES.value()
+    _, rows1 = c.execute("EXECUTE zp USING 7")
+    q1 = _last_query(coord)
+    names1 = _span_names(q1)
+    # first EXECUTE of this type signature plans (once) — with symbolic
+    # parameters, through the normal spans
+    assert {"prepare/bind", "analyze/plan", "optimize"} <= names1
+    assert M.PLAN_CACHE_MISSES.value() - m0 == 1
+
+    _, rows2 = c.execute("EXECUTE zp USING 7")
+    q2 = _last_query(coord)
+    names2 = _span_names(q2)
+    assert "prepare/bind" in names2
+    assert "plan-cache/hit" in names2
+    assert "parse" not in names2
+    assert "analyze/plan" not in names2
+    assert "optimize" not in names2
+    assert rows2 == rows1
+
+    _, rows3 = c.execute("EXECUTE zp USING 32")  # different binding
+    q3 = _last_query(coord)
+    assert "plan-cache/hit" in _span_names(q3)
+    assert "analyze/plan" not in _span_names(q3)
+    assert rows3 != rows1 and rows3[0][0] == 32
+    assert M.PLAN_CACHE_HITS.value() - h0 == 2
+    assert M.PLAN_CACHE_MISSES.value() - m0 == 1  # ONE entry, 3 bindings
+
+    # sanity against the unprepared spelling
+    _, direct = c.execute(
+        "select o_orderkey, o_totalprice from orders where o_orderkey = 32")
+    assert rows3 == direct
+
+
+def test_execute_matches_unprepared_across_types(cluster):
+    """Bindings of several types produce exactly the unprepared results
+    (the binder substitutes into the plan, never re-interprets)."""
+    coord, _ = cluster
+    c = _client(coord)
+    c.execute("PREPARE tm FROM "
+              "select count(*), sum(o_totalprice) from orders "
+              "where o_orderdate < ? and o_totalprice > ?")
+    _, got = c.execute("EXECUTE tm USING date '1995-03-15', 1000.0")
+    _, want = c.execute(
+        "select count(*), sum(o_totalprice) from orders "
+        "where o_orderdate < date '1995-03-15' and o_totalprice > 1000.0")
+    assert got == want
+
+
+# --------------------------------------------------------------- result cache
+def test_result_cache_keys_on_bound_values(cluster):
+    """Per-binding HIT/MISS matrix: each distinct binding caches its own
+    rows; repeats HIT; DML invalidates every binding's entry."""
+    coord, _ = cluster
+    c = _client(coord, catalog="memory", schema="default",
+                result_cache_enabled="true")
+    c.execute("create table rc_pt (k bigint, v varchar)")
+    c.execute("insert into rc_pt values (1, 'one'), (2, 'two')")
+    c.execute("PREPARE rcq FROM select v from rc_pt where k = ?")
+
+    _, r1 = c.execute("EXECUTE rcq USING 1")
+    assert c.cache_status == "MISS" and r1 == [["one"]]
+    c.execute("EXECUTE rcq USING 1")
+    assert c.cache_status == "HIT"
+    _, r2 = c.execute("EXECUTE rcq USING 2")
+    assert c.cache_status == "MISS" and r2 == [["two"]]  # distinct key
+    c.execute("EXECUTE rcq USING 2")
+    assert c.cache_status == "HIT"
+    c.execute("EXECUTE rcq USING 1")
+    assert c.cache_status == "HIT"  # binding 1's entry still live
+
+    c.execute("insert into rc_pt values (3, 'three')")  # bump data_version
+    c.execute("EXECUTE rcq USING 1")
+    assert c.cache_status == "MISS"  # invalidated per binding, naturally
+
+
+def test_prepared_nondeterministic_bypasses_result_cache(cluster):
+    coord, _ = cluster
+    c = _client(coord, result_cache_enabled="true")
+    c.execute("PREPARE nd FROM select random() < ?, count(*) from region")
+    c.execute("EXECUTE nd USING 0.5")
+    assert c.cache_status == "BYPASS"
+
+
+# ---------------------------------------------------------------- bind errors
+def test_bind_errors_are_loud(cluster):
+    from trino_tpu.client.remote import RemoteQueryError
+
+    coord, _ = cluster
+    c = _client(coord)
+    c.execute("PREPARE be FROM "
+              "select o_orderkey from orders where o_orderkey = ?")
+    with pytest.raises(RemoteQueryError, match="expects 1 parameters"):
+        c.execute("EXECUTE be")
+    with pytest.raises(RemoteQueryError, match="expects 1 parameters"):
+        c.execute("EXECUTE be USING 1, 2")
+    with pytest.raises(RemoteQueryError, match="constant"):
+        c.execute("EXECUTE be USING random()")
+    # type-incompatible binding: the varchar signature plans fresh and
+    # fails analysis on the bigint comparison
+    with pytest.raises(RemoteQueryError):
+        c.execute("EXECUTE be USING 'not-a-key'")
+    with pytest.raises(RemoteQueryError, match="not found"):
+        c.execute("EXECUTE never_prepared USING 1")
+    with pytest.raises(RemoteQueryError, match="not found"):
+        c.execute("DEALLOCATE PREPARE never_prepared")
+
+
+def test_deallocate_round_trip(cluster):
+    from trino_tpu.client.remote import RemoteQueryError
+
+    coord, _ = cluster
+    c = _client(coord)
+    c.execute("PREPARE dr FROM select 1")
+    assert "dr" in c.prepared_statements
+    c.execute("EXECUTE dr")
+    c.execute("DEALLOCATE PREPARE dr")
+    assert "dr" not in c.prepared_statements
+    with pytest.raises(RemoteQueryError, match="not found"):
+        c.execute("EXECUTE dr")
+
+
+# ----------------------------------------------------------------- registry
+def test_system_prepared_statements_table_and_metrics(cluster):
+    coord, _ = cluster
+    g0 = M.PREPARED_STATEMENTS.value()
+    _, _, n0 = M.EXECUTE_BIND_SECONDS.snapshot()
+    c = _client(coord)
+    c.execute("PREPARE sysq FROM "
+              "select o_orderkey from orders where o_orderkey = ?")
+    assert M.PREPARED_STATEMENTS.value() >= g0  # gauge tracks registry size
+    c.execute("EXECUTE sysq USING 7")
+    c.execute("EXECUTE sysq USING 7")
+    _, _, n1 = M.EXECUTE_BIND_SECONDS.snapshot()
+    assert n1 - n0 == 2  # one bind-time observation per EXECUTE
+    # a failed bind (bad arity) must NOT count as an execution
+    from trino_tpu.client.remote import RemoteQueryError
+
+    with pytest.raises(RemoteQueryError):
+        c.execute("EXECUTE sysq USING 1, 2, 3")
+    _, rows = c.execute(
+        "select user, name, parameters, executions "
+        "from system.runtime.prepared_statements where name = 'sysq'")
+    assert rows == [["anonymous", "sysq", 1, 2]]
+    c.execute("DEALLOCATE PREPARE sysq")
+    _, rows = c.execute(
+        "select name from system.runtime.prepared_statements "
+        "where name = 'sysq'")
+    assert rows == []
+
+
+def test_prepared_statements_partition_by_user(cluster):
+    """One user's PREPARE is not another's: the registry keys (user,
+    name), mirroring the per-principal cache partitioning."""
+    from trino_tpu.client.remote import RemoteQueryError
+
+    coord, _ = cluster
+    coord.submit("PREPARE mine FROM select 1", {}, user="alice")
+    import time as _t
+
+    deadline = _t.monotonic() + 10
+    while _t.monotonic() < deadline:
+        if coord.prepared.get("alice", "mine") is not None:
+            break
+        _t.sleep(0.05)
+    assert coord.prepared.get("alice", "mine") is not None
+    c = _client(coord)  # anonymous
+    with pytest.raises(RemoteQueryError, match="not found"):
+        c.execute("EXECUTE mine")
+
+
+def test_registry_per_user_bound_protects_other_users():
+    """One principal's PREPARE volume evicts its OWN oldest statements,
+    never another user's live ones (shared-state blast-radius rule)."""
+    from trino_tpu.server.prepared import PreparedStatementRegistry
+
+    reg = PreparedStatementRegistry(max_statements=64, max_per_user=8)
+    a = reg.put("alice", "keep", parse_statement("select 1"), "select 1")
+    for i in range(20):
+        reg.put("bob", f"b{i}", parse_statement("select 1"), "select 1")
+    assert reg.get("alice", "keep") is a  # alice untouched
+    bobs = [e for e in reg.snapshot() if e.user == "bob"]
+    assert len(bobs) == 8  # bob capped at the per-user bound
+    assert {e.name for e in bobs} == {f"b{i}" for i in range(12, 20)}
+
+
+# ------------------------------------------------------------- prepared DML
+def test_prepared_insert_binds_and_mutates(cluster):
+    coord, _ = cluster
+    c = _client(coord, catalog="memory", schema="default")
+    c.execute("create table pdml (a bigint, b varchar)")
+    c.execute("PREPARE pins FROM insert into pdml values (?, ?)")
+    c.execute("EXECUTE pins USING 1, 'x'")
+    c.execute("EXECUTE pins USING 2, 'y'")
+    _, rows = c.execute("select a, b from pdml order by a")
+    assert rows == [[1, "x"], [2, "y"]]
+    # DML bindings reject non-constants exactly like the query path
+    from trino_tpu.client.remote import RemoteQueryError
+
+    with pytest.raises(RemoteQueryError, match="constant"):
+        c.execute("EXECUTE pins USING random(), 'z'")
+    _, rows = c.execute("select count(*) from pdml")
+    assert rows == [[2]]  # the failed bind mutated nothing
+
+
+# ------------------------------------------------------------------- storm
+def test_concurrent_execute_storm(cluster):
+    """8 threads x 12 EXECUTEs with mixed bindings: every result is
+    correct for ITS binding (no cross-binding bleed through the shared
+    plan entry) and the registry survives."""
+    coord, _ = cluster
+    setup = _client(coord)
+    setup.execute("PREPARE storm FROM "
+                  "select o_orderkey, count(*) from orders "
+                  "where o_orderkey = ? group by o_orderkey")
+    keys = (1, 2, 3, 4, 5, 6, 7, 32)
+    errors = []
+
+    def run_one(ti):
+        c = _client(coord)
+        for r in range(12):
+            k = keys[(ti + r) % len(keys)]
+            try:
+                _, rows = c.execute(f"EXECUTE storm USING {k}")
+                assert rows == [[k, 1]], f"binding {k} got {rows}"
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errors.append(str(e))
+
+    threads = [threading.Thread(target=run_one, args=(ti,))
+               for ti in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+    assert errors == []
+    assert coord.prepared.get("anonymous", "storm") is not None
+
+
+# -------------------------------------------------------------------- DBAPI
+def test_dbapi_qmark_routes_through_prepare_execute(cluster):
+    coord, _ = cluster
+    from trino_tpu.client import dbapi
+
+    conn = dbapi.connect(coordinator_url=coord.base_url)
+    cur = conn.cursor()
+    cur.execute("select o_orderkey, o_totalprice from orders "
+                "where o_orderkey = ?", (7,))
+    rows = cur.fetchall()
+    assert len(rows) == 1 and rows[0][0] == 7
+    # the driver registered a server-side prepared statement
+    assert any(n.startswith("dbapi_")
+               for n in conn._client.prepared_statements)
+    # second binding: bare EXECUTE, no re-PREPARE (the known set is stable)
+    known = dict(conn._client.prepared_statements)
+    cur.execute("select o_orderkey, o_totalprice from orders "
+                "where o_orderkey = ?", (32,))
+    assert conn._client.prepared_statements == known
+    assert cur.fetchall()[0][0] == 32
+
+
+def test_dbapi_executemany_loops_one_prepared_plan(cluster):
+    coord, _ = cluster
+    from trino_tpu.client import dbapi
+
+    conn = dbapi.connect(coordinator_url=coord.base_url,
+                         catalog="memory", schema="default")
+    cur = conn.cursor()
+    cur.execute("create table dbm (a bigint, b varchar)")
+    cur.executemany("insert into dbm values (?, ?)",
+                    [(1, "a"), (2, "b"), (3, "c")])
+    cur.execute("select count(*) from dbm")
+    assert cur.fetchone() == (3,)
+    # one PREPARE served all three bindings
+    assert len([n for n in conn._client.prepared_statements
+                if n.startswith("dbapi_")]) == 1
+
+
+def test_dbapi_embedded_still_substitutes(cluster):
+    from trino_tpu.client import dbapi
+
+    conn = dbapi.connect(catalog="tpch", schema="tiny")
+    cur = conn.cursor()
+    cur.execute("select o_orderkey from orders where o_orderkey = ?", (7,))
+    assert cur.fetchall() == [(7,)]

@@ -87,6 +87,10 @@ def render_summary(stats) -> str:
         # the runtime re-planner rewrote fragments mid-query (details:
         # planVersions on GET /v1/query/{id})
         parts.append(f"adapted: {stats['adaptations']} plan change(s)")
+    if stats.get("fastPath") == "fast-path":
+        # the short-query fast path served this statement coordinator-
+        # local (zero task round-trips)
+        parts.append("fast-path")
     if stats.get("deviceCacheHits"):
         # scans served warm from the device table cache (zero transfer)
         parts.append(f"warm scans: {stats['deviceCacheHits']}")

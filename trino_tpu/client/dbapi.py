@@ -99,9 +99,22 @@ class Cursor:
     def execute(self, operation: str, parameters: Optional[Sequence] = None):
         if self._conn._closed:
             raise InterfaceError("connection is closed")
+        if (parameters is not None and self._conn._client is not None
+                and _qmark_count(operation) > 0):
+            # remote qmark binding goes through server-side
+            # PREPARE/EXECUTE: the parameterized plan caches ONCE on the
+            # coordinator and every binding reuses it (the reference
+            # driver's prepared-statement path; executemany loops EXECUTE
+            # over the same prepared plan)
+            return self._execute_prepared_remote(operation, parameters)
         sql = operation
         if parameters:
+            # embedded sessions bind by literal substitution (one
+            # in-process call; no coordinator plan cache to warm)
             sql = _substitute_qmarks(operation, parameters)
+        return self._run(sql)
+
+    def _run(self, sql: str):
         self.cache_status = None
         self.stats = None
         try:
@@ -120,7 +133,28 @@ class Cursor:
         self.rowcount = len(self._rows)
         return self
 
+    def _execute_prepared_remote(self, operation: str, parameters: Sequence):
+        client = self._conn._client
+        name = "dbapi_" + _statement_digest(operation)
+        if name not in client.prepared_statements:
+            self._run(f"PREPARE {name} FROM {operation}")
+        args = ", ".join(_literal(v) for v in parameters)
+        sql = f"EXECUTE {name}" + (f" USING {args}" if args else "")
+        try:
+            return self._run(sql)
+        except DatabaseError as e:
+            if "prepared statement not found" not in str(e):
+                raise
+            # the server lost the statement (restart / registry eviction):
+            # re-PREPARE once and retry
+            client.prepared_statements.pop(name, None)
+            self._run(f"PREPARE {name} FROM {operation}")
+            return self._run(sql)
+
     def executemany(self, operation: str, seq_of_parameters):
+        # each binding runs through execute(): against a coordinator the
+        # first call PREPAREs and every later one is a bare EXECUTE over
+        # the one cached parameterized plan
         for params in seq_of_parameters:
             self.execute(operation, params)
         return self
@@ -164,14 +198,20 @@ def connect(coordinator_url: Optional[str] = None, **kwargs) -> Connection:
     return Connection(coordinator_url, **kwargs)
 
 
-def _substitute_qmarks(sql: str, params: Sequence) -> str:
-    """Bind qmark parameters as SQL literals, string-literal-aware (the
-    reference driver sends PREPARE/EXECUTE; literal substitution keeps the
-    remote path one round trip)."""
-    out = []
-    it = iter(params)
-    i = 0
-    n = len(sql)
+def _statement_digest(sql: str) -> str:
+    """Stable per-statement name suffix for driver-generated PREPAREs (two
+    cursors binding the same SQL share one server-side plan)."""
+    import hashlib
+
+    return hashlib.sha1(sql.strip().encode()).hexdigest()[:12]
+
+
+def _sql_segments(sql: str):
+    """Tokenize into ``("text", chunk)`` / ``("qmark", None)`` segments,
+    ``'...'``-literal aware (with ``''`` escapes) — the ONE scanner both
+    the qmark counter and the literal substitution consume, so the
+    remote-routing decision can never disagree with the substitution."""
+    i, n = 0, len(sql)
     while i < n:
         ch = sql[i]
         if ch == "'":
@@ -183,18 +223,35 @@ def _substitute_qmarks(sql: str, params: Sequence) -> str:
                 if sql[j] == "'":
                     break
                 j += 1
-            out.append(sql[i : j + 1])
+            yield "text", sql[i : j + 1]
             i = j + 1
             continue
         if ch == "?":
+            yield "qmark", None
+            i += 1
+            continue
+        yield "text", ch
+        i += 1
+
+
+def _qmark_count(sql: str) -> int:
+    """``?`` parameter markers outside string literals."""
+    return sum(1 for kind, _ in _sql_segments(sql) if kind == "qmark")
+
+
+def _substitute_qmarks(sql: str, params: Sequence) -> str:
+    """Bind qmark parameters as SQL literals, string-literal-aware
+    (embedded sessions only; the remote path sends PREPARE/EXECUTE)."""
+    out = []
+    it = iter(params)
+    for kind, chunk in _sql_segments(sql):
+        if kind == "qmark":
             try:
                 out.append(_literal(next(it)))
             except StopIteration:
                 raise InterfaceError("not enough parameters for statement") from None
-            i += 1
-            continue
-        out.append(ch)
-        i += 1
+        else:
+            out.append(chunk)
     return "".join(out)
 
 

@@ -193,6 +193,24 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 0, lambda v: None if v >= 0 else "must be >= 0",
         ),
         PropertyMetadata(
+            "short_query_fast_path",
+            "run SELECTs whose optimized plan would fragment into at most "
+            "one distributed stage (point lookups, small scans, single-"
+            "step aggregations) on the coordinator's own engine — same "
+            "admission, caches, stats, and spans, zero task HTTP round-"
+            "trips (server/fastpath.py; reference role: the dispatch/"
+            "execution split of QueuedStatementResource); the decision is "
+            "visible in query info (fastPath) and EXPLAIN ANALYZE",
+            bool, False,
+        ),
+        PropertyMetadata(
+            "fast_path_max_scan_rows",
+            "estimated total scan rows above which a single-stage plan "
+            "still executes distributed (the coordinator must not absorb "
+            "big scans serially just because they fragment simply)",
+            int, 4_000_000, _positive,
+        ),
+        PropertyMetadata(
             "adaptive_execution_enabled",
             "re-plan not-yet-scheduled downstream fragments between stage "
             "completions using the runtime operator-stats rollups (master "

@@ -34,6 +34,11 @@ class StatementClient:
         self.stats: Optional[Dict] = None
         # query id assigned by the coordinator for the last statement
         self.query_id: Optional[str] = None
+        # prepared statements this client knows are live on the server
+        # (name -> statement text), maintained from the
+        # addedPreparedStatements / deallocatedPreparedStatements payload
+        # blocks — the X-Trino-Added-Prepare round-trip analog
+        self.prepared_statements: Dict[str, str] = {}
 
     def execute(self, sql: str, timeout: float = 600.0,
                 on_stats=None) -> Tuple[List[str], List[list]]:
@@ -72,6 +77,10 @@ class StatementClient:
                 self.session_properties[k] = v
             for k in payload.get("resetSessionProperties", []):
                 self.session_properties.pop(k, None)
+            for k, v in payload.get("addedPreparedStatements", {}).items():
+                self.prepared_statements[k] = v
+            for k in payload.get("deallocatedPreparedStatements", []):
+                self.prepared_statements.pop(k, None)
             if "columns" in payload:
                 columns = [c["name"] for c in payload["columns"]]
             rows.extend(payload.get("data", []))

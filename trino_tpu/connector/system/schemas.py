@@ -40,6 +40,23 @@ SYSTEM_TABLES = {
         ("adaptations", "bigint"),
         ("plan_versions", "bigint"),
         ("failure", "varchar"),
+        ("fast_path", "varchar"),      # fast-path | distributed |
+                                       # local-catalog; NULL for non-SELECT
+                                       # and for SELECTs served straight
+                                       # from the result cache (no
+                                       # execution path was taken)
+    ),
+    # prepared statements held by the coordinator registry
+    # (server/prepared.py): one row per (user, name), live until
+    # DEALLOCATE or LRU eviction
+    ("runtime", "prepared_statements"): (
+        ("user", "varchar"),
+        ("name", "varchar"),
+        ("statement", "varchar"),      # the inner (post-FROM) SQL text
+        ("parameters", "bigint"),      # number of ? markers
+        ("created_at", "double"),      # epoch seconds
+        ("executions", "bigint"),
+        ("last_executed_at", "double"),  # epoch seconds; NULL before first
     ),
     # per-slot task records of live queries (worker-reported stats rollup)
     ("runtime", "tasks"): (

@@ -38,10 +38,28 @@ def run_query(session, sql: str) -> QueryResult:
     return _dispatch_statement(session, parse_statement(sql))
 
 
-def _bind_parameters(stmt, params):
+def dispatch_statement(session, stmt) -> QueryResult:
+    """Run an already-parsed statement (the coordinator's EXECUTE path
+    dispatches the stored prepared AST without re-parsing)."""
+    return _dispatch_statement(session, stmt)
+
+
+def bind_parameters(stmt, params):
     """Substitute ``?`` placeholders with the EXECUTE ... USING expressions
     (reference: planner/ParameterRewriter): a generic rewrite over the
-    frozen AST."""
+    frozen AST. Arity must match exactly — too many bindings is as much a
+    caller bug as too few."""
+    from trino_tpu.server.prepared import count_parameters
+
+    need = count_parameters(stmt)
+    if len(params) != need:
+        raise ValueError(
+            f"prepared statement expects {need} parameters, "
+            f"got {len(params)}")
+    return _bind_parameters(stmt, params)
+
+
+def _bind_parameters(stmt, params):
     import dataclasses as _dc
 
     def rewrite(node):
@@ -120,7 +138,7 @@ def _dispatch_statement(session, stmt) -> QueryResult:
         prepared = getattr(session, "prepared_statements", {}).get(stmt.name)
         if prepared is None:
             raise ValueError(f"prepared statement not found: {stmt.name}")
-        bound = _bind_parameters(prepared, stmt.params)
+        bound = bind_parameters(prepared, stmt.params)
         return _dispatch_statement(session, bound)
     if isinstance(stmt, ast.Deallocate):
         store = getattr(session, "prepared_statements", {})

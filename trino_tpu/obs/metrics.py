@@ -428,6 +428,25 @@ ADAPTIVE_SKEW_HOT_PARTITIONS = REGISTRY.counter(
     "hot partitions salted by the adaptive skew mitigation (spread on "
     "the probe producer, replicated on the build producer)")
 
+# serving fast path (server/prepared.py + server/fastpath.py): the
+# high-QPS control-plane surface — prepared statements held by the
+# coordinator registry, per-path execution counts, and EXECUTE bind time
+# (the entire per-request planning cost once the parameterized plan is
+# cached)
+PREPARED_STATEMENTS = REGISTRY.gauge(
+    "trino_tpu_prepared_statements",
+    "prepared statements held by the coordinator registry (all users)")
+FAST_PATH_QUERIES = REGISTRY.counter(
+    "trino_tpu_fast_path_queries_total",
+    "SELECT executions by control-plane path (fast-path = single-stage "
+    "plan run coordinator-local, skipping task round-trips; distributed = "
+    "fragment/schedule/execute across workers; local-catalog = forced "
+    "coordinator-local by a process-local catalog)", ("path",))
+EXECUTE_BIND_SECONDS = REGISTRY.histogram(
+    "trino_tpu_execute_bind_seconds",
+    "EXECUTE parameter bind time: constant-folding the USING expressions "
+    "+ substituting them into the cached parameterized plan")
+
 # plan-IR sanity checking (sql/planner/sanity.py): invariant violations
 # caught at plan time, labeled by the phase family that produced the bad
 # plan (initial-plan | optimizer | fragmentation | adaptive). During

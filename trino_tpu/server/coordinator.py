@@ -102,7 +102,7 @@ class QueryExecution:
 
     def __init__(self, query_id: str, sql: str, session_properties: dict,
                  registry: NodeRegistry, session_factory, user: str = "anonymous",
-                 query_cache=None):
+                 query_cache=None, prepared_registry=None):
         self.query_id = query_id
         self.sql = sql
         self.user = user
@@ -112,6 +112,19 @@ class QueryExecution:
         self.session_factory = session_factory
         # server-wide QueryCache (trino_tpu/cache/) or None (caching off)
         self.query_cache = query_cache
+        # server-wide PreparedStatementRegistry (server/prepared.py) or
+        # None: PREPARE registers, EXECUTE binds + runs, DEALLOCATE drops
+        self.prepared_registry = prepared_registry
+        # which control-plane path executed the SELECT: "fast-path"
+        # (single-stage plan run coordinator-local), "distributed",
+        # "local-catalog" (process-local catalog forced local), or None
+        # (non-SELECT / served from the result cache)
+        self.fast_path: Optional[str] = None
+        # PREPARE/DEALLOCATE round-trip to the client (the
+        # X-Trino-Added-Prepare / X-Trino-Deallocated-Prepare analog,
+        # carried in the result payload like set/reset session)
+        self.add_prepared: Dict[str, str] = {}
+        self.deallocated_prepared: List[str] = []
         # result-cache disposition, surfaced as X-Trino-Tpu-Cache:
         # HIT (served from cache / a concurrent leader), MISS (executed,
         # filled the cache), BYPASS (ineligible or cache disabled)
@@ -246,6 +259,16 @@ class QueryExecution:
             self.columns = ["Query Plan"]
             self.rows = [(line,) for line in text.split("\n")]
             return
+        if isinstance(stmt, (ast.Prepare, ast.ExecutePrepared,
+                             ast.Deallocate)) \
+                and self.prepared_registry is not None:
+            # the serving surface (server/prepared.py): PREPARE registers
+            # against the server-wide registry (per-user), EXECUTE binds
+            # into the cached parameterized plan, DEALLOCATE drops — none
+            # of this can run on the throwaway per-query session, whose
+            # state dies with this statement
+            self._run_prepared_statement(session, stmt)
+            return
         if not isinstance(stmt, ast.Query):
             # metadata statements (SHOW …, EXPLAIN), CALL, and DML/DDL run
             # coordinator-local and always bypass the result cache — the
@@ -264,15 +287,18 @@ class QueryExecution:
             return
         root, versions = self._plan_query(session, stmt)
         key = self._consult_result_cache(session, stmt, root, versions)
+        self._finish_with_result_cache(session, root, key)
+
+    def _finish_with_result_cache(self, session, root, key) -> None:
+        """Shared tail of the SELECT lifecycle: serve/lead/bypass against
+        the result cache, executing through ``_execute_query`` otherwise.
+        A leader that fails abandons its flight (waiters re-execute)."""
         if key == _SERVED_FROM_CACHE:
             self.state.set("FINISHING")
             return
         if key is None:
             self._execute_query(session, root)
             return
-        # result-cache leader: execute, then publish so single-flight
-        # waiters wake with the value; any failure abandons the flight
-        # (waiters re-execute themselves)
         try:
             self._execute_query(session, root)
         except BaseException:
@@ -282,6 +308,154 @@ class QueryExecution:
             key, self.columns, self.rows,
             ttl_ms=session.properties.get("result_cache_ttl_ms", 60_000),
             max_bytes=session.properties.get("result_cache_max_bytes"))
+
+    # ------------------------------------------------- prepared statements
+    def _run_prepared_statement(self, session, stmt) -> None:
+        """PREPARE / EXECUTE / DEALLOCATE against the server-wide registry
+        (reference: PrepareTask/DeallocateTask + the EXECUTE rewrite of
+        QueuedStatementResource, collapsed onto the query thread)."""
+        from trino_tpu.sql.parser import ast
+
+        reg = self.prepared_registry
+        if isinstance(stmt, ast.Prepare):
+            self.cache_status = "BYPASS"
+            self.state.set("RUNNING")
+            inner = stmt.statement
+            if isinstance(inner, (ast.Prepare, ast.ExecutePrepared,
+                                  ast.Deallocate)):
+                raise ValueError(
+                    "cannot PREPARE another prepared-statement control "
+                    "statement")
+            # the inner statement's text, for display surfaces: the PREPARE
+            # grammar is rigid, so stripping the one fixed prefix is exact
+            m = re.match(r"(?is)^\s*prepare\s+\S+\s+from\s+(.*)$",
+                          self.sql.strip())
+            sql_text = (m.group(1) if m else self.sql).strip()
+            reg.put(self.user, stmt.name, inner, sql_text)
+            self.add_prepared[stmt.name] = sql_text
+            self.columns, self.rows = ["result"], [("PREPARE",)]
+            return
+        if isinstance(stmt, ast.Deallocate):
+            self.cache_status = "BYPASS"
+            self.state.set("RUNNING")
+            if not reg.remove(self.user, stmt.name):
+                raise ValueError(
+                    f"prepared statement not found: {stmt.name}")
+            self.deallocated_prepared.append(stmt.name)
+            self.columns, self.rows = ["result"], [("DEALLOCATE",)]
+            return
+        self._run_execute_prepared(session, stmt)
+
+    def _run_execute_prepared(self, session, stmt) -> None:
+        """EXECUTE name [USING ...]: constant-fold the bindings, reuse (or
+        create) the ONE cached parameterized plan for this statement+type
+        signature, substitute the bound constants into a copy, and run it
+        through the normal result-cache + execution pipeline. The second
+        EXECUTE of a statement does zero parse/analyze/plan/optimize work
+        — only the bind pass (microseconds) and execution."""
+        from trino_tpu.obs import metrics as M
+        from trino_tpu.server import prepared as prep
+        from trino_tpu.sql.parser import ast
+
+        ps = self.prepared_registry.get(self.user, stmt.name)
+        if ps is None:
+            raise ValueError(f"prepared statement not found: {stmt.name}")
+        inner = ps.statement
+        # bind step 1 — fold + arity: USING arguments must be constant
+        # expressions whatever the inner statement kind, and the
+        # executions counter only moves once the binding is valid
+        t0 = time.perf_counter()
+        with self.tracer.span("prepare/bind") as sp:
+            sp.set("statement", stmt.name)
+            values = prep.fold_execute_args(stmt.params)
+            prep.check_arity(ps, values)
+            sp.set("parameters", len(values))
+        fold_s = time.perf_counter() - t0
+        self.prepared_registry.touch(self.user, stmt.name)
+        if not isinstance(inner, ast.Query):
+            # prepared DML/DDL/metadata: bind at the AST level (the raw
+            # USING exprs, proven constant above) and run coordinator-
+            # local — the mutation bumps data versions exactly like the
+            # unprepared spelling
+            from trino_tpu.exec.query import bind_parameters
+            from trino_tpu.exec.query import dispatch_statement
+
+            self.cache_status = "BYPASS"
+            bound = bind_parameters(inner, stmt.params)
+            M.EXECUTE_BIND_SECONDS.observe(fold_s)
+            self.state.set("RUNNING")
+            with self.tracer.span("execute/coordinator-local"):
+                result = dispatch_statement(session, bound)
+            self.columns, self.rows = result.column_names, result.rows
+            return
+        ptypes = tuple(c.type for c in values)
+        # planning (plan-cache miss only) stays OUTSIDE the bind timer and
+        # span: trino_tpu_execute_bind_seconds measures exactly the
+        # per-request work a warm EXECUTE pays (fold + substitute)
+        root, versions = self._plan_prepared(session, ps, ptypes)
+        t1 = time.perf_counter()
+        bound_root = prep.bind_plan_parameters(root, values)
+        M.EXECUTE_BIND_SECONDS.observe(
+            fold_s + (time.perf_counter() - t1))
+        key = self._consult_result_cache(session, inner, bound_root,
+                                         versions)
+        self._finish_with_result_cache(session, bound_root, key)
+
+    def _plan_prepared(self, session, ps, ptypes):
+        """The parameterized plan for one prepared statement + binding
+        type signature, through the server's logical-plan cache: ONE cache
+        entry serves every binding of that signature (the plan keeps
+        symbolic ``ir.Parameter`` placeholders — values never bake in).
+        Returns ``(root, versions)`` like ``_plan_query``."""
+        from trino_tpu.sql.analyzer.expr_analyzer import parameter_types
+
+        def plan_fn():
+            from trino_tpu.sql.planner.optimizer import optimize
+            from trino_tpu.sql.planner.planner import Planner
+
+            inner = ps.statement
+            udfs = getattr(session, "udfs", None)
+            if udfs:
+                from trino_tpu.sql.routines import expand_udfs
+
+                inner = expand_udfs(inner, udfs)
+            with parameter_types(ptypes):
+                with tracing.span("analyze/plan"):
+                    root = Planner(session).plan(inner)
+                with tracing.span("optimize"):
+                    return optimize(root, session)
+
+        return self._through_plan_cache(
+            session, ps.statement, ps.plan_cache_sql(ptypes), plan_fn)
+
+    def _through_plan_cache(self, session, stmt, key_sql, plan_fn):
+        """Plan-cache choreography shared by plain SELECTs and prepared
+        EXECUTEs: serve a still-valid entry (hit metric + span), else plan
+        via ``plan_fn`` and admit. Table-function statements never cache
+        (their rows freeze into the plan at plan time). Returns
+        ``(root, versions)`` — versions None when the cache is off."""
+        from trino_tpu.cache.determinism import contains_table_function
+        from trino_tpu.cache.plan_key import capture_versions
+        from trino_tpu.obs import metrics as M
+
+        cache = self.query_cache
+        use_plan_cache = (cache is not None and bool(
+            session.properties.get("logical_plan_cache_enabled", True))
+            and not contains_table_function(stmt))
+        if use_plan_cache:
+            hit = cache.plans.get(session, key_sql)
+            if hit is not None:
+                M.PLAN_CACHE_HITS.inc()
+                with self.tracer.span("plan-cache/hit"):
+                    pass
+                return hit
+            M.PLAN_CACHE_MISSES.inc()
+        root = plan_fn()
+        versions = None
+        if use_plan_cache:
+            versions = capture_versions(session, root)
+            cache.plans.put(session, key_sql, root, versions)
+        return root, versions
 
     def _plan_query(self, session, stmt):
         """Optimized plan for this SELECT, through the server's logical-
@@ -293,30 +467,11 @@ class QueryExecution:
         Returns ``(root, versions)`` — the data versions captured while
         planning/revalidating (None when not computed), handed onward so
         the result-cache lookup doesn't re-stat every table."""
-        from trino_tpu.cache.determinism import contains_table_function
-        from trino_tpu.cache.plan_key import capture_versions
         from trino_tpu.exec.query import plan_sql
-        from trino_tpu.obs import metrics as M
 
-        cache = self.query_cache
-        use_plan_cache = (cache is not None and bool(
-            session.properties.get("logical_plan_cache_enabled", True))
-            and not contains_table_function(stmt))
-        if use_plan_cache:
-            hit = cache.plans.get(session, self.sql)
-            if hit is not None:
-                M.PLAN_CACHE_HITS.inc()
-                with self.tracer.span("plan-cache/hit"):
-                    pass
-                return hit
-            M.PLAN_CACHE_MISSES.inc()
         # plan_sql emits nested parse + analyze/plan + optimize spans
-        root = plan_sql(session, self.sql)
-        versions = None
-        if use_plan_cache:
-            versions = capture_versions(session, root)
-            cache.plans.put(session, self.sql, root, versions)
-        return root, versions
+        return self._through_plan_cache(
+            session, stmt, self.sql, lambda: plan_sql(session, self.sql))
 
     def _consult_result_cache(self, session, stmt, root, versions=None):
         """One admission pass against the server result cache. Returns
@@ -387,7 +542,10 @@ class QueryExecution:
 
     def _execute_query(self, session, root) -> None:
         """Run an already-optimized SELECT plan: coordinator-local for
-        process-local catalogs, else fragment + schedule + root fragment."""
+        process-local catalogs and fast-path-eligible short queries, else
+        fragment + schedule + root fragment."""
+        from trino_tpu.obs import metrics as M
+
         if any(
             isinstance(n, P.TableScanNode)
             and session.catalogs[n.catalog].coordinator_only
@@ -398,15 +556,23 @@ class QueryExecution:
             # engine (its embedded worker role). RUNNING is set so the
             # query observes ITSELF truthfully through
             # system.runtime.queries while its scan materializes.
-            from trino_tpu.exec.executor import Executor
-
-            self.state.set("RUNNING")
-            with self.tracer.span("execute/coordinator-local"):
-                ex = Executor(session)
-                page = ex.execute_checked(root)
-            self._local_executor = ex  # EXPLAIN ANALYZE annotation source
-            self.columns, self.rows = list(root.column_names), page.to_pylist()
+            self._run_local(session, root, path="local-catalog",
+                            span_name="execute/coordinator-local")
             return
+        from trino_tpu.server import fastpath
+
+        take, reason = fastpath.fast_path_decision(session, root)
+        if take:
+            # short-query fast path (server/fastpath.py): the plan would
+            # fragment into at most one distributed stage, so the task
+            # round-trips buy nothing — run it on the coordinator's own
+            # engine, with the decision on the span/query info/EXPLAIN
+            self.fast_path_reason = reason
+            self._run_local(session, root, path="fast-path",
+                            span_name="fastpath/execute", reason=reason)
+            return
+        self.fast_path = "distributed"
+        M.FAST_PATH_QUERIES.inc(1, "distributed")
         with self.tracer.span("fragment") as sp:
             fragments = fragment_plan(root, session)
             sp.set("fragments", len(fragments))
@@ -495,6 +661,60 @@ class QueryExecution:
                     and progress(entry) < progress(have)):
                 return
             self.task_stats[slot] = entry
+
+    def _run_local(self, session, root, path: str, span_name: str,
+                   reason: Optional[str] = None) -> None:
+        """The coordinator-local execution tail shared by the forced
+        local-catalog path and the short-query fast path: run the whole
+        plan on this process's engine, record the path, and feed the
+        stats rollups through the synthetic local task slot."""
+        from trino_tpu.exec.executor import Executor
+        from trino_tpu.obs import metrics as M
+
+        self.fast_path = path
+        M.FAST_PATH_QUERIES.inc(1, path)
+        self.state.set("RUNNING")
+        t0 = time.perf_counter()
+        with self.tracer.span(span_name) as sp:
+            if reason is not None:
+                sp.set("reason", reason)
+            ex = Executor(session)
+            page = ex.execute_checked(root)
+            if reason is not None:
+                sp.set("rows", page.live_count())
+        self._local_executor = ex  # EXPLAIN ANALYZE annotation source
+        self.columns, self.rows = list(root.column_names), page.to_pylist()
+        self._note_local_stats(ex, time.perf_counter() - t0)
+
+    def _note_local_stats(self, ex, elapsed_s: float) -> None:
+        """Fold a coordinator-local execution's stats into the task-stats
+        map so the stage/query rollups, the protocol stats block, and
+        ``system.runtime.queries``/``tasks`` cover fast-path queries
+        exactly like distributed ones (one synthetic task slot in
+        fragment 0 — the coordinator IS that task's worker)."""
+        scan_rows = sum(getattr(ex, "scan_stats", {}).values())
+        scan_cache = getattr(ex, "scan_cache", {})
+        stats = {
+            "elapsedS": round(elapsed_s, 6),
+            "deviceS": round(sum(
+                st.device_s for st in ex.node_stats.values()), 6),
+            "completedSplits": max(1, len(getattr(ex, "scan_stats", {}))),
+            "totalSplits": max(1, len(getattr(ex, "scan_stats", {}))),
+            "inputRows": int(scan_rows),
+            "outputRows": len(self.rows),
+            "outputBytes": sum(
+                st.output_bytes for st in ex.node_stats.values()),
+            "peakBytes": int(ex.memory.peak),
+            "spills": len(ex.memory.spills),
+            "deviceCacheHits": sum(
+                1 for d in scan_cache.values() if d == "hit"),
+            "deviceCacheMisses": sum(
+                1 for d in scan_cache.values() if d == "miss"),
+            "operatorStats": [st.to_dict()
+                              for st in ex.node_stats.values()],
+        }
+        self._note_task_status(f"{self.query_id}.0.local.a0",
+                               {"state": "FINISHED", "stats": stats})
 
     def _sweep_task_stats(self) -> int:
         """One status sweep over every scheduled task (the coordinator's
@@ -593,6 +813,9 @@ class QueryExecution:
         qs["elapsedMs"] = int((end - self.created_at) * 1000)
         qs["state"] = self.state.get()
         qs["cacheStatus"] = self.cache_status
+        # which control-plane path served the SELECT (fast-path /
+        # distributed / local-catalog), for clients and system tables
+        qs["fastPath"] = self.fast_path
         qs["resultRows"] = len(self.rows)
         # adaptive plan changes applied so far — rides every statement
         # response so clients can render "[adapted: N]" live
@@ -629,11 +852,16 @@ class QueryExecution:
         exec_s = _time.perf_counter() - t_exec
         header = [wall_time_header(plan_s, exec_s)]
         if self.fragments is None:
-            # process-local catalogs executed on the coordinator's own
-            # engine: annotate from that executor, exactly the local path
+            # process-local catalogs / fast-path queries executed on the
+            # coordinator's own engine: annotate from that executor,
+            # exactly the local path — with the path decision on display
             from trino_tpu.sql.planner.plan import format_plan
 
             ex = getattr(self, "_local_executor", None)
+            if self.fast_path == "fast-path":
+                header.append(
+                    "Fast path: coordinator-local ("
+                    + getattr(self, "fast_path_reason", "short query") + ")")
             header.append(
                 f"Peak working set: "
                 f"{(ex.memory.peak if ex else 0) // 1024}KiB (coordinator)")
@@ -1137,6 +1365,7 @@ class QueryExecution:
             "query": self.sql,
             "failure": (self.failure or "").split("\n")[0] or None,
             "cacheStatus": self.cache_status,
+            "fastPath": self.fast_path,
             "fragments": {
                 str(fid): [l.task_id for l in locs]
                 for fid, locs in self.fragment_tasks.items()
@@ -1201,6 +1430,14 @@ class CoordinatorServer:
         from trino_tpu.cache import QueryCache
 
         self.query_cache = QueryCache()
+        # prepared statements (server/prepared.py): server-wide registry
+        # keyed (user, name) so PREPARE survives across statements — our
+        # per-query sessions are throwaway; the reference holds these in
+        # the client session and replays them per request, which collapses
+        # to this registry for a single coordinator
+        from trino_tpu.server.prepared import PreparedStatementRegistry
+
+        self.prepared = PreparedStatementRegistry()
         self.queries: Dict[str, QueryExecution] = {}
         self._qlock = threading.Lock()
         self._qid = itertools.count(1)
@@ -1255,7 +1492,8 @@ class CoordinatorServer:
         query_id = f"q{time.strftime('%Y%m%d')}_{next(self._qid):05d}_{uuid.uuid4().hex[:5]}"
         execution = QueryExecution(
             query_id, sql, properties or {}, self.registry, self.session_factory,
-            user=user, query_cache=self.query_cache)
+            user=user, query_cache=self.query_cache,
+            prepared_registry=self.prepared)
         with self._qlock:
             terminal = [qid for qid, q in self.queries.items() if q.state.is_terminal()]
             for qid in terminal[: max(0, len(terminal) - self.MAX_QUERY_HISTORY)]:
@@ -1437,6 +1675,13 @@ def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) ->
         payload["setSessionProperties"] = {k: v for k, v in q.set_session.items()}
     if q.reset_session:
         payload["resetSessionProperties"] = list(q.reset_session)
+    # PREPARE/DEALLOCATE round-trip (the X-Trino-Added-Prepare /
+    # X-Trino-Deallocated-Prepare analog): clients track which names are
+    # live so drivers (DBAPI) can skip re-PREPARE on reuse
+    if q.add_prepared:
+        payload["addedPreparedStatements"] = dict(q.add_prepared)
+    if q.deallocated_prepared:
+        payload["deallocatedPreparedStatements"] = list(q.deallocated_prepared)
     start = token * RESULT_PAGE_ROWS
     chunk = q.rows[start : start + RESULT_PAGE_ROWS]
     payload["columns"] = [{"name": c} for c in q.columns]

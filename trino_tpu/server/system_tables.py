@@ -61,6 +61,9 @@ def query_record(execution, state: Optional[str] = None,
         # the initial plan is version 1; every adaptive change adds one
         "planVersions": adaptations + 1,
         "failure": failure,
+        # control-plane path of the SELECT (server/fastpath.py):
+        # fast-path | distributed | local-catalog; None otherwise
+        "fastPath": execution.fast_path,
     }
 
 
@@ -73,7 +76,7 @@ def _query_row(rec: dict) -> tuple:
         rec["totalSplits"], rec["completedSplits"], rec["inputRows"],
         rec["outputBytes"], rec["peakBytes"], rec["resultRows"],
         rec["cacheStatus"], rec["adaptations"], rec["planVersions"],
-        rec["failure"],
+        rec["failure"], rec.get("fastPath"),
     )
 
 
@@ -139,6 +142,8 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             return self._tasks_rows()
         if (schema, table) == ("runtime", "nodes"):
             return self._nodes_rows()
+        if (schema, table) == ("runtime", "prepared_statements"):
+            return self._prepared_rows()
         if (schema, table) == ("runtime", "device_cache"):
             from trino_tpu.connector.system.connector import device_cache_rows
 
@@ -197,6 +202,15 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
                 int(n["ageS"] * 1000.0),
             ))
         return rows
+
+    def _prepared_rows(self) -> List[tuple]:
+        return [
+            (e.user, e.name, e.sql, int(e.param_count),
+             float(e.created_at), int(e.executions),
+             float(e.last_executed_at)
+             if e.last_executed_at is not None else None)
+            for e in self._server.prepared.snapshot()
+        ]
 
     def _metrics_rows(self) -> List[tuple]:
         from trino_tpu.connector.system.connector import metric_sample_rows

@@ -16,6 +16,30 @@ from trino_tpu.sql import ir
 from trino_tpu.sql.analyzer.scope import AnalysisError, Field, Scope
 from trino_tpu.sql.parser import ast
 
+# prepared-statement parameter types, scoped to one planning run
+# (sql/parser Parameter nodes carry only an index; the types come from the
+# EXECUTE binding that triggered planning — server/prepared.py /
+# exec/query.py set them around Planner.plan). A contextvar, not a
+# constructor argument: ExprAnalyzer is instantiated at dozens of planner
+# sites and every one of them must see the same binding.
+import contextlib
+import contextvars
+
+_PARAM_TYPES: "contextvars.ContextVar[Optional[Tuple[T.Type, ...]]]" = \
+    contextvars.ContextVar("prepared_parameter_types", default=None)
+
+
+@contextlib.contextmanager
+def parameter_types(types):
+    """Make prepared-statement parameter types visible to every
+    ExprAnalyzer created inside the block (one planning run)."""
+    token = _PARAM_TYPES.set(tuple(types))
+    try:
+        yield
+    finally:
+        _PARAM_TYPES.reset(token)
+
+
 AGGREGATE_FUNCTIONS = {
     "count", "sum", "avg", "min", "max",
     "stddev", "stddev_samp", "stddev_pop",
@@ -278,6 +302,17 @@ class ExprAnalyzer:
     def _analyze(self, e: ast.Expression) -> ir.Expr:
         if isinstance(e, ast.Literal):
             return analyze_literal(e)
+        if isinstance(e, ast.Parameter):
+            types = _PARAM_TYPES.get()
+            if types is None:
+                raise AnalysisError(
+                    "parameter markers (?) are only valid inside a prepared "
+                    "statement executed with EXECUTE ... USING")
+            if e.index >= len(types):
+                raise AnalysisError(
+                    f"prepared statement requires at least {e.index + 1} "
+                    f"parameters, but EXECUTE supplied {len(types)}")
+            return ir.Parameter(types[e.index], e.index)
         if isinstance(e, ast.Identifier):
             try:
                 ch, field, depth = self.scope.resolve(e.parts)

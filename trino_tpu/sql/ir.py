@@ -71,6 +71,26 @@ class Lambda(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class Parameter(Expr):
+    """A prepared-statement parameter placeholder (reference: sql/ir
+    Constant's role in planner/ParameterRewriter, kept SYMBOLIC here).
+
+    Carries the type inferred from the first EXECUTE's binding so the
+    whole analyzer/optimizer pipeline type-checks normally, but no
+    optimizer pass treats it as a constant — the value must not bake into
+    the cached plan (no constant folding, no pushdown into scan
+    constraints). ``server/prepared.bind_plan_parameters`` substitutes a
+    ``Constant`` per EXECUTE; an unbound parameter reaching the executor's
+    lowering fails loudly (expr_lower has no case for it, by design)."""
+
+    type: T.Type
+    index: int
+
+    def __repr__(self):
+        return f"?{self.index}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
 class OuterRef(Expr):
     """Correlated reference to channel ``index`` of the OUTER query's scope.
 
