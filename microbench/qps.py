@@ -108,6 +108,10 @@ def run_config(coord_url: str, serving_on: bool, clients: int,
     fast0 = M.FAST_PATH_QUERIES.value("fast-path")
     dist0 = M.FAST_PATH_QUERIES.value("distributed")
     latencies = {"point": [], "cached": [], "uncached": []}
+    # per-phase wall from each response's queryStats.timeline (the phase
+    # ledger): where a p99 regression LIVES — queued vs plan vs device —
+    # which is the attribution the QPS_r02 scaling round needs
+    phase_latencies = {}
     lat_lock = threading.Lock()
     failures = []
 
@@ -141,8 +145,12 @@ def run_config(coord_url: str, serving_on: bool, clients: int,
                 failures.append(f"{kind}: {e}")
                 continue
             dt = time.perf_counter() - t0
+            tl = (getattr(cur, "stats", None) or {}).get("timeline")
             with lat_lock:
                 latencies[kind].append(dt)
+                if tl:
+                    for phase, seconds in tl["phases"].items():
+                        phase_latencies.setdefault(phase, []).append(seconds)
 
     threads = [threading.Thread(target=client_loop, args=(ci,))
                for ci in range(clients)]
@@ -167,6 +175,8 @@ def run_config(coord_url: str, serving_on: bool, clients: int,
                 M.FAST_PATH_QUERIES.value("distributed") - dist0),
         },
         "latency": {k: _latency_summary(v) for k, v in latencies.items()},
+        "phase_latency": {phase: _latency_summary(v)
+                          for phase, v in sorted(phase_latencies.items())},
     }
 
 

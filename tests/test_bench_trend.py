@@ -1,0 +1,118 @@
+"""tools/bench_trend.py: the trajectory fold + the bench-trend gate."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import bench_trend  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(root, name, payload):
+    with open(os.path.join(root, name), "w") as f:
+        json.dump(payload, f)
+
+
+@pytest.fixture()
+def bench_root(tmp_path):
+    root = str(tmp_path)
+    _write(root, "BENCH_r01.json", {
+        "tail": 'noise\n{"metric": "m", "value": 100.0, "unit": "rows/s",'
+                ' "tpu": {"q1": {"rows_per_sec": 100.0}}}\n'})
+    _write(root, "BENCH_r02.json", {
+        "parsed": {"metric": "m", "value": 150.0, "unit": "rows/s",
+                   "tpu": {"q1": {"rows_per_sec": 150.0}}}})
+    _write(root, "QPS_r01.json", {
+        "round": 1,
+        "point_mix": {
+            "speedup": 3.5,
+            "on": {"qps": 220.0, "latency": {
+                "point": {"requests": 10, "p50_ms": 17.0, "p99_ms": 30.0},
+                "cached": {"requests": 0, "p50_ms": 0.0}}},
+            "off": {"qps": 60.0, "latency": {}},
+        }})
+    _write(root, "DEVCACHE.json", {"ratio": {"warm_cold_ratio": 0.003,
+                                             "hit_rate": 1.0}})
+    _write(root, "SKEWJOIN.json", {
+        "adaptation_on": {"recompiles": 0, "rows_per_s": 39000.0},
+        "adaptation_off": {"recompiles": 2, "rows_per_s": 41000.0}})
+    _write(root, "MULTICHIP_r01.json", {"ok": True})
+    return root
+
+
+def test_build_trajectory_normalizes_every_family(bench_root):
+    entries = bench_trend.build_trajectory(bench_root)
+    by_key = {(e["family"], e["metric"], e["round"]): e for e in entries}
+    # r01 headline came from the embedded tail JSON, r02 from `parsed`
+    assert by_key[("bench", "m", 1)]["value"] == 100.0
+    assert by_key[("bench", "m", 2)]["value"] == 150.0
+    assert by_key[("bench", "q1_rows_per_sec", 2)]["direction"] == "up"
+    assert by_key[("qps", "point_mix_on_qps", 1)]["value"] == 220.0
+    # zero-request latency blocks are skipped, populated ones kept
+    assert ("qps", "point_mix_on_point_p50_ms", 1) in by_key
+    assert by_key[("qps", "point_mix_on_point_p50_ms", 1)][
+        "direction"] == "down"
+    assert ("qps", "point_mix_on_cached_p50_ms", 1) not in by_key
+    assert by_key[("devcache", "warm_cold_ratio", 1)]["direction"] == "down"
+    assert by_key[("skewjoin", "adaptation_on_recompiles", 1)]["value"] == 0
+    assert by_key[("multichip", "dryrun_ok", 1)]["value"] == 1.0
+    # every entry carries the machine-readable shape
+    for e in entries:
+        assert {"family", "round", "metric", "value", "unit", "direction",
+                "date", "source"} <= set(e)
+
+
+def test_check_flags_stale_missing_and_regressed(bench_root):
+    # missing TRAJECTORY.json
+    problems = bench_trend.check(bench_root)
+    assert any("missing" in p for p in problems)
+    # fresh write -> clean
+    bench_trend.write_trajectory(bench_root)
+    assert bench_trend.check(bench_root) == []
+    # a regressed new round (higher-better metric dropped 20%) fails
+    _write(bench_root, "BENCH_r03.json", {
+        "parsed": {"metric": "m", "value": 120.0, "unit": "rows/s"}})
+    bench_trend.write_trajectory(bench_root)
+    problems = bench_trend.check(bench_root)
+    assert any("bench/m" in p and "regressed 20.0%" in p for p in problems)
+    # within tolerance passes
+    assert bench_trend.check(bench_root, tolerance=0.25) == []
+    # stale trajectory (artifact changed, file not refreshed) fails
+    _write(bench_root, "BENCH_r03.json", {
+        "parsed": {"metric": "m", "value": 155.0, "unit": "rows/s"}})
+    problems = bench_trend.check(bench_root)
+    assert any("stale" in p for p in problems)
+
+
+def test_lower_better_direction_regression(bench_root):
+    _write(bench_root, "QPS_r02.json", {
+        "round": 2,
+        "point_mix": {"on": {"qps": 230.0, "latency": {
+            "point": {"requests": 10, "p50_ms": 25.0, "p99_ms": 31.0}}}}})
+    entries = bench_trend.build_trajectory(bench_root)
+    problems = bench_trend.find_regressions(entries)
+    # p50 17 -> 25 ms is a 47% regression on a lower-better metric
+    assert any("point_mix_on_point_p50_ms" in p for p in problems)
+    # qps went UP: not flagged
+    assert not any("point_mix_on_qps" in p for p in problems)
+
+
+def test_repo_trajectory_is_fresh_and_green():
+    """The committed TRAJECTORY.json matches the committed artifacts and
+    shows no latest-round regression (the tier-1 bench-trend gate)."""
+    assert bench_trend.check(REPO_ROOT) == []
+
+
+def test_cli_check_mode(bench_root):
+    bench_trend.write_trajectory(bench_root)
+    tool = os.path.join(REPO_ROOT, "tools", "bench_trend.py")
+    out = subprocess.run(
+        [sys.executable, tool, "--check", "--root", bench_root],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "no regression" in out.stdout

@@ -1,0 +1,205 @@
+"""Failure flight recorder (obs/flightrecorder.py) + the span-cap
+satellite (obs/trace.py).
+
+Acceptance (ISSUE 11): a deliberately failed distributed query yields a
+postmortem that merges the coordinator's ring with BOTH workers' rings,
+served via ``GET /v1/query/{id}/trace?recorder=1`` and attached to the
+JSONL query log; the per-tracer span cap drops (counted) instead of
+growing memory.
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu.obs import metrics as M
+from trino_tpu.obs import trace as tracing
+from trino_tpu.obs.flightrecorder import FlightRecorder, trim_postmortem
+from trino_tpu.server import wire
+from trino_tpu.server.coordinator import CoordinatorServer
+from trino_tpu.server.worker import WorkerServer
+
+
+# ---------------------------------------------------------------- units
+def test_ring_is_bounded_and_ordered():
+    r = FlightRecorder(node_id="n1", capacity=4)
+    for i in range(10):
+        r.record("event", f"e{i}", seq=i)
+    snap = r.snapshot()
+    assert len(snap) == 4 and len(r) == 4
+    assert [e["name"] for e in snap] == ["e6", "e7", "e8", "e9"]
+    assert snap[-1]["seq"] == 9 and snap[-1]["ts"] > 0
+    assert [e["name"] for e in r.snapshot(limit=2)] == ["e8", "e9"]
+
+
+def test_tracer_mirrors_closed_spans_into_ring_once():
+    r = FlightRecorder(node_id="n1")
+    t = tracing.Tracer()
+    t.recorder = r
+    with t.span("schedule", workers=2):
+        pass
+    sp = t.spans()[0]
+    t.end_span(sp)  # the idempotent safety net must not double-record
+    records = r.snapshot()
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["kind"] == "span" and rec["name"] == "schedule"
+    assert rec["traceId"] == t.trace_id and rec["spanId"] == sp.span_id
+    assert rec["attributes"] == {"workers": 2}
+
+
+def test_span_cap_drops_counted_without_breaking_callers():
+    dropped0 = M.SPANS_DROPPED.value()
+    t = tracing.Tracer(max_spans=5)
+    spans = []
+    with tracing.activate(t):
+        for i in range(8):
+            with tracing.span(f"s{i}") as sp:
+                sp.set("i", i)  # capped spans still accept attributes
+                spans.append(sp)
+    assert len(t.spans()) == 5
+    assert t.dropped_spans == 3
+    assert M.SPANS_DROPPED.value() == dropped0 + 3
+    # dropped spans still timed correctly for their callers
+    assert all(sp.duration_s is not None for sp in spans)
+
+
+def test_trim_postmortem_caps_per_node_records():
+    pm = {
+        "queryId": "q", "state": "FAILED",
+        "coordinator": {"nodeId": "c",
+                        "records": [{"n": i} for i in range(100)]},
+        "workers": [{"nodeId": "w0",
+                     "records": [{"n": i} for i in range(10)]}],
+    }
+    out = trim_postmortem(pm, per_node=64)
+    assert len(out["coordinator"]["records"]) == 64
+    assert out["coordinator"]["truncated"] == 36
+    assert out["coordinator"]["records"][-1] == {"n": 99}
+    assert len(out["workers"][0]["records"]) == 10
+    assert "truncated" not in out["workers"][0]
+    assert pm["coordinator"]["records"][0] == {"n": 0}  # input untouched
+
+
+# ------------------------------------------------------- cluster fixture
+@pytest.fixture()
+def cluster(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_QUERY_LOG", str(tmp_path / "query.jsonl"))
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [
+        WorkerServer(coordinator_url=coord.base_url, node_id=f"fr-w{i}")
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    assert coord.registry.wait_for_workers(2, timeout=15.0)
+    yield coord, workers, tmp_path / "query.jsonl"
+    for w in workers:
+        w.stop()
+    coord.stop()
+
+
+def _wait_terminal(q, timeout=60.0):
+    deadline = time.time() + timeout
+    while not q.state.is_terminal() and time.time() < deadline:
+        time.sleep(0.05)
+    return q.state.get()
+
+
+def test_worker_recorder_endpoint(cluster):
+    coord, workers, _ = cluster
+    q = coord.submit(
+        "select count(*) from orders", {"catalog": "tpch", "schema": "tiny"})
+    assert _wait_terminal(q) == "FINISHED", q.failure
+    loc = next(loc for locs in q.fragment_tasks.values() for loc in locs)
+    status, body, _ = wire.http_request(
+        "GET", f"{loc.base_url}/v1/task/{loc.task_id}/recorder")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["nodeId"].startswith("fr-w")
+    assert payload["taskKnown"] is True
+    kinds = {r["kind"] for r in payload["records"]}
+    assert "span" in kinds and "event" in kinds
+    names = {r["name"] for r in payload["records"]}
+    assert "task-created" in names and "task" in names
+    # unknown task still answers with the PROCESS ring (postmortems after
+    # worker-side pruning)
+    status, body, _ = wire.http_request(
+        "GET", f"{loc.base_url}/v1/task/nope.0.0.a0/recorder")
+    assert status == 200
+    assert json.loads(body)["taskKnown"] is False
+
+
+def test_failed_distributed_query_yields_merged_postmortem(cluster):
+    """The acceptance scenario: a deliberately failed distributed query's
+    postmortem merges the coordinator ring + BOTH workers' rings, via
+    ?recorder=1 and the JSONL query log."""
+    coord, workers, log_path = cluster
+    q = coord.submit(
+        "select o_orderpriority, count(*) from orders group by "
+        "o_orderpriority",
+        {"catalog": "tpch", "schema": "tiny",
+         # every attempt of every slot fails: the query FAILs terminally
+         "failure_injection": ".a"})
+    assert _wait_terminal(q) == "FAILED"
+    assert "injected failure" in (q.failure or "")
+    # captured at failure time, before the terminal state was visible
+    assert q.postmortem is not None
+    # the endpoint serves it (regex still matches with the query string)
+    trace = json.loads(urllib.request.urlopen(
+        f"{coord.base_url}/v1/query/{q.query_id}/trace?recorder=1").read())
+    pm = trace["postmortem"]
+    assert pm["queryId"] == q.query_id and pm["state"] == "FAILED"
+    assert "injected failure" in pm["failure"]
+    # coordinator ring: admission + spans for this query
+    coord_names = [r["name"] for r in pm["coordinator"]["records"]]
+    assert "submitted" in coord_names and "admitted" in coord_names
+    # BOTH workers' rings made it, each carrying the failed task spans
+    worker_nodes = {w.get("nodeId") for w in pm["workers"]}
+    assert worker_nodes == {"fr-w0", "fr-w1"}
+    for w in pm["workers"]:
+        assert "error" not in w
+        names = [r["name"] for r in w["records"]]
+        assert "task-created" in names
+        task_records = [r for r in w["records"]
+                        if r["kind"] == "span" and r["name"] == "task"]
+        assert any("error" in (r.get("attributes") or {})
+                   for r in task_records)
+    # without ?recorder the trace payload stays lean
+    lean = json.loads(urllib.request.urlopen(
+        f"{coord.base_url}/v1/query/{q.query_id}/trace").read())
+    assert "postmortem" not in lean
+    # the JSONL query log carries the trimmed postmortem
+    lines = [json.loads(line)
+             for line in log_path.read_text().splitlines()]
+    rec = next(line for line in lines if line["queryId"] == q.query_id)
+    assert rec["state"] == "FAILED"
+    assert rec["postmortem"]["queryId"] == q.query_id
+    assert {w["nodeId"] for w in rec["postmortem"]["workers"]} == \
+        {"fr-w0", "fr-w1"}
+    # finished queries log their timeline, no postmortem
+    q2 = coord.submit("select 1 as x", {"catalog": "tpch", "schema": "tiny"})
+    assert _wait_terminal(q2) == "FINISHED", q2.failure
+    time.sleep(0.2)
+    lines = [json.loads(line)
+             for line in log_path.read_text().splitlines()]
+    rec2 = next(line for line in lines if line["queryId"] == q2.query_id)
+    assert "postmortem" not in rec2
+    assert rec2["timeline"]["coverage"] > 0
+
+
+def test_recorder_param_on_live_query_merges_live_rings(cluster):
+    """?recorder=1 on a running/finished query builds a live merge (not
+    stored) — the forensic surface works before anything fails."""
+    coord, workers, _ = cluster
+    q = coord.submit(
+        "select count(*) from lineitem", {"catalog": "tpch",
+                                          "schema": "tiny"})
+    assert _wait_terminal(q) == "FINISHED", q.failure
+    trace = json.loads(urllib.request.urlopen(
+        f"{coord.base_url}/v1/query/{q.query_id}/trace?recorder=1").read())
+    pm = trace["postmortem"]
+    assert pm["state"] == "FINISHED"
+    assert {w.get("nodeId") for w in pm["workers"]} == {"fr-w0", "fr-w1"}

@@ -1,0 +1,183 @@
+"""OTLP export (obs/otlp.py): payload shape, bounded-queue semantics, and
+the tier-1 stub-collector smoke test.
+
+Acceptance (ISSUE 11): a coordinator + 2 workers running one distributed
+query export well-formed OTLP-JSON spans to the in-process stub
+collector — resource spans carry ``query_id``, worker task spans parent
+into the coordinator's trace (same trace id) — and exporter queue
+overflow DROPS (counted in ``trino_tpu_otlp_dropped_total``) instead of
+blocking.
+"""
+import time
+
+import pytest
+
+from trino_tpu.obs import metrics as M
+from trino_tpu.obs.otlp import (
+    ENDPOINT_ENV, OtlpExporter, StubCollector, exporter_from_env,
+    metrics_payload, spans_payload)
+
+
+# ------------------------------------------------------------- unit layer
+def test_off_by_default(monkeypatch):
+    monkeypatch.delenv(ENDPOINT_ENV, raising=False)
+    assert exporter_from_env("trino-tpu-test") is None
+
+
+def test_spans_payload_shape():
+    payload = spans_payload(
+        [{"spanId": "aa" * 8, "parentId": "bb" * 8, "name": "schedule",
+          "start": 1000.0, "durationS": 0.25,
+          "attributes": {"workers": 2, "note": "x", "frac": 0.5,
+                         "flag": True}}],
+        trace_id="cc" * 16,
+        resource={"service.name": "trino-tpu-coordinator",
+                  "query_id": "q1"})
+    rs = payload["resourceSpans"][0]
+    res_attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+    assert res_attrs["service.name"] == {"stringValue": "trino-tpu-coordinator"}
+    assert res_attrs["query_id"] == {"stringValue": "q1"}
+    sp = rs["scopeSpans"][0]["spans"][0]
+    assert sp["traceId"] == "cc" * 16 and sp["spanId"] == "aa" * 8
+    assert sp["parentSpanId"] == "bb" * 8
+    assert int(sp["endTimeUnixNano"]) - int(sp["startTimeUnixNano"]) == \
+        int(0.25 * 1e9)
+    attrs = {a["key"]: a["value"] for a in sp["attributes"]}
+    assert attrs["workers"] == {"intValue": "2"}
+    assert attrs["note"] == {"stringValue": "x"}
+    assert attrs["frac"] == {"doubleValue": 0.5}
+    assert attrs["flag"] == {"boolValue": True}
+
+
+def test_metrics_payload_counters_are_monotonic_sums():
+    samples = [
+        ("trino_tpu_tasks_total", "counter", {}, 3.0, "tasks"),
+        ("trino_tpu_workers", "gauge", {}, 2.0, "workers"),
+        ("trino_tpu_queries", "gauge", {"state": "RUNNING"}, 1.0, "q"),
+    ]
+    payload = metrics_payload(samples, {"service.name": "w"})
+    metrics = {m["name"]: m for m in
+               payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]}
+    assert metrics["trino_tpu_tasks_total"]["sum"]["isMonotonic"] is True
+    assert metrics["trino_tpu_workers"]["gauge"]["dataPoints"][0][
+        "asDouble"] == 2.0
+    dp = metrics["trino_tpu_queries"]["gauge"]["dataPoints"][0]
+    assert dp["attributes"] == [
+        {"key": "state", "value": {"stringValue": "RUNNING"}}]
+
+
+def test_queue_overflow_drops_counted_and_never_blocks():
+    # exporter thread NOT started: the queue can only fill
+    exporter = OtlpExporter("http://127.0.0.1:1", "t", queue_max=3)
+    dropped0 = M.OTLP_DROPPED.value("overflow")
+    t0 = time.monotonic()
+    results = [exporter.export_spans(
+        [{"spanId": "s", "name": "n", "start": 1.0, "durationS": 0.1}],
+        "t" * 32) for _ in range(10)]
+    assert time.monotonic() - t0 < 1.0  # never blocked
+    assert results[:3] == [True] * 3 and results[3:] == [False] * 7
+    assert M.OTLP_DROPPED.value("overflow") == dropped0 + 7
+    assert exporter.pending() == 3
+
+
+def test_unreachable_collector_drops_as_send_error():
+    exporter = OtlpExporter("http://127.0.0.1:1", "t", timeout_s=0.2)
+    exporter.start()
+    dropped0 = M.OTLP_DROPPED.value("send-error")
+    assert exporter.export_spans(
+        [{"spanId": "s", "name": "n", "start": 1.0, "durationS": 0.1}],
+        "t" * 32)
+    assert exporter.flush(timeout=10.0)
+    assert M.OTLP_DROPPED.value("send-error") == dropped0 + 1
+    exporter.shutdown()
+
+
+def test_stub_collector_round_trip():
+    collector = StubCollector().start()
+    try:
+        exporter = OtlpExporter(collector.endpoint, "svc", "node-1")
+        exporter.start()
+        exporter.export_spans(
+            [{"spanId": "ab" * 8, "name": "task", "start": 5.0,
+              "durationS": 1.0, "attributes": {}}],
+            "fe" * 16, {"query_id": "qz"})
+        exporter.export_metrics_snapshot()
+        assert exporter.flush(timeout=10.0)
+        spans = collector.spans()
+        assert len(spans) == 1
+        assert spans[0]["traceId"] == "fe" * 16
+        assert spans[0]["_resource"]["service.name"] == "svc"
+        assert spans[0]["_resource"]["service.instance.id"] == "node-1"
+        assert spans[0]["_resource"]["query_id"] == "qz"
+        assert collector.metric_payloads  # the registry snapshot arrived
+        exporter.shutdown()
+    finally:
+        collector.stop()
+
+
+# --------------------------------------------- tier-1 cluster smoke test
+@pytest.fixture()
+def otlp_cluster(monkeypatch):
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    collector = StubCollector().start()
+    monkeypatch.setenv(ENDPOINT_ENV, collector.endpoint)
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [
+        WorkerServer(coordinator_url=coord.base_url, node_id=f"otlp-w{i}")
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    assert coord.registry.wait_for_workers(2, timeout=15.0)
+    yield collector, coord, workers
+    for w in workers:
+        w.stop()
+    coord.stop()
+    collector.stop()
+
+
+def test_distributed_query_exports_parented_otlp_spans(otlp_cluster):
+    """The smoke acceptance: one distributed query -> the collector holds
+    well-formed OTLP-JSON with the coordinator's lifecycle spans AND both
+    workers' task spans under ONE trace id, query_id on every resource."""
+    collector, coord, workers = otlp_cluster
+    assert coord.otlp is not None and all(w.otlp is not None
+                                          for w in workers)
+    q = coord.submit(
+        "select l_returnflag, count(*) c from lineitem group by "
+        "l_returnflag order by l_returnflag",
+        {"catalog": "tpch", "schema": "tiny"})
+    deadline = time.time() + 60
+    while not q.state.is_terminal() and time.time() < deadline:
+        time.sleep(0.05)
+    assert q.state.get() == "FINISHED", q.failure
+    # worker task exports fire at task completion, the coordinator's at
+    # query completion; wait for both halves to land
+    spans = collector.wait_for_spans(8, timeout=15.0)
+    by_trace = {}
+    for sp in spans:
+        by_trace.setdefault(sp["traceId"], []).append(sp)
+    trace_spans = by_trace.get(q.tracer.trace_id)
+    assert trace_spans, f"trace {q.tracer.trace_id} not exported: " \
+                        f"{list(by_trace)}"
+    names = {sp["name"] for sp in trace_spans}
+    assert {"query", "schedule", "task"} <= names
+    # every resource span of this query carries its query_id
+    assert all(sp["_resource"].get("query_id") == q.query_id
+               for sp in trace_spans)
+    # the worker task spans parent into the coordinator's schedule span
+    schedule = next(sp for sp in trace_spans if sp["name"] == "schedule")
+    tasks = [sp for sp in trace_spans if sp["name"] == "task"]
+    assert len(tasks) >= 2
+    assert {t["parentSpanId"] for t in tasks} == {schedule["spanId"]}
+    # both worker resources appear (service.instance.id = node id)
+    worker_nodes = {t["_resource"].get("service.instance.id")
+                    for t in tasks}
+    assert {"otlp-w0", "otlp-w1"} <= worker_nodes
+    # well-formed ids + timestamps on everything received
+    for sp in trace_spans:
+        assert len(sp["traceId"]) == 32 and len(sp["spanId"]) == 16
+        assert int(sp["endTimeUnixNano"]) >= int(sp["startTimeUnixNano"])

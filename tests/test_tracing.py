@@ -411,3 +411,82 @@ def test_completed_event_carries_spans(cluster):
     names = {s["name"] for s in ev.spans}
     assert "query" in names and "schedule" in names
     assert ev.session_properties.get("catalog") == "tpch"
+
+
+# --------------------------------------- traceparent under FTE retries
+def test_fte_retry_reparents_into_same_trace_exactly_once(tmp_path,
+                                                          monkeypatch):
+    """Satellite (ISSUE 11): a task whose first attempt FAILS under
+    retry_policy=TASK re-parents its retried attempt's spans into the
+    SAME query trace exactly once — the assembled tree holds ONE task
+    span for the retried slot (the winning attempt), no duplicate
+    subtree from the failed attempt, all under the coordinator's
+    schedule span."""
+    monkeypatch.setenv("TRINO_TPU_SPOOL_DIR", str(tmp_path / "spool"))
+    coord = CoordinatorServer()
+    coord.start()
+    workers = [
+        WorkerServer(coordinator_url=coord.base_url, node_id=f"ftetr{i}")
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    try:
+        assert coord.registry.wait_for_workers(2, timeout=15.0)
+        q = coord.submit(
+            "select o_orderpriority, count(*) c from orders group by "
+            "o_orderpriority order by o_orderpriority",
+            {"catalog": "tpch", "schema": "tiny",
+             "retry_policy": "TASK",
+             # first attempt of slot 0 of the source fragment fails
+             "failure_injection": ".0.0.a0"})
+        assert _wait_terminal(q) == "FINISHED", q.failure
+        assert any(t.endswith(".0.0.a0") for t in q.retried_tasks)
+        trace = _get_json(f"{coord.base_url}/v1/query/{q.query_id}/trace")
+        nodes = list(flatten_tree(trace["root"]))
+        tasks = [n for n in nodes if n["name"] == "task"]
+        task_ids = [t["attributes"]["task_id"] for t in tasks]
+        # exactly one task span per SLOT: the retried slot appears once,
+        # as its winning attempt (a1), never the failed a0
+        slots = [tid.rsplit(".a", 1)[0] for tid in task_ids]
+        assert len(slots) == len(set(slots)), task_ids
+        retried_slot = f"{q.query_id}.0.0"
+        winning = [tid for tid in task_ids
+                   if tid.rsplit(".a", 1)[0] == retried_slot]
+        assert winning == [f"{retried_slot}.a1"], task_ids
+        assert not any(tid.endswith(".0.0.a0") for tid in task_ids)
+        # every task span (including the retry) parents into THIS trace's
+        # schedule span — the retried attempt re-propagated the same
+        # traceparent, so nothing dangles or re-roots
+        by_name = {}
+        for n in nodes:
+            by_name.setdefault(n["name"], []).append(n)
+        schedule_ids = {s["spanId"] for s in by_name["schedule"]}
+        assert {t["parentId"] for t in tasks} <= schedule_ids
+        assert trace["spanCount"] == len(nodes)  # single-rooted, lossless
+    finally:
+        for w in workers:
+            w.stop()
+        coord.stop()
+
+
+def test_process_self_metrics_on_both_servers(cluster):
+    """Satellite (ISSUE 11): RSS / FDs / threads / GC gauges refresh on
+    every render — the host-sick-vs-engine-slow discriminators, on
+    coordinator AND worker /v1/metrics."""
+    coord, workers = cluster
+    for url in (coord.base_url, workers[0].base_url):
+        body = urllib.request.urlopen(url + "/v1/metrics").read().decode()
+        for name in ("trino_tpu_process_rss_bytes",
+                     "trino_tpu_process_open_fds",
+                     "trino_tpu_process_threads"):
+            line = next(l for l in body.splitlines()
+                        if l.startswith(name + " "))
+            assert float(line.split()[-1]) > 0, line
+        assert 'trino_tpu_process_gc_collections{generation="0"}' in body
+    # and as rows through system.metrics
+    q = coord.submit(
+        "select name, value from system.metrics "
+        "where name = 'trino_tpu_process_rss_bytes'", {})
+    assert _wait_terminal(q) == "FINISHED", q.failure
+    assert q.rows and q.rows[0][1] > 0

@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""Fold the scattered bench-round artifacts into one machine-readable
+trajectory (``TRAJECTORY.json``) and gate on regressions.
+
+The repo's perf history lives in per-round JSON files whose shapes grew
+organically — ``BENCH_r*.json`` (driver output + a parsed headline),
+``QPS_r*.json`` (serving rounds), ``KERNELS_r*.json`` (join-kernel
+microbench), ``DEVCACHE.json`` / ``SKEWJOIN.json`` (one-shot proofs),
+``MULTICHIP_r*.json`` (mesh dry runs) — which makes the trajectory
+unreadable to tooling. This tool normalizes all of them into one flat
+list of ``{"family", "round", "metric", "value", "unit", "direction",
+"date", "source"}`` entries:
+
+- ``direction`` is ``up`` (bigger is better: qps, rows/sec) or ``down``
+  (smaller is better: latency, ratios, recompiles) — what ``--check``
+  compares against;
+- ``date`` is the artifact file's mtime (ISO date) — informational only,
+  the drift comparison ignores it;
+- ``round`` comes from the ``_rNN`` filename suffix (un-suffixed
+  one-shot artifacts are round 1).
+
+Modes::
+
+    python tools/bench_trend.py            # (re)write TRAJECTORY.json
+    python tools/bench_trend.py --check    # gate: exit 1 on regression
+                                           # or a stale TRAJECTORY.json
+
+``--check`` (also registered in ``tools/lint.py --all`` as the
+``bench-trend`` gate) fails when (a) ``TRAJECTORY.json`` is missing or
+does not match a fresh fold of the artifacts (dates ignored), or (b) a
+metric's LATEST round regressed more than ``--tolerance`` (default 5%)
+against the round before it. New benches therefore ship their artifact
+AND the refreshed trajectory in the same commit, and a perf-regressing
+artifact cannot land silently.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from gates import REPO_ROOT  # noqa: E402
+
+TRAJECTORY_FILE = "TRAJECTORY.json"
+DEFAULT_TOLERANCE = 0.05  # a >5% worse latest round fails --check
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _round_of(path: str) -> int:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else 1
+
+
+def _date_of(path: str) -> str:
+    return datetime.date.fromtimestamp(os.path.getmtime(path)).isoformat()
+
+
+def _entry(family: str, rnd: int, metric: str, value, unit: str,
+           direction: str, path: str) -> dict:
+    return {
+        "family": family,
+        "round": rnd,
+        "metric": metric,
+        "value": float(value),
+        "unit": unit,
+        "direction": direction,
+        "date": _date_of(path),
+        "source": os.path.basename(path),
+    }
+
+
+# ---------------------------------------------------------- extractors
+def _extract_bench(path: str) -> List[dict]:
+    """BENCH_r*.json: the parsed headline (rows/sec/chip + per-query
+    breakdown); older rounds without ``parsed`` fall back to the last
+    JSON line embedded in ``tail``."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    parsed = data.get("parsed")
+    if parsed is None:
+        for line in reversed((data.get("tail") or "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    parsed = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+    if not isinstance(parsed, dict) or "metric" not in parsed:
+        return []
+    rnd = _round_of(path)
+    out = [_entry("bench", rnd, parsed["metric"], parsed["value"],
+                  parsed.get("unit", ""), "up", path)]
+    for qname, q in (parsed.get("tpu") or {}).items():
+        rps = (q or {}).get("rows_per_sec")
+        if rps is not None:
+            out.append(_entry("bench", rnd, f"{qname}_rows_per_sec", rps,
+                              "rows/sec", "up", path))
+    return out
+
+
+def _extract_qps(path: str) -> List[dict]:
+    """QPS_r*.json: qps + latency percentiles per workload mix and
+    serving config, plus the headline speedup."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    rnd = int(data.get("round", _round_of(path)))
+    out: List[dict] = []
+    for mix in ("point_mix", "mixed"):
+        block = data.get(mix)
+        if not isinstance(block, dict):
+            continue
+        speedup = block.get("speedup")
+        if speedup is not None:
+            out.append(_entry("qps", rnd, f"{mix}_speedup", speedup, "x",
+                              "up", path))
+        for cfg in ("off", "on"):
+            run = block.get(cfg)
+            if not isinstance(run, dict):
+                continue
+            if run.get("qps") is not None:
+                out.append(_entry("qps", rnd, f"{mix}_{cfg}_qps",
+                                  run["qps"], "qps", "up", path))
+            for wl, lat in (run.get("latency") or {}).items():
+                if (lat or {}).get("requests"):
+                    for pct in ("p50_ms", "p99_ms"):
+                        if lat.get(pct) is not None:
+                            out.append(_entry(
+                                "qps", rnd, f"{mix}_{cfg}_{wl}_{pct}",
+                                lat[pct], "ms", "down", path))
+    return out
+
+
+def _extract_kernels(path: str) -> List[dict]:
+    """KERNELS_r*.json: probe rows/sec per case and kernel tier."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    rnd = _round_of(path)
+    out: List[dict] = []
+    for case, tiers in (data.get("cases") or {}).items():
+        case_key = case.replace("=", "").replace(",", "_")
+        for tier, rec in (tiers or {}).items():
+            rps = (rec or {}).get("probe_rows_per_sec")
+            if rps is not None:
+                out.append(_entry("kernels", rnd,
+                                  f"{case_key}_{tier}_rows_per_sec",
+                                  rps, "rows/sec", "up", path))
+    return out
+
+
+def _extract_devcache(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    ratio = (data.get("ratio") or {})
+    out: List[dict] = []
+    if ratio.get("warm_cold_ratio") is not None:
+        out.append(_entry("devcache", _round_of(path), "warm_cold_ratio",
+                          ratio["warm_cold_ratio"], "x", "down", path))
+    if ratio.get("hit_rate") is not None:
+        out.append(_entry("devcache", _round_of(path), "hit_rate",
+                          ratio["hit_rate"], "fraction", "up", path))
+    return out
+
+
+def _extract_skewjoin(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out: List[dict] = []
+    for cfg in ("adaptation_off", "adaptation_on"):
+        rec = data.get(cfg)
+        if not isinstance(rec, dict):
+            continue
+        if rec.get("recompiles") is not None:
+            out.append(_entry("skewjoin", _round_of(path),
+                              f"{cfg}_recompiles", rec["recompiles"],
+                              "count", "down", path))
+        if rec.get("rows_per_s") is not None:
+            out.append(_entry("skewjoin", _round_of(path),
+                              f"{cfg}_rows_per_s", rec["rows_per_s"],
+                              "rows/sec", "up", path))
+    return out
+
+
+def _extract_multichip(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    ok = data.get("ok")
+    if ok is None:
+        return []
+    return [_entry("multichip", _round_of(path), "dryrun_ok",
+                   1.0 if ok else 0.0, "bool", "up", path)]
+
+
+_FAMILIES = (
+    ("BENCH_r*.json", _extract_bench),
+    ("QPS_r*.json", _extract_qps),
+    ("KERNELS_r*.json", _extract_kernels),
+    ("DEVCACHE.json", _extract_devcache),
+    ("SKEWJOIN.json", _extract_skewjoin),
+    ("MULTICHIP_r*.json", _extract_multichip),
+)
+
+
+def build_trajectory(root: Optional[str] = None) -> List[dict]:
+    """Fold every artifact under ``root`` into the flat entry list,
+    sorted (family, metric, round) so diffs are stable."""
+    root = root or REPO_ROOT
+    entries: List[dict] = []
+    for pattern, extract in _FAMILIES:
+        for path in sorted(glob.glob(os.path.join(root, pattern))):
+            try:
+                entries.extend(extract(path))
+            except (ValueError, OSError) as e:
+                print(f"bench_trend: skipping unreadable {path}: {e}",
+                      file=sys.stderr)
+    entries.sort(key=lambda e: (e["family"], e["metric"], e["round"]))
+    return entries
+
+
+def find_regressions(entries: List[dict],
+                     tolerance: float = DEFAULT_TOLERANCE) -> List[str]:
+    """Latest round vs the round before, per metric, honoring each
+    metric's direction; a metric seen in fewer than two rounds has no
+    trend to gate."""
+    series: Dict[tuple, Dict[int, dict]] = {}
+    for e in entries:
+        series.setdefault((e["family"], e["metric"]), {})[e["round"]] = e
+    problems = []
+    for (family, metric), by_round in sorted(series.items()):
+        if len(by_round) < 2:
+            continue
+        rounds = sorted(by_round)
+        last, prev = by_round[rounds[-1]], by_round[rounds[-2]]
+        pv, lv = prev["value"], last["value"]
+        if pv == 0:
+            continue
+        change = (lv - pv) / abs(pv)
+        worse = -change if last["direction"] == "up" else change
+        if worse > tolerance:
+            problems.append(
+                f"{family}/{metric}: r{rounds[-2]} -> r{rounds[-1]} "
+                f"regressed {worse * 100:.1f}% "
+                f"({pv:g} -> {lv:g} {last['unit']}, "
+                f"direction={last['direction']}, "
+                f"tolerance={tolerance * 100:.0f}%)")
+    return problems
+
+
+def _strip_dates(entries: List[dict]) -> List[dict]:
+    return [{k: v for k, v in e.items() if k != "date"} for e in entries]
+
+
+def check(root: Optional[str] = None,
+          tolerance: float = DEFAULT_TOLERANCE,
+          entries: Optional[List[dict]] = None) -> List[str]:
+    """The gate body (``tools/lint.py --gate bench-trend``): stale or
+    missing TRAJECTORY.json, or a latest-round regression. Pass a
+    prebuilt ``entries`` list to skip re-folding the artifacts."""
+    root = root or REPO_ROOT
+    if entries is None:
+        entries = build_trajectory(root)
+    problems = []
+    traj_path = os.path.join(root, TRAJECTORY_FILE)
+    if not os.path.exists(traj_path):
+        problems.append(
+            f"{TRAJECTORY_FILE} missing — run: python tools/bench_trend.py")
+    else:
+        committed = None
+        try:
+            with open(traj_path, encoding="utf-8") as f:
+                payload = json.load(f)
+            committed = payload["entries"]
+            if not isinstance(committed, list):
+                raise TypeError("'entries' is not a list")
+        except (ValueError, OSError, KeyError, TypeError,
+                AttributeError) as e:
+            committed = None
+            problems.append(f"{TRAJECTORY_FILE} unreadable: {e!r} — "
+                            "run: python tools/bench_trend.py")
+        if committed is not None and \
+                _strip_dates(committed) != _strip_dates(entries):
+            problems.append(
+                f"{TRAJECTORY_FILE} is stale (bench artifacts changed) — "
+                "run: python tools/bench_trend.py")
+    problems.extend(find_regressions(entries, tolerance))
+    return problems
+
+
+def write_trajectory(root: Optional[str] = None,
+                     entries: Optional[List[dict]] = None) -> str:
+    root = root or REPO_ROOT
+    if entries is None:
+        entries = build_trajectory(root)
+    path = os.path.join(root, TRAJECTORY_FILE)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"entries": entries}, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="gate mode: fail on regression or stale "
+                         f"{TRAJECTORY_FILE} instead of writing it")
+    ap.add_argument("--root", default=None,
+                    help="alternate repo root (tests)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="allowed fractional regression between the last "
+                         "two rounds (default 0.05)")
+    args = ap.parse_args(argv)
+    entries = build_trajectory(args.root)  # fold the artifacts ONCE
+    if args.check:
+        problems = check(args.root, args.tolerance, entries=entries)
+        for p in problems:
+            print(p, file=sys.stderr)
+        if problems:
+            return 1
+        rounds = {e["source"] for e in entries}
+        print(f"bench-trend ok: {len(entries)} trajectory entries from "
+              f"{len(rounds)} artifacts, no regression")
+        return 0
+    path = write_trajectory(args.root, entries=entries)
+    print(f"wrote {path}: {len(entries)} entries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,6 +123,8 @@ ALL_GATES = (
      "no import-time jnp evaluation; no jnp in repr/property/host modules"),
     ("lock-discipline", "lint.lock_discipline",
      "no lock-order inversions, re-entry, or blocking calls under locks"),
+    ("bench-trend", "bench_trend",
+     "TRAJECTORY.json fresh and no latest-round bench regression"),
 )
 
 

@@ -94,7 +94,16 @@ def render_summary(stats) -> str:
     if stats.get("deviceCacheHits"):
         # scans served warm from the device table cache (zero transfer)
         parts.append(f"warm scans: {stats['deviceCacheHits']}")
-    return f" [{', '.join(parts)}]" if parts else ""
+    out = f" [{', '.join(parts)}]" if parts else ""
+    tl = stats.get("timeline")
+    if tl:
+        # the completion-time phase ledger: where the wall went
+        from trino_tpu.obs.timeline import summarize
+
+        ledger = summarize(tl, max_phases=4)
+        if ledger:
+            out += f" [phases: {ledger}]"
+    return out
 
 
 class Console:

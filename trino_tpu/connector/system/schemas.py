@@ -45,6 +45,16 @@ SYSTEM_TABLES = {
                                        # and for SELECTs served straight
                                        # from the result cache (no
                                        # execution path was taken)
+        # phase-ledger rollups (obs/timeline.py), computed at completion
+        # from the merged span tree; NULL while the query still runs.
+        # planning = dispatch + parse-analyze + plan-optimize +
+        # prepare-bind; execution = schedule + device-staging +
+        # device-execute + exchange-wait + result-serialization; the
+        # full per-phase breakdown rides queryStats.timeline.
+        ("queued_ms", "double"),
+        ("planning_ms", "double"),
+        ("execution_ms", "double"),
+        ("unattributed_ms", "double"),
     ),
     # prepared statements held by the coordinator registry
     # (server/prepared.py): one row per (user, name), live until

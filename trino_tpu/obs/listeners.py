@@ -39,7 +39,9 @@ class QueryLogListener(EventListener):
     def query_completed(self, event: QueryCompletedEvent) -> None:
         import json
 
-        line = json.dumps({
+        from trino_tpu.obs.flightrecorder import trim_postmortem
+
+        record = {
             "queryId": event.query_id,
             "user": event.user,
             "state": event.state,
@@ -50,7 +52,15 @@ class QueryLogListener(EventListener):
             "outputRows": event.output_rows,
             "error": ((event.error or "").split("\n")[0][:500] or None),
             "spanCount": len(event.spans),
-        }, ensure_ascii=False)
+            # the phase ledger: where this query's wall went, one dict
+            "timeline": event.timeline,
+        }
+        if event.postmortem is not None:
+            # FAILED queries carry the merged flight-recorder postmortem
+            # (each node's ring trimmed to its tail — the live endpoints
+            # keep the full rings; the durable log keeps what matters)
+            record["postmortem"] = trim_postmortem(event.postmortem)
+        line = json.dumps(record, ensure_ascii=False)
         with open(self.path, "a", encoding="utf-8") as f:
             f.write(line + "\n")
 

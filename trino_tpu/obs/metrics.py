@@ -462,6 +462,36 @@ QUERY_SECONDS = REGISTRY.histogram(
     "trino_tpu_query_seconds",
     "query wall time by terminal state", ("state",))
 
+# the query phase ledger (obs/timeline.py): exclusive wall per phase,
+# observed once per terminal query for EVERY phase (zeros included) so
+# bucket counts align across phases — the queued series is the
+# queue-time histogram multi-tenant workload management reads, and the
+# per-phase p99s are where a flat-p99 serving claim gets its attribution
+QUERY_PHASE_SECONDS = REGISTRY.histogram(
+    "trino_tpu_query_phase_seconds",
+    "exclusive query wall seconds attributed to each phase by the "
+    "completion-time phase ledger (queued | dispatch | parse-analyze | "
+    "plan-optimize | prepare-bind | schedule | device-staging | "
+    "device-execute | exchange-wait | result-serialization | "
+    "client-drain | unattributed)", ("phase",))
+
+# tracing self-protection (obs/trace.py): per-tracer span cap — a
+# pathological query stops RECORDING at the cap instead of growing
+# coordinator/worker memory without bound
+SPANS_DROPPED = REGISTRY.counter(
+    "trino_tpu_spans_dropped_total",
+    "spans dropped by the per-tracer span cap "
+    "(TRINO_TPU_TRACE_MAX_SPANS, default 4096)")
+
+# OTLP export (obs/otlp.py): the background batch exporter never blocks
+# the query path — overflow of its bounded queue and failed sends DROP,
+# counted here by reason
+OTLP_DROPPED = REGISTRY.counter(
+    "trino_tpu_otlp_dropped_total",
+    "OTLP export spans/metric batches dropped instead of blocking "
+    "(reason = overflow: bounded queue full; send-error: collector "
+    "unreachable or non-2xx)", ("reason",))
+
 
 # system catalog (trino_tpu/connector/system/): coordinator query-history
 # ring occupancy + ring evictions (reference: QueryTracker's
@@ -476,9 +506,65 @@ QUERY_HISTORY_EVICTIONS = REGISTRY.counter(
     "(query_max_history / query_min_expire_age_ms retention)")
 
 
+# process self-metrics: the "host sick vs engine slow" discriminators
+# (RSS, FDs, threads, GC) — refreshed immediately before every render so
+# both coordinator and worker /v1/metrics (and system.metrics) carry a
+# current reading without a background sampler thread
+PROCESS_RSS_BYTES = REGISTRY.gauge(
+    "trino_tpu_process_rss_bytes",
+    "resident set size of this server process (VmRSS)")
+PROCESS_OPEN_FDS = REGISTRY.gauge(
+    "trino_tpu_process_open_fds",
+    "open file descriptors held by this server process")
+PROCESS_THREADS = REGISTRY.gauge(
+    "trino_tpu_process_threads",
+    "live Python threads in this server process")
+PROCESS_GC_COLLECTIONS = REGISTRY.gauge(
+    "trino_tpu_process_gc_collections",
+    "Python GC collections per generation since process start "
+    "(point-in-time read of gc.get_stats)", ("generation",))
+
+
+def refresh_process_gauges() -> None:
+    """Sample the process self-metrics (Linux /proc where available,
+    portable fallbacks otherwise); failures leave the previous reading."""
+    import gc
+    import threading as _threading
+
+    try:
+        with open("/proc/self/status", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    PROCESS_RSS_BYTES.set(int(line.split()[1]) * 1024)
+                    break
+    except OSError:
+        try:
+            import resource
+            import sys as _sys
+
+            # ru_maxrss is the PEAK, in bytes on macOS and KiB elsewhere
+            # (this branch only runs where /proc is absent) — coarse but
+            # unit-correct fallback
+            unit = 1 if _sys.platform == "darwin" else 1024
+            PROCESS_RSS_BYTES.set(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * unit)
+        except Exception:  # noqa: BLE001 — self-metrics are best-effort
+            pass
+    try:
+        import os as _os
+
+        PROCESS_OPEN_FDS.set(len(_os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+    PROCESS_THREADS.set(_threading.active_count())
+    for gen, st in enumerate(gc.get_stats()):
+        PROCESS_GC_COLLECTIONS.set(int(st.get("collections", 0)), str(gen))
+
+
 def render_registry() -> str:
     """The whole process's exposition page (worker /v1/metrics, and the
     body of the coordinator's after its gauges refresh)."""
+    refresh_process_gauges()
     return REGISTRY.render()
 
 
@@ -488,6 +574,7 @@ def registry_samples() -> List[tuple]:
     ``system.metrics`` table (the jmx-connector role). Built from the
     same per-metric ``samples()`` expansion the text rendering consumes,
     so the table cannot diverge from ``/v1/metrics``."""
+    refresh_process_gauges()
     with REGISTRY._lock:
         metrics = list(REGISTRY._metrics.values())
     out: List[tuple] = []

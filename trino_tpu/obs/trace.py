@@ -68,10 +68,15 @@ class Span:
         # mutated, with no per-span lock
         self.attributes = {**self.attributes, key: value}
 
-    def close(self) -> None:
+    def close(self) -> bool:
+        """Close once; True only on the closing transition (end_span uses
+        this to record each span into the flight recorder exactly once
+        even though lifecycle code calls it again as a safety net)."""
         if self.end is None:
             self.end = time.time()
             self.duration = time.perf_counter() - self._t0
+            return True
+        return False
 
     @property
     def duration_s(self) -> Optional[float]:
@@ -102,6 +107,13 @@ class _NoopSpan:
 
 NOOP_SPAN = _NoopSpan()
 
+# per-tracer span storage cap (satellite of the phase-ledger PR): a
+# pathological query — a streaming producer emitting a span per batch,
+# a retry storm — must not grow coordinator/worker memory without bound.
+# At the cap new spans still TIME correctly (callers get a live Span) but
+# are not stored; drops are counted so the truncation is visible.
+DEFAULT_MAX_SPANS = int(os.environ.get("TRINO_TPU_TRACE_MAX_SPANS", "4096"))
+
 
 class Tracer:
     """Thread-safe per-query (or per-task) span recorder.
@@ -115,9 +127,16 @@ class Tracer:
     """
 
     def __init__(self, trace_id: Optional[str] = None,
-                 root_parent_id: Optional[str] = None):
+                 root_parent_id: Optional[str] = None,
+                 max_spans: Optional[int] = None):
         self.trace_id = trace_id or _hex_id(16)
         self.root_parent_id = root_parent_id
+        self.max_spans = DEFAULT_MAX_SPANS if max_spans is None else max_spans
+        # optional per-process FlightRecorder (obs/flightrecorder.py):
+        # every closed span also lands in the owning server's bounded
+        # ring, which is what the failure postmortem snapshots
+        self.recorder = None
+        self.dropped_spans = 0
         self._spans: List[Span] = []
         self._lock = threading.Lock()
 
@@ -130,11 +149,24 @@ class Tracer:
             parent_id = self.current_span_id() or self.root_parent_id
         sp = Span(name, parent_id, attributes)
         with self._lock:
-            self._spans.append(sp)
+            if len(self._spans) >= self.max_spans:
+                # cap reached: the span still times and parents correctly
+                # for its caller, it just isn't RETAINED — and the drop is
+                # loud (counter + per-tracer tally), never silent
+                self.dropped_spans += 1
+                dropped = True
+            else:
+                self._spans.append(sp)
+                dropped = False
+        if dropped:
+            from trino_tpu.obs import metrics as M
+
+            M.SPANS_DROPPED.inc()
         return sp
 
     def end_span(self, span: Span) -> None:
-        span.close()
+        if span.close() and self.recorder is not None:
+            self.recorder.record_span(span.to_dict(), self.trace_id)
 
     @contextlib.contextmanager
     def span(self, name: str, parent_id: Optional[str] = None, **attributes):

@@ -173,6 +173,19 @@ class QueryExecution:
         # injected into DispatchManager + the traceparent headers of the
         # internal HTTP clients)
         self.tracer = tracing.Tracer()
+        # the coordinator's flight recorder (obs/flightrecorder.py), set
+        # by CoordinatorServer.submit — the tracer mirrors closed spans
+        # into it, and the FAILED postmortem snapshots it
+        self.recorder = None
+        # merged coordinator+worker flight-recorder postmortem, captured
+        # at FAILED (GET /v1/query/{id}/trace?recorder=1 + the query log)
+        self.postmortem: Optional[dict] = None
+        # completion-time phase ledger (obs/timeline.QueryTimeline),
+        # computed once from the merged span tree and cached
+        self._timeline = None
+        # when the client last fetched a FINISHED result page — feeds the
+        # ledger's client-drain phase (outside the query wall)
+        self.last_drain_at: Optional[float] = None
         self._thread = threading.Thread(target=self._run, daemon=True)
 
     def start(self) -> None:
@@ -209,6 +222,11 @@ class QueryExecution:
             # racing the state change never reads a live elapsed time on a
             # terminal query
             self.ended_at = time.time()
+            # warm the phase ledger on THIS thread before the terminal
+            # transition: the compute pulls worker span dumps over HTTP,
+            # and the state listeners (history recording, events) must
+            # stay fast — they read the cached result
+            self._warm_timeline()
             self.state.set("FINISHED")
         except Exception as e:  # noqa: BLE001 — reported through query info
             self.ended_at = self.ended_at or time.time()
@@ -219,6 +237,15 @@ class QueryExecution:
             root_span.set("error", str(e).split("\n")[0][:300])
             self._cancel_tasks()
             self.tracer.end_span(root_span)
+            self._warm_timeline()
+            # capture the flight-recorder postmortem BEFORE FAILED is
+            # visible (same fast-listener contract as the ledger): the
+            # workers' rings still hold the context around the failure
+            try:
+                self.capture_postmortem(
+                    timeout=self.COMPLETION_PULL_TIMEOUT)
+            except Exception:  # noqa: BLE001 — best-effort forensics
+                pass
             self.state.set("FAILED")
         finally:
             self.ended_at = self.ended_at or time.time()
@@ -367,6 +394,7 @@ class QueryExecution:
         t0 = time.perf_counter()
         with self.tracer.span("prepare/bind") as sp:
             sp.set("statement", stmt.name)
+            sp.set("step", "fold")
             values = prep.fold_execute_args(stmt.params)
             prep.check_arity(ps, values)
             sp.set("parameters", len(values))
@@ -394,7 +422,9 @@ class QueryExecution:
         # per-request work a warm EXECUTE pays (fold + substitute)
         root, versions = self._plan_prepared(session, ps, ptypes)
         t1 = time.perf_counter()
-        bound_root = prep.bind_plan_parameters(root, values)
+        with self.tracer.span("prepare/bind") as sp:
+            sp.set("step", "substitute")
+            bound_root = prep.bind_plan_parameters(root, values)
         M.EXECUTE_BIND_SECONDS.observe(
             fold_s + (time.perf_counter() - t1))
         key = self._consult_result_cache(session, inner, bound_root,
@@ -577,24 +607,33 @@ class QueryExecution:
             fragments = fragment_plan(root, session)
             sp.set("fragments", len(fragments))
         self.fragments = fragments
-        self.state.set("STARTING")
-        workers = self.registry.alive()
-        if not workers:
-            raise RuntimeError("no alive workers")
+        # the schedule span covers the whole dispatch tail — worker
+        # selection, task creation, the RUNNING transition (whose state
+        # listeners run inline), and the stats-poller spawn — so the
+        # phase ledger attributes all of it to `schedule` instead of
+        # leaving sub-millisecond gaps around the task POSTs
         with self.tracer.span("schedule") as sp:
+            self.state.set("STARTING")
+            workers = self.registry.alive()
+            if not workers:
+                raise RuntimeError("no alive workers")
             sp.set("workers", len(workers))
             self._schedule(session, fragments, workers)
-        self.state.set("RUNNING")
-        self._start_stats_poller()
+            self.state.set("RUNNING")
+            self._start_stats_poller()
         with self.tracer.span("execute/root-fragment"):
             result_page = self._run_root_fragment(session, fragments)
         # freeze the rollup on the workers' terminal numbers before the
         # query leaves RUNNING (tasks are at least FLUSHING once the root
-        # fragment has drained their buffers)
-        self._sweep_task_stats()
+        # fragment has drained their buffers); spanned so the ledger can
+        # attribute this control-plane wall instead of leaving a gap
+        with self.tracer.span("stats/sweep") as sp:
+            sp.set("polled", self._sweep_task_stats())
         self.state.set("FINISHING")
         self.columns = fragments[-1].root.column_names
-        self.rows = result_page.to_pylist()
+        with self.tracer.span("result/serialize") as sp:
+            self.rows = result_page.to_pylist()
+            sp.set("rows", len(self.rows))
 
     def _cleanup_spool(self) -> None:
         """Drop this query's spooled task outputs (reference: exchange
@@ -683,7 +722,10 @@ class QueryExecution:
             if reason is not None:
                 sp.set("rows", page.live_count())
         self._local_executor = ex  # EXPLAIN ANALYZE annotation source
-        self.columns, self.rows = list(root.column_names), page.to_pylist()
+        self.columns = list(root.column_names)
+        with self.tracer.span("result/serialize") as sp:
+            self.rows = page.to_pylist()
+            sp.set("rows", len(self.rows))
         self._note_local_stats(ex, time.perf_counter() - t0)
 
     def _note_local_stats(self, ex, elapsed_s: float) -> None:
@@ -800,6 +842,120 @@ class QueryExecution:
             e["workerUri"] = url_by_task.get(e["taskId"])
         return entries
 
+    # ------------------------------------------------------- phase ledger
+    def worker_spans(self, timeout: float = 3.0) -> List[dict]:
+        """Every scheduled task's span dump, fetched in parallel with a
+        short timeout (a gone/partitioned worker loses its spans, never
+        the whole read). Shared by the trace endpoint and the ledger —
+        the completion-path caller passes a tighter timeout because it
+        runs BEFORE the terminal state publishes."""
+        locations = [loc for locs in list(self.fragment_tasks.values())
+                     for loc in list(locs) if loc is not None]
+        if not locations:
+            return []
+
+        def fetch(loc):
+            try:
+                status, body, _ = wire.http_request(
+                    "GET", f"{loc.base_url}/v1/task/{loc.task_id}/spans",
+                    timeout=timeout)
+                if status < 400:
+                    return json.loads(body).get("spans", ())
+            except Exception:  # noqa: BLE001
+                pass
+            return ()
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        spans: List[dict] = []
+        with ThreadPoolExecutor(max_workers=min(8, len(locations))) as tp:
+            for dump in tp.map(fetch, locations):
+                spans.extend(dump)
+        return spans
+
+    # pre-publication pulls (ledger warm + postmortem capture) run on the
+    # query thread BEFORE the terminal state is visible — a blackholed
+    # worker must cost ~a second of failure-reporting latency, not the
+    # trace endpoint's full on-demand timeout
+    COMPLETION_PULL_TIMEOUT = 1.5
+
+    def _warm_timeline(self) -> None:
+        """Compute + cache the ledger (requires ``ended_at``); called on
+        the query thread right before the terminal transition so state
+        listeners — and every later read — get the cached result."""
+        if self._timeline is not None or self.ended_at is None:
+            return
+        try:
+            from trino_tpu.obs.timeline import compute_timeline
+
+            spans = self.tracer.to_dicts() + self.worker_spans(
+                timeout=self.COMPLETION_PULL_TIMEOUT)
+            self._timeline = compute_timeline(
+                spans, self.created_at, self.ended_at)
+        except Exception:  # noqa: BLE001 — the ledger is observability,
+            pass  # never a reason to fail the terminal transition
+
+    def timeline_dict(self) -> Optional[dict]:
+        """The query's phase ledger: None while running, computed ONCE
+        from the merged coordinator+worker span tree at terminal and
+        cached (normally warmed by the query thread just before the
+        terminal transition; a kill/cancel from another thread computes
+        here on first read). ``client-drain`` refreshes on every read —
+        result pages keep draining after the wall ends."""
+        if not self.state.is_terminal() or self.ended_at is None:
+            return None
+        if self._timeline is None:
+            self._warm_timeline()
+        tl = self._timeline
+        if tl is None:
+            return None
+        if self.last_drain_at is not None:
+            tl.client_drain_s = max(0.0, self.last_drain_at - self.ended_at)
+        return tl.to_dict()
+
+    def _timeline_now(self) -> dict:
+        """A ledger over the spans recorded SO FAR (EXPLAIN ANALYZE's
+        header renders mid-query, before the wall closes)."""
+        from trino_tpu.obs.timeline import compute_timeline
+
+        spans = self.tracer.to_dicts() + self.worker_spans()
+        return compute_timeline(spans, self.created_at,
+                                time.time()).to_dict()
+
+    # ---------------------------------------------------- flight recorder
+    def capture_postmortem(self, store: bool = True,
+                           timeout: float = 3.0) -> dict:
+        """Merge this process's flight-recorder ring with every involved
+        worker's (pulled via ``GET /v1/task/{id}/recorder``) into one
+        postmortem. Called on FAILED (stored on the execution + shipped
+        on QueryCompletedEvent) and on demand by
+        ``GET /v1/query/{id}/trace?recorder=1``."""
+        from trino_tpu.obs.flightrecorder import pull_worker_rings
+
+        locations = [loc for locs in list(self.fragment_tasks.values())
+                     for loc in list(locs) if loc is not None]
+        # the failure-path capture runs BEFORE the FAILED transition is
+        # published (fast-listener contract) — a set failure reason means
+        # the query IS failing, and the record must say so
+        state = self.state.get()
+        if self.failure is not None and not self.state.is_terminal():
+            state = "FAILED"
+        pm = {
+            "queryId": self.query_id,
+            "state": state,
+            "failure": (self.failure or "").split("\n")[0] or None,
+            "capturedAt": time.time(),
+            "coordinator": {
+                "nodeId": getattr(self.recorder, "node_id", "coordinator"),
+                "records": (self.recorder.snapshot()
+                            if self.recorder is not None else []),
+            },
+            "workers": pull_worker_rings(locations, timeout=timeout),
+        }
+        if store:
+            self.postmortem = pm
+        return pm
+
     def query_stats(self, stages: Optional[List[dict]] = None) -> dict:
         """Query-level rollup: live while RUNNING, frozen at terminal.
         Pass precomputed ``stages`` to avoid re-rolling the task map when
@@ -820,6 +976,9 @@ class QueryExecution:
         # adaptive plan changes applied so far — rides every statement
         # response so clients can render "[adapted: N]" live
         qs["adaptations"] = len(self.plan_versions)
+        # the phase ledger (obs/timeline.py): per-phase exclusive wall +
+        # unattributed residual, None until the query is terminal
+        qs["timeline"] = self.timeline_dict()
         return qs
 
     def _explain_analyze(self, session, stmt) -> str:
@@ -851,6 +1010,13 @@ class QueryExecution:
         self._execute_query(session, root)
         exec_s = _time.perf_counter() - t_exec
         header = [wall_time_header(plan_s, exec_s)]
+        # the phase ledger over the spans recorded so far (the EXPLAIN
+        # query itself is still running while this renders)
+        from trino_tpu.obs.timeline import summarize as summarize_timeline
+
+        ledger = summarize_timeline(self._timeline_now())
+        if ledger:
+            header.append(f"Phase ledger: {ledger}")
         if self.fragments is None:
             # process-local catalogs / fast-path queries executed on the
             # coordinator's own engine: annotate from that executor,
@@ -1470,6 +1636,18 @@ class CoordinatorServer:
             self.events.add(QueryLogListener(query_log_path))
         self.queries_submitted = 0
         self.start_time = time.time()
+        # failure flight recorder (obs/flightrecorder.py): this process's
+        # bounded ring of recent span/event/admission records — what the
+        # FAILED-query postmortem snapshots on the coordinator side
+        from trino_tpu.obs.flightrecorder import FlightRecorder
+
+        self.recorder = FlightRecorder(node_id="coordinator")
+        # OTLP export (obs/otlp.py): on only when TRINO_TPU_OTLP_ENDPOINT
+        # is set — completed queries' span trees ship to the collector
+        # from a background batch exporter, never the query path
+        from trino_tpu.obs import otlp as _otlp
+
+        self.otlp = _otlp.exporter_from_env("trino-tpu-coordinator")
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -1482,6 +1660,10 @@ class CoordinatorServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self.otlp is not None:
+            # flush + stop the exporter thread: a stopped instance must
+            # not keep reporting metrics under its service identity
+            self.otlp.shutdown()
 
     # retained terminal queries (history for /v1/query) — oldest evicted
     # with their materialized result rows (reference: query.max-history)
@@ -1494,6 +1676,12 @@ class CoordinatorServer:
             query_id, sql, properties or {}, self.registry, self.session_factory,
             user=user, query_cache=self.query_cache,
             prepared_registry=self.prepared)
+        # flight-recorder hookup: closed spans mirror into the process
+        # ring, and the execution can snapshot it for its postmortem
+        execution.recorder = self.recorder
+        execution.tracer.recorder = self.recorder
+        self.recorder.record("admission", "submitted", queryId=query_id,
+                             user=user)
         with self._qlock:
             terminal = [qid for qid, q in self.queries.items() if q.state.is_terminal()]
             for qid in terminal[: max(0, len(terminal) - self.MAX_QUERY_HISTORY)]:
@@ -1513,14 +1701,46 @@ class CoordinatorServer:
             from trino_tpu.obs import metrics as M
 
             M.QUERY_SECONDS.observe(wall, state)
+            self.recorder.record("event", "query-completed",
+                                 queryId=query_id, state=state,
+                                 wallS=round(wall, 6))
+            # the phase ledger: computed ONCE here (the merged span tree
+            # exists now) and fed into the per-phase histogram — this is
+            # where every millisecond of the wall gets attributed
+            timeline = None
+            try:
+                timeline = execution.timeline_dict()
+                if timeline is not None:
+                    from trino_tpu.obs.timeline import observe_phases
+
+                    observe_phases(timeline)
+            except Exception:  # noqa: BLE001 — the ledger is
+                pass  # observability, never a reason to disturb terminal
+            # FAILED queries carry the flight-recorder postmortem —
+            # normally captured by the query thread before the terminal
+            # transition; a kill() from another thread captures here
+            if state == "FAILED" and execution.postmortem is None:
+                try:
+                    execution.capture_postmortem()
+                except Exception:  # noqa: BLE001 — best-effort forensics
+                    pass
             self.events.fire_completed(
                 ev.QueryCompletedEvent(
                     query_id, user, sql, state, created_at, now,
                     wall, len(execution.rows), execution.failure,
                     spans=tuple(execution.tracer.to_dicts()),
                     session_properties=dict(execution.session_properties),
+                    timeline=timeline,
+                    postmortem=execution.postmortem,
                 )
             )
+            if self.otlp is not None:
+                # ship the coordinator half of the trace (workers export
+                # their own task spans at task completion)
+                self.otlp.export_spans(
+                    execution.tracer.to_dicts(), execution.tracer.trace_id,
+                    {"query_id": query_id, "query.user": user,
+                     "query.state": state})
             # completed-query history (system.runtime.queries coverage of
             # finished queries): retention knobs are session-property-
             # gated, read from THIS query's submitted properties — but the
@@ -1553,8 +1773,12 @@ class CoordinatorServer:
         def admit_and_start():
             if not self.resource_group.submit(timeout=600.0, user=user):
                 execution.failure = "Query queue is full (resource group limit)"
+                self.recorder.record("admission", "queue-full",
+                                     queryId=query_id, user=user)
                 execution.state.set("FAILED")
                 return
+            self.recorder.record("admission", "admitted", queryId=query_id,
+                                 user=user)
             # cluster-memory admission: dispatch blocks while the cluster
             # pool is over its limit (reference: ClusterMemoryManager's
             # query.max-memory gate) — the killer frees it if needed; a
@@ -1601,50 +1825,40 @@ class CoordinatorServer:
                 total_rows += len(q.rows)
         return by_state, total_rows
 
-    def query_trace(self, query_id: str) -> Optional[dict]:
+    def query_trace(self, query_id: str,
+                    include_recorder: bool = False) -> Optional[dict]:
         """Assemble the query's cross-process span tree: coordinator-side
         spans merge with each worker task's span dump (pulled on demand from
         ``GET /v1/task/{id}/spans`` — task-span collection is lazy, like the
-        reference's trace export being independent of the query path)."""
+        reference's trace export being independent of the query path).
+        ``include_recorder`` attaches the flight-recorder postmortem: the
+        one captured at FAILED, else a live merge of the rings
+        (``?recorder=1``)."""
         q = self.get_query(query_id)
         if q is None:
             return None
-        spans = q.tracer.to_dicts()
-        # snapshot: the query thread inserts fragments while it schedules,
-        # and a live trace poll must not die on a resizing dict
-        locations = [loc for locs in list(q.fragment_tasks.values())
-                     for loc in list(locs) if loc is not None]
-
-        def fetch(loc):
-            """One task's span dump; a gone/partitioned worker loses its
-            spans, never the whole trace. Short timeout + parallel fetch:
-            the endpoint must answer promptly even when every worker is
-            blackholed (serial 10 s timeouts would stack per task)."""
-            try:
-                status, body, _ = wire.http_request(
-                    "GET", f"{loc.base_url}/v1/task/{loc.task_id}/spans",
-                    timeout=3.0)
-                if status < 400:
-                    return json.loads(body).get("spans", ())
-            except Exception:  # noqa: BLE001
-                pass
-            return ()
-
-        if locations:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=min(8, len(locations))) as tp:
-                for dump in tp.map(fetch, locations):
-                    spans.extend(dump)
+        spans = q.tracer.to_dicts() + q.worker_spans()
         from trino_tpu.obs.trace import build_tree
 
-        return {
+        trace = {
             "queryId": q.query_id,
             "traceId": q.tracer.trace_id,
             "state": q.state.get(),
             "spanCount": len(spans),
+            # the phase ledger rides the trace payload once terminal —
+            # the span tree is the evidence, the ledger the verdict
+            "timeline": q.timeline_dict(),
             "root": build_tree(spans),
         }
+        if include_recorder:
+            # the stored postmortem exists only for FAILED queries (frozen
+            # at failure time); any other state merges the LIVE rings on
+            # every read — never cached, so repeated reads see fresh
+            # process context
+            trace["postmortem"] = (
+                q.postmortem if q.postmortem is not None
+                else q.capture_postmortem(store=False))
+        return trace
 
     def _kill_query(self, query_id: str, reason: str) -> None:
         q = self.get_query(query_id)
@@ -1684,6 +1898,9 @@ def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) ->
         payload["deallocatedPreparedStatements"] = list(q.deallocated_prepared)
     start = token * RESULT_PAGE_ROWS
     chunk = q.rows[start : start + RESULT_PAGE_ROWS]
+    # client-drain bookkeeping for the phase ledger: the query's wall is
+    # over, but the client is still fetching pages
+    q.last_drain_at = time.time()
     payload["columns"] = [{"name": c} for c in q.columns]
     payload["data"] = [list(_jsonable(v) for v in row) for row in chunk]
     if start + RESULT_PAGE_ROWS < len(q.rows):
@@ -1898,12 +2115,21 @@ def _make_handler(server: CoordinatorServer):
                     _result_payload(server, q, int(m.group(2)))).encode(),
                     headers=_cache_header(q))
                 return
-            m = _TRACE_RE.match(self.path)
+            # the trace route accepts a query string (?recorder=1 attaches
+            # the flight-recorder postmortem); other routes stay exact
+            from urllib.parse import parse_qs, urlsplit
+
+            url_parts = urlsplit(self.path)
+            m = _TRACE_RE.match(url_parts.path)
             if m:
                 q = server.get_query(m.group(1))
                 if not self._authenticated(query=q):
                     return
-                trace = (server.query_trace(m.group(1))
+                params = parse_qs(url_parts.query)
+                with_recorder = params.get("recorder", ["0"])[0] not in (
+                    "0", "", "false")
+                trace = (server.query_trace(
+                            m.group(1), include_recorder=with_recorder)
                          if q is not None else None)
                 if trace is None:
                     # covers eviction between the two lookups too: never

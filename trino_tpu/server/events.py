@@ -60,6 +60,13 @@ class QueryCompletedEvent:
     # QueryContext.sessionProperties on the completed event)
     session_properties: Mapping[str, object] = dataclasses.field(
         default_factory=dict)
+    # completion-time phase ledger (obs/timeline.py QueryTimeline.to_dict)
+    # — wall attribution per phase + unattributed residual; None when the
+    # ledger could not be computed
+    timeline: Optional[Mapping[str, object]] = None
+    # flight-recorder postmortem (obs/flightrecorder.py): merged
+    # coordinator + worker rings, captured for FAILED queries only
+    postmortem: Optional[Mapping[str, object]] = None
 
 
 class EventListener:

@@ -40,6 +40,20 @@ def query_record(execution, state: Optional[str] = None,
     qs = execution.query_stats(stages)
     failure = (execution.failure or "").split("\n")[0] or None
     adaptations = len(execution.plan_versions)
+    # phase-ledger rollups (obs/timeline.py): the three coarse buckets
+    # plus the residual, NULL until the ledger exists (query terminal)
+    tl = qs.get("timeline")
+    queued_ms = planning_ms = execution_ms = unattributed_ms = None
+    if tl is not None:
+        ph = tl["phases"]
+        queued_ms = ph.get("queued", 0.0) * 1000.0
+        planning_ms = sum(ph.get(p, 0.0) for p in (
+            "dispatch", "parse-analyze", "plan-optimize",
+            "prepare-bind")) * 1000.0
+        execution_ms = sum(ph.get(p, 0.0) for p in (
+            "schedule", "device-staging", "device-execute",
+            "exchange-wait", "result-serialization")) * 1000.0
+        unattributed_ms = ph.get("unattributed", 0.0) * 1000.0
     return {
         "queryId": execution.query_id,
         "state": state or execution.state.get(),
@@ -64,6 +78,10 @@ def query_record(execution, state: Optional[str] = None,
         # control-plane path of the SELECT (server/fastpath.py):
         # fast-path | distributed | local-catalog; None otherwise
         "fastPath": execution.fast_path,
+        "queuedMs": queued_ms,
+        "planningMs": planning_ms,
+        "executionMs": execution_ms,
+        "unattributedMs": unattributed_ms,
     }
 
 
@@ -77,6 +95,8 @@ def _query_row(rec: dict) -> tuple:
         rec["outputBytes"], rec["peakBytes"], rec["resultRows"],
         rec["cacheStatus"], rec["adaptations"], rec["planVersions"],
         rec["failure"], rec.get("fastPath"),
+        rec.get("queuedMs"), rec.get("planningMs"),
+        rec.get("executionMs"), rec.get("unattributedMs"),
     )
 
 

@@ -137,7 +137,8 @@ class SqlTask:
     """
 
     def __init__(self, request: TaskRequest, session_factory,
-                 traceparent: Optional[str] = None):
+                 traceparent: Optional[str] = None, recorder=None,
+                 otlp=None):
         self.request = request
         self.state: StateMachine[str] = task_state_machine()
         # worker half of the query's trace: same trace id, spans rooted
@@ -147,6 +148,11 @@ class SqlTask:
         self.tracer = tracing.Tracer(
             trace_id=ctx[0] if ctx else None,
             root_parent_id=ctx[1] if ctx else None)
+        # worker-process flight recorder + OTLP exporter (both optional):
+        # closed spans mirror into the ring; the finished task's span
+        # dump ships to the collector under the propagated trace id
+        self.tracer.recorder = recorder
+        self._otlp = otlp
         from trino_tpu.server.buffer import DEFAULT_MAX_BUFFER_BYTES
 
         sink_max = int(request.session_properties.get(
@@ -296,6 +302,12 @@ class SqlTask:
             self._observe_operator_metrics()
             task_span.set("state", self.state.get())
             self.tracer.end_span(task_span)
+            if self._otlp is not None:
+                self._otlp.export_spans(
+                    self.tracer.to_dicts(), self.tracer.trace_id,
+                    {"query_id": self.request.query_id,
+                     "task_id": self.request.task_id,
+                     "task.state": self.state.get()})
 
     def _observe_operator_metrics(self) -> None:
         """Feed the per-operator-kind registry metrics from this task's
@@ -886,10 +898,14 @@ class TaskManager:
     # (reference: SqlTaskManager's task info cache expiry)
     MAX_TASK_HISTORY = 200
 
-    def __init__(self, session_factory):
+    def __init__(self, session_factory, recorder=None, otlp=None):
         self._tasks: Dict[str, SqlTask] = {}
         self._lock = threading.Lock()
         self._session_factory = session_factory
+        # worker-process observability hookups, threaded into every task
+        # (obs/flightrecorder.FlightRecorder / obs/otlp.OtlpExporter)
+        self._recorder = recorder
+        self._otlp = otlp
 
     def create_task(self, request: TaskRequest,
                     traceparent: Optional[str] = None) -> SqlTask:
@@ -900,9 +916,19 @@ class TaskManager:
             task = self._tasks.get(request.task_id)
             if task is None:
                 task = SqlTask(request, self._session_factory,
-                               traceparent=traceparent)
+                               traceparent=traceparent,
+                               recorder=self._recorder, otlp=self._otlp)
                 self._tasks[request.task_id] = task
-                M.TASKS_TOTAL.inc()
+                created = True
+            else:
+                created = False
+        if created:
+            M.TASKS_TOTAL.inc()
+            if self._recorder is not None:
+                self._recorder.record(
+                    "event", "task-created", taskId=request.task_id,
+                    queryId=request.query_id,
+                    splits=sum(len(v) for v in request.splits.values()))
         task.start()
         return task
 
@@ -916,6 +942,10 @@ class TaskManager:
         if task is not None:
             task.output.abort("canceled")
             task.state.set("CANCELED")
+            if self._recorder is not None:
+                self._recorder.record("event", "task-canceled",
+                                      taskId=task_id,
+                                      queryId=task.request.query_id)
 
     def list_info(self) -> List[dict]:
         with self._lock:

@@ -28,6 +28,7 @@ _RESULTS_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)/(\d+)$")
 _TASK_RE = re.compile(r"^/v1/task/([^/]+)$")
 _STATUS_RE = re.compile(r"^/v1/task/([^/]+)/status$")
 _SPANS_RE = re.compile(r"^/v1/task/([^/]+)/spans$")
+_RECORDER_RE = re.compile(r"^/v1/task/([^/]+)/recorder$")
 
 
 def default_session_factory(properties):
@@ -61,8 +62,25 @@ class WorkerServer:
                  memory_limit_bytes: Optional[int] = None):
         import os
 
-        self.tasks = TaskManager(session_factory or shared_catalog_session_factory())
         self.node_id = node_id or f"worker-{time.time_ns() & 0xFFFFFF:x}"
+        # this worker's failure flight recorder (obs/flightrecorder.py):
+        # bounded ring of recent span/event records, pulled by the
+        # coordinator into FAILED-query postmortems via
+        # GET /v1/task/{id}/recorder
+        from trino_tpu.obs.flightrecorder import FlightRecorder
+
+        self.recorder = FlightRecorder(node_id=self.node_id)
+        # OTLP export, on only when TRINO_TPU_OTLP_ENDPOINT is set: each
+        # completed task ships its span dump under the query's PROPAGATED
+        # trace id, so worker spans parent into the coordinator's trace
+        # inside the collector too
+        from trino_tpu.obs import otlp as _otlp
+
+        self.otlp = _otlp.exporter_from_env(
+            "trino-tpu-worker", instance_id=self.node_id)
+        self.tasks = TaskManager(
+            session_factory or shared_catalog_session_factory(),
+            recorder=self.recorder, otlp=self.otlp)
         self.coordinator_url = coordinator_url
         # per-worker memory pool size (reference: memory.heap-headroom /
         # query.max-memory-per-node config); None = unlimited
@@ -87,6 +105,10 @@ class WorkerServer:
         self._stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self.otlp is not None:
+            # flush + stop the exporter thread: a stopped instance must
+            # not keep reporting metrics under its service.instance.id
+            self.otlp.shutdown()
 
     def _announce_loop(self) -> None:
         """Periodic announce = discovery + liveness in one (reference:
@@ -232,6 +254,21 @@ def _make_handler(server: WorkerServer):
                     "taskId": task.request.task_id,
                     "traceId": task.tracer.trace_id,
                     "spans": task.tracer.to_dicts(),
+                }).encode())
+                return
+            m = _RECORDER_RE.match(self.path)
+            if m:
+                if not self._authorized():
+                    return
+                # the PROCESS ring, not a per-task record: a postmortem
+                # wants the context AROUND the failure (what else ran,
+                # which spans closed last) — and it still answers after
+                # the task itself was pruned from the manager
+                self._send(200, json.dumps({
+                    "nodeId": server.node_id,
+                    "taskId": m.group(1),
+                    "taskKnown": server.tasks.get(m.group(1)) is not None,
+                    "records": server.recorder.snapshot(),
                 }).encode())
                 return
             if self.path == "/v1/metrics":
