@@ -4,7 +4,13 @@ Every BENCH_r* round measures single-query throughput; heavy traffic is
 queries per SECOND. This bench boots a real coordinator + N workers in
 one process (the DistributedQueryRunner idiom the test suite uses),
 drives C concurrent DBAPI clients over a mixed serving workload, and
-measures the two control-plane configurations ISSUE 10 ships:
+measures the two control-plane configurations ISSUE 10 ships —
+and, since ISSUE 12's dispatcher/executor split, the CONCURRENCY
+SCALING SWEEP: the serving configuration's point mix at client counts
+{1, 2, 4, 8, 16, 32} (per-stage disjoint key ranges so the shared
+result cache can never flatter a later stage), emitted as
+``QPS_r02.json`` and folded into TRAJECTORY.json as the scaling curve.
+``--check`` additionally runs the dispatcher scaling gate (see main).
 
 - **serving ON** — prepared point lookups through PREPARE/EXECUTE (the
   parameterized plan caches once; every EXECUTE is bind + run) with the
@@ -19,15 +25,19 @@ Workload mix (per client, round-robin):
 - ``cached``  — a repeated aggregate with the result cache on (HIT path);
 - ``uncached``— an aggregate over a shifting predicate (MISS every time).
 
-Emits ``QPS_r01.json`` next to the other bench artifacts: per-config
+Emits ``QPS_r02.json`` next to the other bench artifacts: per-config
 qps + p50/p95/p99 latency per workload class, the per-path breakdown
 (fast-path vs distributed counts from the coordinator's own metrics),
-and the ON/OFF speedup on the point mix.
+the ON/OFF speedup on the point mix, and the concurrency sweep with
+the ISSUE 12 acceptance record.
 
 Run:    python microbench/qps.py [--clients C] [--requests N] [--workers W]
+                                 [--sweep 1,2,4,8,16,32]
 Check:  python microbench/qps.py --check [--min-speedup X]
         (tier-1 quick mode, small N, CPU-runnable: asserts the serving
-        config clears ``min_speedup`` x on the point-lookup mix)
+        config clears ``min_speedup`` x on the point-lookup mix AND the
+        dispatcher scaling gate — QPS at 8 clients strictly above 2
+        clients on multi-core boxes; saturation hold on single-core)
 """
 from __future__ import annotations
 
@@ -77,10 +87,14 @@ def _latency_summary(lat_s) -> dict:
 
 def run_config(coord_url: str, serving_on: bool, clients: int,
                requests_per_client: int, mix=("point", "point", "cached",
-                                              "uncached", "point")) -> dict:
+                                              "uncached", "point"),
+               key_base: int = None) -> dict:
     """One measured configuration: C threads, each its own DBAPI
     connection, round-robin over the workload mix. Returns the stats
-    block (qps, latency summaries per class, failure count)."""
+    block (qps, latency summaries per class, failure count).
+    ``key_base`` offsets the unique point keys — every measured stage of
+    a sweep gets a disjoint range so the shared result cache can never
+    serve one stage the previous stage's keys."""
     from trino_tpu.client import dbapi
     from trino_tpu.obs import metrics as M
 
@@ -126,7 +140,8 @@ def run_config(coord_url: str, serving_on: bool, clients: int,
                 # reusing the OFF run's keys would serve the ON run's
                 # "uncached"/"point" classes as cross-config cache HITs —
                 # measuring the cache instead of the control path
-                base = 2_000_000 if serving_on else 1_000_000
+                base = key_base if key_base is not None else (
+                    2_000_000 if serving_on else 1_000_000)
                 if kind == "point":
                     k = base + ci * 100_000 + r  # unique per request
                     if serving_on:
@@ -181,11 +196,56 @@ def run_config(coord_url: str, serving_on: bool, clients: int,
 
 
 def run_point_only(coord_url: str, serving_on: bool, clients: int,
-                   requests_per_client: int) -> dict:
+                   requests_per_client: int, key_base: int = None) -> dict:
     """The acceptance mix: point lookups only (the serving shape the
     ISSUE's >=Nx bound is defined over)."""
     return run_config(coord_url, serving_on, clients, requests_per_client,
-                      mix=("point",))
+                      mix=("point",), key_base=key_base)
+
+
+def run_sweep(coord_url: str, sweep, total_requests: int = 256,
+              key_offset: int = 0) -> list:
+    """The concurrency scaling curve (ISSUE 12 / QPS_r02): the serving
+    configuration's point mix at each client count, same cluster, each
+    stage on a DISJOINT key range. ``total_requests`` is held roughly
+    constant across stages so each stage measures a similar window;
+    ``key_offset`` keeps REPEATED sweeps on fresh keys (the shared
+    result cache must never serve one repetition the previous one's
+    rows)."""
+    entries = []
+    for i, clients in enumerate(sweep):
+        per_client = max(4, total_requests // max(1, clients))
+        stage = run_point_only(
+            coord_url, True, clients, per_client,
+            key_base=10_000_000 + key_offset + i * 5_000_000)
+        lat = stage["latency"]["point"]
+        entry = {
+            "clients": clients,
+            "requests": lat["requests"],
+            "qps": stage["qps"],
+            "p50_ms": lat["p50_ms"],
+            "p95_ms": lat["p95_ms"],
+            "p99_ms": lat["p99_ms"],
+            "failures": stage["failures"],
+        }
+        entries.append(entry)
+        print(f"  sweep c={clients:>2}: {entry['qps']:>7} qps  "
+              f"p50 {entry['p50_ms']}ms  p99 {entry['p99_ms']}ms",
+              flush=True)
+    return entries
+
+
+def _tune_gc_for_measurement() -> None:
+    """Measurement hygiene for the in-process harness: freeze the booted
+    servers' object graph out of GC scanning and raise the gen-0
+    threshold, so collector pauses (10-40ms on the long-lived graph)
+    stop landing in the p99 of a 2ms serving path. A real deployment
+    applies the same tuning to its server processes."""
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(200_000, 50, 50)
 
 
 def main() -> int:
@@ -195,10 +255,15 @@ def main() -> int:
                     help="requests per client per configuration")
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--check", action="store_true",
-                    help="quick tier-1 mode: small N, assert speedup")
+                    help="quick tier-1 mode: small N, assert the serving "
+                    "speedup AND the dispatcher scaling gate (QPS at 8 "
+                    "clients strictly above QPS at 2)")
     ap.add_argument("--min-speedup", type=float, default=None,
                     help="required ON/OFF qps ratio on the point mix "
                     "(default: 3.0, or 2.0 under --check for CI headroom)")
+    ap.add_argument("--sweep", default="1,2,4,8,16,32",
+                    help="comma-separated client counts for the scaling "
+                    "sweep (full mode; '' disables)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     min_speedup = args.min_speedup if args.min_speedup is not None else (
@@ -237,15 +302,111 @@ def main() -> int:
 
         result = {
             "bench": "qps",
-            "round": 1,
+            "round": 2,
             "platform": os.environ.get("JAX_PLATFORMS", "default"),
             "workers": args.workers,
             "point_mix": {"off": off_point, "on": on_point,
                           "speedup": round(speedup, 3),
                           "min_speedup": min_speedup},
         }
-        if not args.check:
-            # full mode adds the mixed workload (cached/uncached classes)
+        problems = []
+        if off_point["failures"] + on_point["failures"]:
+            problems.append(
+                f"failures={off_point['failures'] + on_point['failures']}")
+        if speedup < min_speedup:
+            problems.append(f"speedup {speedup:.2f}x < {min_speedup}x")
+
+        if args.check:
+            # the dispatcher scaling gate (tier-1, CPU-sized): QPS at 8
+            # clients must be STRICTLY above QPS at 2 — a serving plane
+            # that stops scaling with concurrency is a regression, caught
+            # like a kernel regression. On a SINGLE-core box the strict
+            # form is physically unattainable (2 closed-loop clients
+            # already saturate the core, so added concurrency can only
+            # queue), so there the gate asserts saturation HOLD instead:
+            # 8 clients must keep >= 75% of the 2-client throughput — a
+            # thread-pile-up / lost-keep-alive regression collapses this.
+            # Reps interleave and compare best-of to ride out CPU steal.
+            _tune_gc_for_measurement()
+            single_core = (os.cpu_count() or 1) <= 1
+            print("# scaling gate (serving ON, point mix, "
+                  + ("single-core hold >= 0.75x" if single_core
+                     else "strict 8 > 2") + ")", flush=True)
+            q2, q8, fails = [], [], 0
+            for rep in range(2):
+                scale = run_sweep(coord.base_url, (2, 8),
+                                  total_requests=64,
+                                  key_offset=rep * 50_000_000)
+                q2.append(scale[0]["qps"])
+                q8.append(scale[-1]["qps"])
+                fails += scale[0]["failures"] + scale[-1]["failures"]
+            best2, best8 = max(q2), max(q8)
+            gate_ok = (best8 >= 0.75 * best2 if single_core
+                       else best8 > best2)
+            result["scaling_gate"] = {
+                "mode": ("single-core-hold" if single_core else "strict"),
+                "c2_qps": best2, "c8_qps": best8, "ok": bool(gate_ok),
+            }
+            if fails:
+                problems.append("scaling-gate request failures")
+            if not gate_ok:
+                problems.append(
+                    f"no scaling: {best8} qps at 8 clients vs "
+                    f"{best2} qps at 2 clients "
+                    f"({result['scaling_gate']['mode']})")
+        else:
+            # full mode: the concurrency sweep (the r02 headline) + the
+            # mixed workload
+            sweep_counts = tuple(
+                int(c) for c in args.sweep.split(",") if c.strip())
+            if sweep_counts:
+                _tune_gc_for_measurement()
+                print("# concurrency sweep (serving ON, point mix)",
+                      flush=True)
+                sweep = run_sweep(coord.base_url, sweep_counts,
+                                  total_requests=args.requests * 8)
+                by_clients = {e["clients"]: e for e in sweep}
+                result["sweep"] = {"clients": list(sweep_counts),
+                                   "point": sweep}
+                peak = max(e["qps"] for e in sweep)
+                result["sweep"]["peak_qps"] = peak
+                # the ISSUE 12 acceptance record, measured honestly:
+                # rising past 4 clients, the 16-client throughput vs the
+                # r01 4-client ceiling (220 qps), and the p99 ratio
+                c4, c16 = by_clients.get(4), by_clients.get(16)
+                if c4 and c16:
+                    single_core = (os.cpu_count() or 1) <= 1
+                    accept = {
+                        "cpu_count": os.cpu_count(),
+                        "r01_4client_ceiling_qps": 220.0,
+                        "c4_qps": c4["qps"], "c16_qps": c16["qps"],
+                        "rising_past_4_clients": c16["qps"] > c4["qps"],
+                        "holding_past_4_clients":
+                            c16["qps"] >= 0.75 * c4["qps"],
+                        "c16_ge_2x_r01_ceiling": c16["qps"] >= 440.0,
+                        "p99_ratio_c16_over_c4": round(
+                            c16["p99_ms"] / c4["p99_ms"], 3)
+                        if c4["p99_ms"] else None,
+                        "p99_within_2x": bool(
+                            c4["p99_ms"]
+                            and c16["p99_ms"] <= 2.0 * c4["p99_ms"]),
+                    }
+                    result["accept"] = accept
+                    # on a single-core box a saturated closed loop cannot
+                    # RISE past the core's ceiling (throughput ~ 1/service
+                    # time regardless of clients): require hold there,
+                    # strict rise on real multi-core serving hardware
+                    if single_core:
+                        if not accept["holding_past_4_clients"]:
+                            problems.append(
+                                "QPS collapsed past 4 clients "
+                                f"({c4['qps']} -> {c16['qps']})")
+                    elif not accept["rising_past_4_clients"]:
+                        problems.append(
+                            "QPS not rising past 4 clients "
+                            f"({c4['qps']} -> {c16['qps']})")
+                if any(e["failures"] for e in sweep):
+                    problems.append("sweep request failures")
             print("# mixed workload", flush=True)
             off_mix = run_config(coord.base_url, False, args.clients,
                                  args.requests)
@@ -255,21 +416,18 @@ def main() -> int:
                   f"ON: {on_mix['qps']} qps", flush=True)
             result["mixed"] = {"off": off_mix, "on": on_mix}
 
-        failures = off_point["failures"] + on_point["failures"]
-        ok = speedup >= min_speedup and failures == 0
-        result["ok"] = bool(ok)
+        result["ok"] = not problems
         out = args.out or os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "QPS_r01.json")
+            "QPS_r02.json")
         if args.check and args.out is None:
             out = None  # quick mode never clobbers the recorded round
         if out:
             with open(out, "w") as f:
                 json.dump(result, f, indent=2)
             print(f"wrote {out}", flush=True)
-        if not ok:
-            print(f"FAIL: speedup {speedup:.2f}x < {min_speedup}x "
-                  f"or failures={failures}", file=sys.stderr)
+        if problems:
+            print("FAIL: " + "; ".join(problems), file=sys.stderr)
             return 1
         print("OK", flush=True)
         return 0

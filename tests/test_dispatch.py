@@ -1,0 +1,354 @@
+"""Dispatcher/executor split (server/dispatch.py, ISSUE 12).
+
+- typed overload: a full dispatch queue answers 429 + Retry-After with
+  structured retry guidance — never a hang, never a thread pile-up —
+  and clients resubmit transparently (zero lost queries);
+- executor lanes replace per-query thread creation: a stress run with
+  more clients than lanes completes every query with bounded threads;
+- the dispatch-plane serving index answers version-valid repeat queries
+  on the dispatch thread (no lane, no planning), invalidates on DML,
+  and stays partitioned per user;
+- the phase ledger gains the ``dispatch-queue`` attribution and
+  ``system.runtime.serving`` makes the ownership story queryable;
+- the opt-in executor-process plane: sticky routing keeps the second
+  prepared EXECUTE at zero planning work in a DIFFERENT process,
+  owner-catalog statements bounce to the dispatch process, DML
+  invalidation crosses the process split through connector data
+  versions, and ``system.runtime.queries`` shows every query whichever
+  plane ran it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import tests.conftest  # noqa: F401 — cpu mesh config
+from trino_tpu.obs import metrics as M
+
+PROPS = {"catalog": "tpch", "schema": "tiny",
+         "short_query_fast_path": "true"}
+
+
+# ------------------------------------------------------------- queue units
+def test_dispatch_queue_typed_rejection():
+    from trino_tpu.server.dispatch import DispatchQueue, DispatchRejected
+
+    q = DispatchQueue(capacity=2)
+    q.offer("a")
+    q.offer("b")
+    with pytest.raises(DispatchRejected) as ei:
+        q.offer("c")
+    e = ei.value
+    assert e.code == "DISPATCH_QUEUE_FULL"
+    assert e.queued == 2 and e.capacity == 2
+    payload = e.payload()["error"]
+    assert payload["code"] == "DISPATCH_QUEUE_FULL"
+    assert payload["retryAfterSeconds"] > 0
+    assert q.take(0.1) == "a" and q.take(0.1) == "b"
+    assert q.take(0.05) is None  # empty: times out, never blocks forever
+
+
+def test_lane_defaults_bounded():
+    from trino_tpu.server import dispatch
+
+    assert 1 <= dispatch.default_lane_count() <= 64
+    assert dispatch.default_queue_capacity() >= 1
+
+
+# ------------------------------------------------------- overload behavior
+def test_overload_is_typed_and_drains(tmp_path):
+    """Queue full -> DispatchRejected on the Python surface, 429 +
+    Retry-After on HTTP; once lanes start, every queued query completes
+    (zero lost)."""
+    from trino_tpu.server import wire
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.dispatch import DispatchRejected
+
+    coord = CoordinatorServer(executor_lanes=0, dispatch_queue_capacity=2)
+    coord.start()
+    try:
+        rejected0 = M.DISPATCH_REJECTED.value("queue-full")
+        q1 = coord.submit("select 1", PROPS)
+        q2 = coord.submit("select 2", PROPS)
+        with pytest.raises(DispatchRejected):
+            coord.submit("select 3", PROPS)
+        assert M.DISPATCH_REJECTED.value("queue-full") == rejected0 + 1
+        status, body, headers = wire.http_request(
+            "POST", f"{coord.base_url}/v1/statement", b"select 4",
+            "text/plain",
+            headers={f"X-Trino-Session-{k}": v for k, v in PROPS.items()})
+        assert status == 429
+        assert any(k.lower() == "retry-after" for k in headers)
+        assert b"DISPATCH_QUEUE_FULL" in body
+        # the rejected statements never registered
+        assert len(coord.queries) == 2
+        coord.dispatcher.start_lanes(2)
+        assert q1.state.wait_for_terminal(30.0) == "FINISHED"
+        assert q2.state.wait_for_terminal(30.0) == "FINISHED"
+        assert q1.rows == [(1,)] and q2.rows == [(2,)]
+    finally:
+        coord.stop()
+
+
+def test_client_retries_429_to_completion():
+    """StatementClient treats 429 as backpressure: it honors the retry
+    guidance and resubmits until the queue drains — the query is never
+    lost."""
+    from trino_tpu.client.remote import StatementClient
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    coord = CoordinatorServer(executor_lanes=0, dispatch_queue_capacity=1)
+    coord.start()
+    try:
+        blocker = coord.submit("select 0", PROPS)  # fills the queue
+        client = StatementClient(coord.base_url, PROPS)
+        result = {}
+
+        def go():
+            result["rows"] = client.execute("select 41 + 1",
+                                            timeout=60.0)[1]
+
+        t = threading.Thread(target=go)
+        t.start()
+        time.sleep(1.2)  # let the client hit at least one 429
+        coord.dispatcher.start_lanes(2)
+        t.join(timeout=60.0)
+        assert not t.is_alive()
+        assert result["rows"] == [[42]]
+        assert client.submit_retries >= 1
+        assert blocker.state.wait_for_terminal(30.0) == "FINISHED"
+    finally:
+        coord.stop()
+
+
+def test_stress_more_clients_than_lanes():
+    """12 concurrent clients against 2 lanes + a 4-deep queue: every
+    query completes with the right rows (overload turns into retries,
+    not loss) and the process does NOT grow a thread per query."""
+    from trino_tpu.client.remote import StatementClient
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    coord = CoordinatorServer(executor_lanes=2, dispatch_queue_capacity=4)
+    coord.start()
+    threads_before = threading.active_count()
+    results = []
+    errors = []
+
+    def client_loop(ci):
+        c = StatementClient(coord.base_url, PROPS)
+        for r in range(4):
+            try:
+                _, rows = c.execute(f"select {ci} * 100 + {r}",
+                                    timeout=120.0)
+                results.append((ci, r, rows[0][0]))
+            except Exception as e:  # noqa: BLE001 — the assertion below
+                errors.append(f"{ci}.{r}: {e}")
+
+    try:
+        workers = [threading.Thread(target=client_loop, args=(ci,))
+                   for ci in range(12)]
+        for t in workers:
+            t.start()
+        peak = 0
+        while any(t.is_alive() for t in workers):
+            peak = max(peak, threading.active_count())
+            time.sleep(0.02)
+        for t in workers:
+            t.join()
+        assert not errors, errors[:5]
+        assert len(results) == 48  # zero lost queries
+        assert all(v == ci * 100 + r for ci, r, v in results)
+        # bounded threads: 12 clients + their 12 keep-alive handler
+        # threads + 2 lanes + constant server overhead — NOT 48 query
+        # threads + 48 admission threads (the pre-split behavior)
+        assert peak - threads_before < 34, (peak, threads_before)
+    finally:
+        coord.stop()
+
+
+# ------------------------------------------------------- dispatch-plane serve
+@pytest.fixture()
+def solo_coord():
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    coord = CoordinatorServer()
+    coord.start()
+    yield coord
+    coord.stop()
+
+
+def _wait(q, timeout=30.0):
+    state = q.state.wait_for_terminal(timeout)
+    assert state == "FINISHED", (state, q.failure)
+    return q
+
+
+def test_serving_index_serves_and_invalidates(solo_coord):
+    """The dispatch front answers a version-valid repeat without a lane:
+    MISS fills, repeat serves at dispatch (counted + spanned), DML moves
+    the data version so the next repeat re-executes with fresh rows, and
+    the index never crosses users."""
+    coord = solo_coord
+    props = {"catalog": "memory", "schema": "default",
+             "result_cache_enabled": "true"}
+    _wait(coord.submit("create table memory.default.sx (a bigint)", props))
+    _wait(coord.submit("insert into memory.default.sx values (1), (2)",
+                       props))
+    sql = "select count(*) from memory.default.sx"
+    q = _wait(coord.submit(sql, props))
+    assert q.cache_status == "MISS" and q.rows == [(2,)]
+    served0 = M.DISPATCH_CACHE_SERVED.value()
+    q = _wait(coord.submit(sql, props))
+    assert q.cache_status == "HIT" and q.rows == [(2,)]
+    assert M.DISPATCH_CACHE_SERVED.value() == served0 + 1
+    names = {s["name"] for s in q.tracer.to_dicts()}
+    assert "dispatch/serve" in names
+    assert "dispatch/queue" not in names  # never queued, never on a lane
+    # a dispatch-plane hit must not clear the index (it IS a SELECT
+    # completion): the NEXT repeat serves on the dispatch plane too
+    q = _wait(coord.submit(sql, props))
+    assert q.cache_status == "HIT"
+    assert M.DISPATCH_CACHE_SERVED.value() == served0 + 2
+    # another principal must not be served from anonymous' entry
+    q = _wait(coord.submit(sql, props, user="alice"))
+    assert q.cache_status == "MISS"
+    # DML invalidates: version moved, repeat re-executes with fresh rows
+    _wait(coord.submit("insert into memory.default.sx values (3)", props))
+    q = _wait(coord.submit(sql, props))
+    assert q.cache_status == "MISS" and q.rows == [(3,)]
+    q = _wait(coord.submit(sql, props))
+    assert q.cache_status == "HIT" and q.rows == [(3,)]
+
+
+def test_dispatch_queue_phase_and_serving_table(solo_coord):
+    """The ledger attributes queue residency to ``dispatch-queue`` and
+    the ownership table answers over SQL."""
+    coord = solo_coord
+    q = _wait(coord.submit("select 7", PROPS))
+    names = {s["name"] for s in q.tracer.to_dicts()}
+    assert "dispatch/queue" in names
+    tl = q.timeline_dict()
+    assert tl is not None and "dispatch-queue" in tl["phases"]
+    assert tl["phases"]["dispatch-queue"] >= 0.0
+    assert tl["coverage"] >= 0.95
+
+    q = _wait(coord.submit(
+        "select structure, owner, plane from system.runtime.serving",
+        PROPS))
+    structures = {r[0] for r in q.rows}
+    assert {"dispatch_queue", "executor_lanes", "serving_index",
+            "result_cache", "plan_cache", "prepared_statements",
+            "query_registry", "query_history", "device"} <= structures
+    assert all(r[1] == "dispatch-process" and r[2] == "thread"
+               for r in q.rows)
+
+
+# --------------------------------------------------------- process plane
+@pytest.fixture(scope="module")
+def proc_coord(tmp_path_factory):
+    import os
+
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    fs_root = str(tmp_path_factory.mktemp("proclake"))
+    old = os.environ.get("TRINO_TPU_FS_ROOT")
+    os.environ["TRINO_TPU_FS_ROOT"] = fs_root
+    coord = CoordinatorServer(executor_plane="process",
+                              executor_processes=2)
+    coord.start()
+    yield coord
+    coord.stop()
+    if old is None:
+        os.environ.pop("TRINO_TPU_FS_ROOT", None)
+    else:
+        os.environ["TRINO_TPU_FS_ROOT"] = old
+
+
+def test_process_plane_point_query(proc_coord):
+    coord = proc_coord
+    q = _wait(coord.submit(
+        "select o_orderkey, o_totalprice from orders "
+        "where o_orderkey = 7", PROPS), timeout=180.0)
+    assert q.rows == [(7, "181354.35")] or q.rows == [[7, "181354.35"]]
+    assert q.plane.startswith("executor-process:")
+    assert q.fast_path == "fast-path"
+    assert q.extra_spans  # the child's span tree merged across the split
+
+
+def test_process_plane_prepared_zero_planning(proc_coord):
+    """Sticky routing: the second EXECUTE lands on the child that holds
+    the parameterized plan — zero parse/analyze/plan/optimize work in a
+    DIFFERENT process, proven by the child's own spans."""
+    coord = proc_coord
+    _wait(coord.submit(
+        "PREPARE dp FROM select o_orderkey from orders "
+        "where o_orderkey = ?", PROPS), timeout=180.0)
+    _wait(coord.submit("EXECUTE dp USING 7", PROPS), timeout=180.0)
+    q = _wait(coord.submit("EXECUTE dp USING 32", PROPS), timeout=180.0)
+    assert q.rows in ([(32,)], [[32]])
+    assert q.plane.startswith("executor-process:")
+    names = {s["name"] for s in q.extra_spans}
+    assert "plan-cache/hit" in names and "prepare/bind" in names
+    for absent in ("parse", "analyze/plan", "optimize"):
+        assert absent not in names, names
+
+
+def test_process_plane_owner_catalog_bounces(proc_coord):
+    """Memory/system state is owned by the dispatch process: statements
+    touching it run on dispatch-side lanes, and the registry covers
+    every query regardless of plane."""
+    coord = proc_coord
+    _wait(coord.submit("create table memory.default.pb (a bigint)",
+                       PROPS))
+    _wait(coord.submit("insert into memory.default.pb values (5)", PROPS))
+    q = _wait(coord.submit("select count(*) from memory.default.pb",
+                           PROPS))
+    assert q.rows == [(1,)]
+    assert q.plane == "dispatch-lane"
+    # system.runtime.queries (dispatch-owned) shows BOTH planes' queries
+    q = _wait(coord.submit(
+        "select count(*) from system.runtime.queries", PROPS))
+    assert q.rows[0][0] >= 4
+    planes = {e.plane for e in coord.queries.values()}
+    assert any(p.startswith("executor-process") for p in planes)
+    assert "dispatch-lane" in planes
+
+
+def test_process_plane_dml_invalidation_crosses_processes(proc_coord):
+    """Result-cache shards stay correct across the split: the child's
+    cached SELECT invalidates when the dispatch process runs DML,
+    because the filesystem connector's data version (file mtime+size) is
+    shared through the medium itself."""
+    coord = proc_coord
+    props = {**PROPS, "result_cache_enabled": "true"}
+    _wait(coord.submit(
+        "create table filesystem.lake.inv as select 1 as a", props),
+        timeout=180.0)
+    sql = "select count(*) from filesystem.lake.inv"
+    q = _wait(coord.submit(sql, props), timeout=180.0)
+    assert q.rows == [(1,)] and q.plane.startswith("executor-process:")
+    assert q.cache_status == "MISS"
+    q = _wait(coord.submit(sql, props), timeout=180.0)
+    assert q.rows == [(1,)] and q.cache_status == "HIT"  # child shard
+    # DML runs on the dispatch owner; the version moves for everyone
+    _wait(coord.submit("insert into filesystem.lake.inv values (2)",
+                       props), timeout=180.0)
+    q = _wait(coord.submit(sql, props), timeout=180.0)
+    assert q.rows == [(2,)], "stale cross-process cache entry served"
+    assert q.cache_status == "MISS"
+
+
+def test_process_plane_deallocate_replicates(proc_coord):
+    """DEALLOCATE on the authoritative registry replicates to the
+    executor processes: a later EXECUTE fails loudly everywhere."""
+    coord = proc_coord
+    _wait(coord.submit(
+        "PREPARE ddp FROM select o_orderkey from orders "
+        "where o_orderkey = ?", PROPS), timeout=180.0)
+    _wait(coord.submit("EXECUTE ddp USING 7", PROPS), timeout=180.0)
+    _wait(coord.submit("DEALLOCATE PREPARE ddp", PROPS))
+    q = coord.submit("EXECUTE ddp USING 7", PROPS)
+    assert q.state.wait_for_terminal(180.0) == "FAILED"
+    assert "prepared statement not found" in (q.failure or "")

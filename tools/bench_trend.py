@@ -111,11 +111,30 @@ def _extract_bench(path: str) -> List[dict]:
 
 def _extract_qps(path: str) -> List[dict]:
     """QPS_r*.json: qps + latency percentiles per workload mix and
-    serving config, plus the headline speedup."""
+    serving config, the headline speedup, and (r02+) the concurrency
+    sweep — per-clients qps/p50/p99 plus the peak, so TRAJECTORY.json
+    tracks the scaling CURVE, not one saturation point."""
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
     rnd = int(data.get("round", _round_of(path)))
     out: List[dict] = []
+    sweep = data.get("sweep")
+    if isinstance(sweep, dict):
+        for entry in sweep.get("point") or ():
+            c = entry.get("clients")
+            if c is None:
+                continue
+            if entry.get("qps") is not None:
+                out.append(_entry("qps", rnd, f"sweep_point_c{c}_qps",
+                                  entry["qps"], "qps", "up", path))
+            for pct in ("p50_ms", "p99_ms"):
+                if entry.get(pct) is not None:
+                    out.append(_entry("qps", rnd,
+                                      f"sweep_point_c{c}_{pct}",
+                                      entry[pct], "ms", "down", path))
+        if sweep.get("peak_qps") is not None:
+            out.append(_entry("qps", rnd, "sweep_peak_qps",
+                              sweep["peak_qps"], "qps", "up", path))
     for mix in ("point_mix", "mixed"):
         block = data.get(mix)
         if not isinstance(block, dict):

@@ -87,8 +87,21 @@ def plan_fingerprint(
 ) -> str:
     """sha256 over the canonical plan + captured connector data versions
     (+ any extra discriminators, e.g. result-affecting session values)."""
+    return fingerprint_from_canonical(canonicalize_plan(root), versions,
+                                      extra)
+
+
+def fingerprint_from_canonical(
+    canonical: str,
+    versions: Optional[Iterable[Tuple[Tuple[str, str, str], str]]] = None,
+    extra: Sequence[str] = (),
+) -> str:
+    """``plan_fingerprint`` over an already-canonicalized plan string.
+    The prepared-EXECUTE hot path canonicalizes its parameterized plan
+    ONCE (the bindings ride in ``extra``) instead of re-serializing the
+    bound plan on every request."""
     h = hashlib.sha256()
-    h.update(canonicalize_plan(root).encode())
+    h.update(canonical.encode())
     for (catalog, schema, table), version in sorted(versions or ()):
         h.update(f"|{catalog}.{schema}.{table}@{version}".encode())
     for x in extra:

@@ -158,6 +158,24 @@ class ResultCache:
         if flight is not None:
             flight._resolve(value, ok=True)
 
+    def peek(self, key: str):
+        """Read-only lookup: ``(columns, rows)`` for a live entry, else
+        None. Never starts a flight — the dispatch plane's serving index
+        (server/dispatch.py) consults this on the HTTP thread, where
+        leading (and later having to abandon) a flight would be wrong."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            columns, rows, nbytes, expires_at = ent
+            if time.monotonic() >= expires_at:
+                del self._entries[key]
+                self._bytes -= nbytes
+                M.RESULT_CACHE_BYTES.set(self._bytes)
+                return None
+            self._entries.move_to_end(key)
+            return columns, rows
+
     def abandon(self, key: str) -> None:
         """Leader failed: wake waiters empty-handed (they re-execute)."""
         with self._lock:

@@ -17,6 +17,15 @@ class RemoteQueryError(RuntimeError):
     pass
 
 
+class QueueFullError(RemoteQueryError):
+    """The coordinator's dispatch queue rejected the statement (429 +
+    Retry-After) and client-side retries ran out of budget."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class StatementClient:
     """Submit one statement and iterate its results."""
 
@@ -39,6 +48,27 @@ class StatementClient:
         # addedPreparedStatements / deallocatedPreparedStatements payload
         # blocks — the X-Trino-Added-Prepare round-trip analog
         self.prepared_statements: Dict[str, str] = {}
+        # 429 (dispatch queue full) resubmissions of the LAST statement
+        self.submit_retries = 0
+
+    @staticmethod
+    def _retry_after(body: bytes, resp_headers: Dict[str, str]) -> float:
+        """Server retry guidance from a 429: the structured payload
+        field, else the Retry-After header, else one second — clamped to
+        a sane band so a confused server cannot park the client."""
+        import json
+
+        retry_after = None
+        try:
+            retry_after = json.loads(body)["error"]["retryAfterSeconds"]
+        except (ValueError, KeyError, TypeError):
+            for k, v in (resp_headers or {}).items():
+                if k.lower() == "retry-after":
+                    try:
+                        retry_after = float(v)
+                    except ValueError:
+                        pass
+        return min(30.0, max(0.05, float(retry_after or 1.0)))
 
     def execute(self, sql: str, timeout: float = 600.0,
                 on_stats=None) -> Tuple[List[str], List[list]]:
@@ -51,18 +81,33 @@ class StatementClient:
         self.cache_status = None
         self.stats = None
         self.query_id = None
-        status, body, resp_headers = wire.http_request(
-            "POST", f"{self.coordinator_url}/v1/statement",
-            sql.encode(), "text/plain", headers=headers)
+        self.submit_retries = 0
+        import json
+
+        deadline = time.monotonic() + timeout
+        while True:
+            status, body, resp_headers = wire.http_request(
+                "POST", f"{self.coordinator_url}/v1/statement",
+                sql.encode(), "text/plain", headers=headers)
+            if status != 429:
+                break
+            # typed overload (DISPATCH_QUEUE_FULL): honor Retry-After and
+            # resubmit until the client deadline — overload is backpressure,
+            # not failure, and no query is ever silently lost
+            retry_after = self._retry_after(body, resp_headers)
+            if time.monotonic() + retry_after > deadline:
+                raise QueueFullError(
+                    f"submit rejected (queue full) and retry budget "
+                    f"exhausted: {body[:300].decode(errors='replace')}",
+                    retry_after_s=retry_after)
+            self.submit_retries += 1
+            time.sleep(retry_after)
         self._note_cache_header(resp_headers)
         if status >= 400:
             raise RemoteQueryError(f"submit failed: {body[:500].decode(errors='replace')}")
-        import json
-
         payload = json.loads(body)
         columns: List[str] = []
         rows: List[list] = []
-        deadline = time.monotonic() + timeout
         while True:
             self.query_id = payload.get("id", self.query_id)
             if "stats" in payload:

@@ -68,6 +68,23 @@ SYSTEM_TABLES = {
         ("executions", "bigint"),
         ("last_executed_at", "double"),  # epoch seconds; NULL before first
     ),
+    # the serving plane's shared-state ownership table (server/
+    # dispatch.py): one row per shared structure of the dispatch/executor
+    # split — which process owns it, in which plane mode, and how full
+    # it is — so the ownership story is introspectable over SQL
+    ("runtime", "serving"): (
+        ("structure", "varchar"),      # dispatch_queue | executor_lanes
+                                       # | serving_index | result_cache |
+                                       # plan_cache | prepared_statements
+                                       # | query_registry | query_history
+                                       # | device
+        ("owner", "varchar"),          # dispatch-process |
+                                       # executor-process (sticky shard)
+        ("plane", "varchar"),          # thread | process
+        ("entries", "bigint"),         # occupancy (NULL where not sized)
+        ("bytes", "bigint"),           # byte footprint (NULL unknown)
+        ("detail", "varchar"),         # capacity / ownership note
+    ),
     # per-slot task records of live queries (worker-reported stats rollup)
     ("runtime", "tasks"): (
         ("query_id", "varchar"),

@@ -75,13 +75,16 @@ class FlightRecorder:
             return len(self._ring)
 
 
-def pull_worker_rings(locations, timeout: float = 3.0) -> List[dict]:
+def pull_worker_rings(locations, timeout: float = 3.0,
+                      pool=None) -> List[dict]:
     """Fetch the flight-recorder ring of every distinct worker involved
     in a query. ``locations`` are exchange-client ``TaskLocation``s (one
     representative task id per worker base url is enough — the endpoint
     returns the PROCESS ring). A gone worker contributes an error stub
-    instead of sinking the postmortem; fetches run in parallel with a
-    short timeout so a blackholed cluster still answers promptly."""
+    instead of sinking the postmortem; fetches run in parallel on the
+    server's shared IO ``pool`` when given (serially otherwise — callers
+    on the hot path always pass the pool; the per-call executor this
+    replaced churned a fresh thread pool per capture)."""
     import json
 
     from trino_tpu.server import wire
@@ -106,11 +109,13 @@ def pull_worker_rings(locations, timeout: float = 3.0) -> List[dict]:
         except Exception as e:  # noqa: BLE001 — a dead worker IS the story
             return {"url": url, "error": str(e)[:300]}
 
-    from concurrent.futures import ThreadPoolExecutor
-
     items = sorted(by_url.items())
-    with ThreadPoolExecutor(max_workers=min(8, len(items))) as tp:
-        return list(tp.map(fetch, items))
+    if pool is not None:
+        try:
+            return list(pool.map(fetch, items))
+        except RuntimeError:  # pool already shut down: fall through
+            pass
+    return [fetch(item) for item in items]
 
 
 def trim_postmortem(postmortem: Optional[dict],

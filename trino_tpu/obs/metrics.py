@@ -447,6 +447,44 @@ EXECUTE_BIND_SECONDS = REGISTRY.histogram(
     "EXECUTE parameter bind time: constant-folding the USING expressions "
     "+ substituting them into the cached parameterized plan")
 
+# dispatch plane / executor plane split (server/dispatch.py): the bounded
+# dispatch queue between the HTTP front and the executor lanes, typed
+# overload rejections, lane occupancy, and which plane ran each query
+DISPATCH_QUEUE_DEPTH = REGISTRY.gauge(
+    "trino_tpu_dispatch_queue_depth",
+    "queries waiting in the bounded dispatch queue (between the HTTP "
+    "front and the executor lanes)")
+DISPATCH_REJECTED = REGISTRY.counter(
+    "trino_tpu_dispatch_rejected_total",
+    "statements rejected by the dispatch plane with the typed 429 + "
+    "Retry-After overload response (reason = queue-full)", ("reason",))
+DISPATCH_CACHE_SERVED = REGISTRY.counter(
+    "trino_tpu_dispatch_cache_served_total",
+    "queries answered entirely on the dispatch plane by the serving "
+    "index (result-cache hit revalidated against connector data "
+    "versions — no executor lane, no queue slot, no planning)")
+EXECUTOR_LANES_BUSY = REGISTRY.gauge(
+    "trino_tpu_executor_lanes_busy",
+    "executor lanes currently running a query (the fixed lane pool "
+    "replaced per-query thread creation)")
+EXECUTOR_PLANE_QUERIES = REGISTRY.counter(
+    "trino_tpu_executor_plane_queries_total",
+    "dequeued queries by executing plane (inline = a dispatch-side "
+    "executor lane; process = forwarded to an executor process; "
+    "bounced = an executor process declined ownership and the query "
+    "re-ran inline)", ("plane",))
+
+# HTTP keep-alive connection pool (server/wire.py): control-plane and
+# client calls reuse pooled connections instead of a fresh TCP connect
+# per request
+HTTP_CONNECTIONS_OPENED = REGISTRY.counter(
+    "trino_tpu_http_connections_opened_total",
+    "fresh TCP connections opened by the keep-alive HTTP client pool")
+HTTP_CONNECTION_REUSES = REGISTRY.counter(
+    "trino_tpu_http_connection_reuses_total",
+    "HTTP requests served over a pooled keep-alive connection (no TCP "
+    "connect paid)")
+
 # plan-IR sanity checking (sql/planner/sanity.py): invariant violations
 # caught at plan time, labeled by the phase family that produced the bad
 # plan (initial-plan | optimizer | fragmentation | adaptive). During
@@ -470,10 +508,10 @@ QUERY_SECONDS = REGISTRY.histogram(
 QUERY_PHASE_SECONDS = REGISTRY.histogram(
     "trino_tpu_query_phase_seconds",
     "exclusive query wall seconds attributed to each phase by the "
-    "completion-time phase ledger (queued | dispatch | parse-analyze | "
-    "plan-optimize | prepare-bind | schedule | device-staging | "
-    "device-execute | exchange-wait | result-serialization | "
-    "client-drain | unattributed)", ("phase",))
+    "completion-time phase ledger (queued | dispatch-queue | dispatch | "
+    "parse-analyze | plan-optimize | prepare-bind | schedule | "
+    "device-staging | device-execute | exchange-wait | "
+    "result-serialization | client-drain | unattributed)", ("phase",))
 
 # tracing self-protection (obs/trace.py): per-tracer span cap — a
 # pathological query stops RECORDING at the cap instead of growing

@@ -24,7 +24,13 @@ against ends at ``ended_at``.
 
 Phases (the label set of ``trino_tpu_query_phase_seconds``)::
 
-    queued                submit -> the query thread starts (admission)
+    queued                submit -> the query starts (admission wait
+                          outside the dispatch queue: resource group +
+                          cluster-memory gate)
+    dispatch-queue        residency in the bounded dispatch queue
+                          between the HTTP front and the executor lanes
+                          (server/dispatch.py) — the queueing-time
+                          attribution of the dispatcher/executor split
     dispatch              coordinator control-plane connective work:
                           session setup, statement probe, cache consult,
                           routing, state transitions (the root span's
@@ -48,9 +54,10 @@ from typing import Dict, List, Optional, Tuple
 # ledger phases in display order; client-drain and unattributed are
 # synthesized, everything else is swept from spans
 PHASES: Tuple[str, ...] = (
-    "queued", "dispatch", "parse-analyze", "plan-optimize", "prepare-bind",
-    "schedule", "device-staging", "device-execute", "exchange-wait",
-    "result-serialization", "client-drain", "unattributed")
+    "queued", "dispatch-queue", "dispatch", "parse-analyze",
+    "plan-optimize", "prepare-bind", "schedule", "device-staging",
+    "device-execute", "exchange-wait", "result-serialization",
+    "client-drain", "unattributed")
 
 # span name -> (sweep priority, phase). Lower priority wins where spans
 # overlap: leaf work (staging/execute/exchange) beats the coordinator's
@@ -67,7 +74,8 @@ _P_DISPATCH = 7
 _P_SCHEDULE = 8
 _P_EXECUTE = 9       # execute-window remainder -> device-execute
 _P_ROOT = 10         # root query span remainder -> dispatch
-_P_SYNTH = 11        # synthesized queued segment
+_P_QUEUE = 11        # dispatch-queue residency (before the root opens)
+_P_SYNTH = 12        # synthesized queued segment
 
 SPAN_PHASE: Dict[str, Tuple[int, str]] = {
     "parse": (_P_PARSE, "parse-analyze"),
@@ -78,6 +86,14 @@ SPAN_PHASE: Dict[str, Tuple[int, str]] = {
     "plan/adapt": (_P_PLAN, "plan-optimize"),
     "cache/lookup": (_P_DISPATCH, "dispatch"),
     "stats/sweep": (_P_DISPATCH, "dispatch"),
+    # the dispatcher/executor split (server/dispatch.py): queue
+    # residency is its own phase; the serve/forward control work joins
+    # the dispatch remainder
+    "dispatch/queue": (_P_QUEUE, "dispatch-queue"),
+    "dispatch/serve": (_P_DISPATCH, "dispatch"),
+    # the forward window ENCLOSES the executor process's merged spans:
+    # like the root span, only its exclusive remainder is dispatch
+    "dispatch/forward": (_P_ROOT, "dispatch"),
     "prepare/bind": (_P_BIND, "prepare-bind"),
     "schedule": (_P_SCHEDULE, "schedule"),
     "device/staging": (_P_STAGING, "device-staging"),

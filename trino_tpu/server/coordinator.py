@@ -186,9 +186,30 @@ class QueryExecution:
         # when the client last fetched a FINISHED result page — feeds the
         # ledger's client-drain phase (outside the query wall)
         self.last_drain_at: Optional[float] = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        # dispatch/executor split (server/dispatch.py): which plane ran
+        # this query ("dispatch-lane" inline, "executor-process:N" when
+        # forwarded), the queue-residency span the lane closes on
+        # dequeue, and spans pulled from an executor process's trace
+        # (merged into the ledger and the trace endpoint)
+        self.plane: str = "dispatch-lane"
+        self._dispatch_queue_span = None
+        self.extra_spans: List[dict] = []
+        # set by the server at submit: the shared IO thread pool for
+        # parallel worker pulls (span dumps, flight-recorder rings) and
+        # the dispatcher completion hook
+        self.io_pool = None
+        self.dispatcher = None
+        # serving-index learning (dispatch.ServingIndex): whether the
+        # statement was a plain SELECT shape, and the result-cache key +
+        # captured data versions of a led flight
+        self.is_plain_select = False
+        self.result_cache_key: Optional[str] = None
+        self.result_cache_versions = None
 
     def start(self) -> None:
+        """Run the lifecycle on a fresh thread (legacy surface — the
+        server's executor lanes call ``run()`` inline instead)."""
+        self._thread = threading.Thread(target=self.run, daemon=True)
         self._thread.start()
 
     def cancel(self) -> None:
@@ -206,9 +227,15 @@ class QueryExecution:
             self._cancel_tasks()
 
     # ------------------------------------------------------------ lifecycle
-    def _run(self) -> None:
+    def run(self) -> None:
         root_span = self.tracer.start_span(
             "query", query_id=self.query_id, user=self.user)
+        # the dispatch-queue span opened before this root existed (the
+        # HTTP thread enqueued, a lane dequeued): adopt it so the trace
+        # tree stays single-rooted under the query span
+        qs = getattr(self, "_dispatch_queue_span", None)
+        if qs is not None:
+            qs.parent_id = root_span.span_id
         try:
             with tracing.activate(self.tracer, root_span.span_id):
                 self._run_lifecycle()
@@ -312,6 +339,7 @@ class QueryExecution:
             elif isinstance(stmt, ast.ResetSession):
                 self.reset_session.append(stmt.name)
             return
+        self.is_plain_select = True
         root, versions = self._plan_query(session, stmt)
         key = self._consult_result_cache(session, stmt, root, versions)
         self._finish_with_result_cache(session, root, key)
@@ -361,6 +389,7 @@ class QueryExecution:
             reg.put(self.user, stmt.name, inner, sql_text)
             self.add_prepared[stmt.name] = sql_text
             self.columns, self.rows = ["result"], [("PREPARE",)]
+            self._replicate_registry_change()
             return
         if isinstance(stmt, ast.Deallocate):
             self.cache_status = "BYPASS"
@@ -370,8 +399,17 @@ class QueryExecution:
                     f"prepared statement not found: {stmt.name}")
             self.deallocated_prepared.append(stmt.name)
             self.columns, self.rows = ["result"], [("DEALLOCATE",)]
+            self._replicate_registry_change()
             return
         self._run_execute_prepared(session, stmt)
+
+    def _replicate_registry_change(self) -> None:
+        """Process plane only: replay this PREPARE/DEALLOCATE on every
+        executor process so their replica registries track the dispatch
+        process's authoritative one (the owner of the structure)."""
+        pp = getattr(self.dispatcher, "process_plane", None)
+        if pp is not None:
+            pp.broadcast(self.sql, self.user, self.session_properties)
 
     def _run_execute_prepared(self, session, stmt) -> None:
         """EXECUTE name [USING ...]: constant-fold the bindings, reuse (or
@@ -416,6 +454,7 @@ class QueryExecution:
                 result = dispatch_statement(session, bound)
             self.columns, self.rows = result.column_names, result.rows
             return
+        self.is_plain_select = True
         ptypes = tuple(c.type for c in values)
         # planning (plan-cache miss only) stays OUTSIDE the bind timer and
         # span: trino_tpu_execute_bind_seconds measures exactly the
@@ -427,8 +466,25 @@ class QueryExecution:
             bound_root = prep.bind_plan_parameters(root, values)
         M.EXECUTE_BIND_SECONDS.observe(
             fold_s + (time.perf_counter() - t1))
+        # per-binding consult metadata, computed ONCE per parameterized
+        # plan OBJECT (a replanned/evicted plan is a new object, so this
+        # can never serve a stale canonical): the determinism verdict and
+        # the canonical plan string are binding-independent — only the
+        # bound values (in `extra`) and data versions vary per request
+        meta = getattr(root, "_consult_meta", None)
+        if meta is None:
+            from trino_tpu.cache.determinism import uncachable_reason
+            from trino_tpu.cache.plan_key import canonicalize_plan
+
+            reason = uncachable_reason(inner, root)
+            meta = (reason,
+                    canonicalize_plan(root) if reason is None else None)
+            root._consult_meta = meta
+        binding = "params=" + repr(
+            [(str(c.type), repr(c.value)) for c in values])
         key = self._consult_result_cache(session, inner, bound_root,
-                                         versions)
+                                         versions, prepared_meta=meta,
+                                         binding=binding)
         self._finish_with_result_cache(session, bound_root, key)
 
     def _plan_prepared(self, session, ps, ptypes):
@@ -503,13 +559,19 @@ class QueryExecution:
         return self._through_plan_cache(
             session, stmt, self.sql, lambda: plan_sql(session, self.sql))
 
-    def _consult_result_cache(self, session, stmt, root, versions=None):
+    def _consult_result_cache(self, session, stmt, root, versions=None,
+                              prepared_meta=None, binding=None):
         """One admission pass against the server result cache. Returns
         ``_SERVED_FROM_CACHE`` (columns/rows already populated), a cache
         key string (this query leads the flight and must complete/abandon
-        it), or None (bypass / follower fallback: execute, don't store)."""
+        it), or None (bypass / follower fallback: execute, don't store).
+        ``prepared_meta`` = (reason, canonical-of-parameterized-plan) from
+        the EXECUTE hot path — skips the per-request determinism walk and
+        plan re-serialization; ``binding`` discriminates the key per bound
+        values."""
         from trino_tpu.cache.determinism import uncachable_reason
-        from trino_tpu.cache.plan_key import capture_versions, plan_fingerprint
+        from trino_tpu.cache.plan_key import (
+            capture_versions, fingerprint_from_canonical, plan_fingerprint)
         from trino_tpu.obs import metrics as M
 
         cache = self.query_cache
@@ -517,7 +579,11 @@ class QueryExecution:
                 session.properties.get("result_cache_enabled", False)):
             self.cache_status = "BYPASS"
             return None
-        reason = uncachable_reason(stmt, root)
+        canonical = None
+        if prepared_meta is not None:
+            reason, canonical = prepared_meta
+        else:
+            reason = uncachable_reason(stmt, root)
         if reason is None:
             # captured at plan time (threaded through from _plan_query
             # when it already did the capture): a later mutation bumps the
@@ -538,9 +604,17 @@ class QueryExecution:
             # re-fire per principal, never be laundered through a cache hit
             from trino_tpu.cache.result_cache import session_user
 
-            key = plan_fingerprint(
-                root, versions, extra=(f"user={session_user(session)}",))
+            extra = (f"user={session_user(session)}",) + (
+                (binding,) if binding else ())
+            key = (fingerprint_from_canonical(canonical, versions, extra)
+                   if canonical is not None
+                   else plan_fingerprint(root, versions, extra=extra))
             sp.set("key", key[:16])
+            # serving-index learning (server/dispatch.py): on FINISHED
+            # MISS, the dispatcher maps (user, SQL) -> this key so a
+            # repeat serves on the dispatch plane without planning
+            self.result_cache_key = key
+            self.result_cache_versions = versions
             kind, payload = cache.results.begin(key)
             if kind == "wait":
                 # single-flight: a concurrent identical query is already
@@ -865,12 +939,20 @@ class QueryExecution:
                 pass
             return ()
 
-        from concurrent.futures import ThreadPoolExecutor
-
         spans: List[dict] = []
-        with ThreadPoolExecutor(max_workers=min(8, len(locations))) as tp:
-            for dump in tp.map(fetch, locations):
-                spans.extend(dump)
+        pool = self.io_pool
+        if pool is not None:
+            try:
+                for dump in pool.map(fetch, locations):
+                    spans.extend(dump)
+                return spans
+            except RuntimeError:  # pool shut down mid-stop: inline below
+                pass
+        # no shared pool (bare QueryExecution use): fetch serially — the
+        # per-call ThreadPoolExecutor churn this replaced cost more than
+        # the fan-in it bought on the hot path
+        for loc in locations:
+            spans.extend(fetch(loc))
         return spans
 
     # pre-publication pulls (ledger warm + postmortem capture) run on the
@@ -888,8 +970,9 @@ class QueryExecution:
         try:
             from trino_tpu.obs.timeline import compute_timeline
 
-            spans = self.tracer.to_dicts() + self.worker_spans(
-                timeout=self.COMPLETION_PULL_TIMEOUT)
+            spans = (self.tracer.to_dicts() + list(self.extra_spans)
+                     + self.worker_spans(
+                         timeout=self.COMPLETION_PULL_TIMEOUT))
             self._timeline = compute_timeline(
                 spans, self.created_at, self.ended_at)
         except Exception:  # noqa: BLE001 — the ledger is observability,
@@ -918,7 +1001,8 @@ class QueryExecution:
         header renders mid-query, before the wall closes)."""
         from trino_tpu.obs.timeline import compute_timeline
 
-        spans = self.tracer.to_dicts() + self.worker_spans()
+        spans = (self.tracer.to_dicts() + list(self.extra_spans)
+                 + self.worker_spans())
         return compute_timeline(spans, self.created_at,
                                 time.time()).to_dict()
 
@@ -950,7 +1034,8 @@ class QueryExecution:
                 "records": (self.recorder.snapshot()
                             if self.recorder is not None else []),
             },
-            "workers": pull_worker_rings(locations, timeout=timeout),
+            "workers": pull_worker_rings(locations, timeout=timeout,
+                                         pool=self.io_pool),
         }
         if store:
             self.postmortem = pm
@@ -1553,7 +1638,9 @@ class CoordinatorServer:
 
     def __init__(self, port: int = 0, session_factory=None, resource_group=None,
                  cluster_memory_limit_bytes=None, low_memory_killer=None,
-                 authenticator=None):
+                 authenticator=None, executor_lanes=None,
+                 dispatch_queue_capacity=None, executor_plane=None,
+                 executor_processes=None):
         from trino_tpu.server.resource_groups import ResourceGroup
         from trino_tpu.connector.registry import default_catalogs
         from trino_tpu.server.cluster_memory import (
@@ -1648,18 +1735,54 @@ class CoordinatorServer:
         from trino_tpu.obs import otlp as _otlp
 
         self.otlp = _otlp.exporter_from_env("trino-tpu-coordinator")
+        # dispatch plane / executor plane split (server/dispatch.py): the
+        # bounded dispatch queue, the fixed pool of executor lanes that
+        # replaced per-query thread creation, the dispatch-plane serving
+        # index, and (opt-in) the executor-process pool
+        from trino_tpu.server.dispatch import Dispatcher
+
+        self.dispatcher = Dispatcher(
+            self, lanes=executor_lanes,
+            queue_capacity=dispatch_queue_capacity, plane=executor_plane,
+            processes=executor_processes)
+        # shared IO pool for parallel worker pulls (span dumps, flight-
+        # recorder rings): lazily created, shut down with the server —
+        # replaces the fresh ThreadPoolExecutor these calls built per
+        # invocation on the hot path
+        self._io_pool = None
+        self._io_pool_lock = threading.Lock()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self.base_url = f"http://127.0.0.1:{self.port}"
         self._serve_thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
+    @property
+    def io_pool(self):
+        """The server-wide IO thread pool (created on first use)."""
+        pool = self._io_pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._io_pool_lock:
+                if self._io_pool is None:
+                    self._io_pool = ThreadPoolExecutor(
+                        max_workers=16, thread_name_prefix="coord-io")
+                pool = self._io_pool
+        return pool
+
     def start(self) -> None:
         self._serve_thread.start()
+        self.dispatcher.ensure_lanes()
 
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.dispatcher.shutdown()
+        with self._io_pool_lock:
+            pool, self._io_pool = self._io_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         if self.otlp is not None:
             # flush + stop the exporter thread: a stopped instance must
             # not keep reporting metrics under its service identity
@@ -1671,6 +1794,10 @@ class CoordinatorServer:
 
     def submit(self, sql: str, properties: Optional[dict] = None,
                user: str = "anonymous") -> QueryExecution:
+        # typed overload turn-around BEFORE any per-query state is built:
+        # a full dispatch queue raises DispatchRejected (the protocol
+        # surface answers 429 + Retry-After), never a hang or a thread
+        self.dispatcher.precheck()
         query_id = f"q{time.strftime('%Y%m%d')}_{next(self._qid):05d}_{uuid.uuid4().hex[:5]}"
         execution = QueryExecution(
             query_id, sql, properties or {}, self.registry, self.session_factory,
@@ -1680,12 +1807,20 @@ class CoordinatorServer:
         # ring, and the execution can snapshot it for its postmortem
         execution.recorder = self.recorder
         execution.tracer.recorder = self.recorder
+        execution.io_pool = self.io_pool
+        execution.dispatcher = self.dispatcher
         self.recorder.record("admission", "submitted", queryId=query_id,
                              user=user)
         with self._qlock:
-            terminal = [qid for qid, q in self.queries.items() if q.state.is_terminal()]
-            for qid in terminal[: max(0, len(terminal) - self.MAX_QUERY_HISTORY)]:
-                del self.queries[qid]
+            if len(self.queries) > self.MAX_QUERY_HISTORY:
+                # scan for prunable terminals only once the registry can
+                # actually be over budget — the per-submit full scan this
+                # replaces was measurable on the serving hot path
+                terminal = [qid for qid, q in self.queries.items()
+                            if q.state.is_terminal()]
+                for qid in terminal[: max(0, len(terminal)
+                                          - self.MAX_QUERY_HISTORY)]:
+                    del self.queries[qid]
             self.queries[query_id] = execution
             self.queries_submitted += 1
         from trino_tpu.server import events as ev
@@ -1696,6 +1831,13 @@ class CoordinatorServer:
         def fire_terminal(state):
             if state not in ("FINISHED", "FAILED", "CANCELED"):
                 return
+            try:
+                # serving-index maintenance (server/dispatch.py): learn
+                # MISS-then-filled SELECTs, clear on non-SELECT statements
+                self.dispatcher.note_completion(
+                    execution, execution.is_plain_select)
+            except Exception:  # noqa: BLE001 — index upkeep must never
+                pass  # disturb the terminal transition
             now = time.time()
             wall = now - created_at
             from trino_tpu.obs import metrics as M
@@ -1766,45 +1908,71 @@ class CoordinatorServer:
                 pass  # never a reason to disturb the terminal transition
 
         execution.state.add_listener(fire_terminal)
-        # admission is ASYNC: the submit POST returns a QUEUED payload
-        # immediately and the client polls nextUri; the query starts when
-        # its group grants a slot (reference: QueuedStatementResource's
-        # queued/executing split + ResourceGroupManager.submit)
-        def admit_and_start():
-            if not self.resource_group.submit(timeout=600.0, user=user):
-                execution.failure = "Query queue is full (resource group limit)"
-                self.recorder.record("admission", "queue-full",
-                                     queryId=query_id, user=user)
-                execution.state.set("FAILED")
-                return
-            self.recorder.record("admission", "admitted", queryId=query_id,
-                                 user=user)
-            # cluster-memory admission: dispatch blocks while the cluster
-            # pool is over its limit (reference: ClusterMemoryManager's
-            # query.max-memory gate) — the killer frees it if needed; a
-            # cluster that stays saturated past the deadline FAILS the
-            # query loudly (never silently dispatches over the limit)
-            deadline = time.monotonic() + 600.0
-            while (not self.cluster_memory.has_headroom()
-                   and not execution.state.is_terminal()
-                   and time.monotonic() < deadline):
-                time.sleep(0.2)
-            if (not execution.state.is_terminal()
-                    and not self.cluster_memory.has_headroom()):
-                execution.failure = (
-                    "Cluster is out of memory and did not recover within the "
-                    "admission deadline (EXCEEDED_CLUSTER_MEMORY)")
-                execution.state.set("FAILED")
-            if execution.state.is_terminal():  # canceled/killed while queued
-                self.resource_group.finish(user=user)
-                return
-            execution.state.add_listener(
-                lambda s: self.resource_group.finish(user=user)
-                if s in ("FINISHED", "FAILED", "CANCELED") else None)
-            execution.start()
+        # dispatch is ASYNC: the submit POST returns a QUEUED payload
+        # and the client polls nextUri; the dispatcher either answers the
+        # query on the dispatch plane (serving index), enqueues it for an
+        # executor lane, or rejects it typed when the queue is full
+        # (reference: QueuedStatementResource's queued/executing split)
+        from trino_tpu.server.dispatch import DispatchRejected
 
-        threading.Thread(target=admit_and_start, daemon=True).start()
+        try:
+            self.dispatcher.dispatch(execution)
+        except DispatchRejected as e:
+            # lost the capacity race after registration: unregister and
+            # surface the same typed rejection the precheck gives. The
+            # rejected statement executed NOTHING — it must not count as
+            # a non-SELECT completion and wipe the serving index right
+            # when overload needs it most
+            execution.is_plain_select = True
+            with self._qlock:
+                self.queries.pop(query_id, None)
+            execution.failure = str(e)
+            execution.ended_at = time.time()
+            execution.state.set("FAILED")
+            self.recorder.record("admission", "dispatch-rejected",
+                                 queryId=query_id, user=user)
+            raise
         return execution
+
+    def _admit(self, execution: QueryExecution) -> bool:
+        """Admission, run on an executor lane after dequeue: the resource
+        group (per-user fairness) then the cluster-memory gate. Returns
+        False when the query failed admission or went terminal (canceled)
+        while queued — the lane moves on."""
+        user = execution.user
+        if execution.state.is_terminal():  # canceled while queued
+            return False
+        if not self.resource_group.submit(timeout=600.0, user=user):
+            execution.failure = "Query queue is full (resource group limit)"
+            self.recorder.record("admission", "queue-full",
+                                 queryId=execution.query_id, user=user)
+            execution.state.set("FAILED")
+            return False
+        self.recorder.record("admission", "admitted",
+                             queryId=execution.query_id, user=user)
+        # cluster-memory admission: dispatch blocks while the cluster
+        # pool is over its limit (reference: ClusterMemoryManager's
+        # query.max-memory gate) — the killer frees it if needed; a
+        # cluster that stays saturated past the deadline FAILS the
+        # query loudly (never silently dispatches over the limit)
+        deadline = time.monotonic() + 600.0
+        while (not self.cluster_memory.has_headroom()
+               and not execution.state.is_terminal()
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        if (not execution.state.is_terminal()
+                and not self.cluster_memory.has_headroom()):
+            execution.failure = (
+                "Cluster is out of memory and did not recover within the "
+                "admission deadline (EXCEEDED_CLUSTER_MEMORY)")
+            execution.state.set("FAILED")
+        if execution.state.is_terminal():  # canceled/killed while queued
+            self.resource_group.finish(user=user)
+            return False
+        execution.state.add_listener(
+            lambda s: self.resource_group.finish(user=user)
+            if s in ("FINISHED", "FAILED", "CANCELED") else None)
+        return True
 
     def get_query(self, query_id: str) -> Optional[QueryExecution]:
         with self._qlock:
@@ -1837,7 +2005,8 @@ class CoordinatorServer:
         q = self.get_query(query_id)
         if q is None:
             return None
-        spans = q.tracer.to_dicts() + q.worker_spans()
+        spans = (q.tracer.to_dicts() + list(q.extra_spans)
+                 + q.worker_spans())
         from trino_tpu.obs.trace import build_tree
 
         trace = {
@@ -2008,6 +2177,12 @@ queries</a> · <code>select * from system.runtime.queries</code>)</small></h2>
 def _make_handler(server: CoordinatorServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # close keep-alive connections idle past this (the client pool's
+        # idle TTL is shorter, so the client normally closes first)
+        timeout = 30
+        # TCP_NODELAY: headers and body flush as separate writes — with
+        # Nagle on, the second write stalls behind the delayed ACK
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):
             pass
@@ -2064,7 +2239,23 @@ def _make_handler(server: CoordinatorServer):
                     # the authenticated principal wins over the client's
                     # claimed user header (no impersonation by default)
                     user = identity.user
-                q = server.submit(sql, props, user=user)
+                from trino_tpu.server.dispatch import DispatchRejected
+
+                try:
+                    q = server.submit(sql, props, user=user)
+                except DispatchRejected as e:
+                    # typed overload: 429 + Retry-After with structured
+                    # retry guidance — the client backs off and retries
+                    # instead of piling a thread onto a saturated server
+                    self._send(429, json.dumps(e.payload()).encode(),
+                               headers={"Retry-After":
+                                        f"{e.retry_after_s:g}"})
+                    return
+                # brief long-poll: short queries finish inside this
+                # window, collapsing the protocol to ONE round trip
+                # (submit response already carries the result page)
+                if not q.state.is_terminal():
+                    q.state.wait_for_terminal(0.5)
                 self._send(200, json.dumps(_result_payload(server, q, 0)).encode(),
                            headers=_cache_header(q))
                 return

@@ -147,6 +147,9 @@ def refreshed_server_gauges(server):
         history = getattr(server, "history", None)
         if history is not None:
             M.QUERY_HISTORY_SIZE.set(len(history))
+        dispatcher = getattr(server, "dispatcher", None)
+        if dispatcher is not None:
+            dispatcher.refresh_gauges()
         try:
             yield
         finally:

@@ -46,7 +46,10 @@ def query_record(execution, state: Optional[str] = None,
     queued_ms = planning_ms = execution_ms = unattributed_ms = None
     if tl is not None:
         ph = tl["phases"]
-        queued_ms = ph.get("queued", 0.0) * 1000.0
+        # the dispatch-queue residency is queue time too (the bounded
+        # queue of the dispatcher/executor split sits inside admission)
+        queued_ms = (ph.get("queued", 0.0)
+                     + ph.get("dispatch-queue", 0.0)) * 1000.0
         planning_ms = sum(ph.get(p, 0.0) for p in (
             "dispatch", "parse-analyze", "plan-optimize",
             "prepare-bind")) * 1000.0
@@ -164,6 +167,8 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             return self._nodes_rows()
         if (schema, table) == ("runtime", "prepared_statements"):
             return self._prepared_rows()
+        if (schema, table) == ("runtime", "serving"):
+            return self._server.dispatcher.serving_rows()
         if (schema, table) == ("runtime", "device_cache"):
             from trino_tpu.connector.system.connector import device_cache_rows
 

@@ -10,12 +10,15 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import http.client
 import json
 import os
 import struct
+import threading
 import urllib.error
 import urllib.request
 from typing import List, Optional, Tuple
+from urllib.parse import urlsplit
 
 MEDIA_PAGES = "application/x-trino-tpu-pages"
 
@@ -68,6 +71,92 @@ def unframe_pages(body: bytes) -> List[bytes]:
     return pages
 
 
+# ------------------------------------------------------------ keep-alive
+# Connection pool for the control plane and clients: one TCP connect per
+# (host, port) instead of per REQUEST (the reference's jetty/OkHttp
+# clients pool connections; urllib opened a fresh socket every call —
+# three connects per served query on the statement protocol alone).
+# Idle connections age out (the server side closes idles on its own
+# timeout, so the client TTL stays shorter to avoid request-on-closing
+# races) and stale sockets retry once on a fresh connection.
+_IDLE_MAX_PER_HOST = 8
+_IDLE_TTL_S = 20.0
+
+
+class _ConnectionPool:
+    def __init__(self):
+        self._idle = {}  # (host, port) -> [(conn, idle_since), ...]
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        """A pooled connection that has not idled out, or None."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            stack = self._idle.get(key)
+            while stack:
+                conn, since = stack.pop()
+                if now - since <= _IDLE_TTL_S:
+                    return conn
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        return None
+
+    def put(self, key, conn) -> None:
+        import time as _time
+
+        with self._lock:
+            stack = self._idle.setdefault(key, [])
+            if len(stack) < _IDLE_MAX_PER_HOST:
+                stack.append((conn, _time.monotonic()))
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def clear(self) -> None:
+        with self._lock:
+            stacks, self._idle = list(self._idle.values()), {}
+        for stack in stacks:
+            for conn, _since in stack:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+
+class _KeepAliveConnection(http.client.HTTPConnection):
+    """HTTPConnection with TCP_NODELAY: on a REUSED connection Nagle
+    batches the request bytes behind the previous response's delayed ACK
+    (a ~40ms stall per request on loopback) — pooling without this is
+    slower than fresh connects."""
+
+    def connect(self):
+        import socket
+
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
+_POOL = _ConnectionPool()
+
+# errors that mean "the pooled socket went stale" (server closed it
+# between requests) — safe to retry ONCE on a fresh connection; anything
+# else (including timeouts) propagates
+_STALE_ERRORS = (http.client.BadStatusLine, http.client.CannotSendRequest,
+                 http.client.ResponseNotReady, ConnectionResetError,
+                 ConnectionAbortedError, BrokenPipeError)
+
+
+def reset_connection_pool() -> None:
+    """Drop every pooled connection (tests / fork hygiene)."""
+    _POOL.clear()
+
+
 def http_request(
     method: str,
     url: str,
@@ -76,7 +165,71 @@ def http_request(
     timeout: float = 30.0,
     headers: Optional[dict] = None,
 ) -> Tuple[int, bytes, dict]:
-    """Minimal signed HTTP call. Returns (status, body, headers)."""
+    """Minimal signed HTTP call over a pooled keep-alive connection.
+    Returns (status, body, headers)."""
+    parts = urlsplit(url)
+    if parts.scheme != "http":
+        return _urllib_request(method, url, body, content_type, timeout,
+                               headers)
+    from trino_tpu.obs import metrics as M
+
+    key = (parts.hostname, parts.port or 80)
+    path = parts.path or "/"
+    if parts.query:
+        path += "?" + parts.query
+    hdrs = {"Content-Type": content_type, H_INTERNAL_AUTH: sign(body),
+            "Accept-Encoding": "identity"}
+    for k, v in (headers or {}).items():
+        hdrs[k] = v
+    payload = body if method in ("POST", "PUT") else None
+    # stale-socket retry safety: GET/DELETE/PUT are idempotent on this
+    # protocol (status polls, cancels, announces), so a reused socket
+    # that dies mid-RESPONSE may retry. POST is not (a statement may
+    # already have executed) — it retries only when the failure happened
+    # while SENDING, i.e. the server cannot have received the request.
+    response_retry_ok = method != "POST"
+    conn = _POOL.get(key)
+    reused = conn is not None
+    while True:
+        if conn is None:
+            conn = _KeepAliveConnection(key[0], key[1], timeout=timeout)
+            M.HTTP_CONNECTIONS_OPENED.inc()
+        else:
+            conn.timeout = timeout  # reconnect-after-close honors it too
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+        sent = False
+        try:
+            conn.request(method, path, body=payload, headers=hdrs)
+            sent = True
+            resp = conn.getresponse()
+            data = resp.read()
+            resp_headers = dict(resp.getheaders())
+            if resp.will_close:
+                conn.close()
+            else:
+                _POOL.put(key, conn)
+            if reused:
+                M.HTTP_CONNECTION_REUSES.inc()
+            return resp.status, data, resp_headers
+        except _STALE_ERRORS:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if not reused or (sent and not response_retry_ok):
+                raise
+            conn, reused = None, False  # one retry on a fresh socket
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+
+
+def _urllib_request(method, url, body, content_type, timeout, headers):
+    """Non-http schemes fall back to the original urllib path."""
     req = urllib.request.Request(url, data=body if method in ("POST", "PUT") else None, method=method)
     req.add_header("Content-Type", content_type)
     req.add_header(H_INTERNAL_AUTH, sign(body))

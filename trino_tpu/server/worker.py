@@ -167,6 +167,12 @@ class WorkerServer:
 def _make_handler(server: WorkerServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # close keep-alive connections idle past this (the client pool's
+        # idle TTL is shorter, so the client normally closes first)
+        timeout = 30
+        # TCP_NODELAY: headers and body flush as separate writes — with
+        # Nagle on, the second write stalls behind the delayed ACK
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # quiet
             pass
