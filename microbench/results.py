@@ -1,0 +1,316 @@
+"""Spooled-results export bench: client-drain MB/s, inline vs spooled.
+
+The workload production data-export jobs actually run: pull a large
+table slice through the client protocol. Inline, every result byte
+funnels through the coordinator — Python-row materialization + JSON on
+a dispatch-plane lane, then a single paged stream to the client. The
+spooled protocol (ISSUE 13) hands the client a segment manifest and the
+data plane moves to the producers' ``/v1/segment/{id}`` endpoints,
+fetched in PARALLEL.
+
+Honest measurement: each configuration boots a FRESH coordinator + N
+worker SUBPROCESS cluster (peak RSS is a process-lifetime high-water
+mark — reusing one cluster would let the inline run poison the spooled
+run's reading), runs one warmup that generates the source columns, then
+ONE measured export. Reported per config:
+
+- ``drain_mb_s`` — result megabytes over the result-delivery window.
+  The numerator is the SAME for every config: the inline run's
+  statement-protocol payload bytes (what an inline client actually has
+  to drain for this result). The window is symmetric: the ledger's
+  ``result-serialization`` (result page -> rows/segments) plus the
+  drain half — inline: the ledger's ``client-drain`` (paged JSON);
+  spooled: the client's measured parallel segment fetch+decode wall;
+- ``coord_peak_rss_mb`` — the coordinator subprocess's VmHWM after the
+  run (the "one export query OOMs the dispatch plane" signal).
+
+Emits ``RESULTS_r01.json`` (folded into TRAJECTORY.json by
+tools/bench_trend.py). Acceptance (full mode): spooled >= 3x inline
+drain throughput on a >=100MB result with coordinator peak RSS flat
+(spooled adds no result-proportional coordinator memory).
+
+Run:    python microbench/results.py [--sf 0.3] [--workers 2]
+Check:  python microbench/results.py --check   (tier-1 quick mode:
+        tiny schema, asserts spooled/inline row equality + that the
+        manifest path engaged; no perf gate, no artifact write)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+EXPORT_COLS = ("l_orderkey, l_partkey, l_suppkey, l_linenumber, "
+               "l_quantity, l_extendedprice, l_discount, l_tax")
+EXPORT_SQL = f"select {EXPORT_COLS} from lineitem"
+# forces generation of every export column worker-side with a tiny
+# result, so the measured run sees a warm generator cache in both configs
+WARMUP_SQL = ("select max(l_orderkey + l_partkey + l_suppkey + "
+              "l_linenumber), max(l_quantity + l_extendedprice + "
+              "l_discount + l_tax) from lineitem")
+
+_BOOT = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+         "from trino_tpu.server.{mod} import main; main()")
+
+
+def _spawn(mod: str, args, env):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _BOOT.format(mod=mod), *args],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True)
+    deadline = time.monotonic() + 180.0
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.strip().startswith("{"):
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(f"{mod} subprocess died during boot")
+    if not line.strip():
+        proc.terminate()
+        raise RuntimeError(f"{mod} subprocess did not report its URL")
+    return proc, json.loads(line)
+
+
+def boot_cluster(workers: int):
+    """Coordinator + N workers as real subprocesses (the bench process
+    is client-only, so coordinator RSS is honestly attributable)."""
+    from trino_tpu.server import wire
+
+    env = dict(os.environ)
+    env["TRINO_TPU_INTERNAL_SECRET"] = wire.get_secret()
+    env["PYTHONPATH"] = REPO_ROOT + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env["JAX_PLATFORMS"] = "cpu"
+    coord_proc, hello = _spawn("coordinator", ["--port", "0"], env)
+    url = hello["url"]
+    procs = [coord_proc]
+    try:
+        for i in range(workers):
+            wproc, _ = _spawn(
+                "worker",
+                ["--coordinator", url, "--node-id", f"res{i}"], env)
+            procs.append(wproc)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                alive = wire.json_request("GET", f"{url}/v1/node",
+                                          timeout=5.0)
+                if len(alive) >= workers:
+                    break
+            except Exception:  # noqa: BLE001 — still booting
+                pass
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("workers did not register in time")
+    except BaseException:
+        for p in procs:
+            p.terminate()
+        raise
+    return url, procs
+
+
+def peak_rss_mb(pid: int) -> float:
+    """VmHWM of a subprocess (lifetime peak resident set), in MB."""
+    try:
+        with open(f"/proc/{pid}/status", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def run_config(schema: str, workers: int, spooled: bool,
+               fetch_streams: int, threshold: int = 1 << 20) -> dict:
+    """One measured export on a fresh subprocess cluster."""
+    from trino_tpu.client import dbapi
+    from trino_tpu.server import wire
+
+    url, procs = boot_cluster(workers)
+    coord_pid = procs[0].pid
+    props = {"schema": schema}
+    if spooled:
+        props.update({
+            "spooled_results_enabled": "true",
+            "spooled_results_threshold_bytes": str(threshold),
+        })
+    try:
+        conn = dbapi.connect(coordinator_url=url,
+                             fetch_streams=fetch_streams, **props)
+        cur = conn.cursor()
+        cur.execute(WARMUP_SQL)
+        t0 = time.perf_counter()
+        cur.execute(EXPORT_SQL)
+        wall = time.perf_counter() - t0
+        rows = cur.rowcount
+        client = conn._client
+        qid = client.query_id
+        # final ledger AFTER the drain completed (the in-band stats block
+        # serializes before the last page/acks land)
+        timeline = {}
+        try:
+            info = wire.json_request("GET", f"{url}/v1/query/{qid}",
+                                     timeout=10.0)
+            timeline = (info["queryStats"].get("timeline") or {}).get(
+                "phases", {})
+        except Exception:  # noqa: BLE001 — ledger is supplementary
+            pass
+        checksum = sum(int(r[0]) for r in cur.fetchall()) % (1 << 61)
+        return {
+            "spooled": bool(spooled),
+            "fetch_streams": fetch_streams,
+            "rows": rows,
+            "row_checksum": checksum,
+            "wall_s": round(wall, 3),
+            "response_bytes": getattr(client, "response_bytes", 0),
+            "spooled_segments": getattr(client, "spooled_segments", 0),
+            "spooled_bytes": getattr(client, "spooled_bytes", 0),
+            "segment_fetch_s": round(
+                getattr(client, "segment_fetch_s", 0.0), 3),
+            "ledger_client_drain_s": round(
+                float(timeline.get("client-drain", 0.0)), 3),
+            "ledger_segment_fetch_s": round(
+                float(timeline.get("segment-fetch", 0.0)), 3),
+            "ledger_result_serialization_s": round(
+                float(timeline.get("result-serialization", 0.0)), 3),
+            "spooled_stat": (client.stats or {}).get("spooled"),
+            "coord_peak_rss_mb": round(peak_rss_mb(coord_pid), 1),
+        }
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10.0)
+            except Exception:  # noqa: BLE001 — escalate
+                p.kill()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", default="0.4",
+                    help="tpch scale factor for the export (schema "
+                         "sf<sf>; full mode)")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--check", action="store_true",
+                    help="tier-1 quick mode: tiny schema, correctness "
+                         "only, no artifact write")
+    ap.add_argument("--min-speedup", type=float, default=3.0,
+                    help="required spooled/inline drain ratio (full mode)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    if args.check:
+        schema, threshold = "tiny", 1024
+    else:
+        schema = "sf" + str(args.sf).replace(".", "_")
+        threshold = 1 << 20
+
+    print(f"# export: {EXPORT_SQL.split(' from ')[0]}... from "
+          f"tpch.{schema}.lineitem, {args.workers} workers", flush=True)
+    inline = run_config(schema, args.workers, spooled=False,
+                        fetch_streams=1, threshold=threshold)
+    print(f"  inline    : {inline['rows']} rows in {inline['wall_s']}s "
+          f"(client-drain {inline['ledger_client_drain_s']}s, coord RSS "
+          f"{inline['coord_peak_rss_mb']}MB)", flush=True)
+    spooled_s1 = run_config(schema, args.workers, spooled=True,
+                            fetch_streams=1, threshold=threshold)
+    spooled_s4 = run_config(schema, args.workers, spooled=True,
+                            fetch_streams=4, threshold=threshold)
+    for label, rec in (("spooled x1", spooled_s1),
+                       ("spooled x4", spooled_s4)):
+        print(f"  {label}: {rec['rows']} rows in {rec['wall_s']}s "
+              f"({rec['spooled_segments']} segments, fetch "
+              f"{rec['segment_fetch_s']}s, coord RSS "
+              f"{rec['coord_peak_rss_mb']}MB, mode "
+              f"{rec['spooled_stat']})", flush=True)
+
+    problems = []
+    if not (inline["rows"] == spooled_s1["rows"] == spooled_s4["rows"]):
+        problems.append("row-count mismatch between configs")
+    if not (inline["row_checksum"] == spooled_s1["row_checksum"]
+            == spooled_s4["row_checksum"]):
+        problems.append("row-checksum mismatch between configs")
+    if not (spooled_s1["spooled_stat"] and spooled_s4["spooled_stat"]):
+        problems.append("spooled configs did not use the manifest path")
+    if inline["spooled_stat"]:
+        problems.append("inline config unexpectedly spooled")
+
+    # the result, measured as what an inline client actually drains
+    # (statement-protocol payload bytes); every config's throughput is
+    # over this same numerator, so compression and parallel fetch count
+    # as spooled wins rather than changing the unit
+    result_mb = inline["response_bytes"] / 1e6
+    drains = {}
+    for key, rec, drain_s in (
+            ("inline", inline, inline["ledger_client_drain_s"]),
+            ("spooled_s1", spooled_s1, spooled_s1["segment_fetch_s"]),
+            ("spooled_s4", spooled_s4, spooled_s4["segment_fetch_s"])):
+        # symmetric delivery window: result page -> rows/segments
+        # (result-serialization) + the drain half
+        rec["drain_s"] = round(
+            drain_s + rec["ledger_result_serialization_s"], 3)
+        rec["drain_mb_s"] = (round(result_mb / rec["drain_s"], 2)
+                             if rec["drain_s"] else 0.0)
+        drains[key] = rec["drain_mb_s"]
+    speedup = (drains["spooled_s4"] / drains["inline"]
+               if drains["inline"] else 0.0)
+    rss_delta_mb = round(
+        inline["coord_peak_rss_mb"] - spooled_s4["coord_peak_rss_mb"], 1)
+    result = {
+        "bench": "results",
+        "round": 1,
+        "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        "schema": schema,
+        "workers": args.workers,
+        "result_mb": round(result_mb, 1),
+        "inline": inline,
+        "spooled_s1": spooled_s1,
+        "spooled_s4": spooled_s4,
+        "speedup": round(speedup, 2),
+        "coord_rss_delta_mb": rss_delta_mb,
+        "min_speedup": args.min_speedup,
+    }
+    if not args.check:
+        print(f"  result {result_mb:.1f}MB | drain MB/s: inline "
+              f"{drains['inline']} vs spooled x1 {drains['spooled_s1']} "
+              f"/ x4 {drains['spooled_s4']} -> {speedup:.2f}x "
+              f"(required {args.min_speedup}x); coord RSS saved "
+              f"{rss_delta_mb}MB", flush=True)
+        if result_mb < 100.0:
+            problems.append(f"result only {result_mb:.1f}MB (<100MB): "
+                            "raise --sf")
+        if speedup < args.min_speedup:
+            problems.append(
+                f"spooled drain speedup {speedup:.2f}x < "
+                f"{args.min_speedup}x")
+        # "RSS flat": the spooled coordinator must not pay
+        # result-proportional memory — at least half the result size of
+        # peak-RSS headroom vs the inline run
+        if rss_delta_mb < result_mb / 2:
+            problems.append(
+                f"coordinator RSS not flat: spooled saved only "
+                f"{rss_delta_mb}MB of a {result_mb:.1f}MB result")
+        out = args.out or os.path.join(REPO_ROOT, "RESULTS_r01.json")
+        result["ok"] = not problems
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"wrote {out}", flush=True)
+    if problems:
+        print("FAIL: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    print("OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

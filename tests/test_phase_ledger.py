@@ -54,8 +54,10 @@ def test_exclusive_attribution_with_overlap():
     # connective tissue) is dispatch, not a hidden gap
     assert abs(d["phases"]["dispatch"] - 0.15) < 1e-9
     assert d["unattributedS"] == pytest.approx(0.0)
-    # attributed + unattributed == wall, exactly
-    in_wall = sum(v for p, v in d["phases"].items() if p != "client-drain")
+    # attributed + unattributed == wall, exactly (segment-fetch and
+    # client-drain sit OUTSIDE the wall)
+    in_wall = sum(v for p, v in d["phases"].items()
+                  if p not in ("client-drain", "segment-fetch"))
     assert in_wall == pytest.approx(d["wallS"], abs=1e-6)
     assert tl.wall_s == pytest.approx(1.0)
 
@@ -140,8 +142,10 @@ def _assert_ledger(tl, where):
         f"attributed): {tl['phases']}")
     assert tl["unattributedS"] <= 0.05 * tl["wallS"] + 1e-9
     # exclusive phases can never total more than the wall (per-phase
-    # values are rounded to the microsecond, hence the slack)
-    in_wall = sum(v for p, v in tl["phases"].items() if p != "client-drain")
+    # values are rounded to the microsecond, hence the slack);
+    # segment-fetch and client-drain sit outside the wall
+    in_wall = sum(v for p, v in tl["phases"].items()
+                  if p not in ("client-drain", "segment-fetch"))
     assert in_wall <= tl["wallS"] + 2e-5
     return tl
 

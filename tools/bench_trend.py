@@ -6,7 +6,8 @@ The repo's perf history lives in per-round JSON files whose shapes grew
 organically — ``BENCH_r*.json`` (driver output + a parsed headline),
 ``QPS_r*.json`` (serving rounds), ``KERNELS_r*.json`` (join-kernel
 microbench), ``DEVCACHE.json`` / ``SKEWJOIN.json`` (one-shot proofs),
-``MULTICHIP_r*.json`` (mesh dry runs) — which makes the trajectory
+``MULTICHIP_r*.json`` (mesh dry runs), ``RESULTS_r*.json``
+(spooled-export rounds) — which makes the trajectory
 unreadable to tooling. This tool normalizes all of them into one flat
 list of ``{"family", "round", "metric", "value", "unit", "direction",
 "date", "source"}`` entries:
@@ -210,6 +211,33 @@ def _extract_skewjoin(path: str) -> List[dict]:
     return out
 
 
+def _extract_results(path: str) -> List[dict]:
+    """RESULTS_r*.json: spooled-export drain throughput per config, the
+    spooled/inline speedup, and the coordinator peak-RSS comparison."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    rnd = int(data.get("round", _round_of(path)))
+    out: List[dict] = []
+    for cfg in ("inline", "spooled_s1", "spooled_s4"):
+        rec = data.get(cfg)
+        if not isinstance(rec, dict):
+            continue
+        if rec.get("drain_mb_s") is not None:
+            out.append(_entry("results", rnd, f"{cfg}_drain_mb_s",
+                              rec["drain_mb_s"], "MB/s", "up", path))
+        if rec.get("coord_peak_rss_mb") is not None:
+            out.append(_entry("results", rnd, f"{cfg}_coord_peak_rss_mb",
+                              rec["coord_peak_rss_mb"], "MB", "down",
+                              path))
+    if data.get("speedup") is not None:
+        out.append(_entry("results", rnd, "spooled_drain_speedup",
+                          data["speedup"], "x", "up", path))
+    # result_mb (the workload size) stays OUT of the trajectory: it
+    # describes the dataset, not performance — gating it would fail a
+    # future round for measuring a different export
+    return out
+
+
 def _extract_multichip(path: str) -> List[dict]:
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
@@ -227,6 +255,7 @@ _FAMILIES = (
     ("DEVCACHE.json", _extract_devcache),
     ("SKEWJOIN.json", _extract_skewjoin),
     ("MULTICHIP_r*.json", _extract_multichip),
+    ("RESULTS_r*.json", _extract_results),
 )
 
 

@@ -94,6 +94,13 @@ def render_summary(stats) -> str:
     if stats.get("deviceCacheHits"):
         # scans served warm from the device table cache (zero transfer)
         parts.append(f"warm scans: {stats['deviceCacheHits']}")
+    if stats.get("spooled"):
+        # the spooled result protocol served a segment manifest instead
+        # of inline rows (worker-direct = the coordinator never touched
+        # the result data)
+        parts.append(
+            f"spooled: {stats.get('resultSegments', 0)} segments "
+            f"({stats['spooled']})")
     out = f" [{', '.join(parts)}]" if parts else ""
     tl = stats.get("timeline")
     if tl:
@@ -113,7 +120,9 @@ class Console:
             from trino_tpu.client.remote import StatementClient
 
             props = {"catalog": args.catalog, "schema": args.schema}
-            self._client = StatementClient(args.server, props)
+            self._client = StatementClient(
+                args.server, props,
+                fetch_streams=getattr(args, "fetch_streams", 4))
             self._session = None
         else:
             from trino_tpu.client.session import Session
@@ -160,6 +169,14 @@ class Console:
         dt = time.monotonic() - t0
         summary = f"({len(rows)} row{'s' if len(rows) != 1 else ''} in {dt:.2f}s)"
         summary += render_summary(getattr(self._client, "stats", None))
+        nseg = getattr(self._client, "spooled_segments", 0)
+        if nseg:
+            # spooled-protocol client telemetry: segment bytes fetched in
+            # parallel and the realized drain rate
+            mb = getattr(self._client, "spooled_bytes", 0) / 1e6
+            fetch_s = getattr(self._client, "segment_fetch_s", 0.0)
+            rate = f" @ {mb / fetch_s:.0f}MB/s" if fetch_s > 0 else ""
+            summary += f" [fetched {nseg} segments, {mb:.1f}MB{rate}]"
         cache = getattr(self._client, "cache_status", None)
         if cache:
             # result-cache disposition from the X-Trino-Tpu-Cache header
@@ -199,6 +216,9 @@ def main() -> int:
     ap.add_argument("--catalog", default="tpch")
     ap.add_argument("--schema", default="tiny")
     ap.add_argument("--execute", "-e", default=None, help="run one statement and exit")
+    ap.add_argument("--fetch-streams", type=int, default=4,
+                    help="parallel spooled-segment fetch streams "
+                         "(remote servers with spooled_results_enabled)")
     args = ap.parse_args()
     console = Console(args)
     if args.execute:

@@ -37,12 +37,17 @@ class DatabaseError(Error):
 
 class Connection:
     def __init__(self, coordinator_url: Optional[str] = None, session=None,
-                 catalog: str = "tpch", schema: str = "tiny", **properties):
+                 catalog: str = "tpch", schema: str = "tiny",
+                 fetch_streams: int = 4, **properties):
+        # ``fetch_streams`` is a CLIENT knob (parallel spooled-segment
+        # fetch width), not a server session property — it never rides
+        # the X-Trino-Session-* headers
         if coordinator_url is not None:
             from trino_tpu.client.remote import StatementClient
 
             props = {"catalog": catalog, "schema": schema, **properties}
-            self._client = StatementClient(coordinator_url, props)
+            self._client = StatementClient(coordinator_url, props,
+                                           fetch_streams=fetch_streams)
             self._session = None
         else:
             if session is None:

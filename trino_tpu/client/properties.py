@@ -277,6 +277,47 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 15_000, lambda v: None if v >= 0 else "must be >= 0",
         ),
         PropertyMetadata(
+            "spooled_results_enabled",
+            "serve large SELECT results as a spooled segment manifest "
+            "instead of inline rows: the producers write serde-encoded "
+            "result segments (workers directly for export-shaped plans, "
+            "the coordinator's own segment store otherwise), the "
+            "statement response carries segment URIs, and clients fetch "
+            "them in parallel — the coordinator leaves the data path "
+            "(reference: Trino 455's spooled client protocol)",
+            bool, False,
+        ),
+        PropertyMetadata(
+            "spooled_results_threshold_bytes",
+            "estimated result bytes at/above which an enabled spooled-"
+            "results query answers with a segment manifest; smaller "
+            "results stay inline (the protocol decision, not a cap)",
+            int, 8 << 20, _positive,
+        ),
+        PropertyMetadata(
+            "spooled_results_segment_bytes",
+            "target serialized bytes per spooled result segment — the "
+            "unit of client-side parallel fetch (reference role: the "
+            "spooled protocol's segment sizing)",
+            int, 8 << 20, _positive,
+        ),
+        PropertyMetadata(
+            "result_segment_ttl_ms",
+            "lifetime of an un-acked spooled result segment in "
+            "milliseconds; client acks (DELETE /v1/segment/{id}) delete "
+            "sooner, the TTL bounds the leak when a client vanishes "
+            "mid-fetch",
+            int, 300_000, _positive,
+        ),
+        PropertyMetadata(
+            "inline_result_max_bytes",
+            "hard cap on result bytes the coordinator will materialize "
+            "in process memory for the inline protocol: over it, the "
+            "query auto-spools when spooled_results_enabled, else FAILS "
+            "loudly (one export query must not OOM the dispatch plane)",
+            int, 256 << 20, _positive,
+        ),
+        PropertyMetadata(
             "failure_injection",
             "inject a task failure when this substring matches a task id, "
             "e.g. '.<fragment>.<worker>.a<attempt>' (reference: "

@@ -1,17 +1,30 @@
-"""Columnar wire format: Page <-> bytes, with compression.
+"""Columnar wire format: Page <-> bytes, with per-column compression.
 
 Reference: ``core/trino-main/.../execution/buffer/PageSerializer.java:59`` /
 ``PageDeserializer`` and ``PagesSerdeFactory.java:53-59`` (per-block encodings
 + LZ4/ZSTD frame + optional AES). Here: a compact header + per-column blocks
 (dtype tag, null bitmap, raw values, dictionary vocabulary for varchar),
 compressed with zlib (the image has no lz4 module; the codec byte leaves room
-to add one). Used by the DCN streaming shuffle tier and the spooled exchange
-(SURVEY.md §2.6) — intra-slice repartition never serializes (it rides ICI
-inside the compiled program).
+to add one). Used by the DCN streaming shuffle tier, the spooled exchange,
+and the spooled result segments (SURVEY.md §2.6) — intra-slice repartition
+never serializes (it rides ICI inside the compiled program).
+
+Version 3 compresses each COLUMN block independently and stores a block
+RAW when zlib does not shrink it (the reference's
+``PageSerializer`` marker-byte contract: an incompressible block skips
+the codec). Float/int entropy columns — exactly the shape of a big
+result export — previously paid compress+inflate both ways for nothing;
+now they pay neither, and the per-codec byte counters
+(``trino_tpu_serde_bytes_total{direction,codec}``) make the realized
+compression ratio observable. Version 2 payloads (whole-body zlib)
+still deserialize — spool files written by an older process stay
+readable.
 
 Format (little-endian):
   magic u32 | version u8 | codec u8 | num_columns u16 | num_rows u32
-  then per column (inside the compressed body):
+  then per column: block_codec u8 | block_len u32 | block bytes
+  (block_codec = CODEC_ZLIB when compressed, CODEC_NONE when stored raw)
+  where each block decodes to:
     type_name: u16 len + utf8
     has_nulls: u8; if 1: packed bitmap ceil(n/8) bytes
     dtype_code: u8 (PHYSICAL dtype — may be narrower than the logical type)
@@ -34,6 +47,8 @@ from trino_tpu.data.page import Column, Page
 MAGIC = 0x7E51_00D5
 CODEC_NONE = 0
 CODEC_ZLIB = 1
+
+_CODEC_NAMES = {CODEC_NONE: "none", CODEC_ZLIB: "zlib"}
 
 # Physical dtype tags: a column may ride a narrower dtype than its logical
 # type's (data/page.py Column), so the wire format carries the actual one.
@@ -83,31 +98,81 @@ def _serialize_column(col: Column, n: int, parts: List[bytes]) -> None:
 
 
 def serialize_page(page: Page, codec: int = CODEC_ZLIB) -> bytes:
-    parts: List[bytes] = []
+    from trino_tpu.obs import metrics as M
+
     n = page.num_rows
+    out: List[bytes] = [
+        struct.pack("<IBBHI", MAGIC, 3, codec, page.channel_count, n)]
+    logical = 0
+    wire_by_codec = {CODEC_NONE: 0, CODEC_ZLIB: 0}
     for col in page.columns:
+        parts: List[bytes] = []
         _serialize_column(col, n, parts)
-    body = b"".join(parts)
-    if codec == CODEC_ZLIB:
-        body = zlib.compress(body, level=1)
-    header = struct.pack("<IBBHI", MAGIC, 2, codec, page.channel_count, n)
-    return header + body
+        body = b"".join(parts)
+        logical += len(body)
+        block_codec, block = CODEC_NONE, body
+        if codec == CODEC_ZLIB:
+            comp = zlib.compress(body, level=1)
+            if len(comp) < len(body):
+                # incompressible-column fast path: only blocks zlib
+                # actually SHRANK ship compressed — entropy data (float
+                # measures, high-cardinality ints) stores raw and skips
+                # the inflate on the read side too
+                block_codec, block = CODEC_ZLIB, comp
+        wire_by_codec[block_codec] += len(block)
+        out.append(struct.pack("<BI", block_codec, len(block)))
+        out.append(block)
+    for bc, nbytes in wire_by_codec.items():
+        if nbytes:
+            M.SERDE_BYTES.inc(nbytes, "encode", _CODEC_NAMES[bc])
+    if logical:
+        M.SERDE_BYTES.inc(logical, "encode", "logical")
+    return b"".join(out)
 
 
 def deserialize_page(data: bytes) -> Page:
+    from trino_tpu.obs import metrics as M
+
     magic, version, codec, ncols, nrows = struct.unpack_from("<IBBHI", data, 0)
     if magic != MAGIC:
         raise ValueError("bad page magic")
-    if version != 2:
-        raise ValueError(f"unsupported page format version {version} (expected 2)")
-    body = data[12:]
-    if codec == CODEC_ZLIB:
-        body = zlib.decompress(body)
-    off = 0
     columns: List[Column] = []
+    if version == 2:
+        # legacy whole-body frame (pre-incompressible-fast-path spool
+        # files): one zlib pass over every column block together
+        body = data[12:]
+        if codec == CODEC_ZLIB:
+            body = zlib.decompress(body)
+        off = 0
+        for _ in range(ncols):
+            col, off = _deserialize_column(body, off, nrows)
+            columns.append(col)
+        return Page(columns)
+    if version != 3:
+        raise ValueError(
+            f"unsupported page format version {version} (expected 2 or 3)")
+    off = 12
+    logical = 0
+    wire_by_codec = {CODEC_NONE: 0, CODEC_ZLIB: 0}
     for _ in range(ncols):
-        col, off = _deserialize_column(body, off, nrows)
+        block_codec, block_len = struct.unpack_from("<BI", data, off)
+        off += 5
+        block = data[off:off + block_len]
+        off += block_len
+        wire_by_codec[block_codec] = (
+            wire_by_codec.get(block_codec, 0) + block_len)
+        if block_codec == CODEC_ZLIB:
+            block = zlib.decompress(block)
+        elif block_codec != CODEC_NONE:
+            raise ValueError(f"unknown column block codec {block_codec}")
+        logical += len(block)
+        col, _end = _deserialize_column(block, 0, nrows)
         columns.append(col)
+    for bc, nbytes in wire_by_codec.items():
+        if nbytes:
+            M.SERDE_BYTES.inc(nbytes, "decode", _CODEC_NAMES[bc])
+    if logical:
+        M.SERDE_BYTES.inc(logical, "decode", "logical")
     return Page(columns)
 
 

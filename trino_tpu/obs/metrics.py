@@ -289,6 +289,47 @@ SPOOL_BYTES = REGISTRY.counter(
     "trino_tpu_spool_bytes_total",
     "page bytes read from durable spool files (kept separate from "
     "exchange bytes, which count network pulls from live buffers)")
+# page serde (data/serde.py): per-column wire bytes by codec —
+# zlib (blocks that actually shrank), none (incompressible blocks stored
+# raw), logical (uncompressed column-block bytes, the denominator of the
+# realized compression ratio)
+SERDE_BYTES = REGISTRY.counter(
+    "trino_tpu_serde_bytes_total",
+    "page serde column-block bytes by direction and codec (codec = zlib "
+    "compressed-wire | none raw-stored | logical uncompressed input/"
+    "output; ratio = (zlib + none) / logical)", ("direction", "codec"))
+# spooled result protocol (server/segments.py): result segments written
+# by workers/the coordinator, served to clients, and reclaimed by the
+# ack/TTL/orphan lifecycle
+RESULT_SEGMENTS_WRITTEN = REGISTRY.counter(
+    "trino_tpu_result_segments_written_total",
+    "spooled result segments written to this process's segment store")
+RESULT_SEGMENT_BYTES = REGISTRY.counter(
+    "trino_tpu_result_segment_bytes_total",
+    "spooled result segment bytes by direction (written = rolled into "
+    "the segment store; served = read out by segment GETs)",
+    ("direction",))
+RESULT_SEGMENTS_RECLAIMED = REGISTRY.counter(
+    "trino_tpu_result_segments_reclaimed_total",
+    "result segments deleted, by reason (ack = client fetched and acked; "
+    "ttl = expired un-acked, including failed queries' early drops; "
+    "orphan = stale files swept at server start)", ("reason",))
+RESULT_SEGMENT_RECLAIMED_BYTES = REGISTRY.counter(
+    "trino_tpu_result_segment_reclaimed_bytes_total",
+    "bytes reclaimed by result-segment deletion, by reason "
+    "(ack | ttl | orphan)", ("reason",))
+SPOOLED_RESULT_QUERIES = REGISTRY.counter(
+    "trino_tpu_spooled_result_queries_total",
+    "queries whose results were served as a spooled segment manifest, by "
+    "mode (worker-direct = root-fragment producers wrote the segments "
+    "and the coordinator never touched the data; coordinator = the "
+    "coordinator spooled from its own segment store)", ("mode",))
+INLINE_RESULT_REJECTIONS = REGISTRY.counter(
+    "trino_tpu_inline_result_rejections_total",
+    "queries failed by the inline-result memory guard "
+    "(inline_result_max_bytes exceeded with spooled results disabled — "
+    "the coordinator refuses to materialize, instead of OOMing the "
+    "dispatch plane)")
 COMPILE_CACHE_HITS = REGISTRY.counter(
     "trino_tpu_compile_cache_hits_total",
     "compiled-query runs reusing an already-built XLA executable")
@@ -511,7 +552,8 @@ QUERY_PHASE_SECONDS = REGISTRY.histogram(
     "completion-time phase ledger (queued | dispatch-queue | dispatch | "
     "parse-analyze | plan-optimize | prepare-bind | schedule | "
     "device-staging | device-execute | exchange-wait | "
-    "result-serialization | client-drain | unattributed)", ("phase",))
+    "result-serialization | segment-fetch | client-drain | "
+    "unattributed)", ("phase",))
 
 # tracing self-protection (obs/trace.py): per-tracer span cap — a
 # pathological query stops RECORDING at the cap instead of growing

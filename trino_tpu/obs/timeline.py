@@ -42,7 +42,10 @@ Phases (the label set of ``trino_tpu_query_phase_seconds``)::
     device-staging        host->device transfers (any process)
     device-execute        device compute + compile (any process)
     exchange-wait         exchange pulls / spool reads
-    result-serialization  result page -> row materialization
+    result-serialization  result page -> row materialization (inline) or
+                          result segment encode/spool (spooled protocol)
+    segment-fetch         post-terminal spooled-segment fetches + acks
+                          (outside the wall, beside client-drain)
     client-drain          post-terminal result fetches (outside the wall)
     unattributed          wall not covered by any span (the visible gap)
 """
@@ -51,13 +54,18 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
-# ledger phases in display order; client-drain and unattributed are
-# synthesized, everything else is swept from spans
+# ledger phases in display order; segment-fetch, client-drain, and
+# unattributed are synthesized, everything else is swept from spans
 PHASES: Tuple[str, ...] = (
     "queued", "dispatch-queue", "dispatch", "parse-analyze",
     "plan-optimize", "prepare-bind", "schedule", "device-staging",
     "device-execute", "exchange-wait", "result-serialization",
-    "client-drain", "unattributed")
+    "segment-fetch", "client-drain", "unattributed")
+
+# phases synthesized OUTSIDE the wall interval: reported beside the
+# ledger, excluded from in-wall sums and the coverage denominator
+OUT_OF_WALL_PHASES: Tuple[str, ...] = (
+    "segment-fetch", "client-drain", "unattributed")
 
 # span name -> (sweep priority, phase). Lower priority wins where spans
 # overlap: leaf work (staging/execute/exchange) beats the coordinator's
@@ -105,6 +113,13 @@ SPAN_PHASE: Dict[str, Tuple[int, str]] = {
     "exchange/pull": (_P_EXCHANGE, "exchange-wait"),
     "spool/read": (_P_EXCHANGE, "exchange-wait"),
     "result/serialize": (_P_RESULT, "result-serialization"),
+    # spooled result protocol (server/segments.py): segment encode+write
+    # is the spooled analog of result serialization; the coordinator's
+    # collect window encloses the workers' own execute/write spans, so
+    # like the other execute windows only its remainder is device time
+    "result/spool": (_P_RESULT, "result-serialization"),
+    "segment/write": (_P_RESULT, "result-serialization"),
+    "segments/collect": (_P_EXECUTE, "device-execute"),
     # the execution windows: their exclusive remainder is device compute
     # on this process (root-fragment body, fast-path executor run)
     "execute/root-fragment": (_P_EXECUTE, "device-execute"),
@@ -125,6 +140,9 @@ class QueryTimeline:
     phases: Dict[str, float]
     unattributed_s: float
     client_drain_s: float = 0.0
+    # spooled result protocol: terminal -> last segment fetch/ack seen by
+    # the coordinator (outside the wall, like client-drain)
+    segment_fetch_s: float = 0.0
 
     @property
     def coverage(self) -> float:
@@ -134,7 +152,8 @@ class QueryTimeline:
 
     def to_dict(self) -> dict:
         phases = {p: round(self.phases.get(p, 0.0), 6)
-                  for p in PHASES if p not in ("client-drain", "unattributed")}
+                  for p in PHASES if p not in OUT_OF_WALL_PHASES}
+        phases["segment-fetch"] = round(self.segment_fetch_s, 6)
         phases["client-drain"] = round(self.client_drain_s, 6)
         phases["unattributed"] = round(self.unattributed_s, 6)
         return {
@@ -264,7 +283,7 @@ def summarize(timeline_dict: dict, min_fraction: float = 0.02,
     if wall <= 0:
         return ""
     entries = [(p, float(timeline_dict["phases"].get(p, 0.0)))
-               for p in PHASES if p not in ("client-drain", "unattributed")]
+               for p in PHASES if p not in OUT_OF_WALL_PHASES]
     entries = [(p, s) for p, s in entries if s >= wall * min_fraction]
     entries.sort(key=lambda e: e[1], reverse=True)
     parts = [f"{p} {s * 1e3:.1f}ms" for p, s in entries[:max_phases]]

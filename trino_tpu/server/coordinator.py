@@ -40,6 +40,7 @@ _ANNOUNCE_RE = re.compile(r"^/v1/announce/([^/]+)$")
 _RESULT_RE = re.compile(r"^/v1/statement/executing/([^/]+)/(\d+)$")
 _QUERY_RE = re.compile(r"^/v1/query/([^/]+)$")
 _TRACE_RE = re.compile(r"^/v1/query/([^/]+)/trace$")
+_SEGMENT_RE = re.compile(r"^/v1/segment/([^/]+)$")
 
 RESULT_PAGE_ROWS = 10_000
 
@@ -205,6 +206,26 @@ class QueryExecution:
         self.is_plain_select = False
         self.result_cache_key: Optional[str] = None
         self.result_cache_versions = None
+        # spooled result protocol (server/segments.py): when the query's
+        # results went to segments, the statement response carries this
+        # MANIFEST ({uri, ackUri, id, rows, bytes, codec} per segment)
+        # instead of inline rows; ``spooled`` records which producer
+        # wrote them ("worker-direct" — root-fragment tasks, the
+        # coordinator never touched the data — or "coordinator")
+        self.result_segments: Optional[List[dict]] = None
+        self.spooled: Optional[str] = None
+        # segment id -> owning worker base url (ack forwarding + early
+        # discard); empty for coordinator-spooled queries
+        self._segment_workers: Dict[str, str] = {}
+        # set by CoordinatorServer.submit: this coordinator's segment
+        # store + public base url (None for bare embedded executions,
+        # which then never spool)
+        self.segment_store = None
+        self.segment_base_url: Optional[str] = None
+        # when a client last fetched/acked a result segment through this
+        # coordinator — feeds the ledger's segment-fetch phase (outside
+        # the query wall, beside client-drain)
+        self.last_segment_fetch_at: Optional[float] = None
 
     def start(self) -> None:
         """Run the lifecycle on a fresh thread (legacy surface — the
@@ -310,6 +331,9 @@ class QueryExecution:
             # ExplainAnalyzeOperator consuming the stage stats it ran under)
             self.cache_status = "BYPASS"
             text = self._explain_analyze(session, stmt)
+            # the deliverable is the annotated plan, not the inner
+            # query's rows: release any segments the execution spooled
+            self._discard_spooled_result()
             self.columns = ["Query Plan"]
             self.rows = [(line,) for line in text.split("\n")]
             return
@@ -359,6 +383,13 @@ class QueryExecution:
         except BaseException:
             self.query_cache.results.abandon(key)
             raise
+        if self.result_segments is not None:
+            # spooled results never enter the result cache: the rows were
+            # deliberately never materialized on this coordinator —
+            # abandon the flight so single-flight waiters re-execute
+            # instead of inheriting an empty payload
+            self.query_cache.results.abandon(key)
+            return
         self.query_cache.results.complete(
             key, self.columns, self.rows,
             ttl_ms=session.properties.get("result_cache_ttl_ms", 60_000),
@@ -681,6 +712,10 @@ class QueryExecution:
             fragments = fragment_plan(root, session)
             sp.set("fragments", len(fragments))
         self.fragments = fragments
+        # spooled-results decision for the export shape, made BEFORE
+        # scheduling: the producing fragment's tasks then write result
+        # segments directly and the coordinator never pulls the data
+        spool_fid = self._mark_worker_direct_spool(session, root, fragments)
         # the schedule span covers the whole dispatch tail — worker
         # selection, task creation, the RUNNING transition (whose state
         # listeners run inline), and the stats-poller spawn — so the
@@ -695,8 +730,18 @@ class QueryExecution:
             self._schedule(session, fragments, workers)
             self.state.set("RUNNING")
             self._start_stats_poller()
-        with self.tracer.span("execute/root-fragment"):
-            result_page = self._run_root_fragment(session, fragments)
+        result_page = None
+        if spool_fid is not None:
+            # worker-direct spooled results: wait for the producers to
+            # finish writing their segments, assemble the manifest from
+            # their status payloads — metadata only, no page ever crosses
+            # this process (the coordinator is off the data path)
+            with self.tracer.span("segments/collect") as sp:
+                self._collect_result_segments(spool_fid)
+                sp.set("segments", len(self.result_segments or ()))
+        else:
+            with self.tracer.span("execute/root-fragment"):
+                result_page = self._run_root_fragment(session, fragments)
         # freeze the rollup on the workers' terminal numbers before the
         # query leaves RUNNING (tasks are at least FLUSHING once the root
         # fragment has drained their buffers); spanned so the ledger can
@@ -705,9 +750,8 @@ class QueryExecution:
             sp.set("polled", self._sweep_task_stats())
         self.state.set("FINISHING")
         self.columns = fragments[-1].root.column_names
-        with self.tracer.span("result/serialize") as sp:
-            self.rows = result_page.to_pylist()
-            sp.set("rows", len(self.rows))
+        if result_page is not None:
+            self._materialize_result(session, result_page)
 
     def _cleanup_spool(self) -> None:
         """Drop this query's spooled task outputs (reference: exchange
@@ -725,6 +769,223 @@ class QueryExecution:
                 os.remove(path)
             except OSError:
                 pass
+
+    # ------------------------------------------------- spooled results
+    def result_rows(self) -> int:
+        """Result cardinality across both protocols: materialized rows
+        inline, summed manifest rows when spooled."""
+        if self.result_segments is not None:
+            return sum(int(e.get("rows", 0)) for e in self.result_segments)
+        return len(self.rows)
+
+    def _spool_config(self, session) -> Optional[dict]:
+        """The spooled-results knobs, or None when the protocol is off
+        for this query (disabled, or no segment store — bare embedded
+        executions)."""
+        props = session.properties
+        if self.segment_store is None or not bool(
+                props.get("spooled_results_enabled", False)):
+            return None
+        return {
+            "threshold": int(
+                props.get("spooled_results_threshold_bytes", 8 << 20)),
+            "segment_bytes": int(
+                props.get("spooled_results_segment_bytes", 8 << 20)),
+            "ttl_s": int(props.get("result_segment_ttl_ms",
+                                   300_000)) / 1e3,
+        }
+
+    def _materialize_result(self, session, page) -> None:
+        """The result tail every SELECT path funnels through: serve the
+        page inline (result/serialize -> Python rows) or — when the
+        ACTUAL bytes cross the spool threshold — encode it into this
+        coordinator's segment store and publish a manifest instead. The
+        inline-result memory guard lives here too: over
+        ``inline_result_max_bytes`` the query auto-spools (protocol
+        enabled) or FAILS loudly — one export query must never OOM the
+        dispatch plane by silently materializing in process memory."""
+        from trino_tpu.obs import metrics as M
+
+        est = int(page.live_count()) * int(page.row_byte_estimate())
+        cfg = self._spool_config(session)
+        cap = int(session.properties.get("inline_result_max_bytes",
+                                         256 << 20))
+        if cfg is not None and est >= min(cfg["threshold"], cap):
+            self._spool_result_page(session, page, cfg)
+            return
+        if est > cap:
+            M.INLINE_RESULT_REJECTIONS.inc()
+            raise RuntimeError(
+                f"result is ~{est} serialized bytes, over "
+                f"inline_result_max_bytes={cap}: the coordinator refuses "
+                "to materialize it in process memory "
+                "(INLINE_RESULT_TOO_LARGE) — enable "
+                "spooled_results_enabled to serve it as a spooled "
+                "segment manifest, or narrow the query")
+        with self.tracer.span("result/serialize") as sp:
+            self.rows = page.to_pylist()
+            sp.set("rows", len(self.rows))
+
+    def _spool_result_page(self, session, page, cfg) -> None:
+        """Coordinator-side spool: chunk + serde-encode the result page
+        into size-bounded segments in this coordinator's own store
+        (coordinator-local, fast-path, and non-trivial-root distributed
+        queries — the decision is plan-shape-independent; only the
+        worker-direct shape also skips this process's encode)."""
+        from trino_tpu.data.serde import serialize_page
+        from trino_tpu.obs import metrics as M
+        from trino_tpu.server.task import _chunk_pages
+
+        page = page.compact()
+        chunk_target = int(session.properties.get(
+            "task_output_chunk_bytes", 4 << 20))
+        chunk_rows = (max(1, chunk_target // page.row_byte_estimate())
+                      if page.num_rows else 1)
+        writer = self.segment_store.writer(
+            self.query_id, target_bytes=cfg["segment_bytes"],
+            ttl_s=cfg["ttl_s"])
+        with self.tracer.span("result/spool") as sp:
+            for c in _chunk_pages(page, chunk_rows):
+                writer.add(serialize_page(c), int(c.num_rows))
+            metas = writer.finish()
+            sp.set("segments", len(metas))
+            sp.set("rows", int(page.num_rows))
+        base = self.segment_base_url or ""
+        self.result_segments = [
+            {**m.manifest_entry(),
+             "uri": f"{base}/v1/segment/{m.segment_id}",
+             "ackUri": f"{base}/v1/segment/{m.segment_id}"}
+            for m in metas]
+        self.spooled = "coordinator"
+        self.rows = []
+        M.SPOOLED_RESULT_QUERIES.inc(1, "coordinator")
+
+    def _mark_worker_direct_spool(self, session, root, fragments):
+        """Worker-direct spooling decision, made BEFORE scheduling: when
+        the root single fragment is a pure gather pass-through
+        (OutputNode over one RemoteSourceNode — the export shape) and
+        the ESTIMATED result crosses the spool threshold, the producing
+        fragment's tasks write result segments directly and the
+        coordinator never runs the root fragment at all. Returns the
+        producing fragment id, or None — in which case the actual-bytes
+        decision in ``_materialize_result`` still applies, so the
+        protocol choice stays plan-shape-independent."""
+        cfg = self._spool_config(session)
+        if cfg is None:
+            return None
+        if str(self.session_properties.get(
+                "retry_policy", "NONE")).upper() == "TASK":
+            # FTE may run duplicate attempts whose losing segments would
+            # outlive the manifest; large FTE results still spool through
+            # the coordinator path
+            return None
+        src = self._gather_passthrough(fragments[-1])
+        if src is None:
+            return None
+        frag = next((f for f in fragments if f.id == src.fragment_id),
+                    None)
+        if frag is None or getattr(frag, "output_partition_channels",
+                                   None):
+            return None
+        out = fragments[-1].root
+        from trino_tpu.server import fastpath
+
+        est_rows = fastpath.scan_rows_estimate(session, root)
+        est_bytes = est_rows * 8 * max(1, len(out.column_names or ()))
+        if est_bytes < cfg["threshold"]:
+            return None
+        frag.spool_results = True
+        return frag.id
+
+    @staticmethod
+    def _gather_passthrough(root_frag):
+        """The gather RemoteSourceNode when the root single fragment is
+        a pure pass-through (OutputNode over one gather source — the
+        export shape, where gathered bytes == result bytes), else
+        None."""
+        out = root_frag.root
+        src = out.source if isinstance(out, P.OutputNode) else out
+        if (isinstance(src, RemoteSourceNode)
+                and src.exchange_type == "gather"):
+            return src
+        return None
+
+    SEGMENT_COLLECT_TIMEOUT = 600.0
+
+    def _collect_result_segments(self, fid: int) -> None:
+        """Wait for the result-producing tasks to FINISH (their segments
+        are durable by then) and assemble the statement manifest from
+        their status payloads, in task order — the coordinator handles
+        only metadata. Data fetches go straight to the owning worker;
+        ACKs route through the coordinator (a tiny control-plane DELETE)
+        so segment-fetch activity is attributable per query."""
+        from trino_tpu.obs import metrics as M
+
+        deadline = time.monotonic() + self.SEGMENT_COLLECT_TIMEOUT
+        entries: List[dict] = []
+        base = self.segment_base_url or ""
+        for loc in self.fragment_tasks.get(fid, ()):
+            info = None
+            while True:
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"result task {loc.task_id} did not finish "
+                        f"within {self.SEGMENT_COLLECT_TIMEOUT:g}s")
+                if self.state.is_terminal():
+                    raise RuntimeError("query was canceled")
+                try:
+                    status, body, _ = wire.http_request(
+                        "GET",
+                        f"{loc.base_url}/v1/task/{loc.task_id}/status",
+                        timeout=10.0)
+                except Exception:  # noqa: BLE001 — retry until deadline
+                    time.sleep(0.1)
+                    continue
+                if status >= 400:
+                    raise RuntimeError(
+                        f"result task {loc.task_id} unreachable: "
+                        f"{status}")
+                info = json.loads(body)
+                self._note_task_status(loc.task_id, info)
+                state = info.get("state")
+                if state == "FINISHED":
+                    break
+                if state in ("FAILED", "CANCELED"):
+                    raise RuntimeError(
+                        f"result task {loc.task_id} {state}: "
+                        f"{info.get('failure')}")
+                time.sleep(0.05)
+            for seg in info.get("resultSegments", ()):
+                self._segment_workers[seg["id"]] = loc.base_url
+                entries.append({
+                    **seg,
+                    "uri": f"{loc.base_url}/v1/segment/{seg['id']}",
+                    "ackUri": f"{base}/v1/segment/{seg['id']}",
+                })
+        self.result_segments = entries
+        self.spooled = "worker-direct"
+        self.rows = []
+        M.SPOOLED_RESULT_QUERIES.inc(1, "worker-direct")
+
+    def _discard_spooled_result(self) -> None:
+        """A statement whose deliverable is NOT the inner query's rows
+        (EXPLAIN ANALYZE) ran a query that spooled: release the segments
+        now — no manifest will ever reach a client."""
+        if self.result_segments is None:
+            return
+        for e in self.result_segments:
+            worker = self._segment_workers.get(e["id"])
+            if worker is not None:
+                try:
+                    wire.http_request(
+                        "DELETE", f"{worker}/v1/segment/{e['id']}",
+                        timeout=5.0)
+                except Exception:  # noqa: BLE001 — TTL is the backstop
+                    pass
+            elif self.segment_store is not None:
+                self.segment_store.discard(e["id"])
+        self.result_segments = None
+        self.spooled = None
 
     # ------------------------------------------------------ stats pipeline
     def _note_task_status(self, task_id: str, info: dict) -> None:
@@ -797,9 +1058,10 @@ class QueryExecution:
                 sp.set("rows", page.live_count())
         self._local_executor = ex  # EXPLAIN ANALYZE annotation source
         self.columns = list(root.column_names)
-        with self.tracer.span("result/serialize") as sp:
-            self.rows = page.to_pylist()
-            sp.set("rows", len(self.rows))
+        # same spool/inline decision as the distributed tail: the
+        # protocol choice is plan-shape-independent — a fast-path or
+        # local-catalog export spools from the coordinator's own store
+        self._materialize_result(session, page)
         self._note_local_stats(ex, time.perf_counter() - t0)
 
     def _note_local_stats(self, ex, elapsed_s: float) -> None:
@@ -817,7 +1079,7 @@ class QueryExecution:
             "completedSplits": max(1, len(getattr(ex, "scan_stats", {}))),
             "totalSplits": max(1, len(getattr(ex, "scan_stats", {}))),
             "inputRows": int(scan_rows),
-            "outputRows": len(self.rows),
+            "outputRows": self.result_rows(),
             "outputBytes": sum(
                 st.output_bytes for st in ex.node_stats.values()),
             "peakBytes": int(ex.memory.peak),
@@ -994,6 +1256,11 @@ class QueryExecution:
             return None
         if self.last_drain_at is not None:
             tl.client_drain_s = max(0.0, self.last_drain_at - self.ended_at)
+        if self.last_segment_fetch_at is not None:
+            # segment fetch/ack activity seen by this coordinator —
+            # refreshed per read, like client-drain (outside the wall)
+            tl.segment_fetch_s = max(
+                0.0, self.last_segment_fetch_at - self.ended_at)
         return tl.to_dict()
 
     def _timeline_now(self) -> dict:
@@ -1057,7 +1324,15 @@ class QueryExecution:
         # which control-plane path served the SELECT (fast-path /
         # distributed / local-catalog), for clients and system tables
         qs["fastPath"] = self.fast_path
-        qs["resultRows"] = len(self.rows)
+        qs["resultRows"] = self.result_rows()
+        # spooled result protocol: which producer wrote the segments
+        # (None = inline rows) + the manifest's footprint, for clients
+        # (CLI summary) and system tables
+        qs["spooled"] = self.spooled
+        if self.result_segments is not None:
+            qs["resultSegments"] = len(self.result_segments)
+            qs["resultSegmentBytes"] = sum(
+                int(e.get("bytes", 0)) for e in self.result_segments)
         # adaptive plan changes applied so far — rides every statement
         # response so clients can render "[adapted: N]" live
         qs["adaptations"] = len(self.plan_versions)
@@ -1370,6 +1645,7 @@ class QueryExecution:
                 frag, "skew_spread_partitions", None),
             skew_replicate_partitions=getattr(
                 frag, "skew_replicate_partitions", None),
+            spool_results=getattr(frag, "spool_results", False),
         )
         # trace-context propagation: the worker parents its task span under
         # the coordinator's current (schedule) span via this header
@@ -1553,17 +1829,52 @@ class QueryExecution:
         return up
 
     def _run_root_fragment(self, session, fragments):
+        from trino_tpu.exec.memory import page_bytes
+        from trino_tpu.obs import metrics as M
         from trino_tpu.server.task import FragmentExecutor
 
         root_frag = fragments[-1]
         assert root_frag.partitioning == "single"
+        # inline-result memory guard, applied DURING the gather: with
+        # spooling unavailable, a result past inline_result_max_bytes
+        # fails while pulling — before the coordinator has accumulated
+        # the whole columnar result in process memory (the post-gather
+        # check in _materialize_result only bounds the Python-row
+        # blowup). Scoped to the pass-through root shape, where gather
+        # bytes == result bytes exactly — a reducing root (single-step
+        # aggregation over gathered raw rows) may legitimately gather
+        # far more than it outputs. With spooling enabled there is no
+        # gather cap: the page is spooled from here, holding
+        # ~wire-sized arrays once.
+        budget = None
+        if (self._spool_config(session) is None
+                and self._gather_passthrough(root_frag) is not None):
+            budget = int(session.properties.get(
+                "inline_result_max_bytes", 256 << 20))
         remote_pages: Dict[int, list] = {}
         for node in P.walk_plan(root_frag.root):
             if isinstance(node, RemoteSourceNode):
                 client = ExchangeClient(self.fragment_tasks[node.fragment_id],
                                         tracer=self.tracer)
                 client.start()
-                remote_pages[node.fragment_id] = client.pages()
+                if budget is None:
+                    remote_pages[node.fragment_id] = client.pages()
+                    continue
+                pages, gathered = [], 0
+                for p in client.iter_pages():
+                    gathered += page_bytes(p)
+                    if gathered > budget:
+                        M.INLINE_RESULT_REJECTIONS.inc()
+                        raise RuntimeError(
+                            f"gathered result exceeds "
+                            f"inline_result_max_bytes={budget} while "
+                            "pulling the root fragment's input "
+                            "(INLINE_RESULT_TOO_LARGE) — enable "
+                            "spooled_results_enabled to serve it as a "
+                            "spooled segment manifest, or narrow the "
+                            "query")
+                    pages.append(p)
+                remote_pages[node.fragment_id] = pages
         ex = FragmentExecutor(session, {}, remote_pages)
         self._root_executor = ex  # EXPLAIN ANALYZE: the root stage's stats
         return ex.execute_checked(root_frag.root)
@@ -1729,6 +2040,13 @@ class CoordinatorServer:
         from trino_tpu.obs.flightrecorder import FlightRecorder
 
         self.recorder = FlightRecorder(node_id="coordinator")
+        # spooled result segments (server/segments.py): the coordinator's
+        # own store — coordinator-local/fast-path queries (and
+        # non-trivial-root distributed ones) spool here, so the protocol
+        # decision is plan-shape-independent
+        from trino_tpu.server.segments import SegmentStore
+
+        self.segments = SegmentStore(node_id="coordinator")
         # OTLP export (obs/otlp.py): on only when TRINO_TPU_OTLP_ENDPOINT
         # is set — completed queries' span trees ship to the collector
         # from a background batch exporter, never the query path
@@ -1779,6 +2097,7 @@ class CoordinatorServer:
         self.httpd.shutdown()
         self.httpd.server_close()
         self.dispatcher.shutdown()
+        self.segments.close()
         with self._io_pool_lock:
             pool, self._io_pool = self._io_pool, None
         if pool is not None:
@@ -1809,6 +2128,11 @@ class CoordinatorServer:
         execution.tracer.recorder = self.recorder
         execution.io_pool = self.io_pool
         execution.dispatcher = self.dispatcher
+        # spooled result protocol hookup + an opportunistic TTL sweep
+        # (rate-limited in the store) on the submit cadence
+        execution.segment_store = self.segments
+        execution.segment_base_url = self.base_url
+        self.segments.maybe_sweep()
         self.recorder.record("admission", "submitted", queryId=query_id,
                              user=user)
         with self._qlock:
@@ -1858,6 +2182,15 @@ class CoordinatorServer:
                     observe_phases(timeline)
             except Exception:  # noqa: BLE001 — the ledger is
                 pass  # observability, never a reason to disturb terminal
+            # a FAILED/CANCELED query's result segments will never be
+            # fetched — reclaim the coordinator-hosted ones now instead
+            # of waiting out the TTL (worker-hosted ones TTL out; their
+            # producing tasks normally abandoned them already)
+            if state != "FINISHED":
+                try:
+                    self.segments.drop_query(query_id)
+                except Exception:  # noqa: BLE001 — lifecycle best-effort
+                    pass
             # FAILED queries carry the flight-recorder postmortem —
             # normally captured by the query thread before the terminal
             # transition; a kill() from another thread captures here
@@ -2065,6 +2398,15 @@ def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) ->
         payload["addedPreparedStatements"] = dict(q.add_prepared)
     if q.deallocated_prepared:
         payload["deallocatedPreparedStatements"] = list(q.deallocated_prepared)
+    if q.result_segments is not None:
+        # spooled protocol: the response carries the segment MANIFEST —
+        # clients fetch the data from the producers' segment endpoints
+        # in parallel; this coordinator never pages the rows
+        q.last_drain_at = time.time()
+        payload["columns"] = [{"name": c} for c in q.columns]
+        payload["segments"] = [dict(e) for e in q.result_segments]
+        payload["spooled"] = q.spooled
+        return payload
     start = token * RESULT_PAGE_ROWS
     chunk = q.rows[start : start + RESULT_PAGE_ROWS]
     # client-drain bookkeeping for the phase ledger: the query's wall is
@@ -2339,6 +2681,22 @@ def _make_handler(server: CoordinatorServer):
                     return
                 self._send(200, json.dumps(q.info()).encode())
                 return
+            m = _SEGMENT_RE.match(self.path)
+            if m:
+                # coordinator-hosted spooled result segments: the id is
+                # an unguessable capability (the reference's pre-signed
+                # segment URI model), so no further gate is applied —
+                # range/ack semantics live in server/segments.py
+                from trino_tpu.server.segments import segment_response
+
+                sid = m.group(1)
+                q = server.get_query(sid.split(".", 1)[0])
+                if q is not None:
+                    q.last_segment_fetch_at = time.time()
+                status, body, seg_headers, ctype = segment_response(
+                    server.segments, sid, self.headers.get("Range"))
+                self._send(status, body, ctype, seg_headers)
+                return
             if self.path == "/v1/node":
                 self._send(200, json.dumps(server.registry.alive()).encode())
                 return
@@ -2365,6 +2723,29 @@ def _make_handler(server: CoordinatorServer):
                     return
                 if q is not None:
                     q.cancel()
+                self._send(204)
+                return
+            m = _SEGMENT_RE.match(self.path)
+            if m:
+                # segment ACK: data fetches go straight to the owning
+                # producer, but the tiny ack DELETE routes through the
+                # coordinator — it forwards worker-hosted deletes and
+                # stamps the query's segment-fetch clock either way
+                sid = m.group(1)
+                q = server.get_query(sid.split(".", 1)[0])
+                if q is not None:
+                    q.last_segment_fetch_at = time.time()
+                    worker = q._segment_workers.get(sid)
+                    if worker is not None:
+                        try:
+                            wire.http_request(
+                                "DELETE", f"{worker}/v1/segment/{sid}",
+                                timeout=10.0)
+                        except Exception:  # noqa: BLE001 — TTL backstop
+                            pass
+                        self._send(204)
+                        return
+                server.segments.ack(sid)
                 self._send(204)
                 return
             self._send(404)

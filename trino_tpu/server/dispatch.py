@@ -561,6 +561,12 @@ class ProcessExecutorPlane:
             return None  # the dispatch process owns the device
         if str(props.get("retry_policy", "NONE")).upper() == "TASK":
             return None
+        if str(props.get("spooled_results_enabled", "")).lower() in (
+                "true", "1"):
+            # a spooled manifest must point at a segment store the
+            # DISPATCH process serves — the child's statement protocol
+            # forwards rows, not segments, so these stay inline
+            return None
         m = _EXECUTE_RE.match(sql)
         if m:
             return f"execute:{execution.user}:{m.group(1).lower()}"

@@ -58,6 +58,12 @@ class TaskRequest:
     # for the exactness argument
     skew_spread_partitions: Optional[List[int]] = None
     skew_replicate_partitions: Optional[List[int]] = None
+    # spooled result protocol (server/segments.py): this task produces
+    # the query's RESULT — its output writes size-bounded segments into
+    # the worker's segment store instead of the output buffer, and the
+    # statement response carries their URIs (the coordinator never pulls
+    # the data). Set only on the root fragment's gather producers.
+    spool_results: bool = False
 
     def to_bytes(self) -> bytes:
         return pickle.dumps(self)
@@ -138,7 +144,7 @@ class SqlTask:
 
     def __init__(self, request: TaskRequest, session_factory,
                  traceparent: Optional[str] = None, recorder=None,
-                 otlp=None):
+                 otlp=None, segment_store=None):
         self.request = request
         self.state: StateMachine[str] = task_state_machine()
         # worker half of the query's trace: same trace id, spans rooted
@@ -163,6 +169,24 @@ class SqlTask:
         else:
             self.output = OutputBuffer(
                 request.consumer_count, max_buffer_bytes=sink_max)
+        # spooled result protocol: when this task produces the query's
+        # result, its serialized output chunks roll into size-bounded
+        # segments in the worker's segment store (server/segments.py)
+        # instead of the output buffer — the coordinator collects the
+        # segment metadata from task status and never pulls the data
+        self._result_writer = None
+        self.result_segments: List[dict] = []
+        if (request.spool_results and segment_store is not None
+                and request.output_partition_channels is None):
+            props = request.session_properties
+            from trino_tpu.server.segments import DEFAULT_SEGMENT_BYTES
+
+            self._result_writer = segment_store.writer(
+                request.query_id,
+                target_bytes=int(props.get("spooled_results_segment_bytes")
+                                 or DEFAULT_SEGMENT_BYTES),
+                ttl_s=int(props.get("result_segment_ttl_ms")
+                          or 300_000) / 1e3)
         self.failure: Optional[str] = None
         # peak device/host bytes observed by this task's executors — rolls
         # up into the worker announce for cluster memory management
@@ -295,6 +319,10 @@ class SqlTask:
         except Exception as e:  # noqa: BLE001 — reported through task status
             self.failure = f"{e}\n{traceback.format_exc()}"
             task_span.set("error", str(e).split("\n")[0][:300])
+            if self._result_writer is not None:
+                # no manifest will ever point at a failed attempt's
+                # segments — reclaim them now, not at TTL
+                self._result_writer.abandon()
             self.output.abort(str(e))
             self.state.set("FAILED")
         finally:
@@ -391,6 +419,21 @@ class SqlTask:
             for pid, frames in enumerate(part_frames):
                 for pb in frames:
                     self.output.enqueue_partition(pid, pb)
+            self.output.set_complete()
+            self.state.set("FINISHED")
+            return
+        if self._result_writer is not None:
+            # spooled result output: serialized chunks roll straight into
+            # size-bounded segments in the worker's segment store —
+            # nothing enters the output buffer, so this producer never
+            # parks on a consumer that, by design, is not coming
+            with tracing.span("segment/write") as sp:
+                for c in _chunk_pages(page, chunk_rows):
+                    self._result_writer.add(serialize_page(c),
+                                            int(c.num_rows))
+                self._finish_result_spool()
+                sp.set("segments", len(self.result_segments))
+                sp.set("rows", int(page.live_count()))
             self.output.set_complete()
             self.state.set("FINISHED")
             return
@@ -530,6 +573,21 @@ class SqlTask:
                 self.partition_rows[pid] += int(counts[pid])
         return parts
 
+    def _finish_result_spool(self) -> None:
+        """Seal the result-segment writer: roll the last partial segment
+        and publish the manifest metadata task status carries."""
+        if self._result_writer is None:
+            return
+        metas = self._result_writer.finish()
+        self.result_segments = [m.manifest_entry() for m in metas]
+
+    def _complete_output(self) -> None:
+        """Completion chokepoint for the streaming driver shapes: seal
+        the result spool (if this task produces the query's result),
+        then mark the buffer complete."""
+        self._finish_result_spool()
+        self.output.set_complete()
+
     def _enqueue_out(self, out: Page, part_channels, consumer_count) -> None:
         """Partition-aware enqueue of one output page (shared by the
         streaming paths: per-batch chains, per-split scans, and the fold
@@ -542,6 +600,16 @@ class SqlTask:
             self.output_rows += int(out.live_count())
             self.output_bytes += page_bytes(out)
         chunk_rows = self._chunk_rows(out)
+        if self._result_writer is not None and part_channels is None:
+            # spooled result output (streaming shapes): chunks roll into
+            # the segment store as they serialize — disk-bounded, so the
+            # stream loop never blocks on an output-buffer watermark
+            with tracing.span("segment/write") as sp:
+                for c in _chunk_pages(out, chunk_rows):
+                    self._result_writer.add(serialize_page(c),
+                                            int(c.num_rows))
+                sp.set("rows", int(out.live_count()))
+            return
         if part_channels is not None:
             for pid, part in enumerate(self._partition_pages(out)):
                 for c in _chunk_pages(part.compact(), chunk_rows):
@@ -587,7 +655,7 @@ class SqlTask:
             sp.set("splits", len(splits))
         M.DEVICE_SECONDS.inc(device_s)
         self.state.set("FLUSHING")
-        self.output.set_complete()
+        self._complete_output()
         self.state.set("FINISHED")
         return True
 
@@ -716,7 +784,7 @@ class SqlTask:
             M.DEVICE_SECONDS.inc(device_clock[0])
             self.state.set("FLUSHING")
             enqueue_out(out)
-            self.output.set_complete()
+            self._complete_output()
             self.state.set("FINISHED")
             return True
         batch: List[Page] = []
@@ -738,7 +806,7 @@ class SqlTask:
             sp.set("input_rows", in_rows)
         M.DEVICE_SECONDS.inc(device_clock[0])
         self.state.set("FLUSHING")
-        self.output.set_complete()
+        self._complete_output()
         self.state.set("FINISHED")
         return True
 
@@ -798,6 +866,9 @@ class SqlTask:
             "failure": self.failure,
             "bufferedBytes": self.output.buffered_bytes,
             "memoryBytes": self.memory_bytes,
+            # spooled result protocol: the segments this task wrote (the
+            # coordinator assembles the statement manifest from these)
+            "resultSegments": list(self.result_segments),
             # worker-reported stats ride every status response — the
             # coordinator's stage/query rollup reads them from its
             # status-polling loop (reference: TaskStatus carrying TaskStats)
@@ -898,7 +969,8 @@ class TaskManager:
     # (reference: SqlTaskManager's task info cache expiry)
     MAX_TASK_HISTORY = 200
 
-    def __init__(self, session_factory, recorder=None, otlp=None):
+    def __init__(self, session_factory, recorder=None, otlp=None,
+                 segment_store=None):
         self._tasks: Dict[str, SqlTask] = {}
         self._lock = threading.Lock()
         self._session_factory = session_factory
@@ -906,6 +978,9 @@ class TaskManager:
         # (obs/flightrecorder.FlightRecorder / obs/otlp.OtlpExporter)
         self._recorder = recorder
         self._otlp = otlp
+        # spooled result protocol: the store result-producing tasks
+        # (TaskRequest.spool_results) write their segments into
+        self._segment_store = segment_store
 
     def create_task(self, request: TaskRequest,
                     traceparent: Optional[str] = None) -> SqlTask:
@@ -917,7 +992,8 @@ class TaskManager:
             if task is None:
                 task = SqlTask(request, self._session_factory,
                                traceparent=traceparent,
-                               recorder=self._recorder, otlp=self._otlp)
+                               recorder=self._recorder, otlp=self._otlp,
+                               segment_store=self._segment_store)
                 self._tasks[request.task_id] = task
                 created = True
             else:

@@ -29,6 +29,7 @@ _TASK_RE = re.compile(r"^/v1/task/([^/]+)$")
 _STATUS_RE = re.compile(r"^/v1/task/([^/]+)/status$")
 _SPANS_RE = re.compile(r"^/v1/task/([^/]+)/spans$")
 _RECORDER_RE = re.compile(r"^/v1/task/([^/]+)/recorder$")
+_SEGMENT_RE = re.compile(r"^/v1/segment/([^/]+)$")
 
 
 def default_session_factory(properties):
@@ -78,9 +79,16 @@ class WorkerServer:
 
         self.otlp = _otlp.exporter_from_env(
             "trino-tpu-worker", instance_id=self.node_id)
+        # spooled result segments (server/segments.py): result-producing
+        # tasks write here; clients fetch via GET /v1/segment/{id} —
+        # the worker IS the data plane, the coordinator never relays
+        from trino_tpu.server.segments import SegmentStore
+
+        self.segments = SegmentStore(node_id=self.node_id)
         self.tasks = TaskManager(
             session_factory or shared_catalog_session_factory(),
-            recorder=self.recorder, otlp=self.otlp)
+            recorder=self.recorder, otlp=self.otlp,
+            segment_store=self.segments)
         self.coordinator_url = coordinator_url
         # per-worker memory pool size (reference: memory.heap-headroom /
         # query.max-memory-per-node config); None = unlimited
@@ -105,6 +113,7 @@ class WorkerServer:
         self._stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.segments.close()
         if self.otlp is not None:
             # flush + stop the exporter thread: a stopped instance must
             # not keep reporting metrics under its service.instance.id
@@ -115,6 +124,12 @@ class WorkerServer:
         DiscoveryNodeManager polls announcements; HeartbeatFailureDetector
         pings — here the worker pushes, the coordinator ages entries out)."""
         while not self._stop.is_set():
+            # piggyback the result-segment TTL sweep on the announce
+            # cadence (rate-limited inside the store)
+            try:
+                self.segments.maybe_sweep()
+            except Exception:  # noqa: BLE001 — lifecycle is best-effort
+                pass
             try:
                 from trino_tpu import __version__, devcache
 
@@ -219,6 +234,19 @@ def _make_handler(server: WorkerServer):
             return False
 
         def do_GET(self):
+            m = _SEGMENT_RE.match(self.path)
+            if m:
+                # spooled result segments: NO cluster HMAC — the id is an
+                # unguessable capability and the caller is an external
+                # protocol client (the reference's pre-signed segment
+                # URI model); range/ack semantics live in segments.py
+                from trino_tpu.server.segments import segment_response
+
+                status, body, headers, ctype = segment_response(
+                    server.segments, m.group(1),
+                    self.headers.get("Range"))
+                self._send(status, body, ctype, headers)
+                return
             m = _RESULTS_RE.match(self.path)
             if m:
                 if not self._authorized():
@@ -291,6 +319,14 @@ def _make_handler(server: WorkerServer):
             self._send(404)
 
         def do_DELETE(self):
+            m = _SEGMENT_RE.match(self.path)
+            if m:
+                # client ack: the segment was fetched — delete it now
+                # instead of waiting out the TTL (idempotent: a repeated
+                # ack of a gone segment is still a 204)
+                server.segments.ack(m.group(1))
+                self._send(204)
+                return
             m = _RESULTS_RE.match(self.path)
             if m:
                 if not self._authorized():
