@@ -1,0 +1,241 @@
+"""Pipelined cold-staging microbench: serial vs pipelined, host-tier refill.
+
+The cold q3-shaped staging path (TPC-H Q3's three scans with phase-1
+dynamic-filter domains applied, the exact shape BENCH_r05 measured at
+22.7 s staging for q3_sf10) run three ways through the staging engine
+(trino_tpu/exec/staging.py):
+
+- **serial** — ``staging_parallelism=1``: the sequential
+  scan→decode→transfer loop (the pre-pipeline code path, preserved as the
+  engine's width-1 degenerate case);
+- **pipelined** — the fan-out over the shared staging pool with
+  double-buffered blocked transfer; staged arrays are asserted
+  BIT-IDENTICAL to the serial arm's;
+- **host refill** — the HBM tier is evicted while the host-RAM columnar
+  cache stays warm: staging must rebuild the device pages with ZERO
+  connector scan calls, the cold-path tax an eviction used to re-pay.
+
+Caches (gencache, host tier, HBM tier) are cleared between the cold arms
+so each pays the full connector scan+decode.
+
+Writes ``STAGING_r01.json`` (folded into TRAJECTORY.json by
+``tools/bench_trend.py``). ``--check`` runs a quick small-schema pass as
+the tier-1 regression gate (tests/test_staging.py::test_staging_bench_check):
+bit-identity, zero-connector-call refill above the speedup floor, and the
+pipelined arm never slower than serial beyond tolerance. The ≥2x
+pipelined-over-serial acceptance bound is asserted only on multi-core
+boxes — like ``microbench/qps.py``'s documented single-core carve-out, a
+1-vCPU box timeshares the scan threads and can only prove bit-identity,
+refill, and not-slower there (the overlap fraction is recorded either
+way; the hardware round re-measures on a real host).
+
+Run: python microbench/staging.py [tpch_schema]   (default sf2)
+     python microbench/staging.py --check         (quick gate, sf0.2)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# self-locate the repo (PYTHONPATH must not be used on TPU runs)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MIN_SPEEDUP = 2.0          # pipelined vs serial, multi-core acceptance
+MIN_REFILL_SPEEDUP = 2.5   # host refill vs cold connector re-scan (gate)
+FULL_REFILL_SPEEDUP = 5.0  # the r01 acceptance bound at sf>=2
+MAX_SLOWDOWN = 1.3         # pipelined must never exceed serial by this
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+
+def _clear_caches():
+    from trino_tpu.connector.tpch import generator
+    from trino_tpu.devcache import DEVICE_CACHE, HOST_CACHE
+
+    DEVICE_CACHE.invalidate_all()
+    HOST_CACHE.invalidate_all()
+    generator._gen_cache.clear()
+
+
+def _session(schema: str, parallelism: int):
+    from trino_tpu.client.session import Session
+
+    return Session({"catalog": "tpch", "schema": schema,
+                    "device_cache_enabled": True,
+                    "staging_parallelism": parallelism})
+
+
+def _stage_q3(session, count_scans=False):
+    """Stage Q3's three scans exactly as the compiled tier would (phase-1
+    dynamic-filter domains applied host-side), through the pipelined
+    engine. Returns (pages by table, staging wall seconds, profiles,
+    connector scan calls)."""
+    from trino_tpu.exec import host_eval, staging
+    from trino_tpu.exec.executor import (
+        apply_dynamic_domains, dynamic_domain_map, scan_constraint_with)
+    from trino_tpu.exec.query import plan_sql
+    from trino_tpu.sql.planner import plan as P
+
+    root = plan_sql(session, Q3)
+    dyn = host_eval.resolve_dynamic_filters(session, root)
+    scans = [n for n in P.walk_plan(root) if isinstance(n, P.TableScanNode)]
+    conn = session.catalogs["tpch"]
+    calls = [0]
+    if count_scans:
+        inner = conn.scan
+
+        def counted(split, columns, constraint=None):
+            calls[0] += 1
+            return inner(split, columns, constraint=constraint)
+
+        conn.scan = counted
+    pages, profiles = {}, {}
+    t0 = time.perf_counter()
+    try:
+        for node in scans:
+            constraint = scan_constraint_with(node, dyn)
+            target = staging.target_split_count(
+                session, conn, node.schema, node.table)
+            splits = conn.get_splits(
+                node.schema, node.table, target, constraint=constraint,
+                handle=node.table_handle)
+
+            def prune(datas, node=node):
+                return apply_dynamic_domains(node, dyn, datas)
+
+            page, _rows, prof = staging.staged_scan_page(
+                session, node, conn, splits, constraint, prune=prune,
+                applied_domains=dynamic_domain_map(node, dyn))
+            for c in page.columns:
+                c.values.block_until_ready()
+            pages[node.table] = page
+            profiles[node.table] = prof
+    finally:
+        if count_scans:
+            conn.scan = type(conn).scan.__get__(conn)
+    return pages, time.perf_counter() - t0, profiles, calls[0]
+
+
+def _page_arrays(page):
+    out = []
+    for c in page.columns:
+        out.append(np.asarray(c.values))
+        out.append(None if c.nulls is None else np.asarray(c.nulls))
+    return out
+
+
+def _assert_identical(a_pages, b_pages, label):
+    for table in a_pages:
+        for x, y in zip(_page_arrays(a_pages[table]),
+                        _page_arrays(b_pages[table])):
+            if x is None or y is None:
+                assert x is None and y is None, (label, table)
+                continue
+            assert x.dtype == y.dtype and x.shape == y.shape, (
+                label, table, x.dtype, y.dtype, x.shape, y.shape)
+            assert np.array_equal(x, y), f"{label}: {table} diverged"
+
+
+def run(schema: str, check_mode: bool) -> dict:
+    cores = os.cpu_count() or 1
+
+    _clear_caches()
+    serial_pages, serial_s, _prof, _ = _stage_q3(_session(schema, 1))
+
+    _clear_caches()
+    pipe_session = _session(schema, 0)  # auto width
+    pipe_pages, pipelined_s, profiles, _ = _stage_q3(pipe_session)
+    _assert_identical(serial_pages, pipe_pages, "pipelined-vs-serial")
+
+    splits = sum(p.splits for p in profiles.values())
+    fanout = sum(p.fanout_wall_s for p in profiles.values())
+    busy = sum(p.scan_s + p.prune_s for p in profiles.values())
+    overlap = round(busy / fanout, 3) if fanout else 0.0
+
+    # host refill: evict the HBM tier only; the warm host tier must
+    # rebuild the device pages without a single connector scan call
+    from trino_tpu.devcache import DEVICE_CACHE, HOST_CACHE
+
+    DEVICE_CACHE.invalidate_all()
+    assert HOST_CACHE.cached_bytes() > 0, "host tier not filled"
+    refill_pages, refill_s, _p, refill_scans = _stage_q3(
+        pipe_session, count_scans=True)
+    _assert_identical(pipe_pages, refill_pages, "refill-vs-cold")
+
+    report = {
+        "round": 1,
+        "tpch_schema": schema,
+        "cores": cores,
+        "single_core": cores == 1,
+        "splits": int(splits),
+        "serial_s": round(serial_s, 4),
+        "pipelined_s": round(pipelined_s, 4),
+        "pipelined_speedup": round(serial_s / pipelined_s, 4)
+        if pipelined_s else 0.0,
+        "overlap_fraction": overlap,
+        "host_refill_s": round(refill_s, 4),
+        "refill_speedup": round(pipelined_s / refill_s, 4)
+        if refill_s else 0.0,
+        "refill_connector_scans": int(refill_scans),
+        "host_cache_bytes": HOST_CACHE.cached_bytes(),
+        "min_speedup": MIN_SPEEDUP,
+        "min_refill_speedup": (MIN_REFILL_SPEEDUP if check_mode
+                               else FULL_REFILL_SPEEDUP),
+    }
+
+    assert refill_scans == 0, "host refill touched the connector"
+    bound = MIN_REFILL_SPEEDUP if check_mode else FULL_REFILL_SPEEDUP
+    assert report["refill_speedup"] >= bound, (
+        f"host refill {refill_s:.3f}s not {bound}x faster than cold "
+        f"{pipelined_s:.3f}s")
+    assert pipelined_s <= serial_s * MAX_SLOWDOWN, (
+        f"pipelined {pipelined_s:.3f}s slower than serial {serial_s:.3f}s")
+    if cores >= 4:
+        assert report["pipelined_speedup"] >= MIN_SPEEDUP, (
+            f"pipelined speedup {report['pipelined_speedup']} < "
+            f"{MIN_SPEEDUP}x on a {cores}-core box")
+    return report
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    check_mode = "--check" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    schema = args[0] if args else ("sf0.2" if check_mode else "sf2")
+    report = run(schema, check_mode)
+    print(json.dumps(report, indent=2))
+    if check_mode:
+        print(f"staging-check ok: serial {report['serial_s']}s, "
+              f"pipelined {report['pipelined_s']}s, refill "
+              f"{report['host_refill_s']}s over {report['splits']} splits")
+        return
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "STAGING_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out_path}: cold {report['pipelined_s']}s "
+          f"({report['pipelined_speedup']}x vs serial, overlap "
+          f"{report['overlap_fraction']}), host refill "
+          f"{report['host_refill_s']}s ({report['refill_speedup']}x)")
+
+
+if __name__ == "__main__":
+    main()

@@ -248,6 +248,28 @@ def _extract_multichip(path: str) -> List[dict]:
                    1.0 if ok else 0.0, "bool", "up", path)]
 
 
+def _extract_staging(path: str) -> List[dict]:
+    """STAGING_r*.json: the cold-path curve — cold pipelined staging wall
+    for the q3 shape, the pipelined-vs-serial speedup and overlap
+    fraction, and the host-tier refill speedup. splits/cores/schema stay
+    OUT of the trajectory: they describe the setup, not performance."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    rnd = int(data.get("round", _round_of(path)))
+    out: List[dict] = []
+    for metric, unit, direction in (
+            ("serial_s", "s", "down"),
+            ("pipelined_s", "s", "down"),
+            ("pipelined_speedup", "x", "up"),
+            ("overlap_fraction", "fraction", "up"),
+            ("host_refill_s", "s", "down"),
+            ("refill_speedup", "x", "up")):
+        if data.get(metric) is not None:
+            out.append(_entry("staging", rnd, metric, data[metric], unit,
+                              direction, path))
+    return out
+
+
 _FAMILIES = (
     ("BENCH_r*.json", _extract_bench),
     ("QPS_r*.json", _extract_qps),
@@ -256,6 +278,7 @@ _FAMILIES = (
     ("SKEWJOIN.json", _extract_skewjoin),
     ("MULTICHIP_r*.json", _extract_multichip),
     ("RESULTS_r*.json", _extract_results),
+    ("STAGING_r*.json", _extract_staging),
 )
 
 

@@ -162,6 +162,33 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 1 << 30, _positive,
         ),
         PropertyMetadata(
+            "staging_parallelism",
+            "fan-out width of the pipelined staging engine "
+            "(exec/staging.py): split scan+decode run with this many in "
+            "flight on the shared staging pool, overlapping the "
+            "host->device transfer; 1 = the serial path (the microbench "
+            "baseline), 0 = auto (min(8, cpu count))",
+            int, 0, lambda v: None if v >= 0 else "must be >= 0",
+        ),
+        PropertyMetadata(
+            "staging_split_bytes",
+            "target estimated bytes per scan split: staging derives its "
+            "get_splits target from estimated table bytes / this, so "
+            "tiny tables stay single-split (no fan-out overhead) and "
+            "huge tables parallelize (adaptive split sizing, "
+            "exec/staging.py)",
+            int, 64 << 20, _positive,
+        ),
+        PropertyMetadata(
+            "host_cache_max_bytes",
+            "per-split admission cap against the host-RAM columnar page "
+            "cache (trino_tpu/devcache/hostcache.py): decoded split "
+            "column sets above min(this, the server-wide budget) are "
+            "staged but not retained (the shared budget itself is fixed "
+            "at process scope — one session cannot resize it)",
+            int, 256 << 20, _positive,
+        ),
+        PropertyMetadata(
             "fused_join_enabled",
             "run N:1 lookup joins and semi/anti membership through the "
             "fused sort-merge tier (ops/fused_join.py): build and probe "

@@ -51,6 +51,14 @@ class GenCache:
         with self._lock:
             return self._bytes
 
+    def clear(self) -> None:
+        """Drop every cached range (benchmark isolation: a cold-staging
+        arm must not be served a previous arm's generated columns)."""
+        with self._lock:
+            self._entries.clear()
+            self._entry_bytes.clear()
+            self._bytes = 0
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

@@ -31,19 +31,21 @@ __all__ = ["SystemConnector", "SYSTEM_CATALOG", "SYSTEM_TABLES",
 
 
 def device_cache_rows() -> List[tuple]:
-    """THIS process's device-table-cache entries as
+    """THIS process's staged-table cache entries as
     ``system.runtime.device_cache`` rows (column order:
-    connector/system/schemas.py). The pool is process-global, so the
-    coordinator provider and the providerless fallback (a standalone
-    session, or a worker inspecting itself) share this one
-    materializer."""
-    from trino_tpu.devcache import DEVICE_CACHE
+    connector/system/schemas.py): the warm-HBM pool (tier='hbm') plus the
+    host-RAM columnar tier under it (tier='host'). The pools are
+    process-global, so the coordinator provider and the providerless
+    fallback (a standalone session, or a worker inspecting itself) share
+    this one materializer."""
+    from trino_tpu.devcache import DEVICE_CACHE, HOST_CACHE
 
     return [
         (e["catalog"], e["schema"], e["table"], e["version"], e["shard"],
          e["signature"], int(e["bytes"]), int(e["rows"]), int(e["hits"]),
-         float(e["createdAt"]), float(e["lastUsedAt"]))
-        for e in DEVICE_CACHE.snapshot()
+         float(e["createdAt"]), float(e["lastUsedAt"]), tier)
+        for tier, pool in (("hbm", DEVICE_CACHE), ("host", HOST_CACHE))
+        for e in pool.snapshot()
     ]
 
 

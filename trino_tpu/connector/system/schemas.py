@@ -115,22 +115,30 @@ SYSTEM_TABLES = {
                                             # when not discoverable (CPU)
         ("device_cache_bytes", "bigint"),   # warm-table bytes (revocable)
         ("heartbeat_age_ms", "bigint"),
+        ("host_cache_bytes", "bigint"),     # host-RAM columnar tier bytes
+                                            # (second revocable tier —
+                                            # sheds before the HBM tier)
+        ("host_cache_hits", "bigint"),      # lifetime host-tier hits
     ),
-    # the device table cache (trino_tpu/devcache/): one row per resident
-    # warm-HBM entry of THIS process's pool (the coordinator's when a
-    # provider is attached; any process can inspect its own)
+    # the staged-table caches (trino_tpu/devcache/): one row per resident
+    # entry of THIS process's pools — the warm-HBM tier (tier='hbm') and
+    # the host-RAM columnar tier under it (tier='host', per-split decoded
+    # column sets) — the coordinator's when a provider is attached; any
+    # process can inspect its own
     ("runtime", "device_cache"): (
         ("catalog", "varchar"),
         ("schema_name", "varchar"),
         ("table_name", "varchar"),
         ("data_version", "varchar"),
-        ("shard", "varchar"),          # table | splits:N:... | spmd:N
+        ("shard", "varchar"),          # table | splits:N:... | spmd:N |
+                                       # host:splits:1:... (host tier)
         ("signature", "varchar"),      # projection/pruning digest
         ("entry_bytes", "bigint"),
         ("rows", "bigint"),
         ("hits", "bigint"),
         ("created_at", "double"),      # epoch seconds
         ("last_used_at", "double"),
+        ("tier", "varchar"),           # hbm | host
     ),
     # every touched series of the typed metrics registry as rows — the jmx
     # connector's role; /v1/metrics stays the Prometheus surface

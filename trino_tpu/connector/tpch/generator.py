@@ -33,17 +33,21 @@ _M2 = np.uint64(0x94D049BB133111EB)
 
 
 def _mix(x: np.ndarray) -> np.ndarray:
+    # operands are already uint64 (the _stream contract): the wrapping
+    # arithmetic stays uint64 end to end, so no .astype copies — the old
+    # per-round astype was 3 full-array copies per draw, a measurable
+    # slice of cold staging at sf>=2
     with np.errstate(over="ignore"):
-        x = (x + _GOLDEN).astype(np.uint64)
-        x = ((x ^ (x >> np.uint64(30))) * _M1).astype(np.uint64)
-        x = ((x ^ (x >> np.uint64(27))) * _M2).astype(np.uint64)
+        x = x + _GOLDEN
+        x = (x ^ (x >> np.uint64(30))) * _M1
+        x = (x ^ (x >> np.uint64(27))) * _M2
         return x ^ (x >> np.uint64(31))
 
 
 def _stream(tag: int, idx: np.ndarray) -> np.ndarray:
     """Independent uniform u64 stream ``tag`` evaluated at positions ``idx``."""
     with np.errstate(over="ignore"):
-        base = (np.uint64(tag) * np.uint64(0xD6E8FEB86659FD93)).astype(np.uint64)
+        base = np.uint64(tag) * np.uint64(0xD6E8FEB86659FD93)
         return _mix(base ^ idx.astype(np.uint64))
 
 
@@ -265,6 +269,19 @@ def _phone(nation: np.ndarray, tag: int, idx: np.ndarray) -> ColumnData:
     return ColumnData(T.varchar(), values=d.encode(strs), dictionary=d)
 
 
+def _memo1(fn):
+    """One-draw memo: two columns built from the SAME random draw (e.g.
+    nationkey + phone) share one materialization per build call."""
+    cell = []
+
+    def get():
+        if not cell:
+            cell.append(fn())
+        return cell[0]
+
+    return get
+
+
 def _retail_price_scaled(partkey: np.ndarray) -> np.ndarray:
     # spec 4.2.3: retailprice = (90000 + (partkey/10 mod 20001) + 100*(partkey mod 1000)) / 100
     return (90000 + (partkey // 10) % 20001 + 100 * (partkey % 1000)).astype(np.int64)
@@ -325,91 +342,134 @@ def _generate(table: str, sf: float, lo: int, hi: int, need) -> Dict[str, Column
     if table == "supplier":
         keys = np.arange(lo + 1, hi + 1, dtype=np.int64)
         idx = keys.astype(np.uint64)
-        nation = _randint(201, idx, 0, 24)
-        pool = list(_generic_comment_pool())
-        # spec: 5 suppliers per SF*10k get Customer Complaints, 5 get Recommends
-        pool = pool + [
-            "the furiously express Customer accounts detect Complaints",
-            "blithely special packages wake Customer Recommends quickly",
-        ]
-        comment_codes = np.asarray(_stream(205, idx) % np.uint64(1024), dtype=np.int64)
-        complaints = _stream(206, idx) % np.uint64(2000) == 0
-        recommends = _stream(207, idx) % np.uint64(2000) == 1
-        comment_codes = np.where(complaints, 1024, np.where(recommends, 1025, comment_codes))
-        return {
-            "s_suppkey": ColumnData(T.BIGINT, keys),
-            "s_name": _keyed_name_col("Supplier", keys, lo + 1, hi + 1),
-            "s_address": _pool_comment_col(_generic_comment_pool(), 202, idx),
-            "s_nationkey": ColumnData(T.BIGINT, nation),
-            "s_phone": _phone(nation, 210, idx),
-            "s_acctbal": _dec(_randint(203, idx, -99999, 999999)),
-            "s_comment": _vocab_col(pool, comment_codes.astype(np.int32)),
+
+        def _s_comment():
+            pool = list(_generic_comment_pool())
+            # spec: 5 suppliers per SF*10k get Customer Complaints, 5 get
+            # Recommends
+            pool = pool + [
+                "the furiously express Customer accounts detect Complaints",
+                "blithely special packages wake Customer Recommends quickly",
+            ]
+            codes = np.asarray(_stream(205, idx) % np.uint64(1024), dtype=np.int64)
+            complaints = _stream(206, idx) % np.uint64(2000) == 0
+            recommends = _stream(207, idx) % np.uint64(2000) == 1
+            codes = np.where(complaints, 1024, np.where(recommends, 1025, codes))
+            return _vocab_col(pool, codes.astype(np.int32))
+
+        # shared between s_nationkey and s_phone: one draw, not two
+        _nation = _memo1(lambda: _randint(201, idx, 0, 24))
+
+        builders = {
+            "s_suppkey": lambda: ColumnData(T.BIGINT, keys),
+            "s_name": lambda: _keyed_name_col("Supplier", keys, lo + 1, hi + 1),
+            "s_address": lambda: _pool_comment_col(_generic_comment_pool(), 202, idx),
+            "s_nationkey": lambda: ColumnData(T.BIGINT, _nation()),
+            "s_phone": lambda: _phone(_nation(), 210, idx),
+            "s_acctbal": lambda: _dec(_randint(203, idx, -99999, 999999)),
+            "s_comment": _s_comment,
         }
+        return {c: b() for c, b in builders.items() if c in need}
     if table == "customer":
+        # generation honors ``need`` here exactly like orders/lineitem —
+        # a q3-shaped scan (c_custkey, c_mktsegment) must not pay the
+        # Python-heavy phone/name/address/comment synthesis it projects
+        # away (pre-scan projection: the staging pipeline's "only needed
+        # columns cross" rule applied at the source)
         keys = np.arange(lo + 1, hi + 1, dtype=np.int64)
         idx = keys.astype(np.uint64)
-        nation = _randint(301, idx, 0, 24)
-        seg = np.asarray(_stream(302, idx) % np.uint64(5), dtype=np.int64)
-        return {
-            "c_custkey": ColumnData(T.BIGINT, keys),
-            "c_name": _keyed_name_col("Customer", keys, lo + 1, hi + 1),
-            "c_address": _pool_comment_col(_generic_comment_pool(), 303, idx),
-            "c_nationkey": ColumnData(T.BIGINT, nation),
-            "c_phone": _phone(nation, 310, idx),
-            "c_acctbal": _dec(_randint(304, idx, -99999, 999999)),
-            "c_mktsegment": _vocab_col(MKT_SEGMENTS, seg.astype(np.int32)),
-            "c_comment": _pool_comment_col(_generic_comment_pool(), 305, idx),
+
+        def _c_mktsegment():
+            seg = np.asarray(_stream(302, idx) % np.uint64(5), dtype=np.int64)
+            return _vocab_col(MKT_SEGMENTS, seg.astype(np.int32))
+
+        # shared between c_nationkey and c_phone: one draw, not two
+        _nation = _memo1(lambda: _randint(301, idx, 0, 24))
+
+        builders = {
+            "c_custkey": lambda: ColumnData(T.BIGINT, keys),
+            "c_name": lambda: _keyed_name_col("Customer", keys, lo + 1, hi + 1),
+            "c_address": lambda: _pool_comment_col(_generic_comment_pool(), 303, idx),
+            "c_nationkey": lambda: ColumnData(T.BIGINT, _nation()),
+            "c_phone": lambda: _phone(_nation(), 310, idx),
+            "c_acctbal": lambda: _dec(_randint(304, idx, -99999, 999999)),
+            "c_mktsegment": _c_mktsegment,
+            "c_comment": lambda: _pool_comment_col(_generic_comment_pool(), 305, idx),
         }
+        return {c: b() for c, b in builders.items() if c in need}
     if table == "part":
         keys = np.arange(lo + 1, hi + 1, dtype=np.int64)
         idx = keys.astype(np.uint64)
-        w1 = np.asarray(_stream(401, idx) % np.uint64(92), dtype=np.int64)
-        w2 = np.asarray(_stream(402, idx) % np.uint64(92), dtype=np.int64)
-        # p_name: two color words (dbgen uses five; bounded-vocab deviation)
-        name_codes = (w1 * 92 + w2).astype(np.int64)
-        name_vocab = [f"{a} {b}" for a in PART_COLORS for b in PART_COLORS]
-        m = _randint(403, idx, 1, 5)
-        n = _randint(404, idx, 1, 5)
-        brand_codes = ((m - 1) * 5 + (n - 1)).astype(np.int64)
-        brand_vocab = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
-        t1 = np.asarray(_stream(405, idx) % np.uint64(6), dtype=np.int64)
-        t2 = np.asarray(_stream(406, idx) % np.uint64(5), dtype=np.int64)
-        t3 = np.asarray(_stream(407, idx) % np.uint64(5), dtype=np.int64)
-        type_vocab = [
-            f"{a} {b} {c}" for a in TYPE_SYLLABLE1 for b in TYPE_SYLLABLE2 for c in TYPE_SYLLABLE3
-        ]
-        type_codes = (t1 * 25 + t2 * 5 + t3).astype(np.int64)
-        c1 = np.asarray(_stream(408, idx) % np.uint64(5), dtype=np.int64)
-        c2 = np.asarray(_stream(409, idx) % np.uint64(8), dtype=np.int64)
-        cont_vocab = [f"{a} {b}" for a in CONTAINER_SYLLABLE1 for b in CONTAINER_SYLLABLE2]
-        cont_codes = (c1 * 8 + c2).astype(np.int64)
-        mfgr_vocab = [f"Manufacturer#{i}" for i in range(1, 6)]
-        return {
-            "p_partkey": ColumnData(T.BIGINT, keys),
-            "p_name": _vocab_col(name_vocab, name_codes.astype(np.int32)),
-            "p_mfgr": _vocab_col(mfgr_vocab, (m - 1).astype(np.int32)),
-            "p_brand": _vocab_col(brand_vocab, brand_codes.astype(np.int32)),
-            "p_type": _vocab_col(type_vocab, type_codes.astype(np.int32)),
-            "p_size": ColumnData(T.INTEGER, _randint(410, idx, 1, 50).astype(np.int32)),
-            "p_container": _vocab_col(cont_vocab, cont_codes.astype(np.int32)),
-            "p_retailprice": _dec(_retail_price_scaled(keys)),
-            "p_comment": _pool_comment_col(_generic_comment_pool(), 411, idx),
+
+        def _p_name():
+            w1 = np.asarray(_stream(401, idx) % np.uint64(92), dtype=np.int64)
+            w2 = np.asarray(_stream(402, idx) % np.uint64(92), dtype=np.int64)
+            # p_name: two color words (dbgen uses five; bounded-vocab
+            # deviation)
+            vocab = [f"{a} {b}" for a in PART_COLORS for b in PART_COLORS]
+            return _vocab_col(vocab, (w1 * 92 + w2).astype(np.int32))
+
+        # shared between p_mfgr and p_brand: one draw, not two
+        _m = _memo1(lambda: _randint(403, idx, 1, 5))
+
+        def _p_mfgr():
+            vocab = [f"Manufacturer#{i}" for i in range(1, 6)]
+            return _vocab_col(vocab, (_m() - 1).astype(np.int32))
+
+        def _p_brand():
+            n = _randint(404, idx, 1, 5)
+            vocab = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
+            return _vocab_col(vocab, ((_m() - 1) * 5 + (n - 1)).astype(np.int32))
+
+        def _p_type():
+            t1 = np.asarray(_stream(405, idx) % np.uint64(6), dtype=np.int64)
+            t2 = np.asarray(_stream(406, idx) % np.uint64(5), dtype=np.int64)
+            t3 = np.asarray(_stream(407, idx) % np.uint64(5), dtype=np.int64)
+            vocab = [
+                f"{a} {b} {c}" for a in TYPE_SYLLABLE1 for b in TYPE_SYLLABLE2 for c in TYPE_SYLLABLE3
+            ]
+            return _vocab_col(vocab, (t1 * 25 + t2 * 5 + t3).astype(np.int32))
+
+        def _p_container():
+            c1 = np.asarray(_stream(408, idx) % np.uint64(5), dtype=np.int64)
+            c2 = np.asarray(_stream(409, idx) % np.uint64(8), dtype=np.int64)
+            vocab = [f"{a} {b}" for a in CONTAINER_SYLLABLE1 for b in CONTAINER_SYLLABLE2]
+            return _vocab_col(vocab, (c1 * 8 + c2).astype(np.int32))
+
+        builders = {
+            "p_partkey": lambda: ColumnData(T.BIGINT, keys),
+            "p_name": _p_name,
+            "p_mfgr": _p_mfgr,
+            "p_brand": _p_brand,
+            "p_type": _p_type,
+            "p_size": lambda: ColumnData(
+                T.INTEGER, _randint(410, idx, 1, 50).astype(np.int32)),
+            "p_container": _p_container,
+            "p_retailprice": lambda: _dec(_retail_price_scaled(keys)),
+            "p_comment": lambda: _pool_comment_col(_generic_comment_pool(), 411, idx),
         }
+        return {c: b() for c, b in builders.items() if c in need}
     if table == "partsupp":
-        scount = table_row_count("supplier", sf)
         rows = np.arange(lo, hi, dtype=np.int64)
         part = rows // 4 + 1
-        i = rows % 4
-        # spec 4.2.3: ps_suppkey spread so joins distribute evenly
-        supp = (part + i * (scount // 4 + (part - 1) // scount)) % scount + 1
         idx = rows.astype(np.uint64)
-        return {
-            "ps_partkey": ColumnData(T.BIGINT, part),
-            "ps_suppkey": ColumnData(T.BIGINT, supp.astype(np.int64)),
-            "ps_availqty": ColumnData(T.INTEGER, _randint(501, idx, 1, 9999).astype(np.int32)),
-            "ps_supplycost": _dec(_randint(502, idx, 100, 100000)),
-            "ps_comment": _pool_comment_col(_generic_comment_pool(), 503, idx),
+
+        def _ps_suppkey():
+            scount = table_row_count("supplier", sf)
+            i = rows % 4
+            # spec 4.2.3: ps_suppkey spread so joins distribute evenly
+            supp = (part + i * (scount // 4 + (part - 1) // scount)) % scount + 1
+            return ColumnData(T.BIGINT, supp.astype(np.int64))
+
+        builders = {
+            "ps_partkey": lambda: ColumnData(T.BIGINT, part),
+            "ps_suppkey": _ps_suppkey,
+            "ps_availqty": lambda: ColumnData(
+                T.INTEGER, _randint(501, idx, 1, 9999).astype(np.int32)),
+            "ps_supplycost": lambda: _dec(_randint(502, idx, 100, 100000)),
+            "ps_comment": lambda: _pool_comment_col(_generic_comment_pool(), 503, idx),
         }
+        return {c: b() for c, b in builders.items() if c in need}
     raise KeyError(table)
 
 

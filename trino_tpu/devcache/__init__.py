@@ -1,22 +1,29 @@
-"""Device-resident table cache (warm-HBM buffer pool).
+"""Device-resident table cache (warm-HBM buffer pool) + host-RAM tier.
 
-Public surface: the process-global :data:`DEVICE_CACHE` pool, the key
-constructors consulted by the three staging tiers (eager/compiled scans
-in ``exec/executor.py``, worker fragment scans in ``server/task.py``,
-SPMD sharded staging in ``parallel/spmd.py``), and the device-memory
-capacity probe the worker announce payload ships to the coordinator's
-``ClusterMemoryManager``.
+Public surface: the process-global :data:`DEVICE_CACHE` pool and the
+:data:`HOST_CACHE` tier under it (``devcache/hostcache.py``: decoded
+per-split numpy column sets, same key/flight/invalidation semantics), the
+key constructors consulted by the three staging tiers (eager/compiled
+scans in ``exec/executor.py``, worker fragment scans in
+``server/task.py``, SPMD sharded staging in ``parallel/spmd.py``), and
+the device-memory capacity probe the worker announce payload ships to the
+coordinator's ``ClusterMemoryManager``.
 """
 from trino_tpu.devcache.cache import (
     DEVICE_CACHE, CacheEntry, CacheKey, DeviceTableCache,
     device_memory_bytes, instance_token)
+from trino_tpu.devcache.hostcache import (
+    HOST_CACHE, HostColumnCache, host_admit_budget, shed_revocable,
+    split_data_bytes)
 from trino_tpu.devcache.keys import (
-    admit_budget, cache_enabled, cached_build, cached_stage, scan_cache_key,
-    scan_signature, splits_shard)
+    admit_budget, cache_enabled, cached_build, cached_stage,
+    host_split_keys, scan_cache_key, scan_signature, splits_shard)
 
 __all__ = [
     "DEVICE_CACHE", "CacheEntry", "CacheKey", "DeviceTableCache",
-    "admit_budget", "cache_enabled", "cached_build", "cached_stage",
-    "device_memory_bytes", "instance_token", "scan_cache_key",
-    "scan_signature", "splits_shard",
+    "HOST_CACHE", "HostColumnCache", "admit_budget", "cache_enabled",
+    "cached_build", "cached_stage", "device_memory_bytes",
+    "host_admit_budget", "host_split_keys", "instance_token",
+    "scan_cache_key", "scan_signature", "shed_revocable",
+    "split_data_bytes", "splits_shard",
 ]

@@ -140,12 +140,19 @@ class CacheEntry:
 
 class DeviceTableCache:
     """Byte-budgeted LRU of staged device tables with single-flight
-    admission and version-based invalidation."""
+    admission and version-based invalidation. The metric hooks are class
+    attributes so the host-RAM tier (devcache/hostcache.py) reuses the
+    whole LRU/flight/invalidation machinery under its own counters."""
 
     # followers give a slow leader this long before re-staging themselves
     # (a TPU cold compile through a tunnel can take minutes; staging alone
     # is tens of seconds at sf10)
     FLIGHT_WAIT_S = 600.0
+
+    M_HITS = M.DEVICE_CACHE_HITS
+    M_MISSES = M.DEVICE_CACHE_MISSES
+    M_EVICTIONS = M.DEVICE_CACHE_EVICTIONS
+    M_BYTES = M.DEVICE_CACHE_BYTES
 
     def __init__(self, max_bytes: Optional[int] = None):
         self._max_bytes = max_bytes
@@ -157,13 +164,25 @@ class DeviceTableCache:
         # sweep O(entries-for-this-table), not O(all entries) under the
         # global lock (worker split-set shards accumulate many keys)
         self._by_table: Dict[tuple, set] = {}
+        # lifetime hit count of THIS pool (the worker announce payload's
+        # per-tier column — the process-global metric cannot distinguish
+        # tiers once both exist)
+        self._hit_count = 0
+
+    def _default_max_bytes(self) -> int:
+        """Budget when the constructor did not pin one (subclass hook)."""
+        return _default_budget()
 
     # ---------------------------------------------------------- inspection
     @property
     def max_bytes(self) -> int:
         if self._max_bytes is None:
-            self._max_bytes = _default_budget()
+            self._max_bytes = self._default_max_bytes()
         return self._max_bytes
+
+    def hit_count(self) -> int:
+        with self._lock:
+            return self._hit_count
 
     def cached_bytes(self) -> int:
         with self._lock:
@@ -198,14 +217,21 @@ class DeviceTableCache:
     # ----------------------------------------------------------- lifecycle
     def lookup_or_stage(
         self, key: CacheKey, loader: Callable[[], Tuple[object, int, int, int]],
-        admit_bytes: Optional[int] = None,
-    ) -> Tuple[CacheEntry, str]:
+        admit_bytes: Optional[int] = None, wait: bool = True,
+    ) -> Tuple[Optional[CacheEntry], str]:
         """``(entry, "hit"|"miss")``. ``loader() -> (value, rows, nbytes,
         splits)`` runs OUTSIDE the cache lock (staging is the slow path);
         concurrent callers of the same key single-flight: exactly one
         loader runs, followers are served its entry as hits (they paid no
         transfer). A failed leader wakes followers empty-handed and they
-        race again."""
+        race again.
+
+        ``wait=False``: when another caller is already staging this key,
+        return ``(None, "inflight")`` immediately instead of parking as a
+        follower. Shared-pool worker threads use this so one slow staging
+        can never pin every pool slot behind its flight (the staging
+        fan-out, exec/staging.py) — the caller re-resolves in-flight keys
+        on its OWN thread afterwards with a blocking call."""
         while True:
             with self._lock:
                 self._drop_stale_locked(key)
@@ -214,13 +240,16 @@ class DeviceTableCache:
                     self._entries.move_to_end(key)
                     ent.hits += 1
                     ent.last_used_at = time.time()
-                    M.DEVICE_CACHE_HITS.inc()
+                    self._hit_count += 1
+                    self.M_HITS.inc()
                     return ent, "hit"
                 flight = self._flights.get(key)
                 if flight is None:
                     flight = self._flights[key] = _Flight()
                     lead = True
                 else:
+                    if not wait:
+                        return None, "inflight"
                     lead = False
             if not lead:
                 if not flight.wait(self.FLIGHT_WAIT_S):
@@ -230,7 +259,7 @@ class DeviceTableCache:
                     # one wedged staging
                     value, rows, nbytes, splits = loader()
                     now = time.time()
-                    M.DEVICE_CACHE_MISSES.inc()
+                    self.M_MISSES.inc()
                     return CacheEntry(key, value, rows, int(nbytes), splits,
                                       created_at=now, last_used_at=now), "miss"
                 if flight.ok and flight.value is not None:
@@ -238,7 +267,8 @@ class DeviceTableCache:
                     with self._lock:
                         ent.hits += 1
                         ent.last_used_at = time.time()
-                    M.DEVICE_CACHE_HITS.inc()
+                        self._hit_count += 1
+                    self.M_HITS.inc()
                     return ent, "hit"
                 continue  # leader failed: race for leadership
             try:
@@ -257,8 +287,27 @@ class DeviceTableCache:
                 flight = self._flights.pop(key, None)
             if flight is not None:
                 flight._resolve(ent, ok=True)
-            M.DEVICE_CACHE_MISSES.inc()
+            self.M_MISSES.inc()
             return ent, "miss"
+
+    def peek(self, key: CacheKey) -> Optional[CacheEntry]:
+        """Resident entry for ``key`` (counted + LRU-bumped as a hit), or
+        None — WITHOUT staging on a miss and without joining a flight. The
+        staging pipeline probes the host tier this way up front (under the
+        ``staging/host-cache`` span) and routes only the missing splits
+        into the scan fan-out; a racing ``lookup_or_stage`` on the same
+        key stays correct (it re-checks residency under the lock)."""
+        with self._lock:
+            self._drop_stale_locked(key)
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            self._entries.move_to_end(key)
+            ent.hits += 1
+            ent.last_used_at = time.time()
+            self._hit_count += 1
+        self.M_HITS.inc()
+        return ent
 
     def _admit(self, ent: CacheEntry, admit_bytes: Optional[int]) -> None:
         """Admit under the budget. The session's ``admit_bytes`` is a
@@ -277,7 +326,7 @@ class DeviceTableCache:
             self._entries[ent.key] = ent
             self._bytes += ent.nbytes
             self._by_table.setdefault(ent.key.table_id(), set()).add(ent.key)
-            M.DEVICE_CACHE_BYTES.set(self._bytes)
+            self.M_BYTES.set(self._bytes)
 
     def _remove_locked(self, key: CacheKey) -> Optional[CacheEntry]:
         ent = self._entries.pop(key, None)
@@ -294,8 +343,8 @@ class DeviceTableCache:
     def _evict_lru_locked(self) -> int:
         victim_key = next(iter(self._entries))
         victim = self._remove_locked(victim_key)
-        M.DEVICE_CACHE_EVICTIONS.inc()
-        M.DEVICE_CACHE_BYTES.set(self._bytes)
+        self.M_EVICTIONS.inc()
+        self.M_BYTES.set(self._bytes)
         return victim.nbytes
 
     def _drop_stale_locked(self, key: CacheKey) -> None:
@@ -309,9 +358,9 @@ class DeviceTableCache:
         stale = [k for k in keys if k.data_version != key.data_version]
         for k in stale:
             self._remove_locked(k)
-            M.DEVICE_CACHE_EVICTIONS.inc()
+            self.M_EVICTIONS.inc()
         if stale:
-            M.DEVICE_CACHE_BYTES.set(self._bytes)
+            self.M_BYTES.set(self._bytes)
 
     # ------------------------------------------------------------ pressure
     def yield_bytes(self, nbytes: int) -> int:
@@ -340,7 +389,7 @@ class DeviceTableCache:
             self._entries.clear()
             self._by_table.clear()
             self._bytes = 0
-            M.DEVICE_CACHE_BYTES.set(0)
+            self.M_BYTES.set(0)
 
 
 # the process-wide pool: coordinator-local execution, the compiled tier,

@@ -113,6 +113,32 @@ def splits_shard(splits: List) -> Optional[str]:
     return f"splits:{len(splits)}:{h.hexdigest()}"
 
 
+def host_split_keys(session, node, constraint, applied_domains, splits):
+    """Host-tier cache keys for a split list's decoded column sets (None
+    per bypassed split). Identity = the scan signature (projection +
+    handle + constraint + host-APPLIED domain subset — the pruning baked
+    into the cached arrays) + each split's own boundary digest as the
+    shard, so the same split reached through ANY grouping (whole-table
+    staging, a worker's assigned set, any SPMD mesh width) lands on one
+    entry. The signature (which digests full dynamic-filter domains —
+    megabytes at sf10) and the connector version probe are computed ONCE
+    for the whole list; only the cheap per-split shard digest varies. The
+    bypass rules (disabled cache, unversioned connector, transaction
+    overlay, unstable handle/info repr) are scan_cache_key's, unchanged."""
+    import dataclasses as _dc
+
+    base = scan_cache_key(session, node, constraint, applied_domains,
+                          shard="host")
+    if base is None:
+        return [None] * len(splits)
+    out = []
+    for split in splits:
+        shard = splits_shard([split])
+        out.append(None if shard is None else
+                   _dc.replace(base, shard="host:" + shard))
+    return out
+
+
 def cached_stage(session, node, constraint, applied_domains, shard, loader):
     """The one consult-the-pool-or-stage step every staging tier runs:
     build the key, serve from :data:`DEVICE_CACHE` under a

@@ -93,44 +93,6 @@ def _key_lowereds(c: Column, force_two_limb: bool = False) -> List[join_ops.Lowe
     return [(hi, valid), (lo ^ jnp.int64(-(2**63)), valid)]
 
 
-def assemble_scan_page(column_names, column_types, datas) -> Page:
-    """Build a device Page from per-split connector scan results: concat
-    parts per column (merging varchar dictionaries via
-    spi.concat_column_data), pad empty scans to the canonical one-dead-row
-    page. Shared by the eager executor and the worker fragment executor."""
-    from trino_tpu.connector.spi import concat_column_data
-    from trino_tpu.data.page import fits_int32
-
-    if not datas:
-        return Page.all_dead(column_types)
-    cols: List[Column] = []
-    for name, typ in zip(column_names, column_types):
-        cd = concat_column_data([d[name] for d in datas])
-        if typ.is_nested or cd.hi is not None:
-            cols.append(_column_from_data(cd))
-            continue
-        vals = np.asarray(cd.values)
-        # Physical narrowing: int64-stored columns whose table-wide value
-        # range provably fits int32 ride int32 on device — int64 is emulated
-        # 2x int32 on TPU, so narrow keys sort/join/group ~2x faster (see
-        # data/page.py Column). Table-wide ranges keep splits dtype-uniform.
-        if vals.dtype == np.int64 and fits_int32(cd.vrange):
-            vals = vals.astype(np.int32)
-        cols.append(
-            Column(
-                typ,
-                jnp.asarray(vals),
-                jnp.asarray(cd.nulls) if cd.nulls is not None else None,
-                cd.dictionary,
-                cd.vrange,
-                ascending=bool(getattr(cd, "sorted", False)),
-            )
-        )
-    if cols and cols[0].values.shape[0] == 0:
-        return Page.all_dead(column_types)
-    return Page(cols)
-
-
 def _column_from_data(cd) -> Column:
     """ColumnData -> device Column, recursing into nested children."""
     return Column(
@@ -405,32 +367,47 @@ class Executor:
 
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
         from trino_tpu import devcache
+        from trino_tpu.exec import staging
 
         conn = self.session.catalogs[node.catalog]
         constraint = self.scan_constraint(node)
+        applied = self._host_applied_domains(node)
 
         def load():
-            splits = conn.get_splits(
-                node.schema, node.table, 1, constraint=constraint,
+            # adaptive split sizing: fan big tables out over the staging
+            # pool (pushdown handles stay single-split — the guard is
+            # inside target_split_count)
+            target = staging.target_split_count(
+                self.session, conn, node.schema, node.table,
                 handle=node.table_handle)
-            datas = [conn.scan(s, node.column_names, constraint=constraint)
-                     for s in splits]
+            splits = conn.get_splits(
+                node.schema, node.table, target, constraint=constraint,
+                handle=node.table_handle)
+            prune = None
             if self.apply_df_host:
-                t0 = time.perf_counter()
-                datas = apply_dynamic_domains(
-                    node, self.dyn_domains, datas,
-                    allow=getattr(self, "df_host_allow", None))
-                self.df_apply_s += time.perf_counter() - t0
-            scanned = sum(
-                len(next(iter(d.values())).values) if d else 0 for d in datas
-            )
-            page = assemble_scan_page(
-                node.column_names, node.column_types, datas)
+                allow = getattr(self, "df_host_allow", None)
+
+                def prune(datas):
+                    return apply_dynamic_domains(
+                        node, self.dyn_domains, datas, allow=allow)
+
+            page, scanned, prof = staging.staged_scan_page(
+                self.session, node, conn, splits, constraint,
+                prune=prune, applied_domains=applied)
+            if self.apply_df_host:
+                # CUMULATIVE host domain-application seconds across the
+                # scan threads (StageProfile.prune_s): under a parallel
+                # fan-out this is CPU-seconds of host work, which can
+                # exceed the staging wall — the honest measure of "work
+                # a run repeats", but not a wall clock. The PR 7
+                # accounting identity (STAGING_SECONDS charges exactly
+                # phase1_s + df_apply_s) holds by construction either
+                # way; at parallelism 1 it equals the old serial wall.
+                self.df_apply_s += prof.prune_s
             return page, scanned, _mem.page_bytes(page), len(splits)
 
         ent, disposition = devcache.cached_stage(
-            self.session, node, constraint,
-            self._host_applied_domains(node), "table", load)
+            self.session, node, constraint, applied, "table", load)
         self.scan_cache[node.id] = disposition
         self.scan_stats[node.id] = ent.rows
         self._pending_scan[node.id] = (ent.splits, ent.rows)

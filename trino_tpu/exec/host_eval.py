@@ -97,9 +97,18 @@ def domain_mask(dom: Domain, values: np.ndarray, nulls=None) -> np.ndarray:
                     and span <= max(8 * len(values), 1 << 22):
                 # dense-span set: a boolean lookup table turns membership
                 # into ONE bounded gather (binary search over millions of
-                # needles is ~20x slower host-side)
-                lut = np.zeros(span, dtype=bool)
-                lut[sa.astype(np.int64) - lo] = True
+                # needles is ~20x slower host-side). The LUT is cached on
+                # the Domain like values_sorted: per-SPLIT pruning (the
+                # pipelined staging engine) applies the same domain many
+                # times, and rebuilding a multi-MB table per split would
+                # dominate the mask itself
+                cached = getattr(dom, "values_lut", None)
+                if cached is not None and cached[0] == lo:
+                    lut = cached[1]
+                else:
+                    lut = np.zeros(span, dtype=bool)
+                    lut[sa.astype(np.int64) - lo] = True
+                    object.__setattr__(dom, "values_lut", (lo, lut))
                 inb = (values >= lo) & (values <= hi)
                 idx = np.where(inb, values.astype(np.int64) - lo, 0)
                 m = inb & lut[idx]
@@ -166,8 +175,19 @@ class HostEvaluator:
         dyn = dynamic_domain_map(node, self.dyn_domains)
         if dyn:
             td = TupleDomain(dict(dyn)) if td is None else td.intersect(TupleDomain(dict(dyn)))
-        splits = conn.get_splits(node.schema, node.table, 1, constraint=td,
-                                 handle=node.table_handle)
+        # enumerate with the SAME adaptive target the staging tier will
+        # use (exec/staging.target_split_count): phase-1 evaluation and
+        # the staging loop then request identical split ranges, so the
+        # generator-range cache (connector/gencache.py) fills here and
+        # HITS there — mismatched boundaries would regenerate every
+        # build-side table a second time at staging
+        from trino_tpu.exec import staging as _staging
+
+        target = _staging.target_split_count(
+            self.session, conn, node.schema, node.table,
+            handle=node.table_handle)
+        splits = conn.get_splits(node.schema, node.table, target,
+                                 constraint=td, handle=node.table_handle)
         datas = [conn.scan(s, node.column_names, constraint=td) for s in splits]
         from trino_tpu.connector.spi import concat_column_data
 

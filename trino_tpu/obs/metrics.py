@@ -346,8 +346,10 @@ STAGING_SECONDS = REGISTRY.counter(
     "host-side staging seconds charged to queries: the compiled tier "
     "charges dynamic-filter resolution + host domain application "
     "(bench's staging_df_s — the host work a run repeats without the "
-    "device cache); the worker tier charges the per-split scan+assemble "
-    "wall of FRESH stagings (device-cache hits charge nothing)")
+    "device cache; CUMULATIVE across scan threads under the pipelined "
+    "fan-out, so it can exceed the staging wall); the worker tier "
+    "charges the per-split scan+assemble wall of FRESH stagings "
+    "(device-cache hits charge nothing)")
 DEVICE_SECONDS = REGISTRY.counter(
     "trino_tpu_device_seconds_total",
     "device execution wall seconds (fragment bodies / compiled runs)")
@@ -434,6 +436,39 @@ DEVICE_CACHE_BUILD_HITS = REGISTRY.counter(
     "warm repeated join skipped the build sort entirely; these also count "
     "in the general device-cache hit counter — the artifacts share the "
     "revocable-tier pool and byte budget)")
+# host-RAM columnar page cache (trino_tpu/devcache/hostcache.py): the
+# staging tier UNDER the warm-HBM pool — decoded per-split numpy column
+# sets keyed by the same data_version signature, so an HBM eviction or a
+# re-shard refills from host memory (transfer only) instead of re-running
+# the connector scan and decode
+HOST_CACHE_HITS = REGISTRY.counter(
+    "trino_tpu_host_cache_hits_total",
+    "split stagings served decoded columns from the host-RAM page cache "
+    "(including single-flight followers served by a concurrent leader's "
+    "scan) — the staging pipeline skipped the connector scan and decode")
+HOST_CACHE_MISSES = REGISTRY.counter(
+    "trino_tpu_host_cache_misses_total",
+    "cache-eligible split stagings that ran the connector scan+decode and "
+    "(budget permitting) filled the host-RAM page cache")
+HOST_CACHE_EVICTIONS = REGISTRY.counter(
+    "trino_tpu_host_cache_evictions_total",
+    "host-cache entries dropped (LRU byte budget, revocable-tier shed, or "
+    "a stale data_version after DML)")
+HOST_CACHE_BYTES = REGISTRY.gauge(
+    "trino_tpu_host_cache_bytes",
+    "host RAM held by the columnar page cache (the second revocable tier "
+    "— sheds before the warm-HBM tier under node pressure)")
+# pipelined staging sub-phases (trino_tpu/exec/staging.py): the cold
+# scan->decode->transfer path decomposed, so the trajectory can say WHICH
+# stage of staging ate the wall. staging_seconds_total keeps its exact
+# per-tier charging semantics (bench's staging_df_s identity); this
+# counter is the finer-grained decomposition beside it.
+STAGING_PHASE_SECONDS = REGISTRY.counter(
+    "trino_tpu_staging_phase_seconds_total",
+    "staging pipeline wall seconds by sub-phase: scan (parallel split "
+    "read+decode fan-out), decode (host assembly: concat + dictionary "
+    "merge + physical narrowing), transfer (double-buffered host->device "
+    "blocks), host-cache (host-tier probe)", ("phase",))
 # fused sort-merge join tier (ops/fused_join.py): kernel selections per
 # join execution, labeled by the tier the cost gate chose
 FUSED_JOIN_SELECTIONS = REGISTRY.counter(
@@ -605,19 +640,32 @@ PROCESS_GC_COLLECTIONS = REGISTRY.gauge(
     "(point-in-time read of gc.get_stats)", ("generation",))
 
 
+def current_rss_bytes():
+    """This process's CURRENT resident set (VmRSS), or None where /proc
+    is unavailable — callers needing a live pressure signal (the worker
+    host-RAM shed) must treat None as "unknown", never as 0 (the gauge
+    fallback below reports the lifetime PEAK, which would latch any
+    threshold forever)."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return None
+
+
 def refresh_process_gauges() -> None:
     """Sample the process self-metrics (Linux /proc where available,
     portable fallbacks otherwise); failures leave the previous reading."""
     import gc
     import threading as _threading
 
-    try:
-        with open("/proc/self/status", encoding="ascii") as f:
-            for line in f:
-                if line.startswith("VmRSS:"):
-                    PROCESS_RSS_BYTES.set(int(line.split()[1]) * 1024)
-                    break
-    except OSError:
+    rss = current_rss_bytes()
+    if rss is not None:
+        PROCESS_RSS_BYTES.set(rss)
+    else:
         try:
             import resource
             import sys as _sys

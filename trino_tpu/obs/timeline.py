@@ -107,6 +107,14 @@ SPAN_PHASE: Dict[str, Tuple[int, str]] = {
     "device/staging": (_P_STAGING, "device-staging"),
     "device-cache/lookup": (_P_STAGING, "device-staging"),
     "staging/dynamic-filters": (_P_STAGING, "device-staging"),
+    # the pipelined staging engine's sub-phases (exec/staging.py): same
+    # priority and bucket as their enclosing device/staging window, so
+    # the ledger's device-staging attribution is unchanged while the
+    # span tree now says WHICH stage of staging ate the wall
+    "staging/scan": (_P_STAGING, "device-staging"),
+    "staging/decode": (_P_STAGING, "device-staging"),
+    "staging/transfer": (_P_STAGING, "device-staging"),
+    "staging/host-cache": (_P_STAGING, "device-staging"),
     "device/compile": (_P_DEVICE, "device-execute"),
     "device/execute": (_P_DEVICE, "device-execute"),
     "exchange/overlap": (_P_DEVICE, "device-execute"),

@@ -499,12 +499,25 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
         constraint = scan_constraint_with(node, dyn_domains)
 
         def load(node=node, constraint=constraint):
+            from trino_tpu.exec import staging as _staging
+            from trino_tpu.obs import metrics as _M
+            from trino_tpu.obs import trace as _tracing
+
             arrays, spec, total_rows = _stage_scan_shards(
                 session, node, n_devices, constraint, dyn_domains, profile)
             # cache-resident arrays live ON DEVICE: transfer here (a
             # no-op for already-device arrays), so a warm hit hands back
-            # HBM-resident shards with zero host work
-            arrays = [jnp.asarray(a) for a in arrays]
+            # HBM-resident shards with zero host work. The stacked
+            # [ndev, rows] shard arrays move in double-buffered blocks
+            # along the rows axis (exec/staging.blocked_transfer).
+            t0 = _time.perf_counter()
+            with _tracing.span("staging/transfer", table=node.table) as sp:
+                xfer = _staging.blocked_transfer()
+                arrays = [xfer(a) if isinstance(a, np.ndarray)
+                          else jnp.asarray(a) for a in arrays]
+                sp.set("arrays", len(arrays))
+            _M.STAGING_PHASE_SECONDS.inc(_time.perf_counter() - t0,
+                                         "transfer")
             nbytes = sum(int(a.size) * a.dtype.itemsize for a in arrays)
             return (arrays, spec, total_rows), total_rows, nbytes, n_devices
 
@@ -521,23 +534,55 @@ def stage_sharded_scans(session, root: P.OutputNode, n_devices: int,
 def _stage_scan_shards(session, node, n_devices: int, constraint,
                        dyn_domains, profile=None):
     """Stage ONE scan's per-device shards: ``(arrays, PageSpec,
-    total_rows)`` — the cold path behind the device-cache loader."""
-    from trino_tpu.exec.executor import apply_dynamic_domains
+    total_rows)`` — the cold path behind the device-cache loader. Split
+    reads run through the pipelined engine (exec/staging.py): the
+    adaptive target fans big tables out FINER than the mesh (contiguous
+    fine-split groups per device), every fine split consults the host-RAM
+    tier — so a mesh-width change regroups warm host entries instead of
+    re-running the connector — and scans overlap on the shared pool."""
+    from trino_tpu.exec import staging
+    from trino_tpu.exec.executor import (
+        apply_dynamic_domains, dynamic_domain_map)
 
     conn = session.catalogs[node.catalog]
-    splits = conn.get_splits(
-        node.schema, node.table, n_devices, constraint=constraint,
+    target = staging.target_split_count(
+        session, conn, node.schema, node.table, floor=n_devices,
         handle=node.table_handle)
+    splits = conn.get_splits(
+        node.schema, node.table, target, constraint=constraint,
+        handle=node.table_handle)
+
+    def prune(datas):
+        return apply_dynamic_domains(node, dyn_domains, datas)
+
+    split_datas, prof = staging.stage_splits(
+        session, node, conn, splits, constraint, prune=prune,
+        applied_domains=dynamic_domain_map(node, dyn_domains))
+    if profile is not None:
+        profile["df_apply_s"] = (
+            profile.get("df_apply_s", 0.0) + prof.prune_s)
+    # contiguous split groups per device: with <= n_devices splits, split
+    # i stages on device i (the historical assignment — bit-compatible
+    # with the pre-pipeline layout); finer adaptive split sets group into
+    # n_devices contiguous covers so each shard still reads an ascending
+    # key range and per-shard sortedness survives the concat
+    if len(split_datas) <= n_devices:
+        groups = [[split_datas[i]] if i < len(split_datas) else []
+                  for i in range(n_devices)]
+    else:
+        bounds = [len(split_datas) * i // n_devices
+                  for i in range(n_devices + 1)]
+        groups = [split_datas[bounds[i]:bounds[i + 1]]
+                  for i in range(n_devices)]
     total_rows = 0
     shard_pages = []
     for di in range(n_devices):
-        if di < len(splits):
-            data = conn.scan(splits[di], node.column_names, constraint=constraint)
-            t0 = _time.perf_counter()
-            (data,) = apply_dynamic_domains(node, dyn_domains, [data])
-            if profile is not None:
-                profile["df_apply_s"] = (
-                    profile.get("df_apply_s", 0.0) + _time.perf_counter() - t0)
+        group = [d for d in groups[di] if d]
+        if group:
+            data = group[0] if len(group) == 1 else {
+                name: spi_mod.concat_column_data([g[name] for g in group])
+                for name in node.column_names
+            }
             if data:
                 total_rows += len(next(iter(data.values())).values)
         else:
@@ -558,7 +603,7 @@ def _stage_scan_shards(session, node, n_devices: int, constraint,
         for name, typ in zip(node.column_names, node.column_types):
             cd = data[name]
             vals = np.asarray(cd.values)
-            # physical narrowing, same rule as assemble_scan_page:
+            # physical narrowing, same rule as staging.page_from_host_columns:
             # table-wide ranges keep every shard dtype-uniform
             if vals.dtype == np.int64 and page_mod.fits_int32(cd.vrange):
                 vals = vals.astype(np.int32)

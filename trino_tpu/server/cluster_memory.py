@@ -57,6 +57,11 @@ class ClusterMemoryManager:
                 # REVOCABLE (the worker sheds them under pressure), so
                 # admission never counts them against headroom
                 "deviceCacheBytes": int(payload.get("deviceCacheBytes") or 0),
+                # host-RAM columnar tier (devcache/hostcache.py): the
+                # SECOND revocable tier — the worker sheds it before the
+                # HBM tier (devcache.shed_revocable), and admission
+                # ignores it for the same reason
+                "hostCacheBytes": int(payload.get("hostCacheBytes") or 0),
                 "at": time.monotonic(),
             }
         self._maybe_kill()
@@ -87,10 +92,12 @@ class ClusterMemoryManager:
         return sum(int(c) for c in caps)
 
     def revocable_bytes(self) -> int:
-        """Cluster-wide device-cache bytes — reclaimable on demand (the
-        workers' warm-HBM table caches yield to running queries)."""
+        """Cluster-wide revocable bytes across BOTH cache tiers —
+        reclaimable on demand (workers shed host-RAM pages first, then
+        warm-HBM tables, for running queries' benefit)."""
         with self._lock:
             return sum(int(i.get("deviceCacheBytes") or 0)
+                       + int(i.get("hostCacheBytes") or 0)
                        for i in self._nodes.values())
 
     def effective_limit(self) -> Optional[int]:

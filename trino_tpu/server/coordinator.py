@@ -1521,13 +1521,27 @@ class QueryExecution:
         if frag.partitioning != "source":
             return
         # enumerate splits per scan node, interleave across workers
+        from trino_tpu.exec import staging as _staging
+
         per_worker_splits: List[Dict[int, list]] = [dict() for _ in workers]
-        for node in P.walk_plan(frag.root):
-            if not isinstance(node, P.TableScanNode):
-                continue
+        scan_nodes = [n for n in P.walk_plan(frag.root)
+                      if isinstance(n, P.TableScanNode)]
+        for node in scan_nodes:
             conn = session.catalogs[node.catalog]
-            splits = conn.get_splits(node.schema, node.table,
-                                     max(len(workers), 1),
+            floor = max(len(workers), 1)
+            # adaptive split sizing (exec/staging.py): big tables fan out
+            # finer than one-split-per-worker so task-side staging
+            # pipelines over them — but ONLY for single-scan fragments:
+            # a multi-scan fragment may be a co-located join whose
+            # correctness depends on split i of both tables covering the
+            # SAME key range (pushdown handles are guarded inside
+            # target_split_count)
+            target = floor
+            if len(scan_nodes) == 1:
+                target = _staging.target_split_count(
+                    session, conn, node.schema, node.table, floor=floor,
+                    handle=node.table_handle)
+            splits = conn.get_splits(node.schema, node.table, target,
                                      constraint=node.constraint,
                                      handle=node.table_handle)
             for i, split in enumerate(splits):

@@ -225,6 +225,8 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
                 int(dev_mem) if dev_mem is not None else None,
                 int(info.get("deviceCacheBytes") or 0),
                 int(n["ageS"] * 1000.0),
+                int(info.get("hostCacheBytes") or 0),
+                int(info.get("hostCacheHits") or 0),
             ))
         return rows
 

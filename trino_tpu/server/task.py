@@ -85,7 +85,7 @@ class FragmentExecutor(Executor):
     def _exec_TableScanNode(self, node: P.TableScanNode) -> Page:
         from trino_tpu import devcache
         from trino_tpu.exec import memory as _mem
-        from trino_tpu.exec.executor import assemble_scan_page
+        from trino_tpu.exec import staging
 
         conn = self.session.catalogs[node.catalog]
         splits = self._splits.get(node.id, [])
@@ -95,13 +95,15 @@ class FragmentExecutor(Executor):
         constraint = self.scan_constraint(node)
 
         def load():
+            # the pipelined engine (exec/staging.py): the task's assigned
+            # splits scan in parallel on the shared pool, each consulting
+            # the host-RAM tier, and the assembled columns transfer in
+            # double-buffered blocks. STAGING_SECONDS keeps its worker
+            # semantics: the whole fresh scan+assemble+transfer wall
+            # (device-cache hits never reach this loader).
             t0 = time.perf_counter()
-            datas = [conn.scan(s, node.column_names, constraint=constraint)
-                     for s in splits]
-            rows = sum(
-                len(next(iter(d.values())).values) if d else 0 for d in datas)
-            page = assemble_scan_page(
-                node.column_names, node.column_types, datas)
+            page, rows, _prof = staging.staged_scan_page(
+                self.session, node, conn, splits, constraint)
             M.STAGED_ROWS.inc(rows)
             M.STAGING_SECONDS.inc(time.perf_counter() - t0)
             return page, rows, _mem.page_bytes(page), len(splits)

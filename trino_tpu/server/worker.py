@@ -96,6 +96,11 @@ class WorkerServer:
         self.memory_limit_bytes = (
             memory_limit_bytes if memory_limit_bytes is not None
             else int(env_limit) if env_limit else None)
+        # optional node host-RAM ceiling: process RSS over it sheds the
+        # revocable cache tiers host-first (devcache.shed_revocable) on
+        # the announce cadence; None = host RAM unmanaged
+        env_host = os.environ.get("TRINO_TPU_HOST_MEMORY_LIMIT_BYTES")
+        self.host_memory_limit_bytes = int(env_host) if env_host else None
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -139,6 +144,11 @@ class WorkerServer:
                     # tier: when queries + warm tables overflow the pool,
                     # shed cache FIRST — before the coordinator's
                     # low-memory killer would ever consider a query.
+                    # DEVICE bytes only: the pool models query/device
+                    # memory, and host-RAM cache bytes live in a
+                    # different physical budget (counting them here
+                    # would thrash the host tier on memory-tight
+                    # workers while freeing nothing the pool needs).
                     # Scoped to the band where the cache IS the overflow
                     # (queries alone fit the pool): reservations are
                     # projected peaks, so a huge spilling join reports
@@ -152,6 +162,25 @@ class WorkerServer:
                             - self.memory_limit_bytes)
                     if over > 0 and q_total < self.memory_limit_bytes:
                         devcache.DEVICE_CACHE.yield_bytes(over)
+                # host-RAM pressure is the SEPARATE budget where the
+                # two-tier shed order applies: when the process RSS
+                # crosses the optional node limit, shed host pages
+                # before warm-HBM entries (devcache.shed_revocable — a
+                # lost host page costs one transfer to rebuild, a lost
+                # HBM page costs the whole scan→decode→transfer path
+                # once the host tier is gone too). CURRENT RSS only
+                # (obs/metrics.current_rss_bytes): the gauge fallback
+                # reports the lifetime PEAK on /proc-less platforms,
+                # which would latch the shed on forever once crossed —
+                # no reading, no shed.
+                if self.host_memory_limit_bytes is not None:
+                    from trino_tpu.obs.metrics import current_rss_bytes
+
+                    rss = current_rss_bytes()
+                    if rss is not None:
+                        over_host = rss - self.host_memory_limit_bytes
+                        if over_host > 0:
+                            devcache.shed_revocable(over_host)
                 wire.json_request(
                     "PUT",
                     f"{self.coordinator_url}/v1/announce/{self.node_id}",
@@ -169,6 +198,12 @@ class WorkerServer:
                      "deviceMemoryBytes": devcache.device_memory_bytes(),
                      "deviceCacheBytes":
                          devcache.DEVICE_CACHE.cached_bytes(),
+                     # host-RAM columnar tier occupancy + lifetime hits:
+                     # the SECOND revocable tier (sheds first), surfaced
+                     # by system.runtime.nodes (host_cache_* columns)
+                     "hostCacheBytes":
+                         devcache.HOST_CACHE.cached_bytes(),
+                     "hostCacheHits": devcache.HOST_CACHE.hit_count(),
                      # surfaced by system.runtime.nodes (reference: the
                      # node version in NodeSystemTable rows)
                      "version": __version__},
