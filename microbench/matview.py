@@ -1,0 +1,228 @@
+"""Materialized-view microbench: fresh-MV speedup + the staleness matrix.
+
+Two parts (ROADMAP item 5b acceptance; ISSUE 15):
+
+- **speedup** — the TPC-H q3-shaped join+aggregate on the tpch generator
+  catalog, base vs fresh-MV-substituted. Both arms run EMBEDDED (no
+  result cache exists in front — "result cache cold" holds by
+  construction) with the device cache on and warm: the base arm re-pays
+  the full join+aggregate device time per run, the MV arm scans the
+  precomputed storage table (pre-staged into the warm-HBM tier by the
+  REFRESH). Acceptance: ``speedup >= 5`` at the full scale
+  (``MIN_SPEEDUP_FULL``).
+- **staleness matrix** — the same q3 shape over MUTABLE memory-catalog
+  copies: after each of INSERT / UPDATE / DELETE / DROP+recreate on a
+  base table, substitution must be SUPPRESSED (registry hit count does
+  not move) and the fallback rows must be BIT-IDENTICAL to the base
+  query's (substitution forced off); a REFRESH then flips
+  fallback -> substituted again. Any substitution while stale counts in
+  ``incorrect_freshness_substitutions`` and fails the run.
+
+Writes ``MATVIEW_r01.json`` (folded into TRAJECTORY.json by
+``tools/bench_trend.py``). ``--check`` runs the tiny-schema quick pass
+as the tier-1 regression gate
+(tests/test_matview.py::test_matview_bench_check) with a lower speedup
+floor for CI headroom.
+
+Run: python microbench/matview.py [tpch_schema]   (default sf1)
+     python microbench/matview.py --check         (quick gate, tiny)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# self-locate the repo (PYTHONPATH must not be used on TPU runs)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MIN_SPEEDUP_FULL = 5.0   # the r01 acceptance bound (sf1)
+MIN_SPEEDUP_CHECK = 3.0  # quick-gate floor (tiny schema, CI headroom)
+RUNS = 3                 # timed repeats per arm (best-of)
+
+Q3_AGG = """
+select l_orderkey, o_orderdate, o_shippriority,
+       sum(l_extendedprice * (1 - l_discount)) as revenue
+from {customer}, {orders}, {lineitem}
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+"""
+Q3_TAIL = " order by revenue desc, o_orderdate, l_orderkey limit 10"
+
+
+def _q3(**tables) -> str:
+    return Q3_AGG.format(**tables)
+
+
+def _best_of(session, sql: str, runs: int = RUNS) -> float:
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        session.execute(sql)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _mv_hits(session) -> int:
+    return sum(mv.hits for mv in session.matviews.snapshot())
+
+
+def run_speedup(schema: str) -> dict:
+    """Part 1: base vs fresh-MV q3 on the tpch catalog (immutable base
+    => the view stays fresh; storage falls back to the memory catalog)."""
+    from trino_tpu.client.session import Session
+
+    tables = {"customer": "customer", "orders": "orders",
+              "lineitem": "lineitem"}
+    base_sql = _q3(**tables) + Q3_TAIL
+    session = Session({"catalog": "tpch", "schema": schema,
+                       "device_cache_enabled": True})
+    base_rows = session.execute(base_sql).rows  # warm compile + devcache
+    base_s = _best_of(session, base_sql)
+    session.execute("create materialized view q3rev as " + _q3(**tables))
+    storage_table = session.matviews.snapshot()[0].storage_table
+    hits0 = _mv_hits(session)
+    first_rows = session.execute(base_sql).rows
+    assert _mv_hits(session) > hits0, "fresh MV did not substitute"
+    assert first_rows == base_rows, "substituted rows diverged from base"
+    # the REFRESH pre-staged the storage table: the first substituted
+    # query must have been served warm (a device-cache hit, zero fresh
+    # staged rows for the storage scan)
+    from trino_tpu.devcache import DEVICE_CACHE
+
+    warm = [e for e in DEVICE_CACHE.snapshot()
+            if e["table"] == storage_table]
+    warm_storage_hit = bool(warm) and warm[0]["hits"] >= 1
+    hit_s = _best_of(session, base_sql)
+    session.execute("drop materialized view q3rev")
+    return {
+        "base_seconds": round(base_s, 4),
+        "hit_seconds": round(hit_s, 4),
+        "speedup": round(base_s / hit_s, 2) if hit_s else 0.0,
+        "warm_storage_hit": warm_storage_hit,
+        "rows": len(base_rows),
+    }
+
+
+def run_staleness_matrix(source_schema: str = "tiny") -> dict:
+    """Part 2: INSERT/UPDATE/DELETE/DROP on memory-catalog base tables
+    => substitution suppressed + bit-identical fallback => REFRESH =>
+    substitution resumes. Returns the matrix record (any incorrect-
+    freshness substitution or row divergence raises)."""
+    from trino_tpu.client.session import Session
+
+    s = Session({"catalog": "memory", "schema": "default",
+                 "device_cache_enabled": True})
+    for t in ("customer", "orders", "lineitem"):
+        s.execute(f"create table {t} as select * from "
+                  f"tpch.{source_schema}.{t}")
+    sql = _q3(customer="customer", orders="orders",
+              lineitem="lineitem") + Q3_TAIL
+    s.execute("create materialized view q3m as " + _q3(
+        customer="customer", orders="orders", lineitem="lineitem"))
+
+    def base_truth():
+        s.properties["materialized_view_substitution"] = False
+        try:
+            return s.execute(sql).rows
+        finally:
+            s.properties["materialized_view_substitution"] = True
+
+    incorrect = 0
+    steps = []
+
+    def check_substituted(expect: bool, step: str):
+        nonlocal incorrect
+        before = _mv_hits(s)
+        rows = s.execute(sql).rows
+        substituted = _mv_hits(s) > before
+        truth = base_truth()
+        identical = rows == truth
+        if substituted and not expect:
+            incorrect += 1
+        assert identical, f"{step}: rows diverged from base truth"
+        assert substituted == expect, (
+            f"{step}: expected substituted={expect}, got {substituted}")
+        steps.append({"step": step, "substituted": substituted,
+                      "bit_identical": identical})
+
+    check_substituted(True, "fresh")
+    mutations = [
+        ("insert", "insert into orders select * from orders limit 1"),
+        ("update", "update lineitem set l_quantity = l_quantity + 1 "
+                   "where l_orderkey = 1"),
+        ("delete", "delete from customer where c_custkey = 1"),
+        ("drop", None),  # DROP + recreate customer
+    ]
+    for name, stmt in mutations:
+        if name == "drop":
+            s.execute("drop table customer")
+            s.execute("create table customer as select * from "
+                      f"tpch.{source_schema}.customer")
+        else:
+            s.execute(stmt)
+        check_substituted(False, f"{name}-stale")
+        s.execute("refresh materialized view q3m")
+        check_substituted(True, f"{name}-refreshed")
+    s.execute("drop materialized view q3m")
+    return {"steps": steps,
+            "incorrect_freshness_substitutions": incorrect,
+            "stale_fallback_ok": all(st["bit_identical"] for st in steps)}
+
+
+def run(schema: str, check_mode: bool) -> dict:
+    speedup = run_speedup(schema)
+    matrix = run_staleness_matrix("tiny")
+    report = {
+        "round": 1,
+        "tpch_schema": schema,
+        **speedup,
+        **matrix,
+        "min_speedup": (MIN_SPEEDUP_CHECK if check_mode
+                        else MIN_SPEEDUP_FULL),
+    }
+    bound = report["min_speedup"]
+    assert report["speedup"] >= bound, (
+        f"fresh-MV speedup {report['speedup']}x below the {bound}x bound "
+        f"(base {report['base_seconds']}s vs hit {report['hit_seconds']}s)")
+    assert report["incorrect_freshness_substitutions"] == 0
+    assert report["stale_fallback_ok"]
+    assert report["warm_storage_hit"], (
+        "first post-refresh substituted query was not served from the "
+        "warm device cache")
+    return report
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    check_mode = "--check" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    schema = args[0] if args else ("tiny" if check_mode else "sf1")
+    report = run(schema, check_mode)
+    print(json.dumps({k: v for k, v in report.items() if k != "steps"},
+                     indent=2))
+    if check_mode:
+        print(f"matview-check ok: base {report['base_seconds']}s, "
+              f"hit {report['hit_seconds']}s ({report['speedup']}x), "
+              f"staleness matrix {len(report['steps'])} steps clean")
+        return
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "MATVIEW_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out_path}: fresh-MV q3 {report['hit_seconds']}s vs "
+          f"base {report['base_seconds']}s ({report['speedup']}x), "
+          f"stale fallback bit-identical across "
+          f"{len(report['steps'])} matrix steps")
+
+
+if __name__ == "__main__":
+    main()

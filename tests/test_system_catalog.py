@@ -48,7 +48,7 @@ def test_provider_less_system_tables():
 
     s = Session()
     assert s.execute("show schemas from system").rows == [
-        ("metrics",), ("runtime",)]
+        ("metadata",), ("metrics",), ("runtime",)]
     assert s.execute("show tables from system.runtime").rows == [
         ("device_cache",), ("nodes",), ("prepared_statements",),
         ("queries",), ("serving",), ("tasks",)]

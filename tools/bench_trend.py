@@ -270,6 +270,31 @@ def _extract_staging(path: str) -> List[dict]:
     return out
 
 
+def _extract_matview(path: str) -> List[dict]:
+    """MATVIEW_r*.json: the fresh-MV serving curve — base vs substituted
+    q3-shape seconds, the speedup headline, and the correctness gates
+    (stale fallback bit-identical, zero incorrect-freshness
+    substitutions) as 0/1 metrics so a regression to a wrong-rows state
+    can never land silently. Schema/rows stay OUT: setup, not perf."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    rnd = int(data.get("round", _round_of(path)))
+    out: List[dict] = []
+    for metric, unit, direction in (
+            ("base_seconds", "s", "down"),
+            ("hit_seconds", "s", "down"),
+            ("speedup", "x", "up"),
+            ("incorrect_freshness_substitutions", "count", "down")):
+        if data.get(metric) is not None:
+            out.append(_entry("matview", rnd, metric, data[metric], unit,
+                              direction, path))
+    if data.get("stale_fallback_ok") is not None:
+        out.append(_entry("matview", rnd, "stale_fallback_ok",
+                          1.0 if data["stale_fallback_ok"] else 0.0,
+                          "bool", "up", path))
+    return out
+
+
 _FAMILIES = (
     ("BENCH_r*.json", _extract_bench),
     ("QPS_r*.json", _extract_qps),
@@ -279,6 +304,7 @@ _FAMILIES = (
     ("MULTICHIP_r*.json", _extract_multichip),
     ("RESULTS_r*.json", _extract_results),
     ("STAGING_r*.json", _extract_staging),
+    ("MATVIEW_r*.json", _extract_matview),
 )
 
 

@@ -94,6 +94,12 @@ def render_summary(stats) -> str:
     if stats.get("deviceCacheHits"):
         # scans served warm from the device table cache (zero transfer)
         parts.append(f"warm scans: {stats['deviceCacheHits']}")
+    if stats.get("mvHits"):
+        # fresh materialized views substituted into this query's plan
+        # (the join/aggregate ran at REFRESH time, not now)
+        names = stats.get("mvNames") or ()
+        parts.append(("mv: " + ", ".join(names)) if names
+                     else f"mv hits: {stats['mvHits']}")
     if stats.get("spooled"):
         # the spooled result protocol served a segment manifest instead
         # of inline rows (worker-direct = the coordinator never touched

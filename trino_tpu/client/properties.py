@@ -345,6 +345,30 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 256 << 20, _positive,
         ),
         PropertyMetadata(
+            "materialized_view_substitution",
+            "transparently rewrite query plan subtrees that match a "
+            "FRESH registered materialized view's definition (canonical "
+            "plan fingerprint, exact or select-item-prefix) into a scan "
+            "of the precomputed storage table (trino_tpu/matview/); a "
+            "stale view always falls back to the base plan — never "
+            "wrong rows",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "materialized_view_refresh_on_create",
+            "run the initial REFRESH as part of CREATE MATERIALIZED "
+            "VIEW so the view is born fresh; false registers the "
+            "definition only (the first REFRESH populates it)",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "materialized_view_storage_catalog",
+            "catalog hosting materialized-view storage tables when the "
+            "view's own catalog is not writable (e.g. a view over the "
+            "immutable tpch generator); must support CREATE TABLE",
+            str, "memory",
+        ),
+        PropertyMetadata(
             "failure_injection",
             "inject a task failure when this substring matches a task id, "
             "e.g. '.<fragment>.<worker>.a<attempt>' (reference: "

@@ -14,7 +14,8 @@ class Session:
     """A query session: catalogs, session properties, and an executor."""
 
     def __init__(self, properties: Optional[Dict[str, Any]] = None, num_partitions: int = 1,
-                 identity=None, access_control=None, catalogs=None, udfs=None):
+                 identity=None, access_control=None, catalogs=None, udfs=None,
+                 matviews=None):
         from trino_tpu.client.properties import defaulted
         from trino_tpu.connector.registry import default_catalogs
         from trino_tpu.server.security import AccessControl, Identity
@@ -33,6 +34,15 @@ class Session:
         # shares one dict across sessions (like ``catalogs``) so CREATE
         # FUNCTION persists between statements.
         self.udfs = udfs if udfs is not None else {}
+        # materialized-view registry (trino_tpu/matview/): server mode
+        # shares one instance across sessions (like ``catalogs``) so
+        # CREATE MATERIALIZED VIEW persists between statements; embedded
+        # sessions get a private one
+        if matviews is None:
+            from trino_tpu.matview.registry import MaterializedViewRegistry
+
+            matviews = MaterializedViewRegistry()
+        self.matviews = matviews
 
     def set_property(self, name: str, value: Any) -> None:
         """SET SESSION analog: typed/validated (client/properties.py;

@@ -76,6 +76,7 @@ SYSTEM_TABLES = {
         ("structure", "varchar"),      # dispatch_queue | executor_lanes
                                        # | serving_index | result_cache |
                                        # plan_cache | prepared_statements
+                                       # | materialized_views
                                        # | query_registry | query_history
                                        # | device
         ("owner", "varchar"),          # dispatch-process |
@@ -140,6 +141,24 @@ SYSTEM_TABLES = {
         ("last_used_at", "double"),
         ("tier", "varchar"),           # hbm | host
     ),
+    # registered materialized views (trino_tpu/matview/): definitions,
+    # storage location, and LIVE freshness (recomputed at scan time from
+    # the connectors' current data versions vs the versions recorded at
+    # the last REFRESH)
+    ("metadata", "materialized_views"): (
+        ("catalog", "varchar"),
+        ("schema_name", "varchar"),
+        ("name", "varchar"),
+        ("owner", "varchar"),
+        ("definition", "varchar"),      # the defining query's SQL text
+        ("storage_table", "varchar"),   # catalog.schema.table holding rows
+        ("fresh", "boolean"),           # substitutable right now?
+        ("stale_reason", "varchar"),    # NULL when fresh
+        ("last_refresh", "double"),     # epoch seconds; NULL never run
+        ("base_versions", "varchar"),   # c.s.t@version, ... at REFRESH
+        ("hit_count", "bigint"),        # plans substituted so far
+        ("refresh_count", "bigint"),
+    ),
     # every touched series of the typed metrics registry as rows — the jmx
     # connector's role; /v1/metrics stays the Prometheus surface
     ("metrics", "metrics"): (
@@ -155,4 +174,5 @@ SYSTEM_TABLES = {
 # the docs gate can require each to be documented alongside the tables
 SYSTEM_PROCEDURES = (
     ("runtime", "kill_query"),
+    ("runtime", "sync_materialized_view"),
 )

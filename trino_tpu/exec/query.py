@@ -35,7 +35,7 @@ def plan_sql(session, sql: str):
 
 
 def run_query(session, sql: str) -> QueryResult:
-    return _dispatch_statement(session, parse_statement(sql))
+    return _dispatch_statement(session, parse_statement(sql), sql=sql)
 
 
 def dispatch_statement(session, stmt) -> QueryResult:
@@ -84,7 +84,7 @@ def _bind_parameters(stmt, params):
     return rewrite(stmt)
 
 
-def _dispatch_statement(session, stmt) -> QueryResult:
+def _dispatch_statement(session, stmt, sql=None) -> QueryResult:
     if isinstance(stmt, ast.Explain):
         if stmt.analyze:
             text = explain_analyze(session, stmt.statement,
@@ -100,6 +100,18 @@ def _dispatch_statement(session, stmt) -> QueryResult:
         return _insert(session, stmt)
     if isinstance(stmt, ast.DropTable):
         return _drop_table(session, stmt)
+    if isinstance(stmt, (ast.CreateMaterializedView,
+                         ast.RefreshMaterializedView,
+                         ast.DropMaterializedView)):
+        # materialized views (trino_tpu/matview/): the embedded path runs
+        # the REFRESH's defining query on the local executor; the
+        # coordinator intercepts these statements earlier to execute the
+        # refresh through its distributed path
+        from trino_tpu.matview import lifecycle as mv_lifecycle
+
+        columns, rows = mv_lifecycle.dispatch_mv_statement(
+            session, stmt, sql=sql)
+        return QueryResult(columns, [], rows)
     if isinstance(stmt, ast.Delete):
         return _delete(session, stmt)
     if isinstance(stmt, ast.Update):
@@ -203,8 +215,31 @@ def _dispatch_statement(session, stmt) -> QueryResult:
         stmt = expand_udfs(stmt, udfs)
     root = Planner(session).plan(stmt)
     root = optimize(root, session)
+    # materialized-view substitution (trino_tpu/matview/): a fresh MV
+    # whose definition matches a plan subtree serves as a storage scan
+    from trino_tpu.matview.substitute import substitute_plan
+
+    root, _mv_notes = substitute_plan(session, root)
     page = Executor(session).execute_checked(root)
     return QueryResult(root.column_names, page.columns, page.to_pylist())
+
+
+def mv_notes_header(notes) -> str:
+    """EXPLAIN header lines for the materialized-view substitution
+    decisions: the scan annotation shows WHERE a view substituted; these
+    lines show the freshness verdict (including fallbacks, which leave
+    no mark on the plan)."""
+    lines = []
+    for n in notes or ():
+        if n["result"] == "substituted":
+            extra = (f" (prefix {n['prefix']} columns)"
+                     if n.get("prefix") else "")
+            lines.append(f"Materialized view {n['view']}: substituted"
+                         f"{extra}")
+        else:
+            lines.append(f"Materialized view {n['view']}: fallback "
+                         f"({n['result']}: {n['reason']})")
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 def explain_query(session, sql, mode: str = "logical", stmt=None) -> str:
@@ -215,11 +250,15 @@ def explain_query(session, sql, mode: str = "logical", stmt=None) -> str:
             stmt = stmt.statement
     root = Planner(session).plan(stmt)
     root = optimize(root, session)
+    from trino_tpu.matview.substitute import substitute_plan
+
+    root, mv_notes = substitute_plan(session, root)
+    header = mv_notes_header(mv_notes)
     if mode == "distributed":
         from trino_tpu.sql.planner.fragmenter import fragment_plan, format_fragments
 
-        return format_fragments(fragment_plan(root, session))
-    return format_plan(root)
+        return header + format_fragments(fragment_plan(root, session))
+    return header + format_plan(root)
 
 
 def _resolve_table_name(session, parts, write: bool = False):
@@ -532,6 +571,9 @@ def explain_analyze(session, stmt, verbose: bool = False) -> str:
     t_plan = _time.perf_counter()
     root = Planner(session).plan(stmt)
     root = optimize(root, session)
+    from trino_tpu.matview.substitute import substitute_plan
+
+    root, mv_notes = substitute_plan(session, root)
     plan_s = _time.perf_counter() - t_plan
     ex = Executor(session)
     hits0, misses0 = (M.COMPILE_CACHE_HITS.value(),
@@ -561,7 +603,8 @@ def explain_analyze(session, stmt, verbose: bool = False) -> str:
             f"{int(M.COMPILE_CACHE_HITS.value() - hits0)}/"
             f"{int(M.COMPILE_CACHE_MISSES.value() - misses0)},"
             f" dynamic-filter host seconds={ex.df_apply_s * 1e3:.1f}ms")
-    return "\n".join(header) + "\n" + format_plan(
+    mv_header = mv_notes_header(mv_notes)
+    return mv_header + "\n".join(header) + "\n" + format_plan(
         root, executor=ex, verbose=verbose)
 
 

@@ -1,0 +1,28 @@
+"""Materialized views: version-fresh precomputation served as table scans.
+
+Three pieces (ROADMAP item 5b; reference: the connector-SPI materialized
+view flow — ``getMaterializedView`` / ``MaterializedViewFreshness``):
+
+- ``registry.py`` — the coordinator-owned metadata store (definitions,
+  storage location, canonical match keys, per-refresh base/storage data
+  versions), replicated across the executor-process plane;
+- ``substitute.py`` — the transparent planner pass: a query subtree whose
+  canonical plan fingerprint equals a FRESH view's definition rewrites
+  into a scan of the precomputed storage table (which the device cache
+  then serves from warm HBM);
+- ``lifecycle.py`` — CREATE / REFRESH / DROP execution over the plain
+  connector write SPI, with the atomic version swap that makes staleness
+  a provable, never-wrong-rows property.
+"""
+from trino_tpu.matview.registry import (
+    MaterializedView, MaterializedViewRegistry, drop_payload, from_payload,
+    to_payload)
+from trino_tpu.matview.substitute import (
+    staleness_reason, substitute_plan, substitution_enabled,
+    substitution_versions)
+
+__all__ = [
+    "MaterializedView", "MaterializedViewRegistry", "drop_payload",
+    "from_payload", "to_payload", "staleness_reason", "substitute_plan",
+    "substitution_enabled", "substitution_versions",
+]

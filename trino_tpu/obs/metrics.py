@@ -401,6 +401,18 @@ PLAN_CACHE_MISSES = REGISTRY.counter(
     "trino_tpu_plan_cache_misses_total",
     "plan-cache lookups that planned from scratch (first sight, changed "
     "session properties, or a data-version mismatch)")
+# materialized views (trino_tpu/matview/): the transparent planner
+# substitution pass and the REFRESH swap
+MV_SUBSTITUTIONS = REGISTRY.counter(
+    "trino_tpu_mv_substitutions_total",
+    "materialized-view substitution decisions by the planner pass "
+    "(result = substituted | stale | access-denied | invalid): "
+    "'substituted' rewrote a matched plan subtree into a storage-table "
+    "scan; every other result fell back to the base plan", ("result",))
+MV_REFRESH_SECONDS = REGISTRY.histogram(
+    "trino_tpu_mv_refresh_seconds",
+    "REFRESH MATERIALIZED VIEW wall time: plan + execute the definition "
+    "+ atomic storage swap (+ the optional device-cache warm staging)")
 GENCACHE_HITS = REGISTRY.counter(
     "trino_tpu_gencache_hits_total",
     "generator scan ranges served entirely from the datagen cache")

@@ -206,6 +206,11 @@ class QueryExecution:
         self.is_plain_select = False
         self.result_cache_key: Optional[str] = None
         self.result_cache_versions = None
+        # materialized-view substitutions applied to this query's plan
+        # (qualified view names, in decision order) + the full decision
+        # notes — queryStats.mvHits/mvNames and EXPLAIN ANALYZE headers
+        self.mv_substitutions: List[str] = []
+        self.mv_notes: List[dict] = []
         # spooled result protocol (server/segments.py): when the query's
         # results went to segments, the statement response carries this
         # MANIFEST ({uri, ackUri, id, rows, bytes, codec} per segment)
@@ -347,6 +352,16 @@ class QueryExecution:
             # state dies with this statement
             self._run_prepared_statement(session, stmt)
             return
+        if isinstance(stmt, (ast.CreateMaterializedView,
+                             ast.RefreshMaterializedView,
+                             ast.DropMaterializedView)):
+            # materialized views (trino_tpu/matview/): the REFRESH's
+            # defining query executes through the NORMAL path
+            # (_execute_query: fast-path / local-catalog / distributed),
+            # then the rows swap into the storage table and the registry
+            # change replicates to the executor-process plane
+            self._run_mv_statement(session, stmt, self.sql)
+            return
         if not isinstance(stmt, ast.Query):
             # metadata statements (SHOW …, EXPLAIN), CALL, and DML/DDL run
             # coordinator-local and always bypass the result cache — the
@@ -365,8 +380,96 @@ class QueryExecution:
             return
         self.is_plain_select = True
         root, versions = self._plan_query(session, stmt)
+        root, versions = self._substitute_matviews(session, root, versions)
         key = self._consult_result_cache(session, stmt, root, versions)
         self._finish_with_result_cache(session, root, key)
+
+    # ------------------------------------------------- materialized views
+    def _run_mv_statement(self, session, stmt, sql) -> None:
+        """CREATE / REFRESH / DROP MATERIALIZED VIEW on the coordinator.
+        The refresh's defining query runs through ``_execute_query`` so
+        big definitions fragment and schedule across workers exactly like
+        a user SELECT; the materialized rows then swap into the storage
+        table (matview/lifecycle.py owns the version bookkeeping).
+        ``sql`` is the CREATE statement's own text (the prepared path
+        passes the registered inner text, or None when bound parameters
+        made the stored text no longer describe the bound AST)."""
+        from trino_tpu.matview import lifecycle as mv_lifecycle
+
+        self.cache_status = "BYPASS"
+
+        def execute_fn(root):
+            # the refresh consumes materialized rows: spooled manifests
+            # would leave them in segments nobody decodes server-side
+            session.properties["spooled_results_enabled"] = False
+            self._execute_query(session, root)
+            rows, self.rows = self.rows, []
+            return rows
+
+        # under the executor-process plane, substituted SELECTs run in
+        # the children — warming THIS process's device cache on refresh
+        # would stage a table no query here ever scans
+        warm = getattr(self.dispatcher, "process_plane", None) is None
+        columns, rows = mv_lifecycle.dispatch_mv_statement(
+            session, stmt, sql=sql, execute_fn=execute_fn, warm=warm)
+        if self.state.get() in ("QUEUED", "PLANNING", "STARTING"):
+            self.state.set("RUNNING")
+        self.columns, self.rows = columns, rows
+        self._replicate_mv_change(session, stmt)
+
+    def _replicate_mv_change(self, session, stmt) -> None:
+        """Process plane only: ship the registry mutation to every booted
+        executor process (``CALL system.runtime.sync_materialized_view``
+        with a base64 payload), so sticky-routed SELECTs substitute — or
+        stop substituting — there too. Best-effort, like the prepared-
+        registry broadcast."""
+        pp = getattr(self.dispatcher, "process_plane", None)
+        if pp is None:
+            return
+        import base64
+        import json as _json
+
+        from trino_tpu.matview import registry as mv_registry
+        from trino_tpu.matview.lifecycle import resolve_mv_name
+        from trino_tpu.sql.parser import ast
+
+        catalog, schema, name = resolve_mv_name(session, stmt.name)
+        if isinstance(stmt, ast.DropMaterializedView):
+            payload = mv_registry.drop_payload(catalog, schema, name)
+        else:
+            mv = session.matviews.get(catalog, schema, name)
+            if mv is None or mv.definition_sql is None:
+                return
+            payload = mv_registry.to_payload(mv)
+        blob = base64.b64encode(
+            _json.dumps(payload).encode()).decode()
+        # signed with the cluster-internal secret (children inherit it
+        # via their spawn env): the receiving procedure rejects anything
+        # an ordinary client could forge
+        sig = wire.sign(blob.encode())
+        pp.broadcast(
+            f"CALL system.runtime.sync_materialized_view('{blob}', "
+            f"'{sig}')",
+            self.user, self.session_properties)
+
+    def _substitute_matviews(self, session, root, versions):
+        """The MV substitution pass, applied AFTER the plan cache (a
+        cached plan must stay substitution-free — freshness varies per
+        execution; the pass copies-on-write, never mutating the cached
+        tree) with the captured versions recomputed for the result-cache
+        key: the substituted plan's own scans (storage + any remaining
+        base scans) UNION the views' recorded base versions, so a
+        REFRESH and a base-table DML both invalidate cached results."""
+        from trino_tpu.matview.substitute import (
+            substitute_plan, substitution_versions)
+
+        new_root, notes = substitute_plan(session, root)
+        self.mv_notes = notes
+        self.mv_substitutions = [
+            n["view"] for n in notes if n["result"] == "substituted"]
+        if not self.mv_substitutions:
+            return root, versions
+        return new_root, substitution_versions(session, new_root, notes)
 
     def _finish_with_result_cache(self, session, root, key) -> None:
         """Shared tail of the SELECT lifecycle: serve/lead/bypass against
@@ -480,6 +583,19 @@ class QueryExecution:
             self.cache_status = "BYPASS"
             bound = bind_parameters(inner, stmt.params)
             M.EXECUTE_BIND_SECONDS.observe(fold_s)
+            if isinstance(bound, (ast.CreateMaterializedView,
+                                  ast.RefreshMaterializedView,
+                                  ast.DropMaterializedView)):
+                # prepared MV DDL takes the SAME path as the unprepared
+                # spelling: distributed refresh + executor-plane registry
+                # replication. The registered inner text serves as the
+                # definition SQL; with bound parameters the stored text no
+                # longer describes the bound AST, so replication (which
+                # ships definitions as SQL) degrades to local-only
+                self._run_mv_statement(
+                    session, bound,
+                    ps.sql if not stmt.params else None)
+                return
             self.state.set("RUNNING")
             with self.tracer.span("execute/coordinator-local"):
                 result = dispatch_statement(session, bound)
@@ -513,6 +629,12 @@ class QueryExecution:
             root._consult_meta = meta
         binding = "params=" + repr(
             [(str(c.type), repr(c.value)) for c in values])
+        # MV substitution on the BOUND plan (outside the bind timer): the
+        # result-cache key stays the parameterized canonical — still
+        # correct because the merged versions (storage + base) move on
+        # both REFRESH and base DML
+        bound_root, versions = self._substitute_matviews(
+            session, bound_root, versions)
         key = self._consult_result_cache(session, inner, bound_root,
                                          versions, prepared_meta=meta,
                                          binding=binding)
@@ -1336,6 +1458,11 @@ class QueryExecution:
         # adaptive plan changes applied so far — rides every statement
         # response so clients can render "[adapted: N]" live
         qs["adaptations"] = len(self.plan_versions)
+        # materialized-view substitutions in this query's plan (CLI
+        # prints "mv: <name>"; 0/absent when nothing matched fresh)
+        qs["mvHits"] = len(self.mv_substitutions)
+        if self.mv_substitutions:
+            qs["mvNames"] = list(self.mv_substitutions)
         # the phase ledger (obs/timeline.py): per-phase exclusive wall +
         # unattributed residual, None until the query is terminal
         qs["timeline"] = self.timeline_dict()
@@ -1365,11 +1492,17 @@ class QueryExecution:
             root = Planner(session).plan(inner)
         with tracing.span("optimize"):
             root = optimize(root, session)
+        root, _versions = self._substitute_matviews(session, root, None)
         plan_s = _time.perf_counter() - t_plan
         t_exec = _time.perf_counter()
         self._execute_query(session, root)
         exec_s = _time.perf_counter() - t_exec
         header = [wall_time_header(plan_s, exec_s)]
+        from trino_tpu.exec.query import mv_notes_header
+
+        mv_lines = mv_notes_header(self.mv_notes)
+        if mv_lines:
+            header.extend(mv_lines.rstrip("\n").split("\n"))
         # the phase ledger over the spans recorded so far (the EXPLAIN
         # query itself is still running while this renders)
         from trino_tpu.obs.timeline import summarize as summarize_timeline
@@ -1999,7 +2132,8 @@ class CoordinatorServer:
         def _shared_catalog_session(properties):
             from trino_tpu.client.session import Session
 
-            return Session(properties, catalogs=self.catalogs, udfs=self.udfs)
+            return Session(properties, catalogs=self.catalogs,
+                           udfs=self.udfs, matviews=self.matviews)
 
         self.session_factory = session_factory or _shared_catalog_session
         # query caching subsystem (trino_tpu/cache/): logical-plan cache +
@@ -2016,6 +2150,12 @@ class CoordinatorServer:
         from trino_tpu.server.prepared import PreparedStatementRegistry
 
         self.prepared = PreparedStatementRegistry()
+        # materialized views (trino_tpu/matview/): server-wide registry
+        # shared by every session this coordinator creates; replicated to
+        # executor processes via the sync_materialized_view procedure
+        from trino_tpu.matview.registry import MaterializedViewRegistry
+
+        self.matviews = MaterializedViewRegistry()
         self.queries: Dict[str, QueryExecution] = {}
         self._qlock = threading.Lock()
         self._qid = itertools.count(1)

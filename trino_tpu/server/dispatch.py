@@ -463,6 +463,10 @@ class Dispatcher:
              len(s.prepared.snapshot()), None,
              "authoritative registry; replicated to executor processes"
              if proc else "authoritative registry"),
+            ("materialized_views", owner, self.plane,
+             len(s.matviews), None,
+             "authoritative registry; replicated to executor processes"
+             if proc else "authoritative registry"),
             ("query_registry", owner, self.plane, len(s.queries), None,
              "every query registers here regardless of executing plane"),
             ("query_history", owner, self.plane, len(s.history), None,
@@ -786,6 +790,9 @@ class ProcessExecutorPlane:
             execution.cache_status = cache_status or stats.get(
                 "cacheStatus")
             execution.fast_path = stats.get("fastPath")
+            # MV substitutions decided in the child's planner surface on
+            # the dispatch-side execution too (queryStats.mvHits/mvNames)
+            execution.mv_substitutions = list(stats.get("mvNames") or ())
             execution.plane = f"executor-process:{child['index']}"
             fwd.set("childQueryId", child_qid)
             self._note_child_stats(execution, child, stats)

@@ -173,6 +173,8 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             from trino_tpu.connector.system.connector import device_cache_rows
 
             return device_cache_rows()
+        if (schema, table) == ("metadata", "materialized_views"):
+            return self._matview_rows()
         if (schema, table) == ("metrics", "metrics"):
             return self._metrics_rows()
         raise KeyError(f"system.{schema}.{table} does not exist")
@@ -239,6 +241,28 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             for e in self._server.prepared.snapshot()
         ]
 
+    def _matview_rows(self) -> List[tuple]:
+        """``system.metadata.materialized_views``: every registered view
+        with its freshness recomputed against the connectors' CURRENT
+        data versions at scan time — the table never shows a cached
+        verdict."""
+        from trino_tpu.matview.substitute import staleness_reason
+
+        rows = []
+        for mv in self._server.matviews.snapshot():
+            reason = staleness_reason(self._server.catalogs, mv)
+            base = ", ".join(
+                f"{c}.{s}.{t}@{v}" for (c, s, t), v in
+                (mv.base_versions or ()))
+            rows.append((
+                mv.catalog, mv.schema, mv.name, mv.owner,
+                mv.definition_sql, mv.storage_qualified,
+                reason is None, reason,
+                float(mv.last_refresh) if mv.last_refresh else None,
+                base or None, int(mv.hits), int(mv.refreshes),
+            ))
+        return rows
+
     def _metrics_rows(self) -> List[tuple]:
         from trino_tpu.connector.system.connector import metric_sample_rows
         from trino_tpu.server.events import refreshed_server_gauges
@@ -250,7 +274,40 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
     def procedure(self, schema: str, name: str):
         if (schema, name) == ("runtime", "kill_query"):
             return self._kill_query
+        if (schema, name) == ("runtime", "sync_materialized_view"):
+            return self._sync_materialized_view
         return None
+
+    def _sync_materialized_view(self, session, payload_b64,
+                                signature=None) -> str:
+        """CALL system.runtime.sync_materialized_view(b64_json, hmac):
+        apply one materialized-view registry replication payload — how
+        the dispatch process keeps executor-process replicas in step
+        with its authoritative registry after CREATE/REFRESH/DROP (the
+        prepared-statement broadcast analog, carried as data instead of
+        replayed SQL so children never re-execute a refresh). The
+        payload must be HMAC-signed with the cluster-internal secret
+        (server/wire.py — the same trust root every internal endpoint
+        verifies): an ordinary client cannot inject registry entries,
+        which would otherwise launder access control through a forged
+        storage-table pointer."""
+        import base64
+        import json
+
+        from trino_tpu.matview.lifecycle import sync_from_payload
+        from trino_tpu.server import wire
+
+        blob = str(payload_b64)
+        if not wire.verify(blob.encode(), str(signature)
+                           if signature is not None else None):
+            from trino_tpu.server.security import AccessDeniedError
+
+            raise AccessDeniedError(
+                "sync_materialized_view: bad internal signature — this "
+                "procedure is the executor-plane replication channel, "
+                "not a user surface")
+        payload = json.loads(base64.b64decode(blob))
+        return sync_from_payload(self._server.matviews, payload)
 
     def _kill_query(self, session, query_id, reason=None) -> str:
         """CALL system.runtime.kill_query(query_id, reason): FAIL the named

@@ -379,6 +379,32 @@ class CreateTableAs(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateMaterializedView(Statement):
+    """CREATE [OR REPLACE] MATERIALIZED VIEW [IF NOT EXISTS] name AS query
+    (reference: sql/tree/CreateMaterializedView + the connector SPI's
+    getMaterializedView/MaterializedViewFreshness flow)."""
+
+    name: tuple  # qualified name parts
+    query: "Query" = None
+    not_exists: bool = False
+    or_replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshMaterializedView(Statement):
+    """REFRESH MATERIALIZED VIEW name (reference:
+    sql/tree/RefreshMaterializedView + RefreshMaterializedViewTask)."""
+
+    name: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DropMaterializedView(Statement):
+    name: tuple
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class Insert(Statement):
     """INSERT INTO name [(cols)] query (VALUES arrives as a Values query
     body; reference: sql/tree/Insert)."""

@@ -214,10 +214,25 @@ class Parser:
                 body = self.expr()
                 return ast.CreateFunction(
                     name, tuple(params), rtype, body, or_replace)
+            if self.accept_soft("materialized"):
+                # CREATE [OR REPLACE] MATERIALIZED VIEW [IF NOT EXISTS]
+                # name AS query (reference: SqlBase.g4 createMaterializedView)
+                self.expect_soft("view")
+                not_exists = False
+                if self.accept_kw("if"):
+                    self.expect_kw("not")
+                    self.expect_kw("exists")
+                    not_exists = True
+                name = tuple(self.qualified_name())
+                self.expect_kw("as")
+                return ast.CreateMaterializedView(
+                    name, self.query(), not_exists, or_replace)
             if or_replace:
                 # accepting-and-ignoring OR REPLACE on tables would
                 # silently change semantics
-                raise ParseError("expected FUNCTION after CREATE OR REPLACE")
+                raise ParseError(
+                    "expected FUNCTION or MATERIALIZED VIEW after "
+                    "CREATE OR REPLACE")
             self.expect_kw("table")
             not_exists = False
             if self.accept_kw("if"):
@@ -268,6 +283,11 @@ class Parser:
                     break
             where = self.expr() if self.accept_kw("where") else None
             return ast.Update(name, tuple(assigns), where)
+        if self.at_soft("refresh") and self.at_soft("materialized", ahead=1):
+            self.advance()
+            self.advance()
+            self.expect_soft("view")
+            return ast.RefreshMaterializedView(tuple(self.qualified_name()))
         if self.accept_kw("drop"):
             if self.accept_soft("function"):
                 if_exists = False
@@ -275,6 +295,14 @@ class Parser:
                     self.expect_kw("exists")
                     if_exists = True
                 return ast.DropFunction(tuple(self.qualified_name()), if_exists)
+            if self.accept_soft("materialized"):
+                self.expect_soft("view")
+                if_exists = False
+                if self.accept_kw("if"):
+                    self.expect_kw("exists")
+                    if_exists = True
+                return ast.DropMaterializedView(
+                    tuple(self.qualified_name()), if_exists)
             self.expect_kw("table")
             if_exists = False
             if self.accept_kw("if"):

@@ -58,6 +58,10 @@ class TableScanNode(PlanNode):
     # after phase-1 narrowing; reference: AdaptivePlanner's runtime stats) —
     # when present, cardinality estimation starts from truth, not stats.
     runtime_rows: Optional[int] = None
+    # set by the materialized-view substitution pass (trino_tpu/matview/):
+    # this scan reads the named MV's storage table in place of a matched
+    # plan subtree — EXPLAIN renders it as ``[mv: <name>]``
+    mv_name: Optional[str] = None
 
     @property
     def output_types(self):
@@ -663,6 +667,8 @@ def format_plan(node: PlanNode, indent: int = 0, executor=None,
     detail = ""
     if isinstance(node, TableScanNode):
         detail = f" {node.catalog}.{node.schema}.{node.table} -> {node.column_names}"
+        if node.mv_name is not None:
+            detail += f" [mv: {node.mv_name}]"
         if node.constraint is not None:
             detail += f" constraint={node.constraint!r}"
         if node.table_handle is not None:

@@ -256,6 +256,9 @@ class Planner:
                     Field(n, f.type, wq.name) for n, f in zip(names, sub.scope.fields)
                 ]
                 return RelationPlan(sub.node, Scope(fields, outer_scope))
+            mv_plan = self._plan_matview(rel, outer_scope)
+            if mv_plan is not None:
+                return mv_plan
             return self.plan_table_scan(rel, outer_scope)
         if isinstance(rel, ast.AliasedRelation):
             inner = self.plan_relation(rel.relation, outer_scope, ctes)
@@ -390,6 +393,67 @@ class Planner:
             Field(n, t, alias) for n, t, in zip(names, produced)
         ]
         return RelationPlan(node, Scope(left.scope.fields + unnest_fields, outer_scope))
+
+    def _plan_matview(self, rel: ast.Table, outer_scope: Optional[Scope]
+                      ) -> Optional[RelationPlan]:
+        """FROM <materialized view name>: expand the registered
+        definition like a view (reference: view expansion in
+        StatementAnalyzer + getMaterializedView). Always correct —
+        freshness is irrelevant to an inline expansion — and the
+        expanded plan then flows through the transparent substitution
+        pass (trino_tpu/matview/substitute.py), which rewrites it into a
+        storage-table scan exactly when the view is fresh. A connector
+        table of the same resolved name wins (the registry never
+        shadows real tables); plan-time access control on the base
+        tables fires inside the expansion for the CURRENT principal."""
+        registry = getattr(self.session, "matviews", None)
+        if registry is None or registry.empty():
+            return None
+        parts = [p.lower() for p in rel.parts]
+        if len(parts) == 1:
+            catalog, schema, name = (self.default_catalog,
+                                     self.default_schema, parts[0])
+        elif len(parts) == 2:
+            catalog, schema, name = self.default_catalog, parts[0], parts[1]
+        elif len(parts) == 3:
+            catalog, schema, name = parts
+        else:
+            return None
+        mv = registry.get(catalog, schema, name)
+        if mv is None:
+            return None
+        conn = self.catalogs.get(catalog)
+        try:
+            if conn is not None and conn.get_table(schema, name) is not None:
+                return None  # a real table always wins over the registry
+        except Exception:  # noqa: BLE001 — metadata probe only
+            pass
+        expanding = getattr(self, "_mv_expanding", None)
+        if expanding is None:
+            expanding = self._mv_expanding = set()
+        key = (catalog, schema, name)
+        if key in expanding:
+            raise PlanningError(
+                f"materialized view cycle detected at {mv.qualified}")
+        stmt = mv.definition
+        udfs = getattr(self.session, "udfs", None)
+        if udfs:
+            from trino_tpu.sql.routines import expand_udfs
+
+            stmt = expand_udfs(stmt, udfs)
+        expanding.add(key)
+        # the definition's unqualified names keep resolving against the
+        # CREATOR's defaults, whatever session expands the view
+        saved = (self.default_catalog, self.default_schema)
+        self.default_catalog = mv.default_catalog
+        self.default_schema = mv.default_schema
+        try:
+            sub = self.plan_query(stmt, outer_scope, {})
+        finally:
+            self.default_catalog, self.default_schema = saved
+            expanding.discard(key)
+        fields = [Field(f.name, f.type, name) for f in sub.scope.fields]
+        return RelationPlan(sub.node, Scope(fields, outer_scope))
 
     def plan_table_scan(self, rel: ast.Table, outer_scope: Optional[Scope]) -> RelationPlan:
         parts = [p.lower() for p in rel.parts]
