@@ -1,0 +1,163 @@
+"""Memory-ledger microbench: the cluster footprint trajectory.
+
+Every BENCH_r*/QPS_r* round tracks throughput; this bench tracks what
+the same serving shape COSTS in memory, so a footprint regression (a
+leaked cache tier, an unbounded ring, a staging buffer that stopped
+releasing) gates exactly like a throughput regression. It boots a real
+coordinator + N workers in one process (the DistributedQueryRunner
+idiom), drives the TPC-H q3 shape with the device cache on, and reads
+the cluster memory ledger's own surfaces — the bench measures the
+instrumentation the PR ships:
+
+- **peak_rss_mb** — process peak RSS across the run, sampled from
+  ``/proc`` (obs/metrics.current_rss_bytes) every round; in-process the
+  coordinator and workers share it, on a real deployment each node's
+  announce payload carries its own ``rssBytes``;
+- **announced_rss_mb** — the largest worker-announced RSS the
+  coordinator saw (the ``system.runtime.nodes``-adjacent path);
+- **device_pool_peak_mb** — the device pool's high-water mark from the
+  ledger's watermark series (``MEMORY_LEDGER.pool_peaks``);
+- **attribution_fraction** — from ``system.runtime.memory``: named-owner
+  bytes / the ``total`` watermark row, per device pool at peak — the
+  >= 95% acceptance criterion as a trended metric (direction up).
+
+Writes ``MEMLEDGER_r01.json`` (folded into TRAJECTORY.json by
+``tools/bench_trend.py``; RSS/pool peaks gate direction=down,
+attribution direction=up). ``--check`` is the tiny-schema quick pass.
+
+Run:    python microbench/memledger.py [tpch_schema] [--workers W]
+Check:  python microbench/memledger.py --check
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MIN_ATTRIBUTION = 0.95  # the ISSUE acceptance bound
+ROUNDS = 5              # q3 repeats (cold round 1, warm rounds after)
+
+Q3_SQL = """
+select l_orderkey, o_orderdate, o_shippriority,
+       sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate, l_orderkey limit 10
+"""
+
+
+def _attribution(rows) -> float:
+    """Coverage from system.runtime.memory rows: named-owner bytes over
+    the per-(node, pool) ``total`` watermark, device pool only, summed
+    across nodes. No tracked bytes at all reads as full coverage."""
+    named: dict = {}
+    totals: dict = {}
+    for node_id, pool, owner, nbytes, _peak, _events in rows:
+        if pool != "device":
+            continue
+        if owner == "total":
+            totals[node_id] = totals.get(node_id, 0) + int(nbytes)
+        else:
+            named[node_id] = named.get(node_id, 0) + int(nbytes)
+    total = sum(totals.values())
+    if total <= 0:
+        return 1.0
+    return min(1.0, sum(named.get(n, 0) for n in totals) / total)
+
+
+def run(schema: str, workers: int) -> dict:
+    from trino_tpu.client import dbapi
+    from trino_tpu.obs import metrics as M
+    from trino_tpu.obs.memledger import MEMORY_LEDGER
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    coord = CoordinatorServer()
+    coord.start()
+    fleet = [WorkerServer(coordinator_url=coord.base_url,
+                          node_id=f"mem{i}") for i in range(workers)]
+    for w in fleet:
+        w.start()
+    assert coord.registry.wait_for_workers(workers, timeout=30.0)
+    try:
+        cur = dbapi.connect(
+            coordinator_url=coord.base_url, catalog="tpch", schema=schema,
+            device_cache_enabled="true").cursor()
+        peak_rss = 0
+        wall = []
+        for _ in range(ROUNDS):
+            t0 = time.perf_counter()
+            cur.execute(Q3_SQL)
+            wall.append(time.perf_counter() - t0)
+            rss = M.current_rss_bytes()
+            if rss:
+                peak_rss = max(peak_rss, rss)
+        # let the announce loop deliver the post-run owner rows (0.5 s
+        # cadence) before reading the coordinator-side table
+        time.sleep(1.5)
+        cur.execute("select node_id, pool, owner, bytes, peak_bytes, "
+                    "events from system.runtime.memory")
+        mem_rows = cur.fetchall()
+        announced = max(
+            (int(i.get("rssBytes") or 0)
+             for i in coord.cluster_memory._nodes.values()), default=0)
+        pool_peaks = MEMORY_LEDGER.pool_peaks()
+        return {
+            "round": 1,
+            "tpch_schema": schema,
+            "workers": workers,
+            "q3_rounds": ROUNDS,
+            "warm_q3_seconds": round(min(wall), 4),
+            "peak_rss_mb": round(peak_rss / 2**20, 1),
+            "announced_rss_mb": round(announced / 2**20, 1),
+            "device_pool_peak_mb": round(
+                int(pool_peaks.get("device") or 0) / 2**20, 3),
+            "host_pool_peak_mb": round(
+                int(pool_peaks.get("host") or 0) / 2**20, 3),
+            "attribution_fraction": round(_attribution(mem_rows), 4),
+            "memory_rows": len(mem_rows),
+        }
+    finally:
+        for w in fleet:
+            w.stop()
+        coord.stop()
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    check_mode = "--check" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    schema = args[0] if args else ("tiny" if check_mode else "sf1")
+    report = run(schema, workers=2)
+    print(json.dumps(report, indent=2))
+    assert report["memory_rows"] > 0, "system.runtime.memory came up empty"
+    assert report["attribution_fraction"] >= MIN_ATTRIBUTION, (
+        f"device-pool attribution {report['attribution_fraction']} below "
+        f"the {MIN_ATTRIBUTION} acceptance bound")
+    if check_mode:
+        print(f"memledger-check ok: rss {report['peak_rss_mb']}MB, "
+              f"device pool {report['device_pool_peak_mb']}MB, "
+              f"attribution {report['attribution_fraction']}")
+        return
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "MEMLEDGER_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out_path}: peak rss {report['peak_rss_mb']}MB, "
+          f"device pool peak {report['device_pool_peak_mb']}MB, "
+          f"attribution {report['attribution_fraction']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,23 @@ def test_manager_kills_once_per_pressure_window():
     assert len(killed) == 1
 
 
+def test_revocable_bytes_staleness_guard():
+    """A dead worker's cache bytes must not keep counting as reclaimable
+    headroom: announces older than STALE_HEARTBEATS missed heartbeats
+    drop out of revocable_bytes, and a fresh announce restores them."""
+    mgr = ClusterMemoryManager(kill=lambda q, r: None,
+                               heartbeat_interval_s=0.05)
+    payload = {"queryMemory": {}, "memoryBytes": 0, "memoryLimit": None,
+               "deviceCacheBytes": 4096, "hostCacheBytes": 1024}
+    mgr.update("w0", payload)
+    assert mgr.revocable_bytes() == 5120
+    # wait past the staleness horizon (3 missed heartbeats)
+    time.sleep(ClusterMemoryManager.STALE_HEARTBEATS * 0.05 + 0.1)
+    assert mgr.revocable_bytes() == 0
+    mgr.update("w0", payload)  # the worker comes back
+    assert mgr.revocable_bytes() == 5120
+
+
 def test_dispatch_gate_blocks_over_cluster_limit():
     mgr = ClusterMemoryManager(kill=lambda q, r: None,
                                cluster_limit_bytes=1000)
@@ -82,6 +99,19 @@ def test_oversized_query_killed_small_query_finishes(tight_cluster):
     assert big.state.get() == "FAILED", big.state.get()
     assert "EXCEEDED_CLUSTER_MEMORY" in (big.failure or ""), big.failure
     assert coord.cluster_memory.kills
+    # the FAILED query stores a flight-recorder postmortem whose memory
+    # snapshot names per-pool watermarks and top consumers; the terminal
+    # event listener captures it asynchronously, so poll for it
+    deadline = time.time() + 15
+    while big.postmortem is None and time.time() < deadline:
+        time.sleep(0.1)
+    pm = big.postmortem
+    assert pm and pm["state"] == "FAILED"
+    mem = pm["coordinator"]["memory"]
+    assert set(mem) == {"nodeId", "pools", "topConsumers", "sheds"}
+    assert mem["topConsumers"]  # someone held memory when the query died
+    for rows in mem["topConsumers"].values():
+        assert 0 < len(rows) <= 3
     # the cluster remains usable: a small query completes normally
     small = coord.submit("select count(*) from nation",
                          {"catalog": "tpch", "schema": "tiny"})

@@ -132,7 +132,10 @@ def test_cli_progress_and_summary_rendering():
     assert render_progress(stats) == "[RUNNING 0/1 stages, 3/6 splits, 0.5s]"
     summary = render_summary({"totalRows": 59837, "completedSplits": 2,
                               "totalSplits": 2, "peakBytes": 2048 * 1024})
-    assert summary == " [59.8K rows processed, 2/2 splits, peak 2048KiB]"
+    assert summary == " [59.8K rows processed, 2/2 splits, peak: 2048KiB]"
+    shed = render_summary({"peakBytes": 1024 * 1024,
+                           "memory": {"shedBytes": 512 * 1024}})
+    assert shed == " [peak: 1024KiB, shed: 512KiB]"
     assert render_summary(None) == ""
 
 

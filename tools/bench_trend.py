@@ -295,6 +295,28 @@ def _extract_matview(path: str) -> List[dict]:
     return out
 
 
+def _extract_memledger(path: str) -> List[dict]:
+    """MEMLEDGER_r*.json: the cluster footprint round — process peak RSS
+    and the ledger's per-pool peaks gate downward (a leak regresses the
+    trend), attribution coverage gates upward (owner attribution must
+    not decay). Schema/workers/rounds stay OUT: setup, not footprint."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    rnd = int(data.get("round", _round_of(path)))
+    out: List[dict] = []
+    for metric, unit, direction in (
+            ("peak_rss_mb", "MB", "down"),
+            ("announced_rss_mb", "MB", "down"),
+            ("device_pool_peak_mb", "MB", "down"),
+            ("host_pool_peak_mb", "MB", "down"),
+            ("attribution_fraction", "fraction", "up"),
+            ("warm_q3_seconds", "s", "down")):
+        if data.get(metric) is not None:
+            out.append(_entry("memledger", rnd, metric, data[metric],
+                              unit, direction, path))
+    return out
+
+
 _FAMILIES = (
     ("BENCH_r*.json", _extract_bench),
     ("QPS_r*.json", _extract_qps),
@@ -305,6 +327,7 @@ _FAMILIES = (
     ("RESULTS_r*.json", _extract_results),
     ("STAGING_r*.json", _extract_staging),
     ("MATVIEW_r*.json", _extract_matview),
+    ("MEMLEDGER_r*.json", _extract_memledger),
 )
 
 

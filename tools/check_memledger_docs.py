@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Fail when a memory-ledger event kind or pool is missing from README.
+
+Mirror of the other ``check_*_docs.py`` gates for the cluster memory
+ledger: the event vocabulary is DECLARED in
+``trino_tpu/obs/memledger.py`` (``EVENT_KINDS`` — the ledger raises on
+any kind outside it, so the tuple is the single source of truth), and
+every kind must be documented in README.md's Memory ledger section.
+Kinds are ordinary words (``reserve``, ``release``, ``shed``), so only a
+BACKTICKED mention counts — bare-word presence would pass vacuously.
+The two pool names (``device`` / ``host``) get the same treatment.
+
+The module loads standalone (no jax): memledger.py is deliberately
+stdlib-only at import time for exactly this reason.
+
+Wired into ``tools/lint.py --all`` (registry: tools/gates.py).
+
+Usage: ``python tools/check_memledger_docs.py [--readme PATH]`` — exit 0
+when every kind is documented, 1 with the missing names otherwise.
+"""
+from __future__ import annotations
+
+import sys
+
+if __package__ in (None, ""):  # script mode: tools/ on sys.path
+    import gates
+else:  # imported as tools.check_memledger_docs
+    from tools import gates
+
+
+def _load_ledger():
+    return gates.load_module_file("trino_tpu/obs/memledger.py",
+                                  "_memledger_standalone")
+
+
+def required_names() -> list:
+    """Every vocabulary member the README must backtick: the event kinds
+    plus the pool names."""
+    mod = _load_ledger()
+    return ([("event kind", k) for k in mod.EVENT_KINDS]
+            + [("pool", mod.POOL_DEVICE), ("pool", mod.POOL_HOST)])
+
+
+def check(readme_path: str | None = None) -> list:
+    """Missing documentation items (empty means the docs are complete)."""
+    text = gates.read_readme(readme_path)
+    backticked = gates.backticked_names(text)
+    return [f"{kind} {name} (needs a backticked `{name}`)"
+            for kind, name in required_names()
+            if name not in backticked]
+
+
+def main() -> int:
+    return gates.gate_main(
+        __doc__, check,
+        "memory-ledger event kinds/pools declared in "
+        "trino_tpu/obs/memledger.py but missing from README:",
+        "document each in README.md (## Observability, Memory ledger)",
+        lambda: (f"ok: all {len(_load_ledger().EVENT_KINDS)} ledger event "
+                 "kinds (and both pools) are documented"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

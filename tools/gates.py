@@ -119,6 +119,8 @@ ALL_GATES = (
      "every emitted span name documented in README"),
     ("system-table-docs", "check_system_table_docs",
      "every system table/column/procedure documented in README"),
+    ("memledger-docs", "check_memledger_docs",
+     "every memory-ledger event kind and pool documented in README"),
     ("tracer-leak", "lint.tracer_leak",
      "no import-time jnp evaluation; no jnp in repr/property/host modules"),
     ("lock-discipline", "lint.lock_discipline",

@@ -28,6 +28,14 @@ holding:
   waits RELEASE the wrapped lock and are legitimate — which is exactly
   why they must carry a ``# lint: allow(blocking-under-lock) <reason>``
   annotation instead of passing silently.
+- ``ledger-append-under-lock`` — a memory-ledger append
+  (``.record_event()`` / ``._ledger_event()``) while holding a lock.
+  The ledger takes its OWN process-global lock and (on shed events)
+  touches the metrics registry and flight recorder; appending from under
+  a subsystem lock both nests foreign locks under it and breaks the
+  emit-outside-lock contract that gives "exactly one shed event per
+  reclamation" (devcache collects freed bytes under ``self._lock``,
+  emits after releasing it).
 
 Suppression: ``# lint: allow(<rule>) <reason>`` (see tools/lint).
 """
@@ -44,6 +52,10 @@ from . import Violation, analyze_tree, qualified_name
 _BLOCKING_QUALNAMES = ("time.sleep", "wire.http_request")
 _BLOCKING_PREFIXES = ("requests.",)
 _BLOCKING_METHODS = ("block_until_ready", "wait", "wait_for")
+# memory-ledger append surfaces (obs/memledger.py + the devcache emit
+# helper): they acquire the ledger's own lock and may touch the metrics
+# registry / flight recorder — never call them while holding a lock
+_LEDGER_METHODS = ("record_event", "_ledger_event")
 
 
 @dataclasses.dataclass
@@ -168,6 +180,15 @@ def _scan_method(fn: ast.FunctionDef, kinds: Dict[str, str],
                         f"{held[-1]} — every contender stalls for the "
                         "call's full duration (sleep/network/device "
                         "sync under a lock)"))
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _LEDGER_METHODS):
+                    facts.violations.append(Violation(
+                        "ledger-append-under-lock", rel, node.lineno,
+                        f".{node.func.attr}() called while holding self."
+                        f"{held[-1]} — ledger appends take the process-"
+                        "global ledger lock (and shed events touch the "
+                        "metrics registry + flight recorder); collect "
+                        "bytes under the lock, emit after releasing it"))
             # record self.* calls even with no lock held: the fixpoint
             # must see acquisitions through unlocked intermediate hops
             # (top holds A, calls mid — lock-free — which calls bottom,

@@ -82,7 +82,12 @@ def render_summary(stats) -> str:
         parts.append(
             f"{stats.get('completedSplits', 0)}/{stats['totalSplits']} splits")
     if stats.get("peakBytes"):
-        parts.append(f"peak {stats['peakBytes'] // 1024}KiB")
+        parts.append(f"peak: {stats['peakBytes'] // 1024}KiB")
+    mem = stats.get("memory") or {}
+    if mem.get("shedBytes"):
+        # revocable cache bytes the cluster shed on this query's behalf
+        # (memory ledger: queryStats.memory)
+        parts.append(f"shed: {mem['shedBytes'] // 1024}KiB")
     if stats.get("adaptations"):
         # the runtime re-planner rewrote fragments mid-query (details:
         # planVersions on GET /v1/query/{id})

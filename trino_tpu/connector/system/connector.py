@@ -132,6 +132,15 @@ class SystemConnector(spi.Connector):
             # the cache pool is process-global: even without a live
             # provider a session can inspect its own process's entries
             return device_cache_rows()
+        if (schema, table) == ("runtime", "memory"):
+            # the memory ledger is process-global too: a providerless
+            # session reads its own process's owner rows
+            from trino_tpu.obs.memledger import MEMORY_LEDGER
+
+            nid = MEMORY_LEDGER.node_id or "local"
+            return [(nid, r["pool"], r["owner"], int(r["bytes"]),
+                     int(r["peakBytes"]), int(r["events"]))
+                    for r in MEMORY_LEDGER.owner_rows()]
         return []
 
     def scan(self, split: spi.Split, columns: List[str],

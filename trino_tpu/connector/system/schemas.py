@@ -35,6 +35,10 @@ SYSTEM_TABLES = {
         ("input_rows", "bigint"),
         ("output_bytes", "bigint"),
         ("peak_bytes", "bigint"),
+        ("shed_bytes", "bigint"),      # revocable-cache bytes shed on this
+                                       # query's behalf (memory ledger)
+        ("yield_events", "bigint"),    # revocable-yield events (spill-path
+                                       # cache yields) this query triggered
         ("result_rows", "bigint"),
         ("cache_status", "varchar"),   # HIT | MISS | BYPASS; NULL early
         ("adaptations", "bigint"),
@@ -140,6 +144,21 @@ SYSTEM_TABLES = {
         ("created_at", "double"),      # epoch seconds
         ("last_used_at", "double"),
         ("tier", "varchar"),           # hbm | host
+    ),
+    # the cluster memory ledger (trino_tpu/obs/memledger.py): one row per
+    # (node, pool, owner) — live attributed bytes, the owner's peak, and
+    # how many ledger events it produced. Owners: query:<id> |
+    # device-cache | host-cache | staging | mv-storage | total (the
+    # per-pool watermark row, so attribution coverage = sum(named owners)
+    # / total is computable from this table alone). Coordinator rows come
+    # from its own process ledger; worker rows ride the announce payload.
+    ("runtime", "memory"): (
+        ("node_id", "varchar"),
+        ("pool", "varchar"),           # device | host
+        ("owner", "varchar"),
+        ("bytes", "bigint"),           # live attributed bytes
+        ("peak_bytes", "bigint"),      # this owner's high-water mark
+        ("events", "bigint"),          # ledger events this owner produced
     ),
     # registered materialized views (trino_tpu/matview/): definitions,
     # storage location, and LIVE freshness (recomputed at scan time from

@@ -154,6 +154,12 @@ class DeviceTableCache:
     M_EVICTIONS = M.DEVICE_CACHE_EVICTIONS
     M_BYTES = M.DEVICE_CACHE_BYTES
 
+    # memory-ledger attribution (obs/memledger.py): which pool this
+    # tier's bytes live in and the owner its events carry — the host
+    # tier overrides both
+    LEDGER_POOL = "device"
+    LEDGER_OWNER = "device-cache"
+
     def __init__(self, max_bytes: Optional[int] = None):
         self._max_bytes = max_bytes
         self._lock = threading.Lock()
@@ -172,6 +178,20 @@ class DeviceTableCache:
     def _default_max_bytes(self) -> int:
         """Budget when the constructor did not pin one (subclass hook)."""
         return _default_budget()
+
+    def _ledger_event(self, kind: str, nbytes: int,
+                      reason: Optional[str] = None) -> None:
+        """One memory-ledger event for this tier. Callers MUST have
+        released ``self._lock`` first (the emission discipline
+        ``tools/lint/lock_discipline.py`` enforces): bytes are collected
+        inside the lock, the event is emitted after — which is also what
+        gives pressure sheds their exactly-one-event contract."""
+        if nbytes <= 0:
+            return
+        from trino_tpu.obs.memledger import MEMORY_LEDGER
+
+        MEMORY_LEDGER.record_event(
+            kind, self.LEDGER_POOL, self.LEDGER_OWNER, nbytes, reason=reason)
 
     # ---------------------------------------------------------- inspection
     @property
@@ -233,8 +253,9 @@ class DeviceTableCache:
         fan-out, exec/staging.py) — the caller re-resolves in-flight keys
         on its OWN thread afterwards with a blocking call."""
         while True:
+            inflight = False
             with self._lock:
-                self._drop_stale_locked(key)
+                stale_freed = self._drop_stale_locked(key)
                 ent = self._entries.get(key)
                 if ent is not None:
                     self._entries.move_to_end(key)
@@ -242,15 +263,20 @@ class DeviceTableCache:
                     ent.last_used_at = time.time()
                     self._hit_count += 1
                     self.M_HITS.inc()
-                    return ent, "hit"
-                flight = self._flights.get(key)
-                if flight is None:
-                    flight = self._flights[key] = _Flight()
-                    lead = True
                 else:
-                    if not wait:
-                        return None, "inflight"
-                    lead = False
+                    flight = self._flights.get(key)
+                    if flight is None:
+                        flight = self._flights[key] = _Flight()
+                        lead = True
+                    else:
+                        if not wait:
+                            inflight = True
+                        lead = False
+            self._ledger_event("evict", stale_freed, reason="stale")
+            if ent is not None:
+                return ent, "hit"
+            if inflight:
+                return None, "inflight"
             if not lead:
                 if not flight.wait(self.FLIGHT_WAIT_S):
                     # the leader is alive but STUCK (e.g. blocked in a
@@ -298,14 +324,16 @@ class DeviceTableCache:
         into the scan fan-out; a racing ``lookup_or_stage`` on the same
         key stays correct (it re-checks residency under the lock)."""
         with self._lock:
-            self._drop_stale_locked(key)
+            stale_freed = self._drop_stale_locked(key)
             ent = self._entries.get(key)
-            if ent is None:
-                return None
-            self._entries.move_to_end(key)
-            ent.hits += 1
-            ent.last_used_at = time.time()
-            self._hit_count += 1
+            if ent is not None:
+                self._entries.move_to_end(key)
+                ent.hits += 1
+                ent.last_used_at = time.time()
+                self._hit_count += 1
+        self._ledger_event("evict", stale_freed, reason="stale")
+        if ent is None:
+            return None
         self.M_HITS.inc()
         return ent
 
@@ -319,14 +347,21 @@ class DeviceTableCache:
                else min(self.max_bytes, int(admit_bytes)))
         if ent.nbytes > cap:
             return
+        evicted = 0
         with self._lock:
-            self._remove_locked(ent.key)
+            replaced = self._remove_locked(ent.key)
             while self._bytes + ent.nbytes > self.max_bytes and self._entries:
-                self._evict_lru_locked()
+                evicted += self._evict_lru_locked()
             self._entries[ent.key] = ent
             self._bytes += ent.nbytes
             self._by_table.setdefault(ent.key.table_id(), set()).add(ent.key)
             self.M_BYTES.set(self._bytes)
+        # ledger emission happens OUTSIDE the lock: bytes collected above,
+        # one aggregated evict event for however many LRU victims made room
+        self._ledger_event("evict", evicted, reason="lru")
+        if replaced is not None:
+            self._ledger_event("release", replaced.nbytes, reason="replace")
+        self._ledger_event("admit", ent.nbytes)
 
     def _remove_locked(self, key: CacheKey) -> Optional[CacheEntry]:
         ent = self._entries.pop(key, None)
@@ -347,49 +382,62 @@ class DeviceTableCache:
         self.M_BYTES.set(self._bytes)
         return victim.nbytes
 
-    def _drop_stale_locked(self, key: CacheKey) -> None:
+    def _drop_stale_locked(self, key: CacheKey) -> int:
         """Drop every entry of the same table whose data_version differs
         from the version the caller just observed: a mutation moved the
         version, so those arrays can never be served again — reclaim
-        their HBM now instead of waiting for LRU age-out."""
+        their HBM now instead of waiting for LRU age-out. Returns the
+        bytes freed so the caller can emit the ledger event AFTER
+        releasing the lock."""
         keys = self._by_table.get(key.table_id())
         if not keys:
-            return
+            return 0
         stale = [k for k in keys if k.data_version != key.data_version]
+        freed = 0
         for k in stale:
-            self._remove_locked(k)
+            victim = self._remove_locked(k)
+            if victim is not None:
+                freed += victim.nbytes
             self.M_EVICTIONS.inc()
         if stale:
             self.M_BYTES.set(self._bytes)
+        return freed
 
     # ------------------------------------------------------------ pressure
-    def yield_bytes(self, nbytes: int) -> int:
+    def yield_bytes(self, nbytes: int, reason: str = "yield") -> int:
         """Revocable-tier contract: shed at least ``nbytes`` of cached
         tables (LRU-first) for a running query's benefit; returns the
-        bytes actually freed. Never blocks on staging flights."""
+        bytes actually freed. Never blocks on staging flights. Each call
+        that frees anything emits EXACTLY ONE ledger ``shed`` event
+        carrying the reclaiming ``reason`` (``spill`` / ``pool-overflow``
+        / ``host-pressure`` / ``rss-escalation`` / ...)."""
         if nbytes <= 0:
             return 0
         freed = 0
         with self._lock:
             while freed < nbytes and self._entries:
                 freed += self._evict_lru_locked()
+        self._ledger_event("shed", freed, reason=reason)
         return freed
 
-    def evict_to(self, target_bytes: int) -> int:
+    def evict_to(self, target_bytes: int, reason: str = "trim") -> int:
         """Evict LRU entries until the cache holds at most
         ``target_bytes``; returns bytes freed."""
         freed = 0
         with self._lock:
             while self._bytes > max(0, int(target_bytes)) and self._entries:
                 freed += self._evict_lru_locked()
+        self._ledger_event("evict", freed, reason=reason)
         return freed
 
     def invalidate_all(self) -> None:
         with self._lock:
+            freed = self._bytes
             self._entries.clear()
             self._by_table.clear()
             self._bytes = 0
             self.M_BYTES.set(0)
+        self._ledger_event("release", freed, reason="invalidate")
 
 
 # the process-wide pool: coordinator-local execution, the compiled tier,

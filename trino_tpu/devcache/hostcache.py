@@ -84,6 +84,11 @@ class HostColumnCache(DeviceTableCache):
     M_EVICTIONS = M.HOST_CACHE_EVICTIONS
     M_BYTES = M.HOST_CACHE_BYTES
 
+    # this tier's pages are host RAM: its ledger events land in the host
+    # pool under the host-cache owner (obs/memledger.py taxonomy)
+    LEDGER_POOL = "host"
+    LEDGER_OWNER = "host-cache"
+
     def _default_max_bytes(self) -> int:
         return _default_budget()
 
@@ -120,7 +125,7 @@ def shed_revocable(nbytes: int) -> int:
 
     if nbytes <= 0:
         return 0
-    freed = HOST_CACHE.yield_bytes(nbytes)
+    freed = HOST_CACHE.yield_bytes(nbytes, reason="host-pressure")
     if freed < nbytes and _device_memory_host_backed():
         # escalate into the device tier ONLY where its arrays live in
         # host RAM (CPU meshes — no discoverable HBM): there, evicting
@@ -128,7 +133,11 @@ def shed_revocable(nbytes: int) -> int:
         # accelerator they are HBM-resident: evicting them would free
         # device memory, not host RSS, so a persistent RSS overage
         # would thrash the warm tier every announce cycle for nothing.
-        freed += DEVICE_CACHE.yield_bytes(nbytes - freed)
+        # Each tier's yield emits its own single shed event, so the
+        # ledger shows the escalation ORDER (host first, then device
+        # under the rss-escalation reason).
+        freed += DEVICE_CACHE.yield_bytes(nbytes - freed,
+                                          reason="rss-escalation")
     return freed
 
 

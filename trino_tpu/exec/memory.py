@@ -18,6 +18,9 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional
 
+from trino_tpu.obs import trace as tracing
+from trino_tpu.obs.memledger import MEMORY_LEDGER, POOL_DEVICE
+
 
 def page_bytes(page) -> int:
     """Exact device bytes of a Page (static shapes make this precise)."""
@@ -40,14 +43,27 @@ class SpillEvent:
 
 
 class MemoryContext:
-    """Per-query device-memory budget + peak tracking + spill log."""
+    """Per-query device-memory budget + peak tracking + spill log.
+
+    ``owner`` is the memory-ledger attribution tag (``query:<id>``):
+    when set, every peak INCREASE lands in the process
+    :data:`~trino_tpu.obs.memledger.MEMORY_LEDGER` as a ``reserve``
+    event for that owner (deltas, so the owner's live bytes track the
+    peak), and the spill decision's cache yield is charged to the query
+    (``shed_bytes`` / ``yields`` feed queryStats.memory through the
+    stats spine)."""
 
     MAX_SPILL_PARTITIONS = 64
 
-    def __init__(self, budget_bytes: Optional[int] = None):
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 owner: Optional[str] = None):
         self.budget = int(budget_bytes) if budget_bytes else None
+        self.owner = owner
         self.peak = 0
         self.spills: List[SpillEvent] = []
+        # revocable bytes shed on THIS query's behalf + yield-event count
+        self.shed_bytes = 0
+        self.yields = 0
 
     @property
     def enabled(self) -> bool:
@@ -55,13 +71,28 @@ class MemoryContext:
 
     def observe(self, nbytes: int) -> None:
         if nbytes > self.peak:
+            delta = nbytes - self.peak
             self.peak = nbytes
+            if self.owner:
+                MEMORY_LEDGER.record_event(
+                    "reserve", POOL_DEVICE, self.owner, delta)
+
+    def release(self) -> None:
+        """Query done: the owner's live bytes drop to zero (its peak and
+        event history stay in the ledger for attribution)."""
+        if self.owner and self.peak:
+            MEMORY_LEDGER.record_event(
+                "release", POOL_DEVICE, self.owner, self.peak, reason="done")
 
     def spill_partitions(self, projected_bytes: int) -> int:
         """1 = fits in budget; else the number of hash partitions (power of
         two) whose per-pass working set fits."""
         self.observe(projected_bytes)
         if self.budget is None or projected_bytes <= self.budget:
+            with tracing.span("memory/reserve") as sp:
+                sp.set("bytes", int(projected_bytes))
+                if self.owner:
+                    sp.set("owner", self.owner)
             return 1
         parts = 1
         while parts < self.MAX_SPILL_PARTITIONS and projected_bytes // parts > self.budget:
@@ -75,7 +106,16 @@ class MemoryContext:
         # flush a whole warm cache its passes will never displace).
         from trino_tpu.devcache import DEVICE_CACHE
 
-        DEVICE_CACHE.yield_bytes(projected_bytes // parts)
+        with tracing.span("memory/shed") as sp:
+            freed = DEVICE_CACHE.yield_bytes(
+                projected_bytes // parts, reason="spill")
+            sp.set("requested", int(projected_bytes // parts))
+            sp.set("freed", int(freed))
+            sp.set("partitions", parts)
+            if self.owner:
+                sp.set("owner", self.owner)
+        self.shed_bytes += freed
+        self.yields += 1
         return parts
 
     def record_spill(self, node_id: int, kind: str, partitions: int, projected: int) -> None:

@@ -140,6 +140,8 @@ def rollup_tasks_to_stage(fragment_id: int, task_entries: List[dict],
         "deviceS": 0.0,
         "peakBytes": 0,
         "spills": 0,
+        "shedBytes": 0,
+        "yieldEvents": 0,
         "deviceCacheHits": 0,
         "deviceCacheMisses": 0,
         "operatorStats": [ops[k].to_dict() for k in sorted(ops)],
@@ -158,6 +160,11 @@ def rollup_tasks_to_stage(fragment_id: int, task_entries: List[dict],
         stage["peakBytes"] = max(stage["peakBytes"],
                                  int(s.get("peakBytes", 0)))
         stage["spills"] += int(s.get("spills", 0))
+        # memory-ledger ride-along: bytes shed from the revocable caches
+        # on this task's behalf + yield events — SUMS (each task's sheds
+        # are distinct reclamations, unlike the shared-pool peak)
+        stage["shedBytes"] += int(s.get("shedBytes", 0))
+        stage["yieldEvents"] += int(s.get("yieldEvents", 0))
         stage["deviceCacheHits"] += int(s.get("deviceCacheHits", 0))
         stage["deviceCacheMisses"] += int(s.get("deviceCacheMisses", 0))
         # per-partition output bytes sum ELEMENTWISE across tasks: every
@@ -203,6 +210,8 @@ def rollup_stages_to_query(stages: List[dict]) -> dict:
         "peakBytes": max(
             [int(s.get("peakBytes", 0)) for s in stages], default=0),
         "spills": sum(int(s.get("spills", 0)) for s in stages),
+        "shedBytes": sum(int(s.get("shedBytes", 0)) for s in stages),
+        "yieldEvents": sum(int(s.get("yieldEvents", 0)) for s in stages),
         # warm-HBM serving signal: scans served from the device table
         # cache vs scans that paid a host->device transfer
         "deviceCacheHits": sum(

@@ -374,6 +374,8 @@ def blocked_transfer(profile: Optional[StageProfile] = None,
     import jax
     import jax.numpy as jnp
 
+    from trino_tpu.obs.memledger import MEMORY_LEDGER, POOL_DEVICE
+
     def transfer(arr: np.ndarray):
         arr = np.asarray(arr)
         n = arr.shape[-1] if arr.ndim else 0
@@ -382,19 +384,29 @@ def blocked_transfer(profile: Optional[StageProfile] = None,
         if not n or n <= 2 * block_rows or arr.nbytes > BLOCKED_MAX_BYTES:
             return jnp.asarray(arr)
         axis = arr.ndim - 1
-        blocks = []
-        for bi, i in enumerate(range(0, n, block_rows)):
-            idx = (slice(None),) * axis + (slice(i, i + block_rows),)
-            # force block bi - _INFLIGHT_PUTS resident BEFORE issuing
-            # block bi, so at most _INFLIGHT_PUTS un-materialized puts
-            # ever exist at once (forcing after the issue would briefly
-            # hold one extra)
-            if bi >= _INFLIGHT_PUTS:
-                blocks[bi - _INFLIGHT_PUTS].block_until_ready()
-            blocks.append(jax.device_put(arr[idx]))
-        if profile is not None:
-            profile.transfer_blocks += len(blocks)
-        return jnp.concatenate(blocks, axis=axis)
+        # the blocked path's transient scratch (blocks + concat output,
+        # ~2x the column — the BLOCKED_MAX_BYTES comment) is attributed
+        # to the ledger's staging owner for its lifetime: this is
+        # device-pool pressure the eviction machinery cannot see
+        MEMORY_LEDGER.record_event(
+            "reserve", POOL_DEVICE, "staging", int(arr.nbytes))
+        try:
+            blocks = []
+            for bi, i in enumerate(range(0, n, block_rows)):
+                idx = (slice(None),) * axis + (slice(i, i + block_rows),)
+                # force block bi - _INFLIGHT_PUTS resident BEFORE issuing
+                # block bi, so at most _INFLIGHT_PUTS un-materialized puts
+                # ever exist at once (forcing after the issue would briefly
+                # hold one extra)
+                if bi >= _INFLIGHT_PUTS:
+                    blocks[bi - _INFLIGHT_PUTS].block_until_ready()
+                blocks.append(jax.device_put(arr[idx]))
+            if profile is not None:
+                profile.transfer_blocks += len(blocks)
+            return jnp.concatenate(blocks, axis=axis)
+        finally:
+            MEMORY_LEDGER.record_event(
+                "release", POOL_DEVICE, "staging", int(arr.nbytes))
 
     return transfer
 

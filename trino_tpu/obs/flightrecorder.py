@@ -104,7 +104,10 @@ def pull_worker_rings(locations, timeout: float = 3.0,
             if status < 400:
                 payload = json.loads(body)
                 return {"url": url, "nodeId": payload.get("nodeId"),
-                        "records": payload.get("records", [])}
+                        "records": payload.get("records", []),
+                        # memory-ledger snapshot rides the same pull so a
+                        # postmortem names each node's top consumers
+                        "memory": payload.get("memory")}
             return {"url": url, "error": f"status {status}"}
         except Exception as e:  # noqa: BLE001 — a dead worker IS the story
             return {"url": url, "error": str(e)[:300]}

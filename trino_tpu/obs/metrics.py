@@ -652,6 +652,35 @@ PROCESS_GC_COLLECTIONS = REGISTRY.gauge(
     "(point-in-time read of gc.get_stats)", ("generation",))
 
 
+# fixed byte buckets for memory-size histograms: 64KiB..64GiB in powers
+# of four — straddles tiny test pages through sf100 working sets
+MEMORY_BUCKETS_BYTES: Tuple[float, ...] = (
+    64 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20, 64 << 20, 256 << 20,
+    1 << 30, 4 << 30, 16 << 30, 64 << 30)
+
+# cluster memory ledger (obs/memledger.py): per-pool occupancy sampled on
+# the worker announce loop, pressure-shed events by reclaiming action,
+# and the per-query peak distribution observed at query completion
+MEMORY_POOL_BYTES = REGISTRY.gauge(
+    "trino_tpu_memory_pool_bytes",
+    "live memory-pool occupancy by pool and node, sampled on the worker "
+    "announce loop (device = query reservations + warm-HBM cache [+ "
+    "staging scratch]; host = host-RAM page cache [+ other tracked host "
+    "owners])", ("pool", "node"))
+MEMORY_PRESSURE_EVENTS = REGISTRY.counter(
+    "trino_tpu_memory_pressure_events_total",
+    "revocable-tier pressure sheds by reclaiming action (spill = a "
+    "query's pre-spill cache yield; pool-overflow = device pool over its "
+    "limit; host-pressure = process RSS over the node limit; "
+    "rss-escalation = host pressure escalated into host-backed device "
+    "entries; yield = direct cache yields)", ("action",))
+QUERY_PEAK_MEMORY_BYTES = REGISTRY.histogram(
+    "trino_tpu_query_peak_memory_bytes",
+    "per-query peak device-pool bytes (max over tasks/stages), observed "
+    "once per terminal query", ("state",),
+    buckets=MEMORY_BUCKETS_BYTES)
+
+
 def current_rss_bytes():
     """This process's CURRENT resident set (VmRSS), or None where /proc
     is unavailable — callers needing a live pressure signal (the worker

@@ -118,6 +118,12 @@ SPAN_PHASE: Dict[str, Tuple[int, str]] = {
     "device/compile": (_P_DEVICE, "device-execute"),
     "device/execute": (_P_DEVICE, "device-execute"),
     "exchange/overlap": (_P_DEVICE, "device-execute"),
+    # the memory ledger's spans (exec/memory.py): the budget check and
+    # the pre-spill revocable-tier yield both happen INSIDE the executing
+    # operator, so their wall charges to device-execute like the device
+    # windows they interrupt
+    "memory/reserve": (_P_DEVICE, "device-execute"),
+    "memory/shed": (_P_DEVICE, "device-execute"),
     "exchange/pull": (_P_EXCHANGE, "exchange-wait"),
     "spool/read": (_P_EXCHANGE, "exchange-wait"),
     "result/serialize": (_P_RESULT, "result-serialization"),

@@ -31,12 +31,20 @@ class ClusterMemoryManager:
     cluster limit; kills the policy's victim when a worker is over its
     pool."""
 
+    # worker announce cadence (server/worker.py announce loop)
+    HEARTBEAT_INTERVAL_S = 0.5
+    # announces older than this many missed heartbeats are STALE: a dead
+    # worker's cache bytes must not keep counting as reclaimable headroom
+    STALE_HEARTBEATS = 3
+
     def __init__(self, kill, cluster_limit_bytes: Optional[int] = None,
-                 policy: KillerPolicy = total_reservation_killer):
+                 policy: KillerPolicy = total_reservation_killer,
+                 heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S):
         # kill(query_id, reason) — provided by the coordinator
         self._kill = kill
         self.cluster_limit_bytes = cluster_limit_bytes
         self.policy = policy
+        self.heartbeat_interval_s = heartbeat_interval_s
         self._lock = threading.Lock()
         # node_id -> {"queryMemory": {...}, "memoryBytes": n, "memoryLimit": n|None}
         self._nodes: Dict[str, dict] = {}
@@ -62,6 +70,10 @@ class ClusterMemoryManager:
                 # HBM tier (devcache.shed_revocable), and admission
                 # ignores it for the same reason
                 "hostCacheBytes": int(payload.get("hostCacheBytes") or 0),
+                # per-pool, per-owner memory-ledger rows + process RSS
+                # (the system.runtime.memory per-node source)
+                "memoryOwners": list(payload.get("memoryOwners") or ()),
+                "rssBytes": payload.get("rssBytes"),
                 "at": time.monotonic(),
             }
         self._maybe_kill()
@@ -94,11 +106,26 @@ class ClusterMemoryManager:
     def revocable_bytes(self) -> int:
         """Cluster-wide revocable bytes across BOTH cache tiers —
         reclaimable on demand (workers shed host-RAM pages first, then
-        warm-HBM tables, for running queries' benefit)."""
+        warm-HBM tables, for running queries' benefit). STALE announces
+        (older than STALE_HEARTBEATS missed heartbeats) are skipped: a
+        dead worker's cache cannot actually be reclaimed, so its bytes
+        must not be promised as headroom."""
+        horizon = self.STALE_HEARTBEATS * self.heartbeat_interval_s
+        now = time.monotonic()
         with self._lock:
             return sum(int(i.get("deviceCacheBytes") or 0)
                        + int(i.get("hostCacheBytes") or 0)
-                       for i in self._nodes.values())
+                       for i in self._nodes.values()
+                       if now - i["at"] <= horizon)
+
+    def memory_rows(self) -> list:
+        """(node_id, owner-row) pairs from the newest announce of every
+        tracked node — the coordinator's system.runtime.memory feed (its
+        own process ledger supplies the coordinator rows)."""
+        with self._lock:
+            return [(nid, dict(row))
+                    for nid, info in sorted(self._nodes.items())
+                    for row in info.get("memoryOwners") or ()]
 
     def effective_limit(self) -> Optional[int]:
         """The admission ceiling: the configured cluster limit when set,

@@ -1175,6 +1175,10 @@ class QueryExecution:
             if reason is not None:
                 sp.set("reason", reason)
             ex = Executor(session)
+            # memory-ledger attribution: the coordinator-local path runs
+            # ONE executor per query, so owner mode is exact here (the
+            # worker tier attributes at the task level instead)
+            ex.memory.owner = f"query:{self.query_id}"
             page = ex.execute_checked(root)
             if reason is not None:
                 sp.set("rows", page.live_count())
@@ -1185,6 +1189,7 @@ class QueryExecution:
         # local-catalog export spools from the coordinator's own store
         self._materialize_result(session, page)
         self._note_local_stats(ex, time.perf_counter() - t0)
+        ex.memory.release()
 
     def _note_local_stats(self, ex, elapsed_s: float) -> None:
         """Fold a coordinator-local execution's stats into the task-stats
@@ -1206,6 +1211,8 @@ class QueryExecution:
                 st.output_bytes for st in ex.node_stats.values()),
             "peakBytes": int(ex.memory.peak),
             "spills": len(ex.memory.spills),
+            "shedBytes": int(ex.memory.shed_bytes),
+            "yieldEvents": int(ex.memory.yields),
             "deviceCacheHits": sum(
                 1 for d in scan_cache.values() if d == "hit"),
             "deviceCacheMisses": sum(
@@ -1404,6 +1411,7 @@ class QueryExecution:
         on QueryCompletedEvent) and on demand by
         ``GET /v1/query/{id}/trace?recorder=1``."""
         from trino_tpu.obs.flightrecorder import pull_worker_rings
+        from trino_tpu.obs.memledger import MEMORY_LEDGER
 
         locations = [loc for locs in list(self.fragment_tasks.values())
                      for loc in list(locs) if loc is not None]
@@ -1422,6 +1430,10 @@ class QueryExecution:
                 "nodeId": getattr(self.recorder, "node_id", "coordinator"),
                 "records": (self.recorder.snapshot()
                             if self.recorder is not None else []),
+                # memory-ledger snapshot: per-pool live/peak bytes, top
+                # consumers by owner, and the last shed events — names
+                # WHO was holding memory when the query died
+                "memory": MEMORY_LEDGER.memory_snapshot(),
             },
             "workers": pull_worker_rings(locations, timeout=timeout,
                                          pool=self.io_pool),
@@ -1466,6 +1478,15 @@ class QueryExecution:
         # the phase ledger (obs/timeline.py): per-phase exclusive wall +
         # unattributed residual, None until the query is terminal
         qs["timeline"] = self.timeline_dict()
+        # the memory block: peak by pool plus what was shed/yielded on
+        # this query's behalf (cluster memory ledger read surface — the
+        # CLI summary tag and system.runtime.queries columns feed here)
+        qs["memory"] = {
+            "peakBytes": int(qs.get("peakBytes") or 0),
+            "shedBytes": int(qs.get("shedBytes") or 0),
+            "yieldEvents": int(qs.get("yieldEvents") or 0),
+            "spills": int(qs.get("spills") or 0),
+        }
         return qs
 
     def _explain_analyze(self, session, stmt) -> str:
@@ -1555,6 +1576,24 @@ class QueryExecution:
             f" input rows: {qs['totalRows']},"
             f" peak task memory: {qs['peakBytes'] // 1024}KiB,"
             f" spills: {qs['spills']}")
+        if qs.get("shedBytes"):
+            header.append(
+                f"Memory pressure: {qs['shedBytes'] // 1024}KiB shed from "
+                f"revocable caches across {qs.get('yieldEvents', 0)} "
+                f"yield event(s)")
+        # per-node peak annotation (memory ledger): the MAX task peak
+        # each worker reached for this query — spots the skewed node a
+        # cluster-wide rollup hides
+        node_peaks: Dict[str, int] = {}
+        for rec in self.task_records():
+            node = rec.get("workerUri") or "coordinator"
+            pb = int((rec.get("stats") or {}).get("peakBytes") or 0)
+            if pb > node_peaks.get(node, 0):
+                node_peaks[node] = pb
+        if node_peaks:
+            header.append("Peak task memory by node: " + ", ".join(
+                f"{node} {pb // 1024}KiB"
+                for node, pb in sorted(node_peaks.items())))
         return "\n".join(header) + "\n" + format_fragments(
             self.fragments, stats=node_stats, stage_stats=stage_by_id,
             verbose=stmt.verbose, adapted=self._adapted_notes())
@@ -2194,6 +2233,15 @@ class CoordinatorServer:
         from trino_tpu.obs.flightrecorder import FlightRecorder
 
         self.recorder = FlightRecorder(node_id="coordinator")
+        # cluster memory ledger (obs/memledger.py): the process-global
+        # ring takes this node's identity once (an in-process worker may
+        # have stamped it first — tests run both in one interpreter) and
+        # mirrors shed events into the flight recorder for postmortems
+        from trino_tpu.obs.memledger import MEMORY_LEDGER
+
+        if not MEMORY_LEDGER.node_id:
+            MEMORY_LEDGER.node_id = "coordinator"
+        MEMORY_LEDGER.attach_recorder(self.recorder)
         # spooled result segments (server/segments.py): the coordinator's
         # own store — coordinator-local/fast-path queries (and
         # non-trivial-root distributed ones) spool here, so the protocol
@@ -2324,6 +2372,14 @@ class CoordinatorServer:
             self.recorder.record("event", "query-completed",
                                  queryId=query_id, state=state,
                                  wallS=round(wall, 6))
+            # query-peak histogram (memory ledger): one sample per
+            # terminal query, from the task→stage→query rollup
+            try:
+                peak = int(execution.query_stats().get("peakBytes") or 0)
+                if peak:
+                    M.QUERY_PEAK_MEMORY_BYTES.observe(peak, state)
+            except Exception:  # noqa: BLE001 — observability, never a
+                pass  # reason to disturb the terminal transition
             # the phase ledger: computed ONCE here (the merged span tree
             # exists now) and fed into the per-phase histogram — this is
             # where every millisecond of the wall gets attributed

@@ -72,6 +72,8 @@ def query_record(execution, state: Optional[str] = None,
         "inputRows": int(qs.get("totalRows", 0)),
         "outputBytes": int(qs.get("totalBytes", 0)),
         "peakBytes": int(qs.get("peakBytes", 0)),
+        "shedBytes": int(qs.get("shedBytes", 0)),
+        "yieldEvents": int(qs.get("yieldEvents", 0)),
         "resultRows": len(execution.rows),
         "cacheStatus": execution.cache_status,
         "adaptations": adaptations,
@@ -95,7 +97,8 @@ def _query_row(rec: dict) -> tuple:
         rec["queryId"], rec["state"], rec["user"], rec["query"],
         rec["createdAt"], rec["endedAt"], rec["elapsedMs"], rec["deviceS"],
         rec["totalSplits"], rec["completedSplits"], rec["inputRows"],
-        rec["outputBytes"], rec["peakBytes"], rec["resultRows"],
+        rec["outputBytes"], rec["peakBytes"], rec.get("shedBytes", 0),
+        rec.get("yieldEvents", 0), rec["resultRows"],
         rec["cacheStatus"], rec["adaptations"], rec["planVersions"],
         rec["failure"], rec.get("fastPath"),
         rec.get("queuedMs"), rec.get("planningMs"),
@@ -173,6 +176,8 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             from trino_tpu.connector.system.connector import device_cache_rows
 
             return device_cache_rows()
+        if (schema, table) == ("runtime", "memory"):
+            return self._memory_rows()
         if (schema, table) == ("metadata", "materialized_views"):
             return self._matview_rows()
         if (schema, table) == ("metrics", "metrics"):
@@ -230,6 +235,33 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
                 int(info.get("hostCacheBytes") or 0),
                 int(info.get("hostCacheHits") or 0),
             ))
+        return rows
+
+    def _memory_rows(self) -> List[tuple]:
+        """``system.runtime.memory``: the cluster memory ledger — one row
+        per (node, pool, owner). Worker rows come from each node's newest
+        announce payload (cluster_memory.memory_rows); the coordinator
+        contributes its own process ledger directly (it never announces
+        to itself). A worker ledger sharing this process (in-process test
+        clusters stamp the global ledger with the worker's node id) is
+        NOT double-reported: announce rows win for that node id."""
+        from trino_tpu.obs.memledger import MEMORY_LEDGER
+
+        rows = []
+        announced = set()
+        for nid, row in self._server.cluster_memory.memory_rows():
+            announced.add(nid)
+            rows.append((
+                nid, str(row.get("pool", "")), str(row.get("owner", "")),
+                int(row.get("bytes", 0)), int(row.get("peakBytes", 0)),
+                int(row.get("events", 0)),
+            ))
+        nid = MEMORY_LEDGER.node_id or "coordinator"
+        if nid not in announced:
+            rows.extend(
+                (nid, r["pool"], r["owner"], int(r["bytes"]),
+                 int(r["peakBytes"]), int(r["events"]))
+                for r in MEMORY_LEDGER.owner_rows())
         return rows
 
     def _prepared_rows(self) -> List[tuple]:

@@ -211,6 +211,10 @@ class SqlTask:
         # serde compression flattens a constant hot key to almost no bytes
         self.partition_rows: Optional[List[int]] = None
         self.spill_count = 0
+        # revocable-tier bytes shed on this task's behalf + yield-event
+        # count (exec/memory.py spill path) — queryStats.memory inputs
+        self.shed_bytes = 0
+        self.yield_events = 0
         # device-cache dispositions of this task's scans (warm-serving
         # telemetry: rolls up task -> stage -> query and into the CLI)
         self.device_cache_hits = 0
@@ -222,7 +226,19 @@ class SqlTask:
 
     def _track_executor(self, ex) -> None:
         self._live_executor = ex
-        self.peak_memory_bytes = max(self.peak_memory_bytes, ex.memory.peak)
+        if ex.memory.peak > self.peak_memory_bytes:
+            # task-level reservation event: the TASK peak is max over its
+            # (sequential) executors, so deltas here never double-count
+            # the per-split/per-batch executor peaks the way summing
+            # per-executor events would (exec/memory.py owner mode is for
+            # the coordinator-local one-executor-per-query path)
+            delta = ex.memory.peak - self.peak_memory_bytes
+            self.peak_memory_bytes = ex.memory.peak
+            from trino_tpu.obs.memledger import MEMORY_LEDGER, POOL_DEVICE
+
+            MEMORY_LEDGER.record_event(
+                "reserve", POOL_DEVICE,
+                f"query:{self.request.query_id}", delta)
 
     def _retire_executor(self, ex, splits: int = 0, input_rows: int = 0,
                          device_s: float = 0.0) -> None:
@@ -249,6 +265,8 @@ class SqlTask:
             self.splits_completed += splits
             self.input_rows += input_rows
             self.spill_count += len(ex.memory.spills)
+            self.shed_bytes += ex.memory.shed_bytes
+            self.yield_events += ex.memory.yields
             self.device_cache_hits += sum(
                 1 for d in ex.scan_cache.values() if d == "hit")
             self.device_cache_misses += sum(
@@ -279,6 +297,8 @@ class SqlTask:
                 "outputBytes": self.output_bytes,
                 "peakBytes": peak,
                 "spills": self.spill_count,
+                "shedBytes": self.shed_bytes,
+                "yieldEvents": self.yield_events,
                 "deviceCacheHits": self.device_cache_hits,
                 "deviceCacheMisses": self.device_cache_misses,
                 "operatorStats": ops,
@@ -330,6 +350,14 @@ class SqlTask:
         finally:
             self.ended_at = time.monotonic()
             self._observe_operator_metrics()
+            if self.peak_memory_bytes:
+                from trino_tpu.obs.memledger import (MEMORY_LEDGER,
+                                                     POOL_DEVICE)
+
+                MEMORY_LEDGER.record_event(
+                    "release", POOL_DEVICE,
+                    f"query:{self.request.query_id}",
+                    self.peak_memory_bytes, reason="done")
             task_span.set("state", self.state.get())
             self.tracer.end_span(task_span)
             if self._otlp is not None:

@@ -69,8 +69,17 @@ class WorkerServer:
         # coordinator into FAILED-query postmortems via
         # GET /v1/task/{id}/recorder
         from trino_tpu.obs.flightrecorder import FlightRecorder
+        from trino_tpu.obs.memledger import MEMORY_LEDGER
 
         self.recorder = FlightRecorder(node_id=self.node_id)
+        # the process memory ledger (obs/memledger.py): stamp this node's
+        # identity (first server in the process wins — in-process test
+        # clusters share one ledger exactly like they share the metrics
+        # registry and the cache tiers) and mirror pressure sheds into
+        # the flight recorder so OOM postmortems name the shed tier
+        if not MEMORY_LEDGER.node_id:
+            MEMORY_LEDGER.node_id = self.node_id
+        MEMORY_LEDGER.attach_recorder(self.recorder)
         # OTLP export, on only when TRINO_TPU_OTLP_ENDPOINT is set: each
         # completed task ships its span dump under the query's PROPAGATED
         # trace id, so worker spans parent into the coordinator's trace
@@ -161,7 +170,8 @@ class WorkerServer:
                             + devcache.DEVICE_CACHE.cached_bytes()
                             - self.memory_limit_bytes)
                     if over > 0 and q_total < self.memory_limit_bytes:
-                        devcache.DEVICE_CACHE.yield_bytes(over)
+                        devcache.DEVICE_CACHE.yield_bytes(
+                            over, reason="pool-overflow")
                 # host-RAM pressure is the SEPARATE budget where the
                 # two-tier shed order applies: when the process RSS
                 # crosses the optional node limit, shed host pages
@@ -173,14 +183,23 @@ class WorkerServer:
                 # reports the lifetime PEAK on /proc-less platforms,
                 # which would latch the shed on forever once crossed —
                 # no reading, no shed.
-                if self.host_memory_limit_bytes is not None:
-                    from trino_tpu.obs.metrics import current_rss_bytes
+                from trino_tpu.obs import metrics as M
 
-                    rss = current_rss_bytes()
-                    if rss is not None:
-                        over_host = rss - self.host_memory_limit_bytes
-                        if over_host > 0:
-                            devcache.shed_revocable(over_host)
+                rss = M.current_rss_bytes()
+                if self.host_memory_limit_bytes is not None and rss is not None:
+                    over_host = rss - self.host_memory_limit_bytes
+                    if over_host > 0:
+                        devcache.shed_revocable(over_host)
+                # sample the memory ledger on the announce cadence: live
+                # per-owner bytes from ground-truth sources (the ledger's
+                # event-driven live numbers never drift past one
+                # heartbeat), per-pool watermarks + RSS + jax device
+                # capacity into the per-node time series, and the
+                # process gauges (RSS/fds/threads) so OTLP export and
+                # system.metrics see LIVE values even when nobody
+                # scrapes /v1/metrics
+                mem_rows = self._sample_memory(qmem, rss)
+                M.refresh_process_gauges()
                 wire.json_request(
                     "PUT",
                     f"{self.coordinator_url}/v1/announce/{self.node_id}",
@@ -204,6 +223,10 @@ class WorkerServer:
                      "hostCacheBytes":
                          devcache.HOST_CACHE.cached_bytes(),
                      "hostCacheHits": devcache.HOST_CACHE.hit_count(),
+                     # per-pool, per-owner attribution rows (memory
+                     # ledger): system.runtime.memory's per-node source
+                     "memoryOwners": mem_rows,
+                     "rssBytes": rss,
                      # surfaced by system.runtime.nodes (reference: the
                      # node version in NodeSystemTable rows)
                      "version": __version__},
@@ -212,6 +235,58 @@ class WorkerServer:
             except Exception:  # noqa: BLE001 — coordinator may not be up yet
                 pass
             self._stop.wait(0.5)
+
+    def _sample_memory(self, qmem: dict, rss: Optional[int]) -> list:
+        """One announce tick's memory-ledger sampling: sync live per-owner
+        bytes from their ground-truth sources (task reservations, cache
+        occupancy), sample the per-pool watermarks into the time-series
+        ring, set the per-pool gauges, and return the per-owner rows the
+        announce payload ships (``memoryOwners``)."""
+        from trino_tpu import devcache
+        from trino_tpu.obs import metrics as M
+        from trino_tpu.obs.memledger import MEMORY_LEDGER, TOTAL_OWNER
+
+        dev_owners = {f"query:{q}": int(b) for q, b in qmem.items()}
+        dev_owners["device-cache"] = devcache.DEVICE_CACHE.cached_bytes()
+        host_owners = {"host-cache": devcache.HOST_CACHE.cached_bytes()}
+        # transient owners the sources above cannot see (staging scratch,
+        # MV storage) ride in from the ledger's event-driven live bytes
+        for row in MEMORY_LEDGER.owner_rows():
+            owners = dev_owners if row["pool"] == "device" else host_owners
+            if (row["owner"] != TOTAL_OWNER
+                    and not row["owner"].startswith("query:")
+                    and row["owner"] not in owners and row["bytes"] > 0):
+                owners[row["owner"]] = row["bytes"]
+        MEMORY_LEDGER.sync_pool("device", dev_owners, prefix="query:")
+        MEMORY_LEDGER.sync_pool("host", host_owners)
+        totals = {"device": sum(dev_owners.values()),
+                  "host": sum(host_owners.values())}
+        MEMORY_LEDGER.sample_watermarks(
+            totals, rss_bytes=rss,
+            device_total_bytes=devcache.device_memory_bytes())
+        for pool, total in totals.items():
+            M.MEMORY_POOL_BYTES.set(total, pool, self.node_id)
+        ledger = {(r["pool"], r["owner"]): r
+                  for r in MEMORY_LEDGER.owner_rows()}
+        rows = []
+        for pool, owners in (("device", dev_owners), ("host", host_owners)):
+            for owner, nbytes in sorted(owners.items()):
+                lr = ledger.get((pool, owner), {})
+                rows.append({
+                    "pool": pool, "owner": owner, "bytes": int(nbytes),
+                    "peakBytes": max(int(lr.get("peakBytes", 0)),
+                                     int(nbytes)),
+                    "events": int(lr.get("events", 0)),
+                })
+            lr = ledger.get((pool, TOTAL_OWNER), {})
+            rows.append({
+                "pool": pool, "owner": TOTAL_OWNER,
+                "bytes": int(totals[pool]),
+                "peakBytes": max(int(lr.get("peakBytes", 0)),
+                                 int(totals[pool])),
+                "events": int(lr.get("events", 0)),
+            })
+        return rows
 
 
 def _make_handler(server: WorkerServer):
@@ -333,11 +408,16 @@ def _make_handler(server: WorkerServer):
                 # wants the context AROUND the failure (what else ran,
                 # which spans closed last) — and it still answers after
                 # the task itself was pruned from the manager
+                from trino_tpu.obs.memledger import MEMORY_LEDGER
+
                 self._send(200, json.dumps({
                     "nodeId": server.node_id,
                     "taskId": m.group(1),
                     "taskKnown": server.tasks.get(m.group(1)) is not None,
                     "records": server.recorder.snapshot(),
+                    # merged memory snapshot for OOM postmortems: pool
+                    # watermarks + top consumers + recent sheds
+                    "memory": MEMORY_LEDGER.memory_snapshot(),
                 }).encode())
                 return
             if self.path == "/v1/metrics":
