@@ -53,10 +53,17 @@ def test_build_trajectory_normalizes_every_family(bench_root):
     assert by_key[("bench", "m", 2)]["value"] == 150.0
     assert by_key[("bench", "q1_rows_per_sec", 2)]["direction"] == "up"
     assert by_key[("qps", "point_mix_on_qps", 1)]["value"] == 220.0
-    # zero-request latency blocks are skipped, populated ones kept
+    # zero-request latency blocks are skipped, populated ones kept;
+    # absolute qps/latency series fold as informational (cross-session
+    # single-box absolutes are environment-confounded, never gated)
     assert ("qps", "point_mix_on_point_p50_ms", 1) in by_key
     assert by_key[("qps", "point_mix_on_point_p50_ms", 1)][
-        "direction"] == "down"
+        "direction"] == "info"
+    assert by_key[("qps", "point_mix_on_qps", 1)]["direction"] == "info"
+    # the within-artifact ratio IS gated, at the wider ratio tolerance
+    speedup = by_key[("qps", "point_mix_speedup", 1)]
+    assert speedup["direction"] == "up"
+    assert speedup["tolerance"] == bench_trend.RATIO_TOLERANCE
     assert ("qps", "point_mix_on_cached_p50_ms", 1) not in by_key
     assert by_key[("devcache", "warm_cold_ratio", 1)]["direction"] == "down"
     assert by_key[("skewjoin", "adaptation_on_recompiles", 1)]["value"] == 0
@@ -89,17 +96,40 @@ def test_check_flags_stale_missing_and_regressed(bench_root):
     assert any("stale" in p for p in problems)
 
 
-def test_lower_better_direction_regression(bench_root):
+def test_info_series_never_gate_and_ratios_gate_wide(bench_root):
     _write(bench_root, "QPS_r02.json", {
         "round": 2,
-        "point_mix": {"on": {"qps": 230.0, "latency": {
-            "point": {"requests": 10, "p50_ms": 25.0, "p99_ms": 31.0}}}}})
+        "point_mix": {
+            # speedup collapsed 3.5 -> 2.0 (43% — beyond even the wide
+            # ratio tolerance); absolute p50 also regressed 47% but that
+            # series is informational
+            "speedup": 2.0,
+            "on": {"qps": 230.0, "latency": {
+                "point": {"requests": 10, "p50_ms": 25.0,
+                          "p99_ms": 31.0}}},
+            "off": {"qps": 115.0, "latency": {}},
+        }})
     entries = bench_trend.build_trajectory(bench_root)
     problems = bench_trend.find_regressions(entries)
-    # p50 17 -> 25 ms is a 47% regression on a lower-better metric
-    assert any("point_mix_on_point_p50_ms" in p for p in problems)
-    # qps went UP: not flagged
+    # the same-box ratio gate fires, and names ITS tolerance
+    assert any("point_mix_speedup" in p and "tolerance=30%" in p
+               for p in problems)
+    # absolute latency/qps series are info: never flagged, even when
+    # they moved beyond any tolerance
+    assert not any("point_mix_on_point_p50_ms" in p for p in problems)
     assert not any("point_mix_on_qps" in p for p in problems)
+
+
+def test_ratio_within_wide_tolerance_passes(bench_root):
+    # a ratio wobble inside RATIO_TOLERANCE (3.5 -> 2.8, 20%) is the
+    # cross-round drift asymmetry the wide tolerance exists for
+    _write(bench_root, "QPS_r02.json", {
+        "round": 2,
+        "point_mix": {"speedup": 2.8,
+                      "on": {"qps": 230.0, "latency": {}},
+                      "off": {"qps": 82.0, "latency": {}}}})
+    entries = bench_trend.build_trajectory(bench_root)
+    assert bench_trend.find_regressions(entries) == []
 
 
 def test_repo_trajectory_is_fresh_and_green():

@@ -1,0 +1,49 @@
+"""Resource-group docs drift gate: every selector field, group knob,
+and system.runtime.resource_groups column must be documented in
+README.md's "Resource groups" section
+(tools/check_resource_group_docs.py wired as a tier-1 test)."""
+import os
+import subprocess
+import sys
+
+TOOL = os.path.join(os.path.dirname(__file__), "..", "tools",
+                    "check_resource_group_docs.py")
+
+
+def test_all_resource_group_names_documented():
+    from tools.check_resource_group_docs import check
+
+    missing = check()
+    assert missing == [], (
+        f"resource-group names declared in code but missing from "
+        f"README.md's 'Resource groups' section: {missing}")
+
+
+def test_checker_cli_runs_green():
+    proc = subprocess.run(
+        [sys.executable, TOOL], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_checker_detects_missing_section(tmp_path):
+    """The gate actually gates: a README without the section fails."""
+    from tools.check_resource_group_docs import check
+
+    bare = tmp_path / "README.md"
+    bare.write_text("# no admission docs here\n")
+    problems = check(str(bare))
+    assert problems and "Resource groups" in problems[0]
+
+
+def test_checker_detects_missing_name(tmp_path):
+    """A section that exists but drops a knob names the missing knob."""
+    from tools.check_resource_group_docs import check
+
+    partial = tmp_path / "README.md"
+    partial.write_text(
+        "## Resource groups\n\n`user` `source` `session_property` "
+        "`group` `name` `max_queued` `memory_limit_bytes` `weight` "
+        "`cache_share` `queue_timeout_ms` `sub_groups` `state` `queued` "
+        "`running` `served` `memory_bytes`\n")
+    problems = check(str(partial))
+    assert problems == ["hard_concurrency_limit"]

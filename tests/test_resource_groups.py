@@ -1,0 +1,407 @@
+"""Resource groups (server/resource_groups.py, PR 17): hierarchical
+multi-tenant admission control.
+
+- config layer: JSON validation is loud and happens at construction
+  (server start), never at query time; ``${USER}`` templates expand
+  per user; selectors first-match over user/source/session property;
+- two tenants in limit-1 groups: A's second query queues while B
+  admits — the single global FIFO is gone;
+- weighted-fair drain: 3:1 siblings drain 3:1 under a 40-query storm;
+- a group over its ``memory_limit_bytes`` QUEUES new work until the
+  ledger shows headroom — it never fails the query;
+- queue aging: a query parked past ``queue_timeout_ms`` fails typed
+  ``EXCEEDED_QUEUE_TIMEOUT``, its wait lands in the phase ledger, and
+  history records the group;
+- cache carve-outs: one tenant's warm device-cache entries survive
+  another tenant's eviction storm (``cache_share``);
+- end-to-end wiring: per-group 429 payload (``resourceGroup`` /
+  ``queuedAhead``), ``system.runtime.resource_groups``, the
+  ``resource_group`` column of ``system.runtime.queries``, and
+  serving-index hits counting into the group's ``served`` ledger.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+import tests.conftest  # noqa: F401 — cpu mesh config
+from trino_tpu.obs import metrics as M
+from trino_tpu.server import resource_groups as rg
+
+PROPS = {"catalog": "tpch", "schema": "tiny",
+         "short_query_fast_path": "true"}
+
+
+def _tree(cfg: dict) -> rg.ResourceGroupTree:
+    roots, selectors = rg.parse_config(cfg)
+    return rg.ResourceGroupTree(roots, selectors)
+
+
+def _wait(q, timeout=30.0):
+    state = q.state.wait_for_terminal(timeout)
+    assert state == "FINISHED", (state, q.failure)
+    return q
+
+
+# ------------------------------------------------------------ config layer
+def test_config_validation_is_loud():
+    ok = {"root_groups": [{"name": "global"}],
+          "selectors": [{"group": "global"}]}
+    roots, selectors = rg.parse_config(ok)
+    assert roots[0].name == "global" and len(selectors) == 1
+
+    def bad(doc, needle):
+        with pytest.raises(rg.ConfigError) as ei:
+            rg.parse_config(doc)
+        assert needle in str(ei.value), str(ei.value)
+
+    bad({"root_groups": [], "selectors": [{"group": "g"}]},
+        "non-empty root_groups")
+    bad({"root_groups": [{"name": "g", "max_threads": 2}],
+         "selectors": [{"group": "g"}]}, "unknown knob")
+    bad({"root_groups": [{"name": "g"}],
+         "selectors": [{"group": "g", "query_type": "adhoc"}]},
+        "unknown field")
+    bad({"root_groups": [{"name": "g"}],
+         "selectors": [{"group": "nope"}]}, "does not match")
+    bad({"root_groups": [{"name": "g", "hard_concurrency_limit": 0}],
+         "selectors": [{"group": "g"}]}, "hard_concurrency_limit")
+    bad({"root_groups": [{"name": "${USER}"}],
+         "selectors": [{"group": "${USER}"}]}, "root group cannot")
+    bad({"root_groups": [{"name": "a", "cache_share": 0.7},
+                         {"name": "b", "cache_share": 0.6}],
+         "selectors": [{"group": "a"}]}, "cache_share")
+    bad({"root_groups": [{"name": "g"}], "selectors": [{"group": "g"}],
+         "extra": 1}, "unknown top-level")
+
+
+def test_config_file_and_env_loading(tmp_path, monkeypatch):
+    doc = {"root_groups": [{"name": "global", "hard_concurrency_limit": 3}],
+           "selectors": [{"group": "global"}]}
+    path = tmp_path / "groups.json"
+    path.write_text(json.dumps(doc))
+    roots, _sel = rg.load_config_file(str(path))
+    assert roots[0].hard_concurrency_limit == 3
+    monkeypatch.setenv(rg.ENV_CONFIG, str(path))
+    roots, _sel = rg.config_from_env()
+    assert roots[0].hard_concurrency_limit == 3
+    # invalid JSON is a loud ConfigError, not a silent default
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    with pytest.raises(rg.ConfigError):
+        rg.load_config_file(str(bad))
+
+
+def test_selectors_first_match_and_user_template():
+    tree = _tree({
+        "root_groups": [{
+            "name": "global",
+            "sub_groups": [
+                {"name": "adhoc",
+                 "sub_groups": [{"name": "${USER}",
+                                 "hard_concurrency_limit": 2}]},
+                {"name": "etl"},
+                {"name": "props"}]}],
+        "selectors": [
+            {"source": "etl-.*", "group": "global.etl"},
+            {"session_property": {"name": "resource_group",
+                                  "value": "props"},
+             "group": "global.props"},
+            {"group": "global.adhoc.${USER}"}]})
+    # first match wins: the source selector beats the catch-all
+    assert tree.select("bob", "etl-nightly", {}) == "global.etl"
+    # the session-property routing hint
+    assert tree.select("carol", "", {"resource_group": "props"}) \
+        == "global.props"
+    # ${USER} template: one node per user, materialized on first use
+    assert tree.select("alice", "", {}) == "global.adhoc.alice"
+    assert tree.select("bob", "", {}) == "global.adhoc.bob"
+    names = [r[0] for r in tree.table_rows()]
+    assert "global.adhoc.alice" in names and "global.adhoc.bob" in names
+    # a user name that would split the dotted path is sanitized
+    assert tree.select("d.ave", "", {}) == "global.adhoc.d_ave"
+
+
+# --------------------------------------------------- acceptance: isolation
+def test_two_tenants_limit1_a_queues_while_b_admits():
+    tree = _tree({
+        "root_groups": [{
+            "name": "global", "hard_concurrency_limit": 16,
+            "sub_groups": [{"name": "a", "hard_concurrency_limit": 1},
+                           {"name": "b", "hard_concurrency_limit": 1}]}],
+        "selectors": [{"group": "global"}]})
+    tree.enqueue("global.a", "a1", "a1")
+    tree.enqueue("global.a", "a2", "a2")
+    tree.enqueue("global.b", "b1", "b1")
+    picked = {tree.dequeue(0.5)[1], tree.dequeue(0.5)[1]}
+    # one from EACH tenant ran — a2 did not starve b1 FIFO-style, and
+    # a's limit-1 slot holds a2 back
+    assert picked == {"a1", "b1"}
+    assert tree.dequeue(0.05) is None
+    assert tree.queue_state("global.a") == (1, 200)
+    rows = {r[0]: r for r in tree.table_rows()}
+    assert rows["global.a"][1] == "full" and rows["global.a"][2] == 1
+    assert rows["global.b"][1] == "full"
+    assert rows["global"][3] == 2  # running is a subtree rollup
+    # a slot freed in a admits a's parked query
+    tree.finish("a1")
+    kind, item, group, _waited = tree.dequeue(0.5)
+    assert (kind, item, group) == ("run", "a2", "global.a")
+
+
+def test_weighted_fair_drain_3_to_1_under_storm():
+    tree = _tree({
+        "root_groups": [{
+            "name": "global", "hard_concurrency_limit": 100,
+            "sub_groups": [
+                {"name": "batch", "hard_concurrency_limit": 100,
+                 "weight": 3},
+                {"name": "inter", "hard_concurrency_limit": 100,
+                 "weight": 1}]}],
+        "selectors": [{"group": "global"}]})
+    for i in range(20):
+        tree.enqueue("global.batch", f"b{i}", ("batch", i))
+        tree.enqueue("global.inter", f"i{i}", ("inter", i))
+    drained = [tree.dequeue(0.5) for _ in range(40)]
+    assert all(d is not None and d[0] == "run" for d in drained)
+    first20 = [d[1][0] for d in drained[:20]]
+    # deficit counters proportional to weight: ~3 batch per 1 inter
+    assert 14 <= first20.count("batch") <= 16, first20
+    # work-conserving: all 40 drained, nothing lost
+    assert tree.total_queued() == 0
+    rows = {r[0]: r for r in tree.table_rows()}
+    assert rows["global.batch"][9] == 3 and rows["global.inter"][9] == 1
+
+
+def test_memory_limit_queues_new_work_never_fails_it():
+    tree = _tree({
+        "root_groups": [{
+            "name": "global", "hard_concurrency_limit": 16,
+            "sub_groups": [{"name": "mem", "hard_concurrency_limit": 8,
+                            "memory_limit_bytes": 1000}]}],
+        "selectors": [{"group": "global"}]})
+    live = {}
+    tree.set_memory_probe(lambda: live)
+    tree.enqueue("global.mem", "m1", "m1")
+    assert tree.dequeue(0.5)[1] == "m1"
+    # m1 balloons past the group limit: the group stops admitting
+    live["m1"] = 2000
+    tree.enqueue("global.mem", "m2", "m2")
+    assert tree.dequeue(0.15) is None  # m2 QUEUED, not failed
+    rows = {r[0]: r for r in tree.table_rows()}
+    assert rows["global.mem"][1] == "blocked-memory"
+    assert rows["global.mem"][8] == 2000  # live ledger rollup column
+    # ledger shows headroom again -> the parked query admits
+    live["m1"] = 100
+    kind, item, _group, _w = tree.dequeue(1.0)
+    assert (kind, item) == ("run", "m2")
+
+
+def test_queue_timeout_ages_out_typed():
+    tree = _tree({
+        "root_groups": [{
+            "name": "global",
+            "sub_groups": [{"name": "fast", "hard_concurrency_limit": 1,
+                            "queue_timeout_ms": 30}]}],
+        "selectors": [{"group": "global"}]})
+    tree.enqueue("global.fast", "q1", "q1", now=time.time() - 1.0)
+    kind, item, group, waited = tree.dequeue(0.5)
+    assert (kind, item, group) == ("aged", "q1", "global.fast")
+    assert waited >= 0.9
+    assert rg.EXCEEDED_QUEUE_TIMEOUT == "EXCEEDED_QUEUE_TIMEOUT"
+
+
+def test_note_served_rolls_up_the_chain():
+    tree = _tree({
+        "root_groups": [{
+            "name": "global",
+            "sub_groups": [{"name": "a"}]}],
+        "selectors": [{"group": "global"}]})
+    tree.note_served("global.a")
+    tree.note_served("global.a")
+    rows = {r[0]: r for r in tree.table_rows()}
+    assert rows["global.a"][4] == 2
+    assert rows["global"][4] == 2  # served rolls up like running
+
+
+# ------------------------------------------------ acceptance: carve-outs
+def test_cache_carveout_protects_tenant_warm_set():
+    """One tenant's eviction storm reclaims its OWN over-share bytes;
+    the protected tenant's warm device-cache entries survive."""
+    from trino_tpu.devcache.cache import CacheKey, DeviceTableCache
+
+    cache = DeviceTableCache(max_bytes=1000)
+    before = rg.CACHE_SHARES.snapshot()
+    rg.CACHE_SHARES.configure({"global.a": 0.5})
+
+    def stage(table, group, nbytes=200):
+        tok = rg.set_current_group(group)
+        try:
+            cache.lookup_or_stage(
+                CacheKey("c", "s", table, "v1", "sig", "table", 1),
+                lambda: (object(), 10, nbytes, 1))
+        finally:
+            rg.reset_current_group(tok)
+
+    try:
+        stage("ta0", "global.a")
+        stage("ta1", "global.a")  # 400 bytes <= a's 500-byte carve-out
+        for i in range(8):        # b's storm: 1600 bytes vs 1000 budget
+            stage(f"tb{i}", "global.b")
+        tables = {e["table"] for e in cache.snapshot()}
+        assert {"ta0", "ta1"} <= tables, tables  # warm set survived
+        assert cache.group_bytes().get("global.a") == 400
+        # the storm evicted its own over-share entries, oldest first
+        assert cache.group_bytes().get("global.b") <= 600
+        assert cache.cached_bytes() <= 1000
+    finally:
+        rg.CACHE_SHARES.configure(before)
+
+
+# ----------------------------------------------------- end-to-end wiring
+E2E_CFG = {
+    "root_groups": [{
+        "name": "global", "hard_concurrency_limit": 16,
+        "max_queued": 100,
+        "sub_groups": [
+            {"name": "adhoc",
+             "sub_groups": [{"name": "${USER}",
+                             "hard_concurrency_limit": 2,
+                             "max_queued": 2}]},
+            {"name": "etl", "hard_concurrency_limit": 4, "weight": 3}]}],
+    "selectors": [
+        {"source": "etl-.*", "group": "global.etl"},
+        {"group": "global.adhoc.${USER}"}]}
+
+
+def test_bad_config_fails_server_construction():
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    with pytest.raises(rg.ConfigError):
+        CoordinatorServer(resource_groups_config={
+            "root_groups": [], "selectors": []})
+
+
+def test_coordinator_group_wiring_end_to_end():
+    """Boot with a two-tenant config: per-group queue limits answer the
+    typed per-group 429, queries carry their group through stats and
+    the system tables, and serving-index hits count as ``served``."""
+    from trino_tpu.server import wire
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.dispatch import DispatchRejected
+
+    coord = CoordinatorServer(executor_lanes=0,
+                              resource_groups_config=E2E_CFG)
+    coord.start()
+    try:
+        q1 = coord.submit("select 1", PROPS, user="alice")
+        q2 = coord.submit("select 2", PROPS, user="alice")
+        assert q1.resource_group == "global.adhoc.alice"
+        # alice's queue (max_queued 2) is full: typed per-group 429
+        with pytest.raises(DispatchRejected) as ei:
+            coord.submit("select 3", PROPS, user="alice")
+        e = ei.value
+        assert e.resource_group == "global.adhoc.alice"
+        assert e.queued_ahead == 2
+        err = e.payload()["error"]
+        assert err["resourceGroup"] == "global.adhoc.alice"
+        assert err["queuedAhead"] == 2
+        assert "global.adhoc.alice" in str(e)
+        # the same rejection over HTTP names the group in the body
+        status, body, headers = wire.http_request(
+            "POST", f"{coord.base_url}/v1/statement", b"select 4",
+            "text/plain",
+            headers={"X-Trino-User": "alice",
+                     **{f"X-Trino-Session-{k}": v
+                        for k, v in PROPS.items()}})
+        assert status == 429
+        assert any(k.lower() == "retry-after" for k in headers)
+        assert b"resourceGroup" in body and b"global.adhoc.alice" in body
+        # ...while bob's etl group still admits (per-group isolation)
+        q3 = coord.submit("select 5", PROPS, user="bob",
+                          source="etl-nightly")
+        assert q3.resource_group == "global.etl"
+        coord.dispatcher.start_lanes(4)
+        for q in (q1, q2, q3):
+            _wait(q)
+        # the group rides along in queryStats
+        assert q1.query_stats()["resourceGroup"] == "global.adhoc.alice"
+        # system.runtime.resource_groups: the live tree over SQL
+        q = _wait(coord.submit(
+            "select * from system.runtime.resource_groups", PROPS))
+        assert all(len(r) == 12 for r in q.rows)
+        by_name = {r[0]: r for r in q.rows}
+        assert {"global", "global.adhoc", "global.adhoc.alice",
+                "global.etl"} <= set(by_name)
+        assert by_name["global.etl"][9] == 3  # weight column
+        # system.runtime.queries records the admitting group
+        q = _wait(coord.submit(
+            f"select resource_group from system.runtime.queries "
+            f"where query_id = '{q1.query_id}'", PROPS))
+        assert q.rows == [("global.adhoc.alice",)]
+        # serving-index hit counts against the group's served ledger
+        props = {"catalog": "memory", "schema": "default",
+                 "result_cache_enabled": "true"}
+        _wait(coord.submit(
+            "create table memory.default.rg (a bigint)", props,
+            user="alice"))
+        _wait(coord.submit(
+            "insert into memory.default.rg values (1), (2)", props,
+            user="alice"))
+        sql = "select count(*) from memory.default.rg"
+        _wait(coord.submit(sql, props, user="alice"))  # MISS fills
+        served0 = M.RESOURCE_GROUP_SERVED.value("global.adhoc.alice")
+        q = _wait(coord.submit(sql, props, user="alice"))
+        assert q.cache_status == "HIT"
+        assert M.RESOURCE_GROUP_SERVED.value("global.adhoc.alice") \
+            == served0 + 1
+        q = _wait(coord.submit(
+            "select served from system.runtime.resource_groups "
+            "where name = 'global.adhoc.alice'", PROPS))
+        assert q.rows[0][0] >= 1
+    finally:
+        coord.stop()
+
+
+def test_queue_aging_fails_typed_with_ledger_and_history():
+    """Satellite: a query parked past its group's ``queue_timeout_ms``
+    FAILS typed ``EXCEEDED_QUEUE_TIMEOUT`` (never silently dropped),
+    its wait is attributed in the phase ledger, and the history row
+    names the group."""
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    cfg = {
+        "root_groups": [{
+            "name": "global",
+            "sub_groups": [{"name": "aging", "hard_concurrency_limit": 1,
+                            "queue_timeout_ms": 1}]}],
+        "selectors": [{"user": "ager", "group": "global.aging"},
+                      {"group": "global"}]}
+    coord = CoordinatorServer(executor_lanes=0, resource_groups_config=cfg)
+    coord.start()
+    try:
+        t0 = M.RESOURCE_GROUP_REJECTED.value("global.aging",
+                                             "queue-timeout")
+        q = coord.submit("select 1", PROPS, user="ager")
+        time.sleep(0.15)  # parked well past the 1 ms timeout, no lanes
+        coord.dispatcher.start_lanes(1)
+        assert q.state.wait_for_terminal(30.0) == "FAILED"
+        assert "EXCEEDED_QUEUE_TIMEOUT" in (q.failure or "")
+        assert "global.aging" in q.failure
+        assert M.RESOURCE_GROUP_REJECTED.value(
+            "global.aging", "queue-timeout") == t0 + 1
+        # the whole wall was queue wait — the ledger attributes it
+        tl = q.timeline_dict()
+        assert tl is not None
+        waited = (tl["phases"].get("queued", 0.0)
+                  + tl["phases"].get("dispatch-queue", 0.0))
+        assert waited >= 0.08, tl["phases"]
+        # history names the group alongside the typed failure
+        hq = _wait(coord.submit(
+            f"select state, resource_group from system.runtime.queries "
+            f"where query_id = '{q.query_id}'", PROPS))
+        assert hq.rows == [("FAILED", "global.aging")]
+    finally:
+        coord.stop()

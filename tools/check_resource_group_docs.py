@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Fail when the resource-group surface drifts from the README.
+
+The admission subsystem (``trino_tpu/server/resource_groups.py``)
+declares its whole configuration vocabulary in code: the selector
+fields a config may match on (``SELECTOR_FIELDS``), the per-group knobs
+a group spec may set (``GROUP_KNOBS``), and the live
+``system.runtime.resource_groups`` columns
+(``trino_tpu/connector/system/schemas.py``). Doc coverage is therefore
+a set comparison — load both registries standalone (no jax import; see
+gates.load_module_file), require a "Resource groups" README section,
+and require every name to appear INSIDE that section (any mention
+counts; the table cells use backticks). Wired as a tier-1 test
+(tests/test_resource_group_docs.py) and into ``tools/lint.py --all``
+(shared plumbing: tools/gates.py).
+
+Usage: ``python tools/check_resource_group_docs.py [--readme PATH]`` —
+exit 0 when the section exists and every name is documented, 1 with
+the missing names otherwise.
+"""
+from __future__ import annotations
+
+import re
+import sys
+
+if __package__ in (None, ""):  # script mode: tools/ on sys.path
+    import gates
+else:  # imported as tools.check_resource_group_docs
+    from tools import gates
+
+SECTION_HEADING = "Resource groups"
+
+
+def required_names() -> list:
+    """Selector fields + group knobs + system.runtime.resource_groups
+    columns, from the code registries."""
+    rg = gates.load_module_file("trino_tpu/server/resource_groups.py",
+                                "_resource_groups_standalone")
+    sch = gates.load_module_file("trino_tpu/connector/system/schemas.py",
+                                 "_system_schemas_standalone")
+    cols = [c for c, _t in sch.SYSTEM_TABLES[("runtime", "resource_groups")]]
+    return sorted(set(rg.SELECTOR_FIELDS) | set(rg.GROUP_KNOBS) | set(cols))
+
+
+def resource_group_section(readme_path: str | None) -> str | None:
+    """The README's "Resource groups" section body (heading to the next
+    same-or-higher-level heading), or None when the section is absent."""
+    text = gates.read_readme(readme_path)
+    m = re.search(rf"^(#{{1,6}})\s+{SECTION_HEADING}\s*$", text,
+                  re.MULTILINE | re.IGNORECASE)
+    if m is None:
+        return None
+    level = len(m.group(1))
+    nxt = re.compile(rf"^#{{1,{level}}}\s+\S", re.MULTILINE)
+    tail = text[m.end():]
+    stop = nxt.search(tail)
+    return tail[: stop.start()] if stop else tail
+
+
+def check(readme_path: str | None = None) -> list:
+    """Problems (empty means the docs are complete): a missing section,
+    or each selector field / group knob / table column absent from it."""
+    section = resource_group_section(readme_path)
+    if section is None:
+        return [f"README has no '{SECTION_HEADING}' section"]
+    documented = set(re.findall(r"\b[a-zA-Z$][a-zA-Z0-9_{}$.]*\b", section))
+    documented |= gates.backticked_names(section)
+    return [name for name in required_names() if name not in documented]
+
+
+def main() -> int:
+    return gates.gate_main(
+        __doc__, check,
+        "resource-group selector fields / group knobs / "
+        "system.runtime.resource_groups columns missing from the README "
+        "'Resource groups' section:",
+        "document each in README.md (## Resource groups): selector "
+        "fields and group knobs in the config tables, columns in the "
+        "system-table table",
+        lambda: (f"ok: all {len(required_names())} resource-group "
+                 "config names and table columns are documented"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,9 @@ ALL_GATES = (
      "every system table/column/procedure documented in README"),
     ("memledger-docs", "check_memledger_docs",
      "every memory-ledger event kind and pool documented in README"),
+    ("resource-group-docs", "check_resource_group_docs",
+     "every selector field, group knob, and resource_groups column "
+     "documented in README"),
     ("tracer-leak", "lint.tracer_leak",
      "no import-time jnp evaluation; no jnp in repr/property/host modules"),
     ("lock-discipline", "lint.lock_discipline",

@@ -70,6 +70,18 @@ def session_user(session) -> str:
     return getattr(getattr(session, "identity", None), "user", "") or ""
 
 
+def _current_group() -> Optional[str]:
+    """Resource group of the query on THIS thread (dispatcher lane sets
+    it around execution), or None. Lazy + fail-open so the cache stays
+    importable and functional without the server package."""
+    try:
+        from trino_tpu.server.resource_groups import current_group
+
+        return current_group()
+    except Exception:  # noqa: BLE001 — attribution never fails caching
+        return None
+
+
 class _Flight:
     """One in-progress computation of a cache key (single-flight)."""
 
@@ -93,19 +105,54 @@ class ResultCache:
     def __init__(self, max_bytes: int = DEFAULT_RESULT_CACHE_BYTES):
         self.max_bytes = max_bytes
         self._lock = threading.Lock()
-        # key -> (columns, rows, bytes, expires_at monotonic)
+        # key -> (columns, rows, bytes, expires_at monotonic, group)
         self._entries: "OrderedDict[str, tuple]" = OrderedDict()
         self._bytes = 0
         self._flights: dict = {}
+        # resident bytes per resource group (None = ungrouped) — the
+        # carve-out ground truth for over-share eviction preference
+        self._group_bytes: dict = {}
 
     # ------------------------------------------------------------ inspection
     def cached_bytes(self) -> int:
         with self._lock:
             return self._bytes
 
+    def group_bytes(self) -> dict:
+        """Resident bytes per owning resource group (None = ungrouped)."""
+        with self._lock:
+            return dict(self._group_bytes)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def _group_sub_locked(self, group, nbytes: int) -> None:
+        remaining = self._group_bytes.get(group, 0) - nbytes
+        if remaining > 0:
+            self._group_bytes[group] = remaining
+        else:
+            self._group_bytes.pop(group, None)
+
+    def _victim_key_locked(self, exclude=None):
+        """Eviction victim: the oldest entry of a group over its
+        configured cache share first (one tenant's burst reclaims its own
+        over-share bytes before touching another's warm results), else
+        the LRU head."""
+        try:
+            from trino_tpu.server.resource_groups import CACHE_SHARES
+
+            for k, ent in self._entries.items():  # LRU order
+                if k == exclude:
+                    continue
+                group = ent[4]
+                if CACHE_SHARES.over_share(
+                        group, self._group_bytes.get(group, 0),
+                        self.max_bytes):
+                    return k
+        except Exception:  # noqa: BLE001 — carve-outs never wedge eviction
+            pass
+        return next(iter(self._entries))
 
     # ------------------------------------------------------------- lifecycle
     def begin(self, key: str):
@@ -117,12 +164,13 @@ class ResultCache:
         with self._lock:
             ent = self._entries.get(key)
             if ent is not None:
-                columns, rows, nbytes, expires_at = ent
+                columns, rows, nbytes, expires_at, group = ent
                 if time.monotonic() < expires_at:
                     self._entries.move_to_end(key)
                     return "hit", (columns, rows)
                 del self._entries[key]
                 self._bytes -= nbytes
+                self._group_sub_locked(group, nbytes)
                 M.RESULT_CACHE_BYTES.set(self._bytes)
             flight = self._flights.get(key)
             if flight is not None:
@@ -139,6 +187,7 @@ class ResultCache:
         server-wide cache (one tenant must not flush the others)."""
         value = (columns, rows)
         nbytes = estimate_result_bytes(columns, rows)
+        group = _current_group()
         with self._lock:
             flight = self._flights.pop(key, None)
             budget = (self.max_bytes if max_bytes is None
@@ -147,12 +196,18 @@ class ResultCache:
                 old = self._entries.pop(key, None)
                 if old is not None:
                     self._bytes -= old[2]
+                    self._group_sub_locked(old[4], old[2])
                 self._entries[key] = (
-                    columns, rows, nbytes, time.monotonic() + ttl_ms / 1e3)
+                    columns, rows, nbytes,
+                    time.monotonic() + ttl_ms / 1e3, group)
                 self._bytes += nbytes
+                self._group_bytes[group] = (
+                    self._group_bytes.get(group, 0) + nbytes)
                 while self._bytes > self.max_bytes and len(self._entries) > 1:
-                    _k, (_c, _r, b, _e) = self._entries.popitem(last=False)
+                    vk = self._victim_key_locked(exclude=key)
+                    _c, _r, b, _e, g = self._entries.pop(vk)
                     self._bytes -= b
+                    self._group_sub_locked(g, b)
                     M.RESULT_CACHE_EVICTIONS.inc()
                 M.RESULT_CACHE_BYTES.set(self._bytes)
         if flight is not None:
@@ -167,10 +222,11 @@ class ResultCache:
             ent = self._entries.get(key)
             if ent is None:
                 return None
-            columns, rows, nbytes, expires_at = ent
+            columns, rows, nbytes, expires_at, group = ent
             if time.monotonic() >= expires_at:
                 del self._entries[key]
                 self._bytes -= nbytes
+                self._group_sub_locked(group, nbytes)
                 M.RESULT_CACHE_BYTES.set(self._bytes)
                 return None
             self._entries.move_to_end(key)
@@ -186,6 +242,7 @@ class ResultCache:
     def invalidate_all(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._group_bytes.clear()
             self._bytes = 0
             M.RESULT_CACHE_BYTES.set(0)
 

@@ -96,6 +96,10 @@ def render_summary(stats) -> str:
         # the short-query fast path served this statement coordinator-
         # local (zero task round-trips)
         parts.append("fast-path")
+    if stats.get("resourceGroup"):
+        # the admission group that gated this query (server/
+        # resource_groups.py; live occupancy: system.runtime.resource_groups)
+        parts.append(f"group: {stats['resourceGroup']}")
     if stats.get("deviceCacheHits"):
         # scans served warm from the device table cache (zero transfer)
         parts.append(f"warm scans: {stats['deviceCacheHits']}")

@@ -38,16 +38,20 @@ class DatabaseError(Error):
 class Connection:
     def __init__(self, coordinator_url: Optional[str] = None, session=None,
                  catalog: str = "tpch", schema: str = "tiny",
-                 fetch_streams: int = 4, **properties):
+                 fetch_streams: int = 4, user: Optional[str] = None,
+                 source: Optional[str] = None, **properties):
         # ``fetch_streams`` is a CLIENT knob (parallel spooled-segment
         # fetch width), not a server session property — it never rides
-        # the X-Trino-Session-* headers
+        # the X-Trino-Session-* headers; ``user``/``source`` ride the
+        # X-Trino-User / X-Trino-Source headers (resource-group selector
+        # inputs, server/resource_groups.py)
         if coordinator_url is not None:
             from trino_tpu.client.remote import StatementClient
 
             props = {"catalog": catalog, "schema": schema, **properties}
             self._client = StatementClient(coordinator_url, props,
-                                           fetch_streams=fetch_streams)
+                                           fetch_streams=fetch_streams,
+                                           user=user, source=source)
             self._session = None
         else:
             if session is None:

@@ -369,6 +369,15 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             str, "memory",
         ),
         PropertyMetadata(
+            "resource_group",
+            "admission routing hint matched by resource-group selectors' "
+            "session_property field (server/resource_groups.py): a "
+            "selector configured on this property routes the query into "
+            "its named group subtree before user/source matching is "
+            "consulted; empty means only user/source selectors apply",
+            str, "",
+        ),
+        PropertyMetadata(
             "failure_injection",
             "inject a task failure when this substring matches a task id, "
             "e.g. '.<fragment>.<worker>.a<attempt>' (reference: "

@@ -19,11 +19,18 @@ class RemoteQueryError(RuntimeError):
 
 class QueueFullError(RemoteQueryError):
     """The coordinator's dispatch queue rejected the statement (429 +
-    Retry-After) and client-side retries ran out of budget."""
+    Retry-After) and client-side retries ran out of budget.
+    ``resource_group``/``queued_ahead`` carry the structured 429 payload
+    fields when the server runs group-aware admission: WHICH group said
+    no and how deep its queue was."""
 
-    def __init__(self, message: str, retry_after_s: float = 1.0):
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 resource_group: Optional[str] = None,
+                 queued_ahead: Optional[int] = None):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+        self.resource_group = resource_group
+        self.queued_ahead = queued_ahead
 
 
 class SegmentFetchError(RemoteQueryError):
@@ -46,10 +53,16 @@ class StatementClient:
 
     def __init__(self, coordinator_url: str,
                  session_properties: Optional[Dict[str, str]] = None,
-                 fetch_streams: int = 4):
+                 fetch_streams: int = 4, user: Optional[str] = None,
+                 source: Optional[str] = None):
         self.coordinator_url = coordinator_url.rstrip("/")
         self.session_properties = dict(session_properties or {})
         self.fetch_streams = max(1, int(fetch_streams))
+        # claimed identity + client source (X-Trino-User/X-Trino-Source):
+        # both are resource-group selector routing dimensions; an
+        # authenticator-enforced server overrides the claimed user
+        self.user = user
+        self.source = source
         # spooled-protocol telemetry of the LAST statement: segments
         # fetched, their serialized bytes, and the fetch+decode wall
         self.spooled_segments = 0
@@ -104,6 +117,10 @@ class StatementClient:
         headers = {
             f"X-Trino-Session-{k}": str(v) for k, v in self.session_properties.items()
         }
+        if self.user:
+            headers["X-Trino-User"] = self.user
+        if self.source:
+            headers["X-Trino-Source"] = self.source
         self.cache_status = None
         self.stats = None
         self.query_id = None
@@ -126,10 +143,17 @@ class StatementClient:
             # not failure, and no query is ever silently lost
             retry_after = self._retry_after(body, resp_headers)
             if time.monotonic() + retry_after > deadline:
+                err: Dict = {}
+                try:
+                    err = json.loads(body).get("error") or {}
+                except ValueError:
+                    pass
                 raise QueueFullError(
                     f"submit rejected (queue full) and retry budget "
                     f"exhausted: {body[:300].decode(errors='replace')}",
-                    retry_after_s=retry_after)
+                    retry_after_s=retry_after,
+                    resource_group=err.get("resourceGroup"),
+                    queued_ahead=err.get("queuedAhead"))
             self.submit_retries += 1
             time.sleep(retry_after)
         self._note_cache_header(resp_headers)

@@ -59,6 +59,27 @@ SYSTEM_TABLES = {
         ("planning_ms", "double"),
         ("execution_ms", "double"),
         ("unattributed_ms", "double"),
+        ("resource_group", "varchar"),  # full dotted group path that
+                                        # admitted the query; NULL under
+                                        # a legacy injected flat gate
+    ),
+    # the resource-group admission tree (server/resource_groups.py): one
+    # row per live group node — limits from the validated config, live
+    # occupancy/queue depth, the ledger-backed memory rollup, and the
+    # fairness knobs (weight, cache_share, queue_timeout_ms)
+    ("runtime", "resource_groups"): (
+        ("name", "varchar"),            # full dotted path (global.adhoc.u1)
+        ("state", "varchar"),           # can-run | full | blocked-memory
+        ("queued", "bigint"),
+        ("running", "bigint"),          # subtree rollup
+        ("served", "bigint"),           # concurrency-free serving-index hits
+        ("hard_concurrency_limit", "bigint"),
+        ("max_queued", "bigint"),
+        ("memory_limit_bytes", "bigint"),   # NULL = unlimited
+        ("memory_bytes", "bigint"),     # live ledger bytes of running queries
+        ("weight", "bigint"),           # weighted-fair drain share
+        ("cache_share", "double"),      # carve-out fraction; NULL = none
+        ("queue_timeout_ms", "bigint"),  # aging deadline; NULL = never
     ),
     # prepared statements held by the coordinator registry
     # (server/prepared.py): one row per (user, name), live until

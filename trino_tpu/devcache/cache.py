@@ -134,6 +134,22 @@ class CacheEntry:
     hits: int = 0
     created_at: float = 0.0
     last_used_at: float = 0.0
+    # resource group whose query staged this entry (None outside a lane):
+    # drives the per-group carve-out eviction preference and the ledger
+    # owner suffix (``device-cache:<group>``)
+    group: Optional[str] = None
+
+
+def _current_group() -> Optional[str]:
+    """The resource group of the query running on THIS thread (set by the
+    dispatcher lane around execution), or None outside a lane. Lazy so the
+    cache stays importable without the server package."""
+    try:
+        from trino_tpu.server.resource_groups import current_group
+
+        return current_group()
+    except Exception:  # noqa: BLE001 — attribution never fails staging
+        return None
 
 
 
@@ -174,24 +190,42 @@ class DeviceTableCache:
         # per-tier column — the process-global metric cannot distinguish
         # tiers once both exist)
         self._hit_count = 0
+        # resident bytes per resource group (None = ungrouped): the
+        # carve-out ground truth the over-share eviction preference and
+        # ``system.runtime.resource_groups`` read
+        self._group_bytes: Dict[Optional[str], int] = {}
 
     def _default_max_bytes(self) -> int:
         """Budget when the constructor did not pin one (subclass hook)."""
         return _default_budget()
 
     def _ledger_event(self, kind: str, nbytes: int,
-                      reason: Optional[str] = None) -> None:
+                      reason: Optional[str] = None,
+                      group: Optional[str] = None) -> None:
         """One memory-ledger event for this tier. Callers MUST have
         released ``self._lock`` first (the emission discipline
         ``tools/lint/lock_discipline.py`` enforces): bytes are collected
         inside the lock, the event is emitted after — which is also what
-        gives pressure sheds their exactly-one-event contract."""
+        gives pressure sheds their exactly-one-event contract. Entries
+        staged under a resource group carry the group as an owner SUFFIX
+        (``device-cache:<group>``) symmetric across admit/evict/shed, so
+        the ledger's live bytes attribute carve-out occupancy per tenant;
+        ungrouped entries keep the bare tier owner."""
         if nbytes <= 0:
             return
         from trino_tpu.obs.memledger import MEMORY_LEDGER
 
+        owner = (f"{self.LEDGER_OWNER}:{group}" if group
+                 else self.LEDGER_OWNER)
         MEMORY_LEDGER.record_event(
-            kind, self.LEDGER_POOL, self.LEDGER_OWNER, nbytes, reason=reason)
+            kind, self.LEDGER_POOL, owner, nbytes, reason=reason)
+
+    def _ledger_events(self, kind: str, by_group: Dict[Optional[str], int],
+                       reason: Optional[str] = None) -> None:
+        """Per-group ledger emission for a batch of freed entries: one
+        event per owning group (lock released first, as above)."""
+        for group, nbytes in by_group.items():
+            self._ledger_event(kind, nbytes, reason=reason, group=group)
 
     # ---------------------------------------------------------- inspection
     @property
@@ -207,6 +241,12 @@ class DeviceTableCache:
     def cached_bytes(self) -> int:
         with self._lock:
             return self._bytes
+
+    def group_bytes(self) -> Dict[Optional[str], int]:
+        """Resident bytes per owning resource group (None = ungrouped) —
+        the carve-out occupancy snapshot."""
+        with self._lock:
+            return dict(self._group_bytes)
 
     def __len__(self) -> int:
         with self._lock:
@@ -272,7 +312,7 @@ class DeviceTableCache:
                         if not wait:
                             inflight = True
                         lead = False
-            self._ledger_event("evict", stale_freed, reason="stale")
+            self._ledger_events("evict", stale_freed, reason="stale")
             if ent is not None:
                 return ent, "hit"
             if inflight:
@@ -331,7 +371,7 @@ class DeviceTableCache:
                 ent.hits += 1
                 ent.last_used_at = time.time()
                 self._hit_count += 1
-        self._ledger_event("evict", stale_freed, reason="stale")
+        self._ledger_events("evict", stale_freed, reason="stale")
         if ent is None:
             return None
         self.M_HITS.inc()
@@ -347,27 +387,39 @@ class DeviceTableCache:
                else min(self.max_bytes, int(admit_bytes)))
         if ent.nbytes > cap:
             return
-        evicted = 0
+        if ent.group is None:
+            ent.group = _current_group()
+        evicted: Dict[Optional[str], int] = {}
         with self._lock:
             replaced = self._remove_locked(ent.key)
             while self._bytes + ent.nbytes > self.max_bytes and self._entries:
-                evicted += self._evict_lru_locked()
+                nbytes, group = self._evict_victim_locked()
+                evicted[group] = evicted.get(group, 0) + nbytes
             self._entries[ent.key] = ent
             self._bytes += ent.nbytes
+            self._group_bytes[ent.group] = (
+                self._group_bytes.get(ent.group, 0) + ent.nbytes)
             self._by_table.setdefault(ent.key.table_id(), set()).add(ent.key)
             self.M_BYTES.set(self._bytes)
         # ledger emission happens OUTSIDE the lock: bytes collected above,
-        # one aggregated evict event for however many LRU victims made room
-        self._ledger_event("evict", evicted, reason="lru")
+        # one aggregated evict event per victim group for however many
+        # LRU/over-share victims made room
+        self._ledger_events("evict", evicted, reason="lru")
         if replaced is not None:
-            self._ledger_event("release", replaced.nbytes, reason="replace")
-        self._ledger_event("admit", ent.nbytes)
+            self._ledger_event("release", replaced.nbytes, reason="replace",
+                               group=replaced.group)
+        self._ledger_event("admit", ent.nbytes, group=ent.group)
 
     def _remove_locked(self, key: CacheKey) -> Optional[CacheEntry]:
         ent = self._entries.pop(key, None)
         if ent is None:
             return None
         self._bytes -= ent.nbytes
+        remaining = self._group_bytes.get(ent.group, 0) - ent.nbytes
+        if remaining > 0:
+            self._group_bytes[ent.group] = remaining
+        else:
+            self._group_bytes.pop(ent.group, None)
         keys = self._by_table.get(key.table_id())
         if keys is not None:
             keys.discard(key)
@@ -375,29 +427,54 @@ class DeviceTableCache:
                 del self._by_table[key.table_id()]
         return ent
 
-    def _evict_lru_locked(self) -> int:
-        victim_key = next(iter(self._entries))
+    def _evict_victim_locked(self) -> Tuple[int, Optional[str]]:
+        """Evict one entry and return ``(bytes, group)``. Carve-out
+        preference: the oldest entry belonging to a group holding MORE
+        than its configured cache share goes first, so one tenant's
+        staging storm reclaims its own over-share bytes before touching
+        another tenant's warm state; plain LRU head when nobody is over
+        (or no shares are configured)."""
+        victim_key = None
+        try:
+            from trino_tpu.server.resource_groups import CACHE_SHARES
+
+            for k, e in self._entries.items():  # LRU order
+                if CACHE_SHARES.over_share(
+                        e.group, self._group_bytes.get(e.group, 0),
+                        self.max_bytes):
+                    victim_key = k
+                    break
+        except Exception:  # noqa: BLE001 — carve-outs never wedge eviction
+            victim_key = None
+        if victim_key is None:
+            victim_key = next(iter(self._entries))
         victim = self._remove_locked(victim_key)
         self.M_EVICTIONS.inc()
         self.M_BYTES.set(self._bytes)
-        return victim.nbytes
+        return victim.nbytes, victim.group
 
-    def _drop_stale_locked(self, key: CacheKey) -> int:
+    def _evict_lru_locked(self) -> int:
+        """Back-compat shim over ``_evict_victim_locked`` (bytes only)."""
+        nbytes, _ = self._evict_victim_locked()
+        return nbytes
+
+    def _drop_stale_locked(self, key: CacheKey) -> Dict[Optional[str], int]:
         """Drop every entry of the same table whose data_version differs
         from the version the caller just observed: a mutation moved the
         version, so those arrays can never be served again — reclaim
-        their HBM now instead of waiting for LRU age-out. Returns the
-        bytes freed so the caller can emit the ledger event AFTER
-        releasing the lock."""
+        their HBM now instead of waiting for LRU age-out. Returns bytes
+        freed per owning group so the caller can emit the ledger events
+        AFTER releasing the lock."""
         keys = self._by_table.get(key.table_id())
         if not keys:
-            return 0
+            return {}
         stale = [k for k in keys if k.data_version != key.data_version]
-        freed = 0
+        freed: Dict[Optional[str], int] = {}
         for k in stale:
             victim = self._remove_locked(k)
             if victim is not None:
-                freed += victim.nbytes
+                freed[victim.group] = (
+                    freed.get(victim.group, 0) + victim.nbytes)
             self.M_EVICTIONS.inc()
         if stale:
             self.M_BYTES.set(self._bytes)
@@ -414,30 +491,37 @@ class DeviceTableCache:
         if nbytes <= 0:
             return 0
         freed = 0
+        by_group: Dict[Optional[str], int] = {}
         with self._lock:
             while freed < nbytes and self._entries:
-                freed += self._evict_lru_locked()
-        self._ledger_event("shed", freed, reason=reason)
+                n, group = self._evict_victim_locked()
+                freed += n
+                by_group[group] = by_group.get(group, 0) + n
+        self._ledger_events("shed", by_group, reason=reason)
         return freed
 
     def evict_to(self, target_bytes: int, reason: str = "trim") -> int:
         """Evict LRU entries until the cache holds at most
         ``target_bytes``; returns bytes freed."""
         freed = 0
+        by_group: Dict[Optional[str], int] = {}
         with self._lock:
             while self._bytes > max(0, int(target_bytes)) and self._entries:
-                freed += self._evict_lru_locked()
-        self._ledger_event("evict", freed, reason=reason)
+                n, group = self._evict_victim_locked()
+                freed += n
+                by_group[group] = by_group.get(group, 0) + n
+        self._ledger_events("evict", by_group, reason=reason)
         return freed
 
     def invalidate_all(self) -> None:
         with self._lock:
-            freed = self._bytes
+            by_group = dict(self._group_bytes)
             self._entries.clear()
             self._by_table.clear()
+            self._group_bytes.clear()
             self._bytes = 0
             self.M_BYTES.set(0)
-        self._ledger_event("release", freed, reason="invalidate")
+        self._ledger_events("release", by_group, reason="invalidate")
 
 
 # the process-wide pool: coordinator-local execution, the compiled tier,

@@ -562,6 +562,35 @@ EXECUTOR_PLANE_QUERIES = REGISTRY.counter(
     "bounced = an executor process declined ownership and the query "
     "re-ran inline)", ("plane",))
 
+# resource groups (server/resource_groups.py): hierarchical multi-tenant
+# admission — per-group queue depth/occupancy gauges, queued-phase wait
+# histogram, typed per-group rejections (queue-full = max_queued or
+# global capacity at submit; queue-timeout = aged out of the group queue
+# past queue_timeout_ms), and concurrency-free serving-index hits
+# attributed to the group
+RESOURCE_GROUP_QUEUED = REGISTRY.gauge(
+    "trino_tpu_resource_group_queued",
+    "queries parked in one resource group's queue", ("group",))
+RESOURCE_GROUP_RUNNING = REGISTRY.gauge(
+    "trino_tpu_resource_group_running",
+    "queries running under one resource group (subtree rollup)",
+    ("group",))
+RESOURCE_GROUP_QUEUE_SECONDS = REGISTRY.histogram(
+    "trino_tpu_resource_group_queue_seconds",
+    "time a query waited in its resource group's queue before the "
+    "weighted-fair drain admitted (or aged) it", ("group",))
+RESOURCE_GROUP_REJECTED = REGISTRY.counter(
+    "trino_tpu_resource_group_rejected_total",
+    "queries a resource group said no to, by reason (queue-full = typed "
+    "429 at submit; queue-timeout = typed EXCEEDED_QUEUE_TIMEOUT "
+    "failure after aging out of the group queue)", ("group", "reason"))
+RESOURCE_GROUP_SERVED = REGISTRY.counter(
+    "trino_tpu_resource_group_served_total",
+    "serving-index hits attributed to a resource group "
+    "(concurrency-free: answered on the dispatch thread without "
+    "occupying a group slot, counted so cached repeats stay auditable)",
+    ("group",))
+
 # HTTP keep-alive connection pool (server/wire.py): control-plane and
 # client calls reuse pooled connections instead of a fresh TCP connect
 # per request

@@ -195,6 +195,14 @@ class QueryExecution:
         self.plane: str = "dispatch-lane"
         self._dispatch_queue_span = None
         self.extra_spans: List[dict] = []
+        # resource-group admission (server/resource_groups.py): the full
+        # dotted group path this query was classified into by the
+        # selector chain (None under an injected legacy gate), and the
+        # client-reported source the selectors may route on
+        # (X-Trino-Source); queued-ahead count captured at enqueue
+        self.resource_group: Optional[str] = None
+        self.source: str = ""
+        self.queued_ahead: Optional[int] = None
         # set by the server at submit: the shared IO thread pool for
         # parallel worker pulls (span dumps, flight-recorder rings) and
         # the dispatcher completion hook
@@ -1455,6 +1463,9 @@ class QueryExecution:
         qs["elapsedMs"] = int((end - self.created_at) * 1000)
         qs["state"] = self.state.get()
         qs["cacheStatus"] = self.cache_status
+        # which resource group admitted this query (None under a legacy
+        # injected gate) — clients (CLI summary tag) and system tables
+        qs["resourceGroup"] = self.resource_group
         # which control-plane path served the SELECT (fast-path /
         # distributed / local-catalog), for clients and system tables
         qs["fastPath"] = self.fast_path
@@ -2137,8 +2148,10 @@ class CoordinatorServer:
                  cluster_memory_limit_bytes=None, low_memory_killer=None,
                  authenticator=None, executor_lanes=None,
                  dispatch_queue_capacity=None, executor_plane=None,
-                 executor_processes=None):
-        from trino_tpu.server.resource_groups import ResourceGroup
+                 executor_processes=None, resource_groups_config=None):
+        from trino_tpu.server.resource_groups import (
+            ResourceGroupTree, config_from_env, load_config_file,
+            parse_config)
         from trino_tpu.connector.registry import default_catalogs
         from trino_tpu.server.cluster_memory import (
             ClusterMemoryManager, total_reservation_killer)
@@ -2199,8 +2212,33 @@ class CoordinatorServer:
         self._qlock = threading.Lock()
         self._qid = itertools.count(1)
         # admission control (reference: resource groups / DispatchManager's
-        # resource-group submission)
-        self.resource_group = resource_group or ResourceGroup()
+        # resource-group submission). Default: the hierarchical
+        # ResourceGroupTree — selector-routed, weighted-fair, with
+        # per-group concurrency/queue/memory limits, configured from
+        # `resource_groups_config` (a dict or a JSON file path) or the
+        # TRINO_TPU_RESOURCE_GROUPS_CONFIG file; config validation runs
+        # HERE so a bad file fails server start, not the first query.
+        # An explicitly injected `resource_group` gate keeps the legacy
+        # flat blocking-submit admission path.
+        if resource_group is not None:
+            self.resource_groups = None
+            self.resource_group = resource_group
+        else:
+            if resource_groups_config is None:
+                roots, selectors = config_from_env()
+            elif isinstance(resource_groups_config, str):
+                roots, selectors = load_config_file(resource_groups_config)
+            else:
+                roots, selectors = parse_config(resource_groups_config)
+            self.resource_groups = ResourceGroupTree(roots, selectors)
+            # group memory limits read the cluster ledger's live
+            # per-query bytes (the PR 16 attribution spine)
+            self.resource_groups.set_memory_probe(
+                self.cluster_memory.query_reservations)
+            # the tree also serves the flat gate's read surface (info()
+            # feeds /ui); submit()/finish() calls never reach it — the
+            # tree path admits at dequeue time
+            self.resource_group = self.resource_groups
         # end-user authentication on the public API (None = open cluster;
         # reference: PasswordAuthenticatorManager / jwt — server/auth.py)
         self.authenticator = authenticator
@@ -2264,7 +2302,7 @@ class CoordinatorServer:
         self.dispatcher = Dispatcher(
             self, lanes=executor_lanes,
             queue_capacity=dispatch_queue_capacity, plane=executor_plane,
-            processes=executor_processes)
+            processes=executor_processes, groups=self.resource_groups)
         # shared IO pool for parallel worker pulls (span dumps, flight-
         # recorder rings): lazily created, shut down with the server —
         # replaces the fresh ThreadPoolExecutor these calls built per
@@ -2314,16 +2352,25 @@ class CoordinatorServer:
     MAX_QUERY_HISTORY = 100
 
     def submit(self, sql: str, properties: Optional[dict] = None,
-               user: str = "anonymous") -> QueryExecution:
+               user: str = "anonymous", source: str = "") -> QueryExecution:
+        # resource-group classification runs FIRST (cheap: a regex chain
+        # over user/source/session properties) so the overload turn-around
+        # below can name the saturated group and its queue depth
+        group = None
+        if self.resource_groups is not None:
+            group = self.resource_groups.select(user, source,
+                                                properties or {})
         # typed overload turn-around BEFORE any per-query state is built:
         # a full dispatch queue raises DispatchRejected (the protocol
         # surface answers 429 + Retry-After), never a hang or a thread
-        self.dispatcher.precheck()
+        self.dispatcher.precheck(group)
         query_id = f"q{time.strftime('%Y%m%d')}_{next(self._qid):05d}_{uuid.uuid4().hex[:5]}"
         execution = QueryExecution(
             query_id, sql, properties or {}, self.registry, self.session_factory,
             user=user, query_cache=self.query_cache,
             prepared_registry=self.prepared)
+        execution.resource_group = group
+        execution.source = source
         # flight-recorder hookup: closed spans mirror into the process
         # ring, and the execution can snapshot it for its postmortem
         execution.recorder = self.recorder
@@ -2483,16 +2530,34 @@ class CoordinatorServer:
         False when the query failed admission or went terminal (canceled)
         while queued — the lane moves on."""
         user = execution.user
-        if execution.state.is_terminal():  # canceled while queued
-            return False
-        if not self.resource_group.submit(timeout=600.0, user=user):
-            execution.failure = "Query queue is full (resource group limit)"
-            self.recorder.record("admission", "queue-full",
+        if self.resource_groups is not None:
+            # group-aware path: the tree ALREADY admitted this query at
+            # dequeue time (weighted-fair pick under concurrency + memory
+            # eligibility) — release its slot at terminal, or right now
+            # if it went terminal (canceled) between dequeue and here
+            qid = execution.query_id
+            if execution.state.is_terminal():
+                self.resource_groups.finish(qid)
+                return False
+            groups = self.resource_groups
+            execution.state.add_listener(
+                lambda s: groups.finish(qid)
+                if s in ("FINISHED", "FAILED", "CANCELED") else None)
+            self.recorder.record(
+                "admission", "admitted", queryId=qid, user=user,
+                group=execution.resource_group)
+        else:
+            if execution.state.is_terminal():  # canceled while queued
+                return False
+            if not self.resource_group.submit(timeout=600.0, user=user):
+                execution.failure = (
+                    "Query queue is full (resource group limit)")
+                self.recorder.record("admission", "queue-full",
+                                     queryId=execution.query_id, user=user)
+                execution.state.set("FAILED")
+                return False
+            self.recorder.record("admission", "admitted",
                                  queryId=execution.query_id, user=user)
-            execution.state.set("FAILED")
-            return False
-        self.recorder.record("admission", "admitted",
-                             queryId=execution.query_id, user=user)
         # cluster-memory admission: dispatch blocks while the cluster
         # pool is over its limit (reference: ClusterMemoryManager's
         # query.max-memory gate) — the killer frees it if needed; a
@@ -2510,11 +2575,15 @@ class CoordinatorServer:
                 "admission deadline (EXCEEDED_CLUSTER_MEMORY)")
             execution.state.set("FAILED")
         if execution.state.is_terminal():  # canceled/killed while queued
-            self.resource_group.finish(user=user)
+            # tree path: its terminal listener (registered above) already
+            # released the group slot when the state flipped
+            if self.resource_groups is None:
+                self.resource_group.finish(user=user)
             return False
-        execution.state.add_listener(
-            lambda s: self.resource_group.finish(user=user)
-            if s in ("FINISHED", "FAILED", "CANCELED") else None)
+        if self.resource_groups is None:
+            execution.state.add_listener(
+                lambda s: self.resource_group.finish(user=user)
+                if s in ("FINISHED", "FAILED", "CANCELED") else None)
         return True
 
     def get_query(self, query_id: str) -> Optional[QueryExecution]:
@@ -2703,6 +2772,18 @@ def _render_ui(server: CoordinatorServer) -> str:
     recent_html = "".join(recent) or (
         "<tr><td colspan='7'>no completed queries yet</td></tr>")
     rg = server.resource_group.info()
+    group_rows = ""
+    for gname, g in sorted(rg.get("groups", {}).items()):
+        group_rows += (
+            f"<tr><td>{html.escape(gname)}</td><td>{g['state']}</td>"
+            f"<td>{g['running']}</td><td>{g['queued']}</td>"
+            f"<td>{g['served']}</td><td>{g['weight']}</td></tr>")
+    groups_html = (
+        "<h2>resource groups <small>(<code>select * from "
+        "system.runtime.resource_groups</code>)</small></h2><table>"
+        "<tr><th>group</th><th>state</th><th>running</th><th>queued</th>"
+        f"<th>served</th><th>weight</th></tr>{group_rows}</table>"
+        if group_rows else "")
     return f"""<!doctype html><html><head><meta http-equiv="refresh" content="3">
 <title>trino-tpu</title><style>
 body{{font-family:monospace;margin:2em;background:#111;color:#ddd}}
@@ -2713,6 +2794,7 @@ h1,h2{{color:#fff}}</style></head><body>
 <h1>trino-tpu coordinator</h1>
 <p>resource group "{rg['name']}": {rg['running']} running, {rg['queued']} queued
 (limit {rg['hardConcurrencyLimit']})</p>
+{groups_html}
 <h2>workers</h2><table><tr><th>node</th><th>url</th></tr>{nodes}</table>
 <h2>queries <small>(<a href="#recent" style="color:#6ae">recent
 queries</a> · <code>select * from system.runtime.queries</code>)</small></h2>
@@ -2791,10 +2873,13 @@ def _make_handler(server: CoordinatorServer):
                     # the authenticated principal wins over the client's
                     # claimed user header (no impersonation by default)
                     user = identity.user
+                # the client-reported source (X-Trino-Source): a
+                # resource-group selector routing dimension, like user
+                source = self.headers.get("X-Trino-Source", "")
                 from trino_tpu.server.dispatch import DispatchRejected
 
                 try:
-                    q = server.submit(sql, props, user=user)
+                    q = server.submit(sql, props, user=user, source=source)
                 except DispatchRejected as e:
                     # typed overload: 429 + Retry-After with structured
                     # retry guidance — the client backs off and retries

@@ -95,29 +95,43 @@ def default_queue_capacity() -> int:
 
 class DispatchRejected(RuntimeError):
     """Typed overload: the dispatch queue is full. Carries the retry
-    guidance the 429 response ships (the QUERY_QUEUE_FULL analog)."""
+    guidance the 429 response ships (the QUERY_QUEUE_FULL analog).
+    Group-aware admission adds WHICH queue said no (``resource_group``)
+    and how many queries sit ahead (``queued_ahead``) so a client can
+    tell its own group's saturation from global overload. The message
+    keeps the stable "Dispatch queue is full" prefix — the process
+    plane's bounce detection matches on it."""
 
     code = "DISPATCH_QUEUE_FULL"
 
     def __init__(self, queued: int, capacity: int,
-                 retry_after_s: float = DEFAULT_RETRY_AFTER_S):
+                 retry_after_s: float = DEFAULT_RETRY_AFTER_S,
+                 resource_group: Optional[str] = None,
+                 queued_ahead: Optional[int] = None):
         self.queued = queued
         self.capacity = capacity
         self.retry_after_s = retry_after_s
+        self.resource_group = resource_group
+        self.queued_ahead = queued_ahead
+        where = (f" for resource group {resource_group}"
+                 if resource_group else "")
         super().__init__(
-            f"Dispatch queue is full ({queued}/{capacity} queued); "
+            f"Dispatch queue is full{where} ({queued}/{capacity} queued); "
             f"retry in {retry_after_s:g}s")
 
     def payload(self) -> dict:
-        return {
-            "error": {
-                "message": str(self),
-                "code": self.code,
-                "retryAfterSeconds": self.retry_after_s,
-                "queued": self.queued,
-                "capacity": self.capacity,
-            }
+        err = {
+            "message": str(self),
+            "code": self.code,
+            "retryAfterSeconds": self.retry_after_s,
+            "queued": self.queued,
+            "capacity": self.capacity,
         }
+        if self.resource_group is not None:
+            err["resourceGroup"] = self.resource_group
+        if self.queued_ahead is not None:
+            err["queuedAhead"] = self.queued_ahead
+        return {"error": err}
 
 
 class DispatchQueue:
@@ -126,9 +140,15 @@ class DispatchQueue:
     the overload contract (bounded memory, bounded threads, a clear
     client signal instead of an invisible pile-up)."""
 
+    # recent take() timestamps kept for the drain-rate estimator — the
+    # Retry-After a 429 ships is how long the observed rate needs to
+    # clear the queue ahead, not a constant
+    DRAIN_WINDOW = 64
+
     def __init__(self, capacity: int):
         self.capacity = max(1, int(capacity))
         self._dq: deque = deque()
+        self._drains: deque = deque(maxlen=self.DRAIN_WINDOW)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._closed = False
@@ -136,6 +156,27 @@ class DispatchQueue:
     def depth(self) -> int:
         with self._lock:
             return len(self._dq)
+
+    def drain_rate(self) -> float:
+        """Observed dequeue rate in items/second over the recent take
+        window (0.0 until two takes have happened)."""
+        with self._lock:
+            drains = list(self._drains)
+        if len(drains) < 2:
+            return 0.0
+        window = drains[-1] - drains[0]
+        if window <= 0:
+            return 0.0
+        return (len(drains) - 1) / window
+
+    def retry_after_s(self, queued_ahead: int) -> float:
+        """Honest Retry-After: time for the observed drain rate to clear
+        ``queued_ahead`` items, clamped to [0.1, 30]; the constant
+        fallback covers a queue that has never drained."""
+        rate = self.drain_rate()
+        if rate <= 0.0:
+            return DEFAULT_RETRY_AFTER_S
+        return min(30.0, max(0.1, (queued_ahead + 1) / rate))
 
     def check_capacity(self) -> None:
         """Cheap pre-admission probe for the HTTP thread: raises
@@ -148,7 +189,9 @@ class DispatchQueue:
             depth = len(self._dq)
         if full:
             M.DISPATCH_REJECTED.inc(1, "queue-full")
-            raise DispatchRejected(depth, self.capacity)
+            raise DispatchRejected(depth, self.capacity,
+                                   retry_after_s=self.retry_after_s(depth),
+                                   queued_ahead=depth)
 
     def offer(self, item) -> None:
         from trino_tpu.obs import metrics as M
@@ -162,7 +205,9 @@ class DispatchQueue:
         M.DISPATCH_QUEUE_DEPTH.set(depth)
         if rejected:
             M.DISPATCH_REJECTED.inc(1, "queue-full")
-            raise DispatchRejected(depth, self.capacity)
+            raise DispatchRejected(depth, self.capacity,
+                                   retry_after_s=self.retry_after_s(depth),
+                                   queued_ahead=depth)
 
     def take(self, timeout: float = 0.5):
         """Next queued item, or None on timeout/close (lanes poll so
@@ -176,6 +221,7 @@ class DispatchQueue:
             if not self._dq:
                 return None
             item = self._dq.popleft()
+            self._drains.append(time.time())
             depth = len(self._dq)
         M.DISPATCH_QUEUE_DEPTH.set(depth)
         return item
@@ -184,6 +230,127 @@ class DispatchQueue:
         with self._lock:
             self._closed = True
             self._cond.notify_all()
+
+
+class GroupDispatchQueue:
+    """Group-aware admission buffer: the ``DispatchQueue`` surface
+    (offer/take/depth/close/check_capacity) over a
+    :class:`~trino_tpu.server.resource_groups.ResourceGroupTree`.
+    Queries park in their GROUP's queue (bounded by the group's
+    ``max_queued``) and lanes drain by weighted-fair pick among eligible
+    groups instead of global FIFO; the global ``capacity`` still bounds
+    total parked queries so coordinator memory stays bounded under any
+    config. A query parked past its group's ``queue_timeout_ms`` is
+    failed HERE, typed ``EXCEEDED_QUEUE_TIMEOUT``, on the lane thread
+    that swept it out."""
+
+    def __init__(self, tree, capacity: int):
+        self.tree = tree
+        self.capacity = max(1, int(capacity))
+
+    def depth(self) -> int:
+        return self.tree.total_queued()
+
+    def drain_rate(self) -> float:
+        return self.tree.drain_rate()
+
+    def retry_after_s(self, queued_ahead: int) -> float:
+        return self.tree.retry_after_s(queued_ahead,
+                                       fallback=DEFAULT_RETRY_AFTER_S)
+
+    def check_capacity(self, group: Optional[str] = None) -> None:
+        """Overload probe for the HTTP thread: global capacity first,
+        then the target group's ``max_queued`` when known."""
+        from trino_tpu.obs import metrics as M
+
+        depth = self.depth()
+        if depth >= self.capacity:
+            M.DISPATCH_REJECTED.inc(1, "queue-full")
+            if group is not None:
+                M.RESOURCE_GROUP_REJECTED.inc(1, group, "queue-full")
+            raise DispatchRejected(
+                depth, self.capacity,
+                retry_after_s=self.retry_after_s(depth),
+                resource_group=group, queued_ahead=depth)
+        if group is not None:
+            queued, max_queued = self.tree.queue_state(group)
+            if queued >= max_queued:
+                M.DISPATCH_REJECTED.inc(1, "queue-full")
+                M.RESOURCE_GROUP_REJECTED.inc(1, group, "queue-full")
+                raise DispatchRejected(
+                    queued, max_queued,
+                    retry_after_s=self.retry_after_s(queued),
+                    resource_group=group, queued_ahead=queued)
+
+    def offer(self, execution) -> None:
+        from trino_tpu.obs import metrics as M
+
+        group = getattr(execution, "resource_group", None)
+        if group is None:
+            group = self.tree.select(execution.user, getattr(
+                execution, "source", ""), execution.session_properties)
+            execution.resource_group = group
+        depth = self.depth()
+        if depth >= self.capacity:
+            M.DISPATCH_REJECTED.inc(1, "queue-full")
+            M.RESOURCE_GROUP_REJECTED.inc(1, group, "queue-full")
+            raise DispatchRejected(
+                depth, self.capacity,
+                retry_after_s=self.retry_after_s(depth),
+                resource_group=group, queued_ahead=depth)
+        try:
+            ahead = self.tree.enqueue(group, execution.query_id, execution)
+        except IndexError:
+            queued, max_queued = self.tree.queue_state(group)
+            M.DISPATCH_REJECTED.inc(1, "queue-full")
+            M.RESOURCE_GROUP_REJECTED.inc(1, group, "queue-full")
+            raise DispatchRejected(
+                queued, max_queued,
+                retry_after_s=self.retry_after_s(queued),
+                resource_group=group, queued_ahead=queued)
+        execution.queued_ahead = ahead
+        M.DISPATCH_QUEUE_DEPTH.set(self.depth())
+
+    def take(self, timeout: float = 0.5):
+        """Next ADMITTED execution (weighted-fair, concurrency- and
+        memory-eligible), or None on timeout/close. Aged-out queries are
+        failed inline and the wait continues — a lane never returns a
+        query that was not admitted."""
+        from trino_tpu.obs import metrics as M
+
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            out = self.tree.dequeue(timeout=remaining)
+            if out is None:
+                return None
+            kind, execution, group, waited = out
+            M.DISPATCH_QUEUE_DEPTH.set(self.depth())
+            if kind == "run":
+                return execution
+            self._fail_aged(execution, group, waited)
+
+    def _fail_aged(self, execution, group: str, waited: float) -> None:
+        """Typed queue-timeout failure: the query never ran, its whole
+        wall clock IS the queued phase (the timeline synthesizes it from
+        the created->first-span gap)."""
+        from trino_tpu.obs import metrics as M
+
+        M.RESOURCE_GROUP_REJECTED.inc(1, group, "queue-timeout")
+        sp = getattr(execution, "_dispatch_queue_span", None)
+        if sp is not None:
+            execution.tracer.end_span(sp)
+            execution._dispatch_queue_span = None
+        execution.failure = (
+            f"Query exceeded the queue timeout of resource group {group}: "
+            f"EXCEEDED_QUEUE_TIMEOUT after {waited:.1f}s queued")
+        execution.ended_at = time.time()
+        execution.state.set("FAILED")
+
+    def close(self) -> None:
+        self.tree.close()
 
 
 class ServingIndex:
@@ -256,13 +423,18 @@ class Dispatcher:
     def __init__(self, server, lanes: Optional[int] = None,
                  queue_capacity: Optional[int] = None,
                  plane: Optional[str] = None,
-                 processes: Optional[int] = None):
+                 processes: Optional[int] = None,
+                 groups=None):
         self._server = server
         self.lane_count = (default_lane_count()
                            if lanes is None else max(0, int(lanes)))
-        self.queue = DispatchQueue(default_queue_capacity()
-                                   if queue_capacity is None
-                                   else queue_capacity)
+        capacity = (default_queue_capacity()
+                    if queue_capacity is None else queue_capacity)
+        # a coordinator with a ResourceGroupTree gets group-aware
+        # admission; one with an injected flat gate keeps the single FIFO
+        self.groups = groups
+        self.queue = (GroupDispatchQueue(groups, capacity)
+                      if groups is not None else DispatchQueue(capacity))
         self.plane = (plane or os.environ.get(
             "TRINO_TPU_EXECUTOR_PLANE") or "thread").lower()
         self.index = ServingIndex()
@@ -284,6 +456,14 @@ class Dispatcher:
         ``DispatchRejected`` when the queue is full."""
         self.ensure_lanes()
         if self._serve_from_index(execution):
+            if self.groups is not None:
+                group = getattr(execution, "resource_group", None)
+                if group:
+                    # a serving-index hit is concurrency-free but NOT
+                    # invisible: it counts against the group's served
+                    # tally so a saturated group's cached repeats stay
+                    # auditable
+                    self.groups.note_served(group)
             return True
         sp = execution.tracer.start_span("dispatch/queue")
         try:
@@ -294,9 +474,15 @@ class Dispatcher:
         execution._dispatch_queue_span = sp
         return False
 
-    def precheck(self) -> None:
-        """HTTP-thread overload probe, before any per-query state."""
-        self.queue.check_capacity()
+    def precheck(self, group: Optional[str] = None) -> None:
+        """HTTP-thread overload probe, before any per-query state.
+        ``group`` (known only under group-aware admission) adds the
+        target group's ``max_queued`` bound to the global-capacity
+        check."""
+        if group is not None and self.groups is not None:
+            self.queue.check_capacity(group)
+        else:
+            self.queue.check_capacity()
 
     def _serve_from_index(self, execution) -> bool:
         """Dispatch-plane result-cache consult: answer a repeat query
@@ -415,18 +601,27 @@ class Dispatcher:
 
     def _run_one(self, execution) -> None:
         from trino_tpu.obs import metrics as M
+        from trino_tpu.server import resource_groups as rg
 
         if not self._server._admit(execution):
             return
-        pp = self.process_plane
-        if pp is not None:
-            key = pp.route_key(execution)
-            if key is not None:
-                M.EXECUTOR_PLANE_QUERIES.inc(1, "process")
-                pp.run(execution, key=key)
-                return
-        M.EXECUTOR_PLANE_QUERIES.inc(1, "inline")
-        execution.run()
+        # bind the query's group to this lane for the run: cache tiers
+        # read it at admission time to tag entries with their owner
+        # group (the carve-out bookkeeping)
+        token = rg.set_current_group(
+            getattr(execution, "resource_group", None))
+        try:
+            pp = self.process_plane
+            if pp is not None:
+                key = pp.route_key(execution)
+                if key is not None:
+                    M.EXECUTOR_PLANE_QUERIES.inc(1, "process")
+                    pp.run(execution, key=key)
+                    return
+            M.EXECUTOR_PLANE_QUERIES.inc(1, "inline")
+            execution.run()
+        finally:
+            rg.reset_current_group(token)
 
     def refresh_gauges(self) -> None:
         from trino_tpu.obs import metrics as M

@@ -87,6 +87,10 @@ def query_record(execution, state: Optional[str] = None,
         "planningMs": planning_ms,
         "executionMs": execution_ms,
         "unattributedMs": unattributed_ms,
+        # the resource group that admitted the query (None under a
+        # legacy injected gate) — history keeps the attribution after
+        # the execution is pruned
+        "resourceGroup": execution.resource_group,
     }
 
 
@@ -103,6 +107,7 @@ def _query_row(rec: dict) -> tuple:
         rec["failure"], rec.get("fastPath"),
         rec.get("queuedMs"), rec.get("planningMs"),
         rec.get("executionMs"), rec.get("unattributedMs"),
+        rec.get("resourceGroup"),
     )
 
 
@@ -172,6 +177,8 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             return self._prepared_rows()
         if (schema, table) == ("runtime", "serving"):
             return self._server.dispatcher.serving_rows()
+        if (schema, table) == ("runtime", "resource_groups"):
+            return self._resource_group_rows()
         if (schema, table) == ("runtime", "device_cache"):
             from trino_tpu.connector.system.connector import device_cache_rows
 
@@ -263,6 +270,15 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
                  int(r["peakBytes"]), int(r["events"]))
                 for r in MEMORY_LEDGER.owner_rows())
         return rows
+
+    def _resource_group_rows(self) -> List[tuple]:
+        """``system.runtime.resource_groups``: one row per live group
+        node of the admission tree (empty under a legacy injected flat
+        gate — the table only describes group-aware admission)."""
+        groups = getattr(self._server, "resource_groups", None)
+        if groups is None:
+            return []
+        return groups.table_rows()
 
     def _prepared_rows(self) -> List[tuple]:
         return [
