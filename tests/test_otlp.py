@@ -66,6 +66,44 @@ def test_metrics_payload_counters_are_monotonic_sums():
         {"key": "state", "value": {"stringValue": "RUNNING"}}]
 
 
+def test_metrics_payload_histograms_are_real_histograms():
+    h = "hist help"
+    samples = [
+        ("trino_tpu_compile_seconds_bucket", "histogram",
+         {"tier": "compiled", "cache": "miss", "le": "0.1"}, 1.0, h),
+        ("trino_tpu_compile_seconds_bucket", "histogram",
+         {"tier": "compiled", "cache": "miss", "le": "1"}, 3.0, h),
+        ("trino_tpu_compile_seconds_bucket", "histogram",
+         {"tier": "compiled", "cache": "miss", "le": "+Inf"}, 4.0, h),
+        ("trino_tpu_compile_seconds_sum", "histogram",
+         {"tier": "compiled", "cache": "miss"}, 2.5, h),
+        ("trino_tpu_compile_seconds_count", "histogram",
+         {"tier": "compiled", "cache": "miss"}, 4.0, h),
+        ("trino_tpu_workers", "gauge", {}, 2.0, "workers"),
+    ]
+    payload = metrics_payload(samples, {"service.name": "w"})
+    metrics = {m["name"]: m for m in
+               payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]}
+    # the expanded Prometheus series do NOT leak through as gauges
+    assert "trino_tpu_compile_seconds_bucket" not in metrics
+    assert "trino_tpu_compile_seconds_sum" not in metrics
+    assert "trino_tpu_compile_seconds_count" not in metrics
+    hist = metrics["trino_tpu_compile_seconds"]["histogram"]
+    assert hist["aggregationTemporality"] == 2
+    dp = hist["dataPoints"][0]
+    assert dp["explicitBounds"] == [0.1, 1.0]
+    # cumulative le counts (1, 3) + total 4 -> per-bucket (1, 2, 1)
+    assert dp["bucketCounts"] == ["1", "2", "1"]
+    assert dp["sum"] == 2.5 and dp["count"] == "4"
+    attrs = {a["key"]: a["value"] for a in dp["attributes"]}
+    assert "le" not in attrs  # bucket label stripped from the point
+    assert attrs["tier"] == {"stringValue": "compiled"}
+    assert attrs["cache"] == {"stringValue": "miss"}
+    # gauges still export as gauges alongside
+    assert metrics["trino_tpu_workers"]["gauge"]["dataPoints"][0][
+        "asDouble"] == 2.0
+
+
 def test_queue_overflow_drops_counted_and_never_blocks():
     # exporter thread NOT started: the queue can only fill
     exporter = OtlpExporter("http://127.0.0.1:1", "t", queue_max=3)
@@ -101,6 +139,8 @@ def test_stub_collector_round_trip():
             [{"spanId": "ab" * 8, "name": "task", "start": 5.0,
               "durationS": 1.0, "attributes": {}}],
             "fe" * 16, {"query_id": "qz"})
+        # touch a histogram so the snapshot must carry a real one
+        M.COMPILE_SECONDS_TIERED.observe(0.05, "compiled", "miss")
         exporter.export_metrics_snapshot()
         assert exporter.flush(timeout=10.0)
         spans = collector.spans()
@@ -110,6 +150,17 @@ def test_stub_collector_round_trip():
         assert spans[0]["_resource"]["service.instance.id"] == "node-1"
         assert spans[0]["_resource"]["query_id"] == "qz"
         assert collector.metric_payloads  # the registry snapshot arrived
+        exported = {m["name"]: m for p in collector.metric_payloads
+                    for m in p["resourceMetrics"][0]["scopeMetrics"][0]
+                    ["metrics"]}
+        hist = exported["trino_tpu_compile_seconds"]["histogram"]
+        dp = next(d for d in hist["dataPoints"]
+                  if {a["key"]: a["value"].get("stringValue")
+                      for a in d["attributes"]} ==
+                  {"tier": "compiled", "cache": "miss"})
+        assert int(dp["count"]) >= 1 and float(dp["sum"]) > 0
+        assert len(dp["bucketCounts"]) == len(dp["explicitBounds"]) + 1
+        assert sum(int(c) for c in dp["bucketCounts"]) == int(dp["count"])
         exporter.shutdown()
     finally:
         collector.stop()

@@ -50,9 +50,9 @@ def test_provider_less_system_tables():
     assert s.execute("show schemas from system").rows == [
         ("metadata",), ("metrics",), ("runtime",)]
     assert s.execute("show tables from system.runtime").rows == [
-        ("device_cache",), ("memory",), ("nodes",),
-        ("prepared_statements",), ("queries",), ("resource_groups",),
-        ("serving",), ("tasks",)]
+        ("compiles",), ("device_cache",), ("kernels",), ("memory",),
+        ("nodes",), ("prepared_statements",), ("queries",),
+        ("resource_groups",), ("serving",), ("tasks",)]
     assert s.execute("select * from system.runtime.queries").rows == []
     assert s.execute("select * from system.runtime.tasks").rows == []
     M.STAGED_ROWS.inc(0)  # touch so at least one series exists

@@ -382,6 +382,45 @@ def _extract_memledger(path: str) -> List[dict]:
     return out
 
 
+def _extract_profile(path: str) -> List[dict]:
+    """PROFILE_r*.json: the device-profiler round — per-shape dispatch-
+    overhead fraction (down: ROADMAP item 2's fragment megakernels must
+    shrink it) and attribution fraction (up: kernel coverage of the
+    device phases must not decay), both ratio-tolerance (timing-fraction
+    wobble); plus the compiled-tier cold compile seconds and the
+    cache-hit correctness count (a rerun recording new misses is a
+    jit-cache regression). Workers/requests stay OUT: setup, not perf."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    rnd = int(data.get("round", _round_of(path)))
+    out: List[dict] = []
+    for shape, rec in sorted((data.get("shapes") or {}).items()):
+        if not isinstance(rec, dict):
+            continue
+        if rec.get("dispatch_overhead_fraction") is not None:
+            out.append(_entry("profile", rnd,
+                              f"{shape}_dispatch_overhead_fraction",
+                              rec["dispatch_overhead_fraction"],
+                              "fraction", "down", path,
+                              tolerance=RATIO_TOLERANCE))
+        if rec.get("attributed_fraction") is not None:
+            out.append(_entry("profile", rnd,
+                              f"{shape}_attributed_fraction",
+                              rec["attributed_fraction"], "fraction",
+                              "up", path, tolerance=RATIO_TOLERANCE))
+    cc = data.get("compile_cache")
+    if isinstance(cc, dict):
+        if cc.get("compile_seconds") is not None:
+            out.append(_entry("profile", rnd, "compile_seconds_total",
+                              cc["compile_seconds"], "s", "down", path,
+                              tolerance=RATIO_TOLERANCE))
+        if cc.get("second_run_new_misses") is not None:
+            out.append(_entry("profile", rnd, "rerun_new_compile_misses",
+                              cc["second_run_new_misses"], "count",
+                              "down", path))
+    return out
+
+
 _FAMILIES = (
     ("BENCH_r*.json", _extract_bench),
     ("QPS_r*.json", _extract_qps),
@@ -393,6 +432,7 @@ _FAMILIES = (
     ("STAGING_r*.json", _extract_staging),
     ("MATVIEW_r*.json", _extract_matview),
     ("MEMLEDGER_r*.json", _extract_memledger),
+    ("PROFILE_r*.json", _extract_profile),
 )
 
 

@@ -52,6 +52,13 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             bool, True,
         ),
         PropertyMetadata(
+            "device_profiling",
+            "bracket every device dispatch with block_until_ready so the "
+            "kernel ledger measures device seconds (off: zero-sync "
+            "counting only — device seconds estimated from wall)",
+            bool, False,
+        ),
+        PropertyMetadata(
             "slow_injection",
             "straggler injection for speculative-execution tests: "
             "'<task-id-substring>:<seconds>' sleeps matching tasks "

@@ -181,6 +181,41 @@ SYSTEM_TABLES = {
         ("peak_bytes", "bigint"),      # this owner's high-water mark
         ("events", "bigint"),          # ledger events this owner produced
     ),
+    # the kernel ledger (trino_tpu/obs/devprofiler.py): one row per
+    # (query, plan node, operator, tier, node) — device dispatches with
+    # wall vs device seconds split, so dispatch overhead is an explicit
+    # per-operator number. Terminal queries read the folded profiler
+    # store; RUNNING queries merge their live task rollups.
+    ("runtime", "kernels"): (
+        ("query_id", "varchar"),
+        ("node_id", "varchar"),        # worker uri or "coordinator"
+        ("plan_node_id", "varchar"),
+        ("operator", "varchar"),       # TableScan | Join | CompiledBody...
+        ("tier", "varchar"),           # eager | compiled | spmd
+        ("launches", "bigint"),
+        ("wall_seconds", "double"),
+        ("device_seconds", "double"),  # measured under device_profiling,
+                                       # estimated from wall otherwise
+        ("dispatch_overhead_seconds", "double"),  # wall − device
+        ("input_bytes", "bigint"),
+        ("output_bytes", "bigint"),
+        ("estimated", "boolean"),      # true = no-sync estimate
+    ),
+    # the compile ledger (trino_tpu/obs/devprofiler.py): one row per
+    # jit/Pallas compile event cluster-wide — plan fingerprint + shape
+    # signature name WHAT compiled, cache says hit or miss. Worker rows
+    # ride the announce payload (compileEvents); coordinator rows come
+    # from its own process ring.
+    ("runtime", "compiles"): (
+        ("node_id", "varchar"),
+        ("query_id", "varchar"),       # empty for bench/local compiles
+        ("tier", "varchar"),           # eager | compiled | spmd
+        ("fingerprint", "varchar"),    # plan fingerprint (cache/plan_key)
+        ("shape_signature", "varchar"),
+        ("compile_seconds", "double"),
+        ("cache", "varchar"),          # hit | miss
+        ("created_at", "double"),      # epoch seconds
+    ),
     # registered materialized views (trino_tpu/matview/): definitions,
     # storage location, and LIVE freshness (recomputed at scan time from
     # the connectors' current data versions vs the versions recorded at

@@ -170,6 +170,11 @@ class CompiledQuery:
     # double-and-recompile loop; 0 when hints were right the first time —
     # e.g. under adaptive_capacity_reseed)
     recompiles: int = 0
+    # kernel-ledger rollup (obs/devprofiler.py): one "CompiledBody" row
+    # accumulating this query's jitted-body dispatches
+    kernel_stats: Dict[tuple, dict] = dataclasses.field(default_factory=dict)
+    # compile-ledger identity, computed lazily once per instance
+    _fingerprint: str = ""
 
     MAX_RECOMPILES = 16  # doubling buckets: 2^16x headroom over the estimate
 
@@ -353,6 +358,46 @@ class CompiledQuery:
         # + compiles (a miss), later calls reuse the executable (hits)
         self._executable_fresh = True
 
+    def _profile_run(self, fresh: bool, dispatch_wall_s: float,
+                     body_device_s: float, estimated: bool) -> None:
+        """Feed the device profiler: one compile-ledger event per run
+        (miss on fresh executables, hit on reuse) + a ``CompiledBody``
+        kernel row. Best-effort — accounting never fails work."""
+        try:
+            from trino_tpu.cache.plan_key import plan_fingerprint
+            from trino_tpu.obs.devprofiler import (
+                DEVICE_PROFILER, shape_signature)
+
+            if not self._fingerprint:
+                self._fingerprint = plan_fingerprint(self.root)
+            DEVICE_PROFILER.record_compile(
+                "compiled", self._fingerprint,
+                shape_signature(self.input_arrays),
+                dispatch_wall_s if fresh else 0.0,
+                "miss" if fresh else "hit", started=fresh)
+            # a fresh run's dispatch wall is dominated by trace+compile —
+            # charged to the compile ledger above, NOT to the kernel row,
+            # so dispatch overhead stays a steady-state signal
+            wall = (body_device_s if fresh
+                    else dispatch_wall_s + (0.0 if estimated
+                                            else body_device_s))
+            key = (str(self.root.id), "CompiledBody", "compiled")
+            ks = self.kernel_stats.get(key)
+            if ks is None:
+                ks = self.kernel_stats[key] = {
+                    "planNodeId": key[0], "operator": key[1],
+                    "tier": "compiled", "launches": 0, "wallS": 0.0,
+                    "deviceS": 0.0, "inputBytes": 0, "outputBytes": 0,
+                    "estimated": estimated}
+            ks["launches"] += 1
+            ks["wallS"] += wall
+            ks["deviceS"] += body_device_s
+            ks["estimated"] = bool(ks["estimated"] or estimated)
+            DEVICE_PROFILER.count_launch(wall, body_device_s
+                                         if not estimated else 0.0)
+        except Exception:  # noqa: BLE001 — accounting never fails work
+            pass
+
     def run(self) -> Page:
         """Execute; on a capacity overflow, double the offending join's
         bucket and recompile (reference analog: the spill/partition FSM of
@@ -364,6 +409,13 @@ class CompiledQuery:
             # first call on a fresh executable traces + compiles (a compile-
             # cache miss); subsequent calls reuse the jitted executable
             fresh = self._executable_fresh
+            if fresh:
+                try:
+                    from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+                    DEVICE_PROFILER.compile_started()
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
             with tracing.span(
                     "device/compile" if fresh else "device/execute") as sp:
                 t0 = time.perf_counter()
@@ -371,6 +423,24 @@ class CompiledQuery:
                 device_s = time.perf_counter() - t0
                 sp.set("device_seconds", round(device_s, 6))
                 sp.set("staged_rows", int(sum(self.scan_rows.values())))
+            # kernel/compile ledger (obs/devprofiler.py): with
+            # device_profiling on, bracket the post-dispatch wait so
+            # device seconds are measured, not dispatch wall
+            props = getattr(self.session, "properties", None) or {}
+            sync = bool(props.get("device_profiling", False))
+            # estimated (no-sync) mode: a fresh run's wall is compile, not
+            # kernel time — estimate the body's device share as 0 there
+            body_device_s = 0.0 if fresh else device_s
+            estimated = True
+            if sync:
+                t_sync = time.perf_counter()
+                try:
+                    jax.block_until_ready(out_arrays)
+                except Exception:  # noqa: BLE001 — profiling never fails
+                    pass
+                body_device_s = time.perf_counter() - t_sync
+                estimated = False
+            self._profile_run(fresh, device_s, body_device_s, estimated)
             (M.COMPILE_CACHE_MISSES if fresh else M.COMPILE_CACHE_HITS).inc()
             self._executable_fresh = False
             # a fresh run's wall is dominated by trace+XLA-compile; charge

@@ -243,6 +243,13 @@ class Executor:
         # (splits, scanned_rows) staged by the scan method that just ran,
         # consumed by the execute() wrapper into the scan's OperatorStats
         self._pending_scan: Dict[int, Tuple[int, int]] = {}
+        # bytes a node produced on its LATEST execution — parents charge
+        # input bytes per invocation (kernel-ledger input side)
+        self._last_output_bytes: Dict[int, int] = {}
+        # device profiler (obs/devprofiler.py): per-(node, operator)
+        # kernel rollups — launches, wall vs device seconds, bytes.
+        # Accumulated here, folded ONCE at task/query completion.
+        self.kernel_stats: Dict[Tuple[int, str], dict] = {}
         # device-memory budget + spill decisions (exec/memory.py; reference:
         # lib/trino-memory-context + the spill FSMs). Property name mirrors
         # the reference's query_max_memory_per_node.
@@ -257,6 +264,11 @@ class Executor:
         if not props.get("dynamic_filtering_enabled", True):
             self.enable_dynamic_filtering = False
         self.spill_enabled = bool(props.get("spill_enabled", True))
+        # device_profiling session property: when on, each dispatch is
+        # block_until_ready-bracketed so device seconds are measured;
+        # when off (default) NO sync is added — device seconds are
+        # estimated from wall and only zero-sync counting happens
+        self.profile_sync = bool(props.get("device_profiling", False))
 
     # ------------------------------------------------------------------ api
     def execute_checked(self, node: P.PlanNode) -> Page:
@@ -285,6 +297,26 @@ class Executor:
             wall = time.perf_counter() - t0
             child_wall = self._child_wall.pop()
             self._child_wall[-1] += wall
+        excl_wall = max(0.0, wall - child_wall)
+        # kernel ledger (obs/devprofiler.py): device seconds per dispatch.
+        # profile_sync ON: eager jax dispatch returns before the math
+        # finishes — the block_until_ready wait IS the device time, and
+        # excl_wall (dispatch + host glue) minus it is the overhead.
+        # OFF: zero-sync estimate — device ≈ exclusive wall, flagged.
+        device_s = excl_wall
+        estimated = True
+        if self.profile_sync:
+            t_sync = time.perf_counter()
+            try:
+                jax.block_until_ready([c.values for c in page.columns])
+            except Exception:  # noqa: BLE001 — profiling never fails work
+                pass
+            device_s = time.perf_counter() - t_sync
+            estimated = False
+            # the sync wait is elapsed time inside THIS node's subtree:
+            # charge it to the parent's child ledger so the parent's
+            # exclusive wall stays exclusive of it
+            self._child_wall[-1] += device_s
         live = page.live_count()  # live rows, not padded slots
         nbytes = _mem.page_bytes(page)
         st = self.node_stats.get(node.id)
@@ -305,6 +337,36 @@ class Executor:
         splits, scanned = self._pending_scan.pop(node.id, (0, 0))
         st.splits += splits
         st.input_rows += scanned  # scans: connector rows are the input side
+        # kernel-ledger rollup: one "launch" per node execution in the
+        # eager tier (each _exec_ dispatches this node's device ops).
+        # Wall here is EXCLUSIVE (matches st.wall_s), with the measured
+        # sync wait added back when profiling — wall − device = the
+        # per-operator dispatch overhead megakernels must beat.
+        in_bytes = sum(
+            self._last_output_bytes.get(s.id, 0) for s in node.sources)
+        kwall = excl_wall + (device_s if not estimated else 0.0)
+        kkey = (node.id, st.operator)
+        ks = self.kernel_stats.get(kkey)
+        if ks is None:
+            ks = self.kernel_stats[kkey] = {
+                "planNodeId": str(node.id), "operator": st.operator,
+                "tier": "eager", "launches": 0, "wallS": 0.0,
+                "deviceS": 0.0, "inputBytes": 0, "outputBytes": 0,
+                "estimated": estimated}
+        ks["launches"] += 1
+        ks["wallS"] += kwall
+        ks["deviceS"] += device_s
+        ks["inputBytes"] += in_bytes
+        ks["outputBytes"] += nbytes
+        ks["estimated"] = bool(ks["estimated"] or estimated)
+        try:
+            from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+            DEVICE_PROFILER.count_launch(kwall, device_s
+                                         if not estimated else 0.0)
+        except Exception:  # noqa: BLE001 — accounting never fails work
+            pass
+        self._last_output_bytes[node.id] = nbytes
         self._last_output_rows[node.id] = live
         # operator-output reservation rolls into the query's peak (the
         # LocalMemoryContext -> query-pool rollup, exact from static shapes)

@@ -99,6 +99,19 @@ def merge_operator_dicts(
     return merged
 
 
+def merge_kernel_lists(dict_lists: Iterable[Iterable[dict]]) -> List[dict]:
+    """Merge per-task ``kernelStats`` payload lists (kernel-ledger rows,
+    obs/devprofiler.py wire shape) — additive by construction, keyed by
+    (plan node, operator, tier, node) so per-worker attribution survives
+    the stage rollup."""
+    from trino_tpu.obs.devprofiler import merge_kernel_rows
+
+    merged: Dict[tuple, dict] = {}
+    for rows in dict_lists:
+        merge_kernel_rows(merged, list(rows or ()))
+    return [merged[k] for k in sorted(merged)]
+
+
 def _stage_state(task_entries: List[dict]) -> str:
     """A stage is FINISHED only when every task finished; any failed or
     canceled task marks the whole stage (a FAILED stage must never read as
@@ -145,6 +158,9 @@ def rollup_tasks_to_stage(fragment_id: int, task_entries: List[dict],
         "deviceCacheHits": 0,
         "deviceCacheMisses": 0,
         "operatorStats": [ops[k].to_dict() for k in sorted(ops)],
+        "kernelStats": merge_kernel_lists(
+            e.get("stats", {}).get("kernelStats")
+            for e in task_entries) if include_operators else [],
     }
     part_bytes = None
     part_rows = None

@@ -1,0 +1,308 @@
+"""Device execution profiler: kernel ledger, compile ledger, utilization.
+
+Reference role: the device half of Trino's operator stats — Trino's
+``OperatorStats`` carries ``addInputWall``/``getOutputWall`` per driver;
+here the analogous split is *wall vs device* per dispatch.  PAPER.md's
+framing maps Trino's runtime codegen onto XLA/Pallas compilation, which
+makes compile events and kernel launches first-class engine work.  The
+phase ledger (obs/timeline.py) made every wall-clock millisecond
+attributable and the memory ledger (obs/memledger.py) every byte; this
+module attributes the *inside* of the ``device-execute`` and
+``device-staging`` phases.
+
+Three stores per process (design mirrors obs/memledger.py: bounded
+rings, O(1) append under a short lock, fan-out outside the lock):
+
+- a **kernel ledger** — per-query rollups keyed
+  ``(plan_node_id, operator, tier)`` recording launch count, wall
+  seconds, device seconds, and input/output bytes.  ``wall − device`` is
+  the per-operator dispatch overhead — the number ROADMAP item 2's
+  fragment megakernels must beat.  Device seconds are
+  ``block_until_ready``-bracketed only when the ``device_profiling``
+  session property is on; otherwise they are estimated from wall
+  (``estimated=True`` rows) so the serving plane never pays a sync.
+- a **compile ledger** — a bounded ring of jit/Pallas compile events,
+  each naming its tier (``eager``/``compiled``/``spmd``), plan
+  fingerprint (cache/plan_key.py spine), shape signature, compile
+  seconds, and cache ``hit``/``miss``.  Mirrored into the flight
+  recorder so FAILED-query postmortems show recompile storms.
+- a **utilization sampler** — monotonic process counters (launches,
+  busy seconds, compiles in flight) sampled on the worker announce tick
+  into a watermark-style ring (launches/sec, device-busy fraction).
+
+Hot-path contract: ``count_launch`` is a couple of integer adds under
+one short lock — safe on the point-lookup serving path.  Metrics and
+recorder fan-out happen at *fold* time (query completion) or compile
+time (rare), never per-dispatch.
+
+This module is import-clean standalone (stdlib only at import time) so
+doc gates can load it without the package/jax.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+# compile events are rare (one per fresh jit); 256 ≈ hours of history
+COMPILE_CAPACITY = 256
+# announce loop samples every 0.5 s -> ~2 minutes of per-node history
+UTILIZATION_CAPACITY = 240
+# per-query kernel rollups kept after the query folds (LRU)
+MAX_QUERY_PROFILES = 64
+
+TIERS = ("eager", "compiled", "spmd")
+
+
+def merge_kernel_rows(dst: Dict[tuple, dict],
+                      rows: List[dict]) -> Dict[tuple, dict]:
+    """Fold serialized kernel rows (``kernel_rows`` wire shape) into a
+    ``(planNodeId, operator, tier, nodeId)``-keyed accumulator."""
+    for row in rows or []:
+        key = (row.get("planNodeId", ""), row.get("operator", ""),
+               row.get("tier", "eager"), row.get("nodeId", ""))
+        agg = dst.get(key)
+        if agg is None:
+            agg = {"planNodeId": key[0], "operator": key[1],
+                   "tier": key[2], "nodeId": key[3], "launches": 0,
+                   "wallS": 0.0, "deviceS": 0.0, "inputBytes": 0,
+                   "outputBytes": 0, "estimated": False}
+            dst[key] = agg
+        agg["launches"] += int(row.get("launches", 0))
+        agg["wallS"] += float(row.get("wallS", 0.0))
+        agg["deviceS"] += float(row.get("deviceS", 0.0))
+        agg["inputBytes"] += int(row.get("inputBytes", 0))
+        agg["outputBytes"] += int(row.get("outputBytes", 0))
+        agg["estimated"] = bool(agg["estimated"] or row.get("estimated"))
+    return dst
+
+
+class DeviceProfiler:
+    """One process's device profiler (coordinator AND every worker —
+    same pattern as the per-process memory ledger)."""
+
+    def __init__(self, node_id: str = "",
+                 compile_capacity: int = COMPILE_CAPACITY,
+                 utilization_capacity: int = UTILIZATION_CAPACITY,
+                 max_query_profiles: int = MAX_QUERY_PROFILES):
+        self.node_id = node_id
+        self._lock = threading.Lock()
+        self._compiles: "deque[dict]" = deque(maxlen=compile_capacity)
+        self._utilization: "deque[dict]" = deque(
+            maxlen=utilization_capacity)
+        # queryId -> {(planNodeId, operator, tier, nodeId) -> rollup}
+        self._queries: "OrderedDict[str, Dict[tuple, dict]]" = OrderedDict()
+        self._max_query_profiles = max_query_profiles
+        # monotonic utilization counters (cheap adds on the hot path)
+        self._launches_total = 0
+        self._busy_s_total = 0.0
+        self._compiles_total = 0
+        self._compile_inflight = 0
+        # previous sample point for rate computation
+        self._last_sample_ts: Optional[float] = None
+        self._last_launches = 0
+        self._last_busy_s = 0.0
+        self._recorder = None
+
+    # ------------------------------------------------------------ wiring
+    def attach_recorder(self, recorder) -> None:
+        """Mirror compile events into the process flight recorder so a
+        FAILED-query postmortem shows whether a recompile storm preceded
+        the failure (satellite of the flight-recorder contract)."""
+        self._recorder = recorder
+
+    # --------------------------------------------------------- hot path
+    def count_launch(self, wall_s: float, busy_s: float,
+                     n: int = 1) -> None:
+        """Zero-sync accounting for one (or ``n``) device dispatches:
+        two adds under a short lock, no metrics fan-out.  Safe on the
+        point-lookup serving path with ``device_profiling`` off."""
+        with self._lock:
+            self._launches_total += n
+            self._busy_s_total += busy_s if busy_s > 0 else wall_s
+
+    # ----------------------------------------------------- compile ring
+    def compile_started(self) -> None:
+        with self._lock:
+            self._compile_inflight += 1
+
+    def record_compile(self, tier: str, fingerprint: str, shape_sig: str,
+                       compile_s: float, cache: str,
+                       query_id: str = "", started: bool = False) -> None:
+        """Append one compile event (``cache`` is ``"hit"`` or
+        ``"miss"``); fan out to the tiered compile-seconds histogram and
+        the flight recorder OUTSIDE the ledger lock.
+
+        ``started=True`` pairs with a prior :meth:`compile_started` and
+        decrements the in-flight gauge counter."""
+        rec = {"ts": time.time(), "nodeId": self.node_id,
+               "queryId": query_id, "tier": tier,
+               "fingerprint": fingerprint, "shapeSig": shape_sig,
+               "compileS": round(float(compile_s), 6), "cache": cache}
+        with self._lock:
+            self._compiles.append(rec)
+            self._compiles_total += 1
+            if started and self._compile_inflight > 0:
+                self._compile_inflight -= 1
+        # fan-out outside the lock — accounting never fails work
+        try:
+            from trino_tpu.obs import metrics as M
+
+            M.COMPILE_SECONDS_TIERED.observe(float(compile_s), tier, cache)
+        except Exception:  # noqa: BLE001 — accounting never fails work
+            pass
+        if self._recorder is not None:
+            try:
+                self._recorder.record(
+                    "compile", "device/compile-event", tier=tier,
+                    cache=cache, fingerprint=fingerprint,
+                    shapeSig=shape_sig, compileS=round(float(compile_s), 6),
+                    queryId=query_id)
+            except Exception:  # noqa: BLE001 — best-effort forensics
+                pass
+
+    # ------------------------------------------------------- query fold
+    def record_query_kernels(self, query_id: str, rows: List[dict],
+                             node_id: Optional[str] = None) -> None:
+        """Fold a query's kernel rows (from executors / task rollups)
+        into the per-query store, and bump the per-operator launch and
+        dispatch-overhead metrics ONCE per fold — not per dispatch."""
+        if not rows:
+            return
+        node = node_id if node_id is not None else self.node_id
+        stamped = [dict(r, nodeId=r.get("nodeId") or node) for r in rows]
+        with self._lock:
+            store = self._queries.get(query_id)
+            if store is None:
+                store = {}
+                self._queries[query_id] = store
+                while len(self._queries) > self._max_query_profiles:
+                    self._queries.popitem(last=False)
+            else:
+                self._queries.move_to_end(query_id)
+            merge_kernel_rows(store, stamped)
+        # metrics fan-out outside the lock, once per fold
+        try:
+            from trino_tpu.obs import metrics as M
+
+            for row in stamped:
+                op = row.get("operator", "")
+                launches = int(row.get("launches", 0))
+                if launches:
+                    M.KERNEL_LAUNCHES.inc(launches, op)
+                overhead = max(
+                    0.0, float(row.get("wallS", 0.0))
+                    - float(row.get("deviceS", 0.0)))
+                if overhead > 0:
+                    M.KERNEL_DISPATCH_OVERHEAD.inc(overhead, op)
+        except Exception:  # noqa: BLE001 — accounting never fails work
+            pass
+
+    # ----------------------------------------------------- announce tick
+    def sample_utilization(self) -> dict:
+        """One announce-loop tick: turn the monotonic counters into
+        launches/sec and device-busy fraction since the last tick."""
+        now = time.time()
+        with self._lock:
+            launches = self._launches_total
+            busy_s = self._busy_s_total
+            inflight = self._compile_inflight
+            prev_ts = self._last_sample_ts
+            dt = (now - prev_ts) if prev_ts is not None else 0.0
+            d_launches = launches - self._last_launches
+            d_busy = busy_s - self._last_busy_s
+            self._last_sample_ts = now
+            self._last_launches = launches
+            self._last_busy_s = busy_s
+            sample = {
+                "ts": now, "nodeId": self.node_id,
+                "launchesTotal": launches,
+                "launchesPerS": round(d_launches / dt, 3) if dt > 0 else 0.0,
+                "busyFraction": round(min(1.0, d_busy / dt), 4)
+                if dt > 0 else 0.0,
+                "compileInflight": inflight,
+                "compilesTotal": self._compiles_total,
+            }
+            self._utilization.append(sample)
+        return sample
+
+    # ------------------------------------------------------------- reads
+    def kernel_rows(self, query_id: Optional[str] = None) -> List[dict]:
+        """Per-(query, planNode, operator, tier, node) rollup rows — the
+        ``system.runtime.kernels`` source."""
+        with self._lock:
+            if query_id is not None:
+                stores = {query_id: self._queries.get(query_id, {})}
+            else:
+                stores = {qid: dict(s) for qid, s in self._queries.items()}
+            rows = []
+            for qid, store in stores.items():
+                for agg in store.values():
+                    row = dict(agg)
+                    row["queryId"] = qid
+                    row["dispatchOverheadS"] = round(
+                        max(0.0, row["wallS"] - row["deviceS"]), 6)
+                    rows.append(row)
+        rows.sort(key=lambda r: (r["queryId"], r["planNodeId"],
+                                 r["operator"], r["nodeId"]))
+        return rows
+
+    def compile_rows(self, query_id: Optional[str] = None,
+                     limit: Optional[int] = None) -> List[dict]:
+        """Oldest-first copy of the compile ring (optionally filtered
+        to one query) — the ``system.runtime.compiles`` source."""
+        with self._lock:
+            records = list(self._compiles)
+        if query_id is not None:
+            records = [r for r in records if r.get("queryId") == query_id]
+        if limit is not None and len(records) > limit:
+            records = records[-limit:]
+        return records
+
+    def utilization_rows(self, limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            samples = list(self._utilization)
+        if limit is not None and len(samples) > limit:
+            samples = samples[-limit:]
+        return samples
+
+    def counters(self) -> Dict[str, float]:
+        with self._lock:
+            return {"launchesTotal": self._launches_total,
+                    "busySTotal": round(self._busy_s_total, 6),
+                    "compilesTotal": self._compiles_total,
+                    "compileInflight": self._compile_inflight}
+
+    def profile_snapshot(self, query_id: str) -> dict:
+        """The ``/v1/query/{id}/profile`` block for THIS process: the
+        query's kernel rollups + its compile events + recent
+        utilization."""
+        return {"nodeId": self.node_id,
+                "kernels": self.kernel_rows(query_id),
+                "compiles": self.compile_rows(query_id),
+                "utilization": self.utilization_rows(limit=8)}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._compiles)
+
+
+def shape_signature(arrays) -> str:
+    """Short, stable signature of input array shapes/dtypes — the compile
+    ledger's ``shapeSig`` (mirrors jit's retrace key conceptually)."""
+    import hashlib
+
+    parts = []
+    for arr in arrays:
+        shape = tuple(getattr(arr, "shape", ()) or ())
+        dtype = str(getattr(arr, "dtype", type(arr).__name__))
+        parts.append(f"{dtype}{list(shape)}")
+    sig = ";".join(parts)
+    digest = hashlib.sha256(sig.encode()).hexdigest()[:12]
+    return f"{digest}:{len(parts)}"
+
+
+# the per-process profiler (coordinator AND every worker — same pattern
+# as MEMORY_LEDGER); servers stamp node_id at startup
+DEVICE_PROFILER = DeviceProfiler()

@@ -370,6 +370,23 @@ OPERATOR_ROWS = REGISTRY.counter(
     "rows output by operator kind, accumulated at task completion",
     ("operator",))
 
+# device profiler (obs/devprofiler.py): per-operator launch + dispatch
+# overhead counters bumped at query fold time (never per-dispatch), and
+# the tiered compile-seconds histogram fed by every compile event
+KERNEL_LAUNCHES = REGISTRY.counter(
+    "trino_tpu_kernel_launches_total",
+    "device dispatches by operator kind, folded from the kernel ledger "
+    "at query completion", ("operator",))
+KERNEL_DISPATCH_OVERHEAD = REGISTRY.counter(
+    "trino_tpu_kernel_dispatch_overhead_seconds",
+    "per-operator wall minus device seconds (host dispatch overhead — "
+    "the number fragment megakernels must beat), folded from the kernel "
+    "ledger at query completion", ("operator",))
+COMPILE_SECONDS_TIERED = REGISTRY.histogram(
+    "trino_tpu_compile_seconds",
+    "per-event jit/Pallas compile seconds by execution tier and "
+    "compile-cache outcome (hit events observe ~0)", ("tier", "cache"))
+
 # query caching subsystem (trino_tpu/cache/): coordinator result cache,
 # logical-plan cache, and the connector-side datagen cache
 RESULT_CACHE_HITS = REGISTRY.counter(

@@ -107,16 +107,82 @@ def spans_payload(span_dicts: List[dict], trace_id: str,
     }
 
 
+def _hist_collect(hist: Dict[str, dict], name: str, labels: Dict[str, str],
+                  value: float, help_text: str) -> None:
+    """Fold one expanded histogram sample (``_bucket``/``_sum``/
+    ``_count``) back into a per-(base name, label set) accumulator."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            break
+    else:
+        return  # histogram-typed sample with an unknown suffix: drop
+    base = name[:-len(suffix)]
+    rec = hist.setdefault(base, {"help": help_text, "points": {}})
+    base_labels = {k: v for k, v in labels.items() if k != "le"}
+    key = tuple(sorted(base_labels.items()))
+    pt = rec["points"].setdefault(
+        key, {"labels": base_labels, "buckets": [], "sum": 0.0, "count": 0})
+    if suffix == "_bucket":
+        le = labels.get("le", "+Inf")
+        if le != "+Inf":  # +Inf is redundant with _count
+            pt["buckets"].append((float(le), value))
+    elif suffix == "_sum":
+        pt["sum"] = float(value)
+    else:
+        pt["count"] = int(value)
+
+
+def _hist_metrics(hist: Dict[str, dict], now_ns: str) -> List[dict]:
+    """Real OTLP histogram metrics from the accumulated expansion:
+    cumulative Prometheus ``le`` counts become per-bucket counts
+    (``bucketCounts`` has ``len(explicitBounds) + 1`` entries — the last
+    is the overflow bucket above the highest bound)."""
+    out: List[dict] = []
+    for base in sorted(hist):
+        rec = hist[base]
+        points = []
+        for key in sorted(rec["points"]):
+            pt = rec["points"][key]
+            finite = sorted(pt["buckets"])
+            counts: List[int] = []
+            prev = 0.0
+            for _, cum in finite:
+                counts.append(max(0, int(cum - prev)))
+                prev = cum
+            counts.append(max(0, int(pt["count"] - prev)))
+            points.append({
+                "bucketCounts": [str(c) for c in counts],
+                "explicitBounds": [b for b, _ in finite],
+                "sum": pt["sum"],
+                "count": str(pt["count"]),
+                "timeUnixNano": now_ns,
+                "attributes": [_kv(k, v)
+                               for k, v in pt["labels"].items()],
+            })
+        out.append({
+            "name": base,
+            "description": rec["help"],
+            "histogram": {"aggregationTemporality": 2,  # CUMULATIVE
+                          "dataPoints": points},
+        })
+    return out
+
+
 def metrics_payload(samples: List[tuple],
                     resource: Dict[str, object]) -> dict:
     """One OTLP-JSON ``ExportMetricsServiceRequest`` body from the typed
     registry's sample expansion (``registry_samples()``): counters ship
-    as cumulative monotonic sums, everything else (gauges + the expanded
-    histogram ``_bucket``/``_sum``/``_count`` series) as gauges — a
-    faithful row-for-row mirror of the Prometheus page."""
+    as cumulative monotonic sums, histograms are reassembled from their
+    expanded ``_bucket``/``_sum``/``_count`` series into real OTLP
+    histogram points (explicitBounds + per-bucket counts + sum + count),
+    everything else as gauges."""
     now_ns = str(int(time.time() * 1e9))
     by_name: Dict[str, dict] = {}
+    hist: Dict[str, dict] = {}
     for name, type_name, labels, value, help_text in samples:
+        if type_name == "histogram":
+            _hist_collect(hist, name, labels, value, help_text)
+            continue
         m = by_name.get(name)
         if m is None:
             points_key = "sum" if type_name == "counter" else "gauge"
@@ -132,13 +198,14 @@ def metrics_payload(samples: List[tuple],
             "timeUnixNano": now_ns,
             "attributes": [_kv(k, v) for k, v in labels.items()],
         })
+    metrics = list(by_name.values()) + _hist_metrics(hist, now_ns)
     return {
         "resourceMetrics": [{
             "resource": {
                 "attributes": [_kv(k, v) for k, v in resource.items()]},
             "scopeMetrics": [{
                 "scope": {"name": "trino_tpu"},
-                "metrics": list(by_name.values()),
+                "metrics": metrics,
             }],
         }],
     }

@@ -735,6 +735,11 @@ class DistributedQuery:
     # capacity-overflow regrowth recompiles (0 when the hints were right
     # the first time — e.g. under adaptive_capacity_reseed)
     recompiles: int = 0
+    # kernel-ledger rollup (obs/devprofiler.py): one "SpmdBody" row
+    # accumulating this query's shard_map-body dispatches
+    kernel_stats: Dict[tuple, dict] = dataclasses.field(default_factory=dict)
+    # compile-ledger identity, computed lazily once per instance
+    _fingerprint: str = ""
 
     MAX_RECOMPILES = 16
 
@@ -827,13 +832,76 @@ class DistributedQuery:
             out_specs=(PSpec(AXIS), PSpec(AXIS)),
         )
         self.fn = jax.jit(shard_fn)
+        # compile-cache state (see CompiledQuery._jit): the next call on
+        # this jitted callable traces + compiles (a miss); later calls
+        # reuse the executable (hits) — the compile ledger records both
+        self._executable_fresh = True
+
+    def _profile_run(self, fresh: bool, dispatch_wall_s: float,
+                     body_device_s: float, estimated: bool) -> None:
+        """Feed the device profiler: one compile-ledger event per run + a
+        ``SpmdBody`` kernel row. Best-effort — accounting never fails."""
+        try:
+            from trino_tpu.cache.plan_key import plan_fingerprint
+            from trino_tpu.obs.devprofiler import (
+                DEVICE_PROFILER, shape_signature)
+
+            if not self._fingerprint:
+                self._fingerprint = plan_fingerprint(self.root)
+            DEVICE_PROFILER.record_compile(
+                "spmd", self._fingerprint, shape_signature(self.inputs),
+                dispatch_wall_s if fresh else 0.0,
+                "miss" if fresh else "hit", started=fresh)
+            wall = (body_device_s if fresh
+                    else dispatch_wall_s + (0.0 if estimated
+                                            else body_device_s))
+            key = (str(self.root.id), "SpmdBody", "spmd")
+            ks = self.kernel_stats.get(key)
+            if ks is None:
+                ks = self.kernel_stats[key] = {
+                    "planNodeId": key[0], "operator": key[1],
+                    "tier": "spmd", "launches": 0, "wallS": 0.0,
+                    "deviceS": 0.0, "inputBytes": 0, "outputBytes": 0,
+                    "estimated": estimated}
+            ks["launches"] += 1
+            ks["wallS"] += wall
+            ks["deviceS"] += body_device_s
+            ks["estimated"] = bool(ks["estimated"] or estimated)
+            DEVICE_PROFILER.count_launch(wall, body_device_s
+                                         if not estimated else 0.0)
+        except Exception:  # noqa: BLE001 — accounting never fails work
+            pass
 
     def run(self) -> Page:
         from trino_tpu.exec.executor import QueryError, raise_query_errors
         from trino_tpu.sql.planner import stats
 
         for _ in range(self.MAX_RECOMPILES):
+            fresh = getattr(self, "_executable_fresh", False)
+            if fresh:
+                try:
+                    from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+                    DEVICE_PROFILER.compile_started()
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
+            t0 = _time.perf_counter()
             out_arrays, error_flags = self.fn(self.inputs)
+            dispatch_s = _time.perf_counter() - t0
+            props = getattr(self.session, "properties", None) or {}
+            sync = bool(props.get("device_profiling", False))
+            body_device_s = 0.0 if fresh else dispatch_s
+            estimated = True
+            if sync:
+                t_sync = _time.perf_counter()
+                try:
+                    jax.block_until_ready(out_arrays)
+                except Exception:  # noqa: BLE001 — profiling never fails
+                    pass
+                body_device_s = _time.perf_counter() - t_sync
+                estimated = False
+            self._profile_run(fresh, dispatch_s, body_device_s, estimated)
+            self._executable_fresh = False
             codes = self.error_codes_cell[0]
             # flags are stacked per device: overflow on ANY shard grows the
             # bucket (capacity first — other flags may be truncation artifacts)

@@ -40,6 +40,7 @@ _ANNOUNCE_RE = re.compile(r"^/v1/announce/([^/]+)$")
 _RESULT_RE = re.compile(r"^/v1/statement/executing/([^/]+)/(\d+)$")
 _QUERY_RE = re.compile(r"^/v1/query/([^/]+)$")
 _TRACE_RE = re.compile(r"^/v1/query/([^/]+)/trace$")
+_PROFILE_RE = re.compile(r"^/v1/query/([^/]+)/profile$")
 _SEGMENT_RE = re.compile(r"^/v1/segment/([^/]+)$")
 
 RESULT_PAGE_ROWS = 10_000
@@ -1442,6 +1443,10 @@ class QueryExecution:
                 # consumers by owner, and the last shed events — names
                 # WHO was holding memory when the query died
                 "memory": MEMORY_LEDGER.memory_snapshot(),
+                # device-profiler snapshot: the newest compile-ledger
+                # events + utilization counters — a recompile storm
+                # preceding the failure is visible right here
+                "profiler": _profiler_snapshot(),
             },
             "workers": pull_worker_rings(locations, timeout=timeout,
                                          pool=self.io_pool),
@@ -1499,6 +1504,77 @@ class QueryExecution:
             "spills": int(qs.get("spills") or 0),
         }
         return qs
+
+    # ---------------------------------------------------- device profiler
+    def kernel_rows_live(self) -> List[dict]:
+        """This query's merged kernel-ledger rows (obs/devprofiler.py):
+        worker rows from the task records (stamped with the assigned
+        worker uri), coordinator rows from the local/root executors.
+        Live while RUNNING — the same merge the terminal fold persists."""
+        from trino_tpu.obs.devprofiler import merge_kernel_rows
+
+        merged: Dict[tuple, dict] = {}
+        # adaptive re-planner: superseded fragments re-ran as copies with
+        # the same plan-node ids — keep them out, exactly like the
+        # EXPLAIN ANALYZE operator merge
+        superseded = {fid for ch in self.plan_versions
+                      for fid in ch.get("supersedes", ())}
+        for rec in self.task_records():
+            if rec.get("fragment") in superseded:
+                continue
+            node = rec.get("workerUri") or "coordinator"
+            rows = (rec.get("stats") or {}).get("kernelStats") or []
+            merge_kernel_rows(merged, [
+                dict(r, nodeId=r.get("nodeId") or node) for r in rows])
+        for ex in (getattr(self, "_local_executor", None),
+                   getattr(self, "_root_executor", None)):
+            if ex is None:
+                continue
+            merge_kernel_rows(merged, [
+                dict(r, nodeId="coordinator")
+                for r in getattr(ex, "kernel_stats", {}).values()])
+        rows = []
+        for k in sorted(merged):
+            row = dict(merged[k])
+            row["queryId"] = self.query_id
+            row["dispatchOverheadS"] = round(
+                max(0.0, row["wallS"] - row["deviceS"]), 6)
+            rows.append(row)
+        return rows
+
+    def fold_kernel_profile(self) -> None:
+        """Persist the merged kernel rows into the process device
+        profiler ONCE at terminal (the ``system.runtime.kernels`` store;
+        per-operator launch/overhead metrics bump here, never
+        per-dispatch)."""
+        if getattr(self, "_kernels_folded", False):
+            return
+        self._kernels_folded = True
+        from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+        rows = self.kernel_rows_live()
+        if rows:
+            DEVICE_PROFILER.record_query_kernels(self.query_id, rows)
+
+    def profile_dict(self) -> dict:
+        """The ``GET /v1/query/{id}/profile`` payload: merged kernel
+        rows, this query's compile-ledger events, the phase ledger, and
+        recent utilization samples from the coordinator's profiler."""
+        from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+        folded = getattr(self, "_kernels_folded", False)
+        kernels = (DEVICE_PROFILER.kernel_rows(self.query_id)
+                   if folded else self.kernel_rows_live())
+        return {
+            "queryId": self.query_id,
+            "state": self.state.get(),
+            "kernels": kernels,
+            "compiles": DEVICE_PROFILER.compile_rows(
+                query_id=self.query_id),
+            "utilization": DEVICE_PROFILER.utilization_rows(limit=8),
+            "counters": DEVICE_PROFILER.counters(),
+            "timeline": self.timeline_dict(),
+        }
 
     def _explain_analyze(self, session, stmt) -> str:
         """Distributed EXPLAIN ANALYZE: plan, execute through the real
@@ -1605,9 +1681,17 @@ class QueryExecution:
             header.append("Peak task memory by node: " + ", ".join(
                 f"{node} {pb // 1024}KiB"
                 for node, pb in sorted(node_peaks.items())))
+        # kernel-ledger annotations (device profiler): VERBOSE prints a
+        # per-node launches=/dispatch_overhead= line from the merged rows
+        kern = None
+        if stmt.verbose:
+            from trino_tpu.sql.planner.plan import kernel_annotations
+
+            kern = kernel_annotations(self.kernel_rows_live())
         return "\n".join(header) + "\n" + format_fragments(
             self.fragments, stats=node_stats, stage_stats=stage_by_id,
-            verbose=stmt.verbose, adapted=self._adapted_notes())
+            verbose=stmt.verbose, adapted=self._adapted_notes(),
+            kernels=kern)
 
     def _schedule(self, session, fragments, workers) -> None:
         """Create one task per worker for each source fragment, splits
@@ -2280,6 +2364,14 @@ class CoordinatorServer:
         if not MEMORY_LEDGER.node_id:
             MEMORY_LEDGER.node_id = "coordinator"
         MEMORY_LEDGER.attach_recorder(self.recorder)
+        # device profiler (obs/devprofiler.py): same first-server-wins
+        # identity stamp; compile-ledger events mirror into the flight
+        # recorder so postmortems show recompile storms
+        from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+        if not DEVICE_PROFILER.node_id:
+            DEVICE_PROFILER.node_id = "coordinator"
+        DEVICE_PROFILER.attach_recorder(self.recorder)
         # spooled result segments (server/segments.py): the coordinator's
         # own store — coordinator-local/fast-path queries (and
         # non-trivial-root distributed ones) spool here, so the protocol
@@ -2439,6 +2531,14 @@ class CoordinatorServer:
                     observe_phases(timeline)
             except Exception:  # noqa: BLE001 — the ledger is
                 pass  # observability, never a reason to disturb terminal
+            # kernel-ledger fold (device profiler): persist the merged
+            # per-operator kernel rows ONCE — system.runtime.kernels and
+            # the per-operator launch/overhead metrics read the folded
+            # store, so nothing bumps per-dispatch on the serving path
+            try:
+                execution.fold_kernel_profile()
+            except Exception:  # noqa: BLE001 — observability only
+                pass
             # a FAILED/CANCELED query's result segments will never be
             # fetched — reclaim the coordinator-hosted ones now instead
             # of waiting out the TTL (worker-hosted ones TTL out; their
@@ -2707,6 +2807,18 @@ def _cache_header(q: QueryExecution) -> Optional[dict]:
     return {CACHE_HEADER: q.cache_status} if q.cache_status else None
 
 
+def _profiler_snapshot() -> dict:
+    """The postmortem's device-profiler block: newest compile-ledger
+    events + the monotonic utilization counters."""
+    try:
+        from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+        return {"compiles": DEVICE_PROFILER.compile_rows(limit=16),
+                "counters": DEVICE_PROFILER.counters()}
+    except Exception:  # noqa: BLE001 — best-effort forensics
+        return {}
+
+
 def _int_property(properties: dict, name: str, default: int) -> int:
     """Integer session property from a raw (wire-string) property map —
     malformed values fall back like the typed registry's defaults."""
@@ -2965,6 +3077,19 @@ def _make_handler(server: CoordinatorServer):
                     self._send(404, b'{"error": "no such query"}')
                     return
                 self._send(200, json.dumps(trace).encode())
+                return
+            m = _PROFILE_RE.match(url_parts.path)
+            if m:
+                # the device-profiler read surface (obs/devprofiler.py):
+                # merged coordinator+worker kernel rows, this query's
+                # compile events, utilization samples, phase ledger
+                q = server.get_query(m.group(1))
+                if not self._authenticated(query=q):
+                    return
+                if q is None:
+                    self._send(404, b'{"error": "no such query"}')
+                    return
+                self._send(200, json.dumps(q.profile_dict()).encode())
                 return
             m = _QUERY_RE.match(self.path)
             if m:

@@ -185,6 +185,10 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             return device_cache_rows()
         if (schema, table) == ("runtime", "memory"):
             return self._memory_rows()
+        if (schema, table) == ("runtime", "kernels"):
+            return self._kernels_rows()
+        if (schema, table) == ("runtime", "compiles"):
+            return self._compiles_rows()
         if (schema, table) == ("metadata", "materialized_views"):
             return self._matview_rows()
         if (schema, table) == ("metrics", "metrics"):
@@ -270,6 +274,72 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
                  int(r["peakBytes"]), int(r["events"]))
                 for r in MEMORY_LEDGER.owner_rows())
         return rows
+
+    def _kernels_rows(self) -> List[tuple]:
+        """``system.runtime.kernels``: the kernel ledger — one row per
+        (query, plan node, operator, tier, node). Terminal queries read
+        from the folded device-profiler store; RUNNING queries merge
+        their live task rollups so the table never lags the engine."""
+        from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+        rows = []
+        seen = set()
+        for q in self._live_executions():
+            if getattr(q, "_kernels_folded", False):
+                continue  # folded rows below are fresher-complete
+            for r in q.kernel_rows_live():
+                seen.add(q.query_id)
+                rows.append(self._kernel_row(r))
+        rows.extend(self._kernel_row(r) for r in DEVICE_PROFILER.kernel_rows()
+                    if r["queryId"] not in seen)
+        return rows
+
+    @staticmethod
+    def _kernel_row(r: dict) -> tuple:
+        return (
+            str(r.get("queryId", "")), str(r.get("nodeId", "")),
+            str(r.get("planNodeId", "")), str(r.get("operator", "")),
+            str(r.get("tier", "")), int(r.get("launches", 0)),
+            float(r.get("wallS", 0.0)), float(r.get("deviceS", 0.0)),
+            float(r.get("dispatchOverheadS",
+                        max(0.0, float(r.get("wallS", 0.0))
+                            - float(r.get("deviceS", 0.0))))),
+            int(r.get("inputBytes", 0)), int(r.get("outputBytes", 0)),
+            bool(r.get("estimated", False)),
+        )
+
+    def _compiles_rows(self) -> List[tuple]:
+        """``system.runtime.compiles``: the compile ledger — one row per
+        jit/Pallas compile event, cluster-wide. Worker rows ride the
+        announce payload (``compileEvents``); the coordinator
+        contributes its own process ring directly. A worker profiler
+        sharing this process (in-process test clusters) is NOT
+        double-reported: announce rows win for that node id."""
+        from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+        rows = []
+        announced = set()
+        for n in self._server.registry.snapshot():
+            info = n.get("info") or {}
+            events = info.get("compileEvents")
+            if events is None:
+                continue
+            announced.add(n["nodeId"])
+            rows.extend(self._compile_row(n["nodeId"], e) for e in events)
+        nid = DEVICE_PROFILER.node_id or "coordinator"
+        if nid not in announced:
+            rows.extend(self._compile_row(nid, e)
+                        for e in DEVICE_PROFILER.compile_rows())
+        return rows
+
+    @staticmethod
+    def _compile_row(nid: str, e: dict) -> tuple:
+        return (
+            str(e.get("nodeId") or nid), str(e.get("queryId", "")),
+            str(e.get("tier", "")), str(e.get("fingerprint", "")),
+            str(e.get("shapeSig", "")), float(e.get("compileS", 0.0)),
+            str(e.get("cache", "")), float(e.get("ts", 0.0)),
+        )
 
     def _resource_group_rows(self) -> List[tuple]:
         """``system.runtime.resource_groups``: one row per live group

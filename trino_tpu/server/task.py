@@ -199,6 +199,9 @@ class SqlTask:
         # under _stats_lock, and status responses snapshot the same way —
         # so a coordinator poll mid-execution reads a consistent rollup.
         self.operator_stats: Dict[int, "OperatorStats"] = {}
+        # kernel-ledger rollup (obs/devprofiler.py): retired executors
+        # fold their kernel_stats here; status snapshots ship the rows
+        self.kernel_stats: Dict[tuple, dict] = {}
         self._stats_lock = threading.Lock()
         self.total_splits = sum(len(v) for v in request.splits.values())
         self.splits_completed = 0
@@ -256,6 +259,11 @@ class SqlTask:
                     self.operator_stats[nid] = _dc.replace(st)
                 else:
                     have.add(st)
+            from trino_tpu.obs.devprofiler import merge_kernel_rows
+
+            merge_kernel_rows(
+                self.kernel_stats,
+                list(getattr(ex, "kernel_stats", {}).values()))
             # the fragment body IS the device execution: charge its wall to
             # the fragment root's device-seconds
             root_st = self.operator_stats.get(self.request.fragment_root.id)
@@ -302,6 +310,8 @@ class SqlTask:
                 "deviceCacheHits": self.device_cache_hits,
                 "deviceCacheMisses": self.device_cache_misses,
                 "operatorStats": ops,
+                "kernelStats": [dict(self.kernel_stats[k])
+                                for k in sorted(self.kernel_stats)],
             }
             if part_bytes is not None:
                 snap["partitionBytes"] = part_bytes

@@ -80,6 +80,14 @@ class WorkerServer:
         if not MEMORY_LEDGER.node_id:
             MEMORY_LEDGER.node_id = self.node_id
         MEMORY_LEDGER.attach_recorder(self.recorder)
+        # the process device profiler (obs/devprofiler.py): same
+        # first-server-wins identity stamp; compile-ledger events mirror
+        # into the flight recorder so postmortems show recompile storms
+        from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+        if not DEVICE_PROFILER.node_id:
+            DEVICE_PROFILER.node_id = self.node_id
+        DEVICE_PROFILER.attach_recorder(self.recorder)
         # OTLP export, on only when TRINO_TPU_OTLP_ENDPOINT is set: each
         # completed task ships its span dump under the query's PROPAGATED
         # trace id, so worker spans parent into the coordinator's trace
@@ -200,6 +208,14 @@ class WorkerServer:
                 # scrapes /v1/metrics
                 mem_rows = self._sample_memory(qmem, rss)
                 M.refresh_process_gauges()
+                # device-profiler utilization tick (obs/devprofiler.py):
+                # launches/sec + device-busy fraction since the last
+                # heartbeat, and the newest compile-ledger events so
+                # system.runtime.compiles merges cluster-wide
+                from trino_tpu.obs.devprofiler import DEVICE_PROFILER
+
+                util_sample = DEVICE_PROFILER.sample_utilization()
+                compile_events = DEVICE_PROFILER.compile_rows(limit=64)
                 wire.json_request(
                     "PUT",
                     f"{self.coordinator_url}/v1/announce/{self.node_id}",
@@ -226,6 +242,11 @@ class WorkerServer:
                      # per-pool, per-owner attribution rows (memory
                      # ledger): system.runtime.memory's per-node source
                      "memoryOwners": mem_rows,
+                     # device-profiler ride-alongs: the latest utilization
+                     # sample + newest compile-ledger events
+                     # (system.runtime.compiles' per-node source)
+                     "profiler": util_sample,
+                     "compileEvents": compile_events,
                      "rssBytes": rss,
                      # surfaced by system.runtime.nodes (reference: the
                      # node version in NodeSystemTable rows)

@@ -387,7 +387,7 @@ def adapt_partitioned_to_broadcast(frag: PlanFragment, join: P.JoinNode,
 
 def format_fragments(fragments: List[PlanFragment], stats=None,
                      stage_stats=None, verbose: bool = False,
-                     adapted=None) -> str:
+                     adapted=None, kernels=None) -> str:
     """EXPLAIN (TYPE DISTRIBUTED) rendering (reference: PlanPrinter's
     fragmented text plan). With ``stats`` (plan-node id → OperatorStats,
     the coordinator's rollup of worker-reported task stats) this renders
@@ -418,13 +418,13 @@ def format_fragments(fragments: List[PlanFragment], stats=None,
                 f" output={si['outputBytes'] // 1024}KiB,"
                 f" peak={si['peakBytes'] // 1024}KiB,"
                 f" spills={si['spills']}")
-        lines.append(_format(f.root, 1, stats, verbose))
+        lines.append(_format(f.root, 1, stats, verbose, kernels))
         lines.append("")
     return "\n".join(lines).rstrip()
 
 
 def _format(node: P.PlanNode, indent: int, stats=None,
-            verbose: bool = False) -> str:
+            verbose: bool = False, kernels=None) -> str:
     if isinstance(node, RemoteSourceNode):
         pad = "  " * indent
         line = (f"{pad}- RemoteSource[{node.exchange_type}]"
@@ -433,13 +433,14 @@ def _format(node: P.PlanNode, indent: int, stats=None,
         if st is not None:
             line += f"  [wall={st.wall_s * 1e3:.1f}ms rows={st.output_rows}]"
         return line
-    base = P.format_plan(node, indent, stats=stats, verbose=verbose).split("\n")
+    base = P.format_plan(node, indent, stats=stats, verbose=verbose,
+                         kernels=kernels).split("\n")
     out = [base[0]]
     # re-render children so RemoteSourceNodes print specially
     kids = list(node.sources)
     if kids:
         out = [base[0]]
         for k in kids:
-            out.append(_format(k, indent + 1, stats, verbose))
+            out.append(_format(k, indent + 1, stats, verbose, kernels))
         return "\n".join(out)
     return base[0]

@@ -652,8 +652,22 @@ def uses_expansion_kernel(n: JoinNode) -> bool:
     return not n.right_unique and not n.singleton
 
 
+def kernel_annotations(rows) -> dict:
+    """Per-plan-node launch counts + dispatch overhead from kernel-ledger
+    rows (obs/devprofiler.py wire shape) — the EXPLAIN ANALYZE VERBOSE
+    ``launches=/dispatch_overhead=`` annotation source."""
+    out: dict = {}
+    for r in rows or ():
+        nid = str(r.get("planNodeId", ""))
+        agg = out.setdefault(nid, {"launches": 0, "overheadS": 0.0})
+        agg["launches"] += int(r.get("launches", 0))
+        agg["overheadS"] += max(
+            0.0, float(r.get("wallS", 0.0)) - float(r.get("deviceS", 0.0)))
+    return out
+
+
 def format_plan(node: PlanNode, indent: int = 0, executor=None,
-                stats=None, verbose: bool = False) -> str:
+                stats=None, verbose: bool = False, kernels=None) -> str:
     """Text plan printer (reference: sql/planner/planprinter/PlanPrinter.java).
     With ``executor`` (a finished eager Executor), renders EXPLAIN ANALYZE:
     per-operator wall time / output rows / scan+spill detail from its stats
@@ -661,7 +675,13 @@ def format_plan(node: PlanNode, indent: int = 0, executor=None,
     ``stats`` (node id → OperatorStats, e.g. the coordinator's rollup of
     worker-reported task stats), the same annotations render WITHOUT a
     local executor — the distributed EXPLAIN ANALYZE path. ``verbose``
-    additionally prints bytes / peak reservation / split counts."""
+    additionally prints bytes / peak reservation / split counts and the
+    kernel ledger's per-node ``launches=/dispatch_overhead=`` line
+    (``kernels``: plan-node id → annotation, see kernel_annotations;
+    derived from the executor's own kernel stats when not passed)."""
+    if verbose and kernels is None and executor is not None:
+        kernels = kernel_annotations(
+            getattr(executor, "kernel_stats", {}).values())
     pad = "  " * indent
     label = type(node).__name__.replace("Node", "")
     detail = ""
@@ -722,9 +742,15 @@ def format_plan(node: PlanNode, indent: int = 0, executor=None,
                 detail += (f" [bytes={st.output_bytes}"
                            f" peak={st.peak_bytes}"
                            f" calls={st.invocations}]")
+    if verbose and kernels:
+        kr = kernels.get(str(node.id))
+        if kr is not None:
+            detail += (f" [launches={kr['launches']}"
+                       f" dispatch_overhead={kr['overheadS'] * 1e3:.1f}ms]")
     lines = [f"{pad}- {label}{detail}"]
     for s in node.sources:
-        lines.append(format_plan(s, indent + 1, executor, stats, verbose))
+        lines.append(format_plan(s, indent + 1, executor, stats, verbose,
+                                 kernels))
     return "\n".join(lines)
 
 
