@@ -1,0 +1,297 @@
+"""Data-plane flow-ledger microbench: the per-link transfer trajectory.
+
+BENCH/QPS rounds track throughput and MEMLEDGER tracks bytes-at-rest;
+this bench tracks bytes IN MOTION — which link moved how many bytes at
+what rate — by reading the flow ledger's own surfaces, so the bench
+measures the instrumentation the PR ships:
+
+- **per-link MB/s** — ``FLOW_LEDGER`` rollups over a 2-worker
+  distributed TPC-H q3 (``exchange-pull``, ``staging-transfer``,
+  ``client-drain``, ``control``) plus a spooled result export
+  (``spool-write`` / ``segment-fetch``); absolutes fold into
+  TRAJECTORY.json as ``direction: "info"`` (single loopback box);
+- **conservation_fraction** — exchange-pull ledger bytes over the serde
+  decode-side wire bytes (``trino_tpu_serde_bytes_total`` zlib+none)
+  across the q3 rounds: every byte the page codec decoded must have been
+  attributed to a pull record (framing/page headers make the ledger side
+  strictly larger, so a fraction below 1.0 means a producer is not
+  recording). Gated direction=up, >= 0.95 acceptance;
+- **straggler detection** — a deliberately skewed repartition join on a
+  4-worker cluster (every probe row's derived key collapses onto one
+  nation key, so one join task receives ~the whole probe side while its
+  three stage peers idle): the detector must flag the hot task with a
+  transfer-vs-device cause, and must flag NOTHING on the uniform q3 /
+  export rounds (``straggler_false_positives`` gated at 0). The skew
+  run lowers ``straggler_multiple`` to 2.0 — the sensitivity knob this
+  PR registers — because a 4-task stage's median includes startup wall
+  the cold tasks spend waiting on the same exchange.
+
+Writes ``FLOW_r01.json`` (folded into TRAJECTORY.json by
+``tools/bench_trend.py``'s FLOW family). ``--check`` is the tiny-schema
+quick pass: 2-worker cluster only, conservation + zero-false-positive
+asserts, no artifact (tiny's sub-``min_elapsed`` tasks can never flag,
+so the skew phase would assert nothing it can miss).
+
+Run:    python microbench/flows.py [tpch_schema] [--workers W]
+Check:  python microbench/flows.py --check
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MIN_CONSERVATION = 0.95   # the ISSUE acceptance bound
+ROUNDS = 3                # q3 repeats (cold round 1, warm rounds after)
+SKEW_WORKERS = 4          # >2: a 2-task stage's median caps ratio at 2x
+SKEW_MULTIPLE = 2.0       # straggler_multiple for the skew run (see doc)
+
+Q3_SQL = """
+select l_orderkey, o_orderdate, o_shippriority,
+       sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate, l_orderkey limit 10
+"""
+
+# wide rows, no aggregate: enough result bytes to cross the spool
+# threshold so the spool-write/segment-fetch links light up (bounded by
+# key, not LIMIT: a per-worker limit under worker-direct spooling would
+# make the returned row count ambiguous)
+EXPORT_SQL = ("select o_orderkey, o_custkey, o_totalprice, o_orderdate "
+              "from orders where o_orderkey <= {max_key}")
+
+# every o_custkey > 3 collapses onto derived key 1 = one nation key, so
+# the hash exchange routes ~the whole probe side to one join task; the
+# build side stays unique-keyed (nation), so no output explosion
+SKEW_SQL = """
+select count(*) as c, sum(n.n_nationkey) as s
+from (select case when o_custkey > 3 then 1 else o_custkey end as o_k
+      from orders) o
+join nation n on o.o_k = n.n_nationkey
+"""
+
+
+def _decode_wire_bytes() -> float:
+    """Serde decode-side WIRE bytes (compressed zlib blocks + raw-stored
+    none blocks; 'logical' is the uncompressed denominator, not wire)."""
+    from trino_tpu.obs import metrics as M
+
+    return (M.SERDE_BYTES.value("decode", "zlib")
+            + M.SERDE_BYTES.value("decode", "none"))
+
+
+def _link_totals() -> dict:
+    """``{link: {"bytes", "seconds"}}`` from the process flow ledger."""
+    from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+    agg: dict = {}
+    for r in FLOW_LEDGER.transfer_rows():
+        a = agg.setdefault(r["link"], {"bytes": 0, "seconds": 0.0})
+        a["bytes"] += int(r["bytes"])
+        a["seconds"] += float(r["seconds"])
+    return agg
+
+
+def _boot(workers: int, prefix: str):
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    coord = CoordinatorServer()
+    coord.start()
+    fleet = [WorkerServer(coordinator_url=coord.base_url,
+                          node_id=f"{prefix}{i}") for i in range(workers)]
+    for w in fleet:
+        w.start()
+    assert coord.registry.wait_for_workers(workers, timeout=30.0)
+    return coord, fleet
+
+
+def _stop(coord, fleet) -> None:
+    for w in fleet:
+        w.stop()
+    coord.stop()
+
+
+def run_uniform(schema: str, workers: int) -> dict:
+    """Phase 1: uniform q3 rounds (conservation window) + spooled export
+    on a 2-worker cluster; no task may flag as a straggler."""
+    from trino_tpu.client import dbapi
+
+    coord, fleet = _boot(workers, "flow")
+    try:
+        cur = dbapi.connect(coordinator_url=coord.base_url,
+                            catalog="tpch", schema=schema).cursor()
+        pull0 = _link_totals().get("exchange-pull", {}).get("bytes", 0)
+        serde0 = _decode_wire_bytes()
+        false_positives = 0
+        wall = []
+        for _ in range(ROUNDS):
+            t0 = time.perf_counter()
+            cur.execute(Q3_SQL)
+            cur.fetchall()
+            wall.append(time.perf_counter() - t0)
+            flows = (cur.stats or {}).get("flows") or {}
+            false_positives += int(flows.get("stragglers") or 0)
+        pull_delta = _link_totals().get("exchange-pull", {}).get("bytes", 0) - pull0
+        serde_delta = _decode_wire_bytes() - serde0
+        conservation = (min(1.0, pull_delta / serde_delta)
+                        if serde_delta > 0 else 1.0)
+
+        # spooled export: result segments written worker-side and fetched
+        # by the client (spool-write + segment-fetch + the drain tail)
+        max_key = 600_000 if schema != "tiny" else 60_000
+        spool = dbapi.connect(
+            coordinator_url=coord.base_url, catalog="tpch", schema=schema,
+            spooled_results_enabled="true",
+            spooled_results_threshold_bytes="1024",
+            spooled_results_segment_bytes="65536").cursor()
+        spool.execute(EXPORT_SQL.format(max_key=max_key))
+        nrows = len(spool.fetchall())
+        assert nrows > 0
+        assert (spool.stats or {}).get("spooled"), "export never spooled"
+        flows = (spool.stats or {}).get("flows") or {}
+        false_positives += int(flows.get("stragglers") or 0)
+
+        # the announce loop (0.5 s cadence) must deliver worker flow rows
+        # before the coordinator-side table read
+        time.sleep(1.5)
+        cur.execute("select link, sum(bytes) from system.runtime.transfers "
+                    "group by link")
+        table_links = {r[0]: int(r[1]) for r in cur.fetchall()}
+        cur.execute("select count(*) from system.runtime.stragglers")
+        false_positives += int(cur.fetchall()[0][0])
+        return {
+            "warm_q3_seconds": round(min(wall), 4),
+            "conservation_fraction": round(conservation, 4),
+            "exchange_pull_bytes": int(pull_delta),
+            "serde_decode_wire_bytes": int(serde_delta),
+            "straggler_false_positives": false_positives,
+            "table_links": table_links,
+        }
+    finally:
+        _stop(coord, fleet)
+
+
+def run_skew(schema: str) -> dict:
+    """Phase 2: the skewed repartition join on a 4-worker cluster; the
+    hot join task must flag with a transfer-vs-device cause.
+
+    Runs the query TWICE: the cold round compiles the join kernel on
+    every task, so elapsed is compile-uniform (~5 s each) and hides the
+    skew; the warm round hits the compile cache and the hot task's
+    elapsed is pure data (observed ~4-5x its stage median)."""
+    from trino_tpu.client import dbapi
+
+    coord, fleet = _boot(SKEW_WORKERS, "skew")
+    try:
+        # join_max_broadcast_rows=1 forces the repartition path: a 25-row
+        # build side would otherwise broadcast and the probe would never
+        # cross the hash exchange
+        cur = dbapi.connect(coordinator_url=coord.base_url,
+                            catalog="tpch", schema=schema,
+                            join_max_broadcast_rows=1,
+                            straggler_multiple=SKEW_MULTIPLE).cursor()
+        for _ in range(2):
+            cur.execute(SKEW_SQL)
+            rows = cur.fetchall()
+            assert rows and int(rows[0][0]) > 0, rows
+        cur2 = dbapi.connect(coordinator_url=coord.base_url,
+                             catalog="tpch", schema=schema).cursor()
+        cur2.execute("select task_id, ratio, cause, elapsed_seconds, "
+                     "stage_median_seconds from system.runtime.stragglers")
+        flagged = cur2.fetchall()
+        top = max(flagged, key=lambda r: float(r[1]), default=None)
+        cause = top[2] if top is not None else None
+        return {
+            "flagged": bool(flagged),
+            "cause": cause,
+            "cause_ok": cause in ("transfer-bound", "device-bound"),
+            "ratio": round(float(top[1]), 2) if top is not None else None,
+            "hot_elapsed_s": round(float(top[3]), 3) if top else None,
+            "stage_median_s": round(float(top[4]), 3) if top else None,
+            "multiple": SKEW_MULTIPLE,
+            "flagged_tasks": len(flagged),
+        }
+    finally:
+        _stop(coord, fleet)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    check_mode = "--check" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    schema = args[0] if args else ("tiny" if check_mode else "sf1")
+
+    uniform = run_uniform(schema, workers=2)
+    assert uniform["conservation_fraction"] >= MIN_CONSERVATION, (
+        f"exchange-pull conservation {uniform['conservation_fraction']} "
+        f"below the {MIN_CONSERVATION} acceptance bound "
+        f"(pull={uniform['exchange_pull_bytes']} "
+        f"serde={uniform['serde_decode_wire_bytes']})")
+    assert uniform["straggler_false_positives"] == 0, (
+        f"uniform rounds flagged "
+        f"{uniform['straggler_false_positives']} straggler(s)")
+    assert uniform["table_links"], "system.runtime.transfers came up empty"
+
+    if check_mode:
+        print(json.dumps(uniform, indent=2))
+        print(f"flows-check ok: conservation "
+              f"{uniform['conservation_fraction']}, links "
+              f"{sorted(uniform['table_links'])}, 0 false positives")
+        return
+
+    straggler = run_skew(schema)
+
+    # per-link throughput from the whole run (both clusters share the
+    # process-global ledger; seconds are per-link transfer wall)
+    links = {}
+    for link, a in sorted(_link_totals().items()):
+        links[link] = {
+            "mb": round(a["bytes"] / 1e6, 3),
+            "mb_s": (round(a["bytes"] / a["seconds"] / 1e6, 2)
+                     if a["seconds"] > 0 else None),
+        }
+    for need in ("exchange-pull", "staging-transfer", "spool-write",
+                 "segment-fetch", "client-drain"):
+        assert need in links, (
+            f"link {need} never recorded (have {sorted(links)})")
+
+    report = {
+        "round": 1,
+        "tpch_schema": schema,
+        "workers": 2,
+        "skew_workers": SKEW_WORKERS,
+        "q3_rounds": ROUNDS,
+        "warm_q3_seconds": uniform["warm_q3_seconds"],
+        "links": links,
+        "conservation_fraction": uniform["conservation_fraction"],
+        "straggler_false_positives": uniform["straggler_false_positives"],
+        "straggler": straggler,
+    }
+    print(json.dumps(report, indent=2))
+    assert straggler["flagged"], "skewed join's hot task never flagged"
+    assert straggler["cause_ok"], f"unexpected cause {straggler['cause']}"
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "FLOW_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out_path}: conservation "
+          f"{report['conservation_fraction']}, straggler "
+          f"{straggler['cause']} @ {straggler['ratio']}x, "
+          f"{len(links)} links")
+
+
+if __name__ == "__main__":
+    main()

@@ -208,13 +208,20 @@ def test_distributed_query_exports_parented_otlp_spans(otlp_cluster):
     # worker task exports fire at task completion, the coordinator's at
     # query completion; wait for both halves to land
     spans = collector.wait_for_spans(8, timeout=15.0)
-    by_trace = {}
-    for sp in spans:
-        by_trace.setdefault(sp["traceId"], []).append(sp)
-    trace_spans = by_trace.get(q.tracer.trace_id)
+    # the first 8 spans to land can all be worker-side (their exports fire
+    # first); keep draining until THIS query's lifecycle spans arrive
+    deadline = time.time() + 15.0
+    trace_spans, names = [], set()
+    while time.time() < deadline:
+        spans = collector.spans()
+        trace_spans = [sp for sp in spans
+                       if sp["traceId"] == q.tracer.trace_id]
+        names = {sp["name"] for sp in trace_spans}
+        if {"query", "schedule", "task"} <= names:
+            break
+        time.sleep(0.05)
     assert trace_spans, f"trace {q.tracer.trace_id} not exported: " \
-                        f"{list(by_trace)}"
-    names = {sp["name"] for sp in trace_spans}
+                        f"{ {sp['traceId'] for sp in spans} }"
     assert {"query", "schedule", "task"} <= names
     # every resource span of this query carries its query_id
     assert all(sp["_resource"].get("query_id") == q.query_id

@@ -66,7 +66,8 @@ class FakeExchangeClient:
     instances: List["FakeExchangeClient"] = []
     pages_to_serve: List[Page] = []
 
-    def __init__(self, locations, max_buffered_pages: int = 64):
+    def __init__(self, locations, max_buffered_pages: int = 64,
+                 owner: str = "", stall_key=None):
         self.consumed_at: List[float] = []
         self.served = 0
         FakeExchangeClient.instances.append(self)

@@ -52,7 +52,8 @@ def test_provider_less_system_tables():
     assert s.execute("show tables from system.runtime").rows == [
         ("compiles",), ("device_cache",), ("kernels",), ("memory",),
         ("nodes",), ("prepared_statements",), ("queries",),
-        ("resource_groups",), ("serving",), ("tasks",)]
+        ("resource_groups",), ("serving",), ("stragglers",),
+        ("tasks",), ("transfers",)]
     assert s.execute("select * from system.runtime.queries").rows == []
     assert s.execute("select * from system.runtime.tasks").rows == []
     M.STAGED_ROWS.inc(0)  # touch so at least one series exists

@@ -421,6 +421,43 @@ def _extract_profile(path: str) -> List[dict]:
     return out
 
 
+def _extract_flows(path: str) -> List[dict]:
+    """FLOW_r*.json: the data-plane round — per-link effective MB/s fold
+    as ``info`` (absolute single-box throughput is confounded by the box,
+    exactly the QPS-family rationale); the GATED series are byte
+    conservation (exchange-pull ledger bytes vs the serde counter — must
+    not decay) and the straggler detector's correctness bits: the skewed
+    join's hot task flagged, with the right cause, and zero false
+    positives on the uniform query. Schema/workers stay OUT: setup."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    rnd = int(data.get("round", _round_of(path)))
+    out: List[dict] = []
+    for link, rec in sorted((data.get("links") or {}).items()):
+        if isinstance(rec, dict) and rec.get("mb_s") is not None:
+            out.append(_entry("flow", rnd, f"{link}_mb_s", rec["mb_s"],
+                              "MB/s", "info", path))
+    if data.get("conservation_fraction") is not None:
+        out.append(_entry("flow", rnd, "conservation_fraction",
+                          data["conservation_fraction"], "fraction",
+                          "up", path))
+    if data.get("straggler_false_positives") is not None:
+        out.append(_entry("flow", rnd, "straggler_false_positives",
+                          data["straggler_false_positives"], "count",
+                          "down", path))
+    straggler = data.get("straggler")
+    if isinstance(straggler, dict):
+        if straggler.get("flagged") is not None:
+            out.append(_entry("flow", rnd, "straggler_flagged",
+                              1.0 if straggler["flagged"] else 0.0,
+                              "bool", "up", path))
+        if straggler.get("cause_ok") is not None:
+            out.append(_entry("flow", rnd, "straggler_cause_ok",
+                              1.0 if straggler["cause_ok"] else 0.0,
+                              "bool", "up", path))
+    return out
+
+
 _FAMILIES = (
     ("BENCH_r*.json", _extract_bench),
     ("QPS_r*.json", _extract_qps),
@@ -433,6 +470,7 @@ _FAMILIES = (
     ("MATVIEW_r*.json", _extract_matview),
     ("MEMLEDGER_r*.json", _extract_memledger),
     ("PROFILE_r*.json", _extract_profile),
+    ("FLOW_r*.json", _extract_flows),
 )
 
 

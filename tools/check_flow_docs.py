@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Fail when flow-ledger vocabulary is missing from README.
+
+Mirror of the other ``check_*_docs.py`` gates for the data-plane flow
+ledger: the vocabulary is DECLARED in ``trino_tpu/obs/flowledger.py``
+(``LINK_CLASSES`` / ``STALL_SITES`` / ``STRAGGLER_CAUSES`` — the ledger
+raises on names outside the first two, so the tuples are the single
+source of truth), and every member must be documented in README.md's
+flow-ledger section. The ``system.runtime.transfers`` /
+``system.runtime.stragglers`` column sets (declared in
+``trino_tpu/connector/system/schemas.py``) get the same treatment here
+— they are this PR's vocabulary even though the system-table gate also
+covers columns. Names are ordinary words, so only a BACKTICKED mention
+counts — bare-word presence would pass vacuously.
+
+Both modules load standalone (no jax): flowledger.py and schemas.py are
+deliberately stdlib-only at import time for exactly this reason.
+
+Wired into ``tools/lint.py --all`` (registry: tools/gates.py).
+
+Usage: ``python tools/check_flow_docs.py [--readme PATH]`` — exit 0 when
+every name is documented, 1 with the missing names otherwise.
+"""
+from __future__ import annotations
+
+import sys
+
+if __package__ in (None, ""):  # script mode: tools/ on sys.path
+    import gates
+else:  # imported as tools.check_flow_docs
+    from tools import gates
+
+
+def _load_ledger():
+    return gates.load_module_file("trino_tpu/obs/flowledger.py",
+                                  "_flowledger_standalone")
+
+
+def _load_schemas():
+    return gates.load_module_file("trino_tpu/connector/system/schemas.py",
+                                  "_system_schemas_standalone")
+
+
+def required_names() -> list:
+    """Every vocabulary member the README must backtick: link classes,
+    stall sites, straggler causes, and the two flow tables' columns."""
+    ledger = _load_ledger()
+    schemas = _load_schemas()
+    required = ([("link class", n) for n in ledger.LINK_CLASSES]
+                + [("stall site", n) for n in ledger.STALL_SITES]
+                + [("straggler cause", n) for n in ledger.STRAGGLER_CAUSES])
+    for table in ("transfers", "stragglers"):
+        for col, _type in schemas.SYSTEM_TABLES[("runtime", table)]:
+            required.append((f"runtime.{table} column", col))
+    return required
+
+
+def check(readme_path: str | None = None) -> list:
+    """Missing documentation items (empty means the docs are complete)."""
+    text = gates.read_readme(readme_path)
+    backticked = gates.backticked_names(text)
+    seen = set()
+    missing = []
+    for kind, name in required_names():
+        if name in backticked or name in seen:
+            continue
+        seen.add(name)  # shared column names report once
+        missing.append(f"{kind} {name} (needs a backticked `{name}`)")
+    return missing
+
+
+def main() -> int:
+    return gates.gate_main(
+        __doc__, check,
+        "flow-ledger vocabulary declared in trino_tpu/obs/flowledger.py "
+        "(or the flow tables in connector/system/schemas.py) but missing "
+        "from README:",
+        "document each in README.md (## Observability, Data-plane flow "
+        "ledger)",
+        lambda: (f"ok: all {len(_load_ledger().LINK_CLASSES)} link classes "
+                 "(plus stall sites, straggler causes, and both flow "
+                 "tables' columns) are documented"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

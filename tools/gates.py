@@ -121,6 +121,9 @@ ALL_GATES = (
      "every system table/column/procedure documented in README"),
     ("memledger-docs", "check_memledger_docs",
      "every memory-ledger event kind and pool documented in README"),
+    ("flow-docs", "check_flow_docs",
+     "every flow-ledger link class, stall site, straggler cause, and "
+     "flow-table column documented in README"),
     ("resource-group-docs", "check_resource_group_docs",
      "every selector field, group knob, and resource_groups column "
      "documented in README"),

@@ -116,6 +116,15 @@ def render_summary(stats) -> str:
         parts.append(
             f"spooled: {stats.get('resultSegments', 0)} segments "
             f"({stats['spooled']})")
+    flows = stats.get("flows") or {}
+    if flows.get("drainMbPerS") is not None:
+        # client-drain throughput from the flow ledger (result bytes
+        # serialized to this client over the drain wall)
+        parts.append(f"drain: {flows['drainMbPerS']:g} MB/s")
+    if flows.get("stragglers"):
+        # straggler verdicts (flow ledger): details on
+        # GET /v1/query/{id}/flows or system.runtime.stragglers
+        parts.append(f"stragglers: {flows['stragglers']}")
     out = f" [{', '.join(parts)}]" if parts else ""
     tl = stats.get("timeline")
     if tl:

@@ -391,6 +391,15 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "FailureInjector.java:41-69; test-only)",
             str, "",
         ),
+        PropertyMetadata(
+            "straggler_multiple",
+            "flow-ledger straggler detector sensitivity: a task is "
+            "flagged when its elapsed exceeds this multiple of its "
+            "stage's median task elapsed (obs/flowledger.py; read "
+            "surfaces: system.runtime.stragglers, "
+            "GET /v1/query/{id}/flows, EXPLAIN ANALYZE)",
+            float, 3.0,
+        ),
     ]
 }
 
@@ -419,6 +428,11 @@ def validate_property(name: str, value: Any) -> Any:
             value = int(value)
         except ValueError:
             raise ValueError(f"session property '{name}': expected integer, got {value!r}")
+    elif meta.py_type is float and isinstance(value, (str, int)):
+        try:
+            value = float(value)
+        except ValueError:
+            raise ValueError(f"session property '{name}': expected number, got {value!r}")
     if not isinstance(value, meta.py_type):
         raise ValueError(
             f"session property '{name}': expected {meta.py_type.__name__},"

@@ -145,6 +145,9 @@ SYSTEM_TABLES = {
                                             # (second revocable tier —
                                             # sheds before the HBM tier)
         ("host_cache_hits", "bigint"),      # lifetime host-tier hits
+        ("net_bytes_sent", "bigint"),       # flow-ledger lifetime bytes
+                                            # sent across every link
+        ("net_bytes_received", "bigint"),   # ...and received
     ),
     # the staged-table caches (trino_tpu/devcache/): one row per resident
     # entry of THIS process's pools — the warm-HBM tier (tier='hbm') and
@@ -215,6 +218,43 @@ SYSTEM_TABLES = {
         ("compile_seconds", "double"),
         ("cache", "varchar"),          # hit | miss
         ("created_at", "double"),      # epoch seconds
+    ),
+    # the data-plane flow ledger (trino_tpu/obs/flowledger.py): one row
+    # per (node, link, owner) transfer rollup — bytes in motion typed by
+    # link class (exchange-pull | spool-write | segment-fetch |
+    # staging-transfer | client-drain | control) with derived effective
+    # MB/s. Worker rows ride the announce payload (flows); coordinator
+    # rows come from its own process ledger (announce rows win for a
+    # shared in-process ledger).
+    ("runtime", "transfers"): (
+        ("node_id", "varchar"),
+        ("link", "varchar"),           # link class (see above)
+        ("owner", "varchar"),          # task:<id> | query:<id> |
+                                       # drain:<id> | staging | control
+        ("bytes", "bigint"),
+        ("pages", "bigint"),
+        ("transfers", "bigint"),       # records folded into this row
+        ("seconds", "double"),         # transfer wall attributed here
+        ("mb_per_s", "double"),        # bytes/seconds; NULL if no wall
+        ("retries", "bigint"),
+        ("last_status", "varchar"),    # last HTTP status / path marker
+    ),
+    # the straggler detector (trino_tpu/obs/flowledger.py): one row per
+    # flagged task — elapsed exceeded the configurable multiple of its
+    # stage median (straggler_multiple session property), attributed to
+    # its dominant cause (transfer-bound | device-bound | queue-bound).
+    # RUNNING queries detect live; terminal queries read frozen verdicts.
+    ("runtime", "stragglers"): (
+        ("query_id", "varchar"),
+        ("stage_id", "bigint"),
+        ("task_id", "varchar"),
+        ("worker_uri", "varchar"),
+        ("elapsed_seconds", "double"),
+        ("stage_median_seconds", "double"),
+        ("ratio", "double"),           # elapsed / stage median
+        ("multiple", "double"),        # threshold multiple in force
+        ("cause", "varchar"),          # dominant ledger seconds bucket
+        ("completed_splits", "bigint"),
     ),
     # registered materialized views (trino_tpu/matview/): definitions,
     # storage location, and LIVE freshness (recomputed at scan time from

@@ -374,6 +374,7 @@ def blocked_transfer(profile: Optional[StageProfile] = None,
     import jax
     import jax.numpy as jnp
 
+    from trino_tpu.obs.flowledger import FLOW_LEDGER
     from trino_tpu.obs.memledger import MEMORY_LEDGER, POOL_DEVICE
 
     def transfer(arr: np.ndarray):
@@ -381,8 +382,14 @@ def blocked_transfer(profile: Optional[StageProfile] = None,
         n = arr.shape[-1] if arr.ndim else 0
         row_bytes = (arr.nbytes // n) if n else 0
         block_rows = max(1, block_bytes // max(1, row_bytes)) if n else 0
+        t0 = time.perf_counter()
         if not n or n <= 2 * block_rows or arr.nbytes > BLOCKED_MAX_BYTES:
-            return jnp.asarray(arr)
+            out = jnp.asarray(arr)
+            FLOW_LEDGER.record_transfer(
+                "staging-transfer", "staging", int(arr.nbytes),
+                time.perf_counter() - t0, pages=1, src="host", dst="device",
+                direction="send", status="single-shot")
+            return out
         axis = arr.ndim - 1
         # the blocked path's transient scratch (blocks + concat output,
         # ~2x the column — the BLOCKED_MAX_BYTES comment) is attributed
@@ -403,7 +410,12 @@ def blocked_transfer(profile: Optional[StageProfile] = None,
                 blocks.append(jax.device_put(arr[idx]))
             if profile is not None:
                 profile.transfer_blocks += len(blocks)
-            return jnp.concatenate(blocks, axis=axis)
+            out = jnp.concatenate(blocks, axis=axis)
+            FLOW_LEDGER.record_transfer(
+                "staging-transfer", "staging", int(arr.nbytes),
+                time.perf_counter() - t0, pages=len(blocks), src="host",
+                dst="device", direction="send", status="blocked")
+            return out
         finally:
             MEMORY_LEDGER.record_event(
                 "release", POOL_DEVICE, "staging", int(arr.nbytes))

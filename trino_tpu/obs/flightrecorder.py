@@ -107,7 +107,10 @@ def pull_worker_rings(locations, timeout: float = 3.0,
                         "records": payload.get("records", []),
                         # memory-ledger snapshot rides the same pull so a
                         # postmortem names each node's top consumers
-                        "memory": payload.get("memory")}
+                        "memory": payload.get("memory"),
+                        # flow-ledger snapshot rides along too: per-link
+                        # rollups + the node's last transfers/stalls
+                        "flows": payload.get("flows")}
             return {"url": url, "error": f"status {status}"}
         except Exception as e:  # noqa: BLE001 — a dead worker IS the story
             return {"url": url, "error": str(e)[:300]}

@@ -726,6 +726,30 @@ QUERY_PEAK_MEMORY_BYTES = REGISTRY.histogram(
     "once per terminal query", ("state",),
     buckets=MEMORY_BUCKETS_BYTES)
 
+# data-plane flow ledger (obs/flowledger.py): every cross-boundary byte
+# typed by link class, the producers' backpressure stalls, and the
+# straggler detector's terminal-query verdicts
+TRANSFER_BYTES = REGISTRY.counter(
+    "trino_tpu_transfer_bytes_total",
+    "bytes moved across a data-plane link, by link class (exchange-pull "
+    "| spool-write | segment-fetch | staging-transfer | client-drain | "
+    "control) and direction (send | recv, from this process's "
+    "viewpoint)", ("link", "direction"))
+TRANSFER_SECONDS = REGISTRY.counter(
+    "trino_tpu_transfer_seconds",
+    "wall seconds spent moving bytes on a data-plane link (cumulative "
+    "across concurrent transfers, so bytes/seconds is the per-stream "
+    "effective rate, not the aggregate)", ("link",))
+BACKPRESSURE_STALL_SECONDS = REGISTRY.counter(
+    "trino_tpu_backpressure_stall_seconds_total",
+    "seconds producers spent blocked on full output buffers plus "
+    "consumers spent on empty exchange polls, by stage", ("stage",))
+STRAGGLER_TASKS = REGISTRY.counter(
+    "trino_tpu_straggler_tasks_total",
+    "tasks flagged by the straggler detector at query completion, by "
+    "dominant cause (transfer-bound | device-bound | queue-bound)",
+    ("cause",))
+
 
 def current_rss_bytes():
     """This process's CURRENT resident set (VmRSS), or None where /proc

@@ -21,7 +21,10 @@ consumer pull is a byte copy.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Tuple
+
+from trino_tpu.obs.flowledger import FLOW_LEDGER
 
 
 # default producer-blocking watermark (reference: sink.max-buffer-size /
@@ -40,7 +43,8 @@ class OutputBuffer:
     the same flow control on a thread-per-fragment worker)."""
 
     def __init__(self, consumer_count: int = 1,
-                 max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES):
+                 max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES,
+                 stall_key=None):
         assert consumer_count >= 1
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -52,12 +56,24 @@ class OutputBuffer:
         self._max_bytes = max_buffer_bytes
         self._bytes = 0  # un-GC'd page bytes
         self.peak_buffered_bytes = 0
+        # flow-ledger label for full-wait stall samples: (stage, partition)
+        self._stall_key = stall_key if stall_key is not None else (None, None)
+        self.stalled_seconds = 0.0  # cumulative producer full-wait
 
     def enqueue(self, page_bytes: bytes, timeout: float = 300.0) -> None:
+        waited_s = 0.0
+        depth = 0
+        timed_out = False
         with self._cond:
             if self._aborted is not None:
                 return  # writes to a destroyed buffer are discarded
             assert not self._complete, "enqueue after set_complete"
+            # about to block? sample this full-wait into the backpressure
+            # timeline (the ledger append happens OUTSIDE the lock below)
+            t0 = (time.perf_counter()
+                  if self._bytes >= self._max_bytes else None)
+            if t0 is not None:
+                depth = self._bytes
             # block while over the watermark (unless aborted — a dead
             # consumer must not wedge the producer forever)
             # lint: allow(blocking-under-lock) Condition.wait_for RELEASES the lock while blocked; this IS the backpressure
@@ -66,16 +82,25 @@ class OutputBuffer:
                 or self._bytes < self._max_bytes,
                 timeout,
             )
+            if t0 is not None:
+                waited_s = time.perf_counter() - t0
             if not ok:
-                raise TimeoutError(
-                    f"output buffer full for {timeout}s "
-                    f"({self._bytes} buffered bytes, no consumer progress)")
-            if self._aborted is not None:
-                return
-            self._pages.append(page_bytes)
-            self._bytes += len(page_bytes)
-            self.peak_buffered_bytes = max(self.peak_buffered_bytes, self._bytes)
-            self._cond.notify_all()
+                timed_out = True
+            elif self._aborted is None:
+                self._pages.append(page_bytes)
+                self._bytes += len(page_bytes)
+                self.peak_buffered_bytes = max(self.peak_buffered_bytes, self._bytes)
+                self._cond.notify_all()
+        if waited_s > 0.0:
+            self.stalled_seconds += waited_s
+            stage, partition = self._stall_key
+            FLOW_LEDGER.record_stall(
+                "buffer-enqueue", stage, partition, waited_s,
+                depth_bytes=depth, limit_bytes=self._max_bytes)
+        if timed_out:
+            raise TimeoutError(
+                f"output buffer full for {timeout}s "
+                f"({depth} buffered bytes, no consumer progress)")
 
     def set_complete(self) -> None:
         with self._cond:
@@ -146,11 +171,13 @@ class PartitionedOutputBuffer:
     consumer."""
 
     def __init__(self, partitions: int,
-                 max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES):
+                 max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES,
+                 stall_stage=None):
         assert partitions >= 1
         self._parts = [
-            OutputBuffer(1, max_buffer_bytes=max(max_buffer_bytes // partitions, 1 << 16))
-            for _ in range(partitions)
+            OutputBuffer(1, max_buffer_bytes=max(max_buffer_bytes // partitions, 1 << 16),
+                         stall_key=(stall_stage, pid))
+            for pid in range(partitions)
         ]
         # cumulative serialized bytes enqueued per partition (never
         # decremented by GC), reported in task stats. NOT the skew
@@ -188,3 +215,7 @@ class PartitionedOutputBuffer:
     @property
     def buffered_bytes(self) -> int:
         return sum(p.buffered_bytes for p in self._parts)
+
+    @property
+    def stalled_seconds(self) -> float:
+        return sum(p.stalled_seconds for p in self._parts)

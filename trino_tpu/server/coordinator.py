@@ -41,6 +41,7 @@ _RESULT_RE = re.compile(r"^/v1/statement/executing/([^/]+)/(\d+)$")
 _QUERY_RE = re.compile(r"^/v1/query/([^/]+)$")
 _TRACE_RE = re.compile(r"^/v1/query/([^/]+)/trace$")
 _PROFILE_RE = re.compile(r"^/v1/query/([^/]+)/profile$")
+_FLOWS_RE = re.compile(r"^/v1/query/([^/]+)/flows$")
 _SEGMENT_RE = re.compile(r"^/v1/segment/([^/]+)$")
 
 RESULT_PAGE_ROWS = 10_000
@@ -1447,6 +1448,10 @@ class QueryExecution:
                 # events + utilization counters — a recompile storm
                 # preceding the failure is visible right here
                 "profiler": _profiler_snapshot(),
+                # flow-ledger snapshot: per-link rollups + the last
+                # transfers + stall rollups — what was moving (and who
+                # was blocked on whom) when the query died
+                "flows": _flows_snapshot(),
             },
             "workers": pull_worker_rings(locations, timeout=timeout,
                                          pool=self.io_pool),
@@ -1503,7 +1508,35 @@ class QueryExecution:
             "yieldEvents": int(qs.get("yieldEvents") or 0),
             "spills": int(qs.get("spills") or 0),
         }
+        # the data-plane block (flow ledger): drain throughput for the
+        # CLI summary tag + the straggler count, absent on any ledger
+        # hiccup rather than failing a stats poll
+        try:
+            qs["flows"] = self.flow_stats_block()
+        except Exception:  # noqa: BLE001 — observability only
+            pass
         return qs
+
+    def flow_stats_block(self) -> dict:
+        """The ``stats.flows`` block of the statement protocol: this
+        query's client-drain rollup (bytes + effective MB/s) and the
+        straggler count. Re-read by ``_drain_body`` on the final result
+        page so the CLI summary includes that response's own bytes."""
+        from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+        owner = f"drain:{self.query_id}"
+        drain_bytes = 0
+        drain_s = 0.0
+        for r in FLOW_LEDGER.transfer_rows():
+            if r["owner"] == owner:
+                drain_bytes += r["bytes"]
+                drain_s += r["seconds"]
+        return {
+            "drainBytes": drain_bytes,
+            "drainMbPerS": (round(drain_bytes / drain_s / 1e6, 3)
+                            if drain_s > 0 else None),
+            "stragglers": len(self.straggler_rows()),
+        }
 
     # ---------------------------------------------------- device profiler
     def kernel_rows_live(self) -> List[dict]:
@@ -1574,6 +1607,95 @@ class QueryExecution:
             "utilization": DEVICE_PROFILER.utilization_rows(limit=8),
             "counters": DEVICE_PROFILER.counters(),
             "timeline": self.timeline_dict(),
+        }
+
+    # ------------------------------------------------------- flow ledger
+    def _owns_flow(self, owner: str) -> bool:
+        """Does a flow-ledger rollup owner belong to this query? Owners
+        are ``task:{qid}.{frag}.{slot}.a{n}``, ``query:{qid}`` (spool
+        writes / segment fetches) and ``drain:{qid}`` (client drain)."""
+        return (owner == f"query:{self.query_id}"
+                or owner == f"drain:{self.query_id}"
+                or owner.startswith(f"task:{self.query_id}."))
+
+    def _straggler_multiple(self) -> float:
+        """The ``straggler_multiple`` session property (elapsed must
+        exceed this multiple of the stage median to flag); malformed
+        values fall back to the ledger default."""
+        from trino_tpu.obs.flowledger import DEFAULT_STRAGGLER_MULTIPLE
+
+        try:
+            return float(self.session_properties.get(
+                "straggler_multiple", DEFAULT_STRAGGLER_MULTIPLE))
+        except (TypeError, ValueError):
+            return DEFAULT_STRAGGLER_MULTIPLE
+
+    def flow_rows_live(self) -> List[dict]:
+        """This query's per-link transfer rollups, merged cluster-wide:
+        worker rows ride the announce payload (``flows``), the
+        coordinator contributes its own process ledger directly. A
+        worker ledger sharing this process (in-process test clusters
+        stamp the global ledger with the first server's id) is NOT
+        double-reported: announce rows win for that node id — the
+        kernel/memory ledger fold pattern."""
+        from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+        rows = []
+        announced = set()
+        for n in self.registry.snapshot():
+            flows = (n.get("info") or {}).get("flows")
+            if flows is None:
+                continue
+            announced.add(n["nodeId"])
+            rows.extend(dict(r, nodeId=n["nodeId"]) for r in flows
+                        if self._owns_flow(str(r.get("owner", ""))))
+        nid = FLOW_LEDGER.node_id or "coordinator"
+        if nid not in announced:
+            rows.extend(dict(r, nodeId=nid)
+                        for r in FLOW_LEDGER.transfer_rows()
+                        if self._owns_flow(r["owner"]))
+        return rows
+
+    def straggler_rows(self) -> List[dict]:
+        """Straggler verdicts over this query's task records: frozen at
+        terminal by :meth:`fold_flow_profile`, detected live while
+        RUNNING (same live/folded split as the kernel rows)."""
+        folded = getattr(self, "_stragglers", None)
+        if folded is not None:
+            return folded
+        from trino_tpu.obs.flowledger import detect_stragglers
+
+        return detect_stragglers(self.task_records(),
+                                 multiple=self._straggler_multiple())
+
+    def fold_flow_profile(self) -> None:
+        """Freeze the straggler verdicts ONCE at terminal and bump the
+        per-cause straggler counter (metrics fire at query end, never
+        per stats poll)."""
+        if getattr(self, "_flows_folded", False):
+            return
+        self._flows_folded = True
+        self._stragglers = self.straggler_rows()
+        if self._stragglers:
+            from trino_tpu.obs import metrics as M
+
+            for f in self._stragglers:
+                M.STRAGGLER_TASKS.inc(1, f["cause"])
+
+    def flows_dict(self) -> dict:
+        """The ``GET /v1/query/{id}/flows`` payload: this query's
+        cluster-merged per-link rows, the straggler verdicts, and the
+        process backpressure stall rollups (stage-labelled; the stall
+        series is process-scoped like the metrics registry)."""
+        from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+        return {
+            "queryId": self.query_id,
+            "state": self.state.get(),
+            "transfers": self.flow_rows_live(),
+            "stragglers": self.straggler_rows(),
+            "stalls": FLOW_LEDGER.stall_rows(),
+            "net": FLOW_LEDGER.net_totals(),
         }
 
     def _explain_analyze(self, session, stmt) -> str:
@@ -1681,6 +1803,27 @@ class QueryExecution:
             header.append("Peak task memory by node: " + ", ".join(
                 f"{node} {pb // 1024}KiB"
                 for node, pb in sorted(node_peaks.items())))
+        # data-flow annotations (flow ledger): per-link bytes + effective
+        # throughput for this query, then any straggler verdicts with
+        # their dominant cause — the skewed node reads right here
+        try:
+            by_link: Dict[str, list] = {}
+            for r in self.flow_rows_live():
+                agg = by_link.setdefault(r["link"], [0, 0.0])
+                agg[0] += int(r["bytes"])
+                agg[1] += float(r["seconds"])
+            if by_link:
+                header.append("Data flow: " + ", ".join(
+                    f"{link} {b / 1e6:.1f}MB"
+                    + (f" @ {b / s / 1e6:.1f}MB/s" if s > 0 else "")
+                    for link, (b, s) in sorted(by_link.items())))
+            for f in self.straggler_rows():
+                header.append(
+                    f"Straggler: task {f['taskId']} {f['elapsedS']:.2f}s"
+                    f" vs stage median {f['stageMedianS']:.2f}s"
+                    f" ({f['ratio']:.1f}x, {f['cause']})")
+        except Exception:  # noqa: BLE001 — annotations are observability
+            pass
         # kernel-ledger annotations (device profiler): VERBOSE prints a
         # per-node launches=/dispatch_overhead= line from the merged rows
         kern = None
@@ -2135,8 +2278,13 @@ class QueryExecution:
         remote_pages: Dict[int, list] = {}
         for node in P.walk_plan(root_frag.root):
             if isinstance(node, RemoteSourceNode):
+                # flow-ledger attribution: the coordinator's root gather
+                # is this query's exchange pull (the "task:{qid}." owner
+                # prefix groups it with the workers' task pulls)
                 client = ExchangeClient(self.fragment_tasks[node.fragment_id],
-                                        tracer=self.tracer)
+                                        tracer=self.tracer,
+                                        owner=f"task:{self.query_id}.root",
+                                        stall_key=(root_frag.id, None))
                 client.start()
                 if budget is None:
                     remote_pages[node.fragment_id] = client.pages()
@@ -2372,6 +2520,14 @@ class CoordinatorServer:
         if not DEVICE_PROFILER.node_id:
             DEVICE_PROFILER.node_id = "coordinator"
         DEVICE_PROFILER.attach_recorder(self.recorder)
+        # data-plane flow ledger (obs/flowledger.py): same
+        # first-server-wins identity stamp; retried transfers mirror
+        # into the flight recorder so postmortems show flaky links
+        from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+        if not FLOW_LEDGER.node_id:
+            FLOW_LEDGER.node_id = "coordinator"
+        FLOW_LEDGER.attach_recorder(self.recorder)
         # spooled result segments (server/segments.py): the coordinator's
         # own store — coordinator-local/fast-path queries (and
         # non-trivial-root distributed ones) spool here, so the protocol
@@ -2539,6 +2695,13 @@ class CoordinatorServer:
                 execution.fold_kernel_profile()
             except Exception:  # noqa: BLE001 — observability only
                 pass
+            # flow-ledger fold: freeze the straggler verdicts and bump
+            # the per-cause counter ONCE — system.runtime.stragglers and
+            # the /flows surface read the frozen verdicts after this
+            try:
+                execution.fold_flow_profile()
+            except Exception:  # noqa: BLE001 — observability only
+                pass
             # a FAILED/CANCELED query's result segments will never be
             # fetched — reclaim the coordinator-hosted ones now instead
             # of waiting out the TTL (worker-hosted ones TTL out; their
@@ -2568,11 +2731,26 @@ class CoordinatorServer:
             )
             if self.otlp is not None:
                 # ship the coordinator half of the trace (workers export
-                # their own task spans at task completion)
+                # their own task spans at task completion) — with the
+                # query's per-link flow totals + straggler count as
+                # resource attributes, so the collector sees the data
+                # plane without a second export path
+                otlp_attrs = {"query_id": query_id, "query.user": user,
+                              "query.state": state}
+                try:
+                    by_link: Dict[str, int] = {}
+                    for r in execution.flow_rows_live():
+                        by_link[r["link"]] = (by_link.get(r["link"], 0)
+                                              + int(r["bytes"]))
+                    for link, nbytes in sorted(by_link.items()):
+                        otlp_attrs[f"flow.{link}.bytes"] = nbytes
+                    otlp_attrs["flow.stragglers"] = len(
+                        execution.straggler_rows())
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
                 self.otlp.export_spans(
                     execution.tracer.to_dicts(), execution.tracer.trace_id,
-                    {"query_id": query_id, "query.user": user,
-                     "query.state": state})
+                    otlp_attrs)
             # completed-query history (system.runtime.queries coverage of
             # finished queries): retention knobs are session-property-
             # gated, read from THIS query's submitted properties — but the
@@ -2798,6 +2976,40 @@ def _result_payload(server: CoordinatorServer, q: QueryExecution, token: int) ->
     return payload
 
 
+def _drain_body(server: CoordinatorServer, q: QueryExecution,
+                token: int) -> bytes:
+    """Serialize one statement-protocol response and charge its bytes to
+    the query's ``client-drain`` flow when it carries results (rows or a
+    segment manifest). The serialize wall is the drain cost the
+    coordinator can see — socket write time belongs to the client."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    payload = _result_payload(server, q, token)
+    body = json.dumps(payload).encode()
+    if "data" in payload or "segments" in payload:
+        try:
+            from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+            FLOW_LEDGER.record_transfer(
+                "client-drain", f"drain:{q.query_id}", len(body),
+                _time.perf_counter() - t0,
+                pages=len(payload.get("data") or payload.get("segments")
+                          or ()),
+                src=FLOW_LEDGER.node_id or "coordinator", dst="client",
+                direction="send")
+            if "nextUri" not in payload and "stats" in payload:
+                # final page: refresh the stats flows block so the CLI
+                # summary's drain tag counts THIS response's bytes (the
+                # stats were built before the record above) — one extra
+                # dumps of the last page buys a truthful summary
+                payload["stats"]["flows"] = q.flow_stats_block()
+                body = json.dumps(payload).encode()
+        except Exception:  # noqa: BLE001 — accounting never fails serving
+            pass
+    return body
+
+
 CACHE_HEADER = "X-Trino-Tpu-Cache"
 
 
@@ -2815,6 +3027,17 @@ def _profiler_snapshot() -> dict:
 
         return {"compiles": DEVICE_PROFILER.compile_rows(limit=16),
                 "counters": DEVICE_PROFILER.counters()}
+    except Exception:  # noqa: BLE001 — best-effort forensics
+        return {}
+
+
+def _flows_snapshot() -> dict:
+    """The postmortem's flow-ledger block: per-link rollups, net totals,
+    the newest transfer records and the stall rollups."""
+    try:
+        from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+        return FLOW_LEDGER.flow_snapshot()
     except Exception:  # noqa: BLE001 — best-effort forensics
         return {}
 
@@ -3005,7 +3228,7 @@ def _make_handler(server: CoordinatorServer):
                 # (submit response already carries the result page)
                 if not q.state.is_terminal():
                     q.state.wait_for_terminal(0.5)
-                self._send(200, json.dumps(_result_payload(server, q, 0)).encode(),
+                self._send(200, _drain_body(server, q, 0),
                            headers=_cache_header(q))
                 return
             self._send(404)
@@ -3051,9 +3274,8 @@ def _make_handler(server: CoordinatorServer):
                 # long-poll briefly so clients don't busy-spin
                 if not q.state.is_terminal():
                     q.state.wait_for_terminal(0.5)
-                self._send(200, json.dumps(
-                    _result_payload(server, q, int(m.group(2)))).encode(),
-                    headers=_cache_header(q))
+                self._send(200, _drain_body(server, q, int(m.group(2))),
+                           headers=_cache_header(q))
                 return
             # the trace route accepts a query string (?recorder=1 attaches
             # the flight-recorder postmortem); other routes stay exact
@@ -3090,6 +3312,19 @@ def _make_handler(server: CoordinatorServer):
                     self._send(404, b'{"error": "no such query"}')
                     return
                 self._send(200, json.dumps(q.profile_dict()).encode())
+                return
+            m = _FLOWS_RE.match(url_parts.path)
+            if m:
+                # the flow-ledger read surface (obs/flowledger.py): this
+                # query's cluster-merged per-link transfer rows, the
+                # straggler verdicts, and the backpressure stall rollups
+                q = server.get_query(m.group(1))
+                if not self._authenticated(query=q):
+                    return
+                if q is None:
+                    self._send(404, b'{"error": "no such query"}')
+                    return
+                self._send(200, json.dumps(q.flows_dict()).encode())
                 return
             m = _QUERY_RE.match(self.path)
             if m:

@@ -16,6 +16,7 @@ from trino_tpu.data.page import Page
 from trino_tpu.data.serde import deserialize_page
 from trino_tpu.obs import metrics as M
 from trino_tpu.obs import trace as tracing
+from trino_tpu.obs.flowledger import FLOW_LEDGER
 from trino_tpu.server import wire
 
 
@@ -45,12 +46,21 @@ class ExchangeClient:
     """
 
     def __init__(self, locations: List[TaskLocation], max_buffered_pages: int = 64,
-                 tracer: Optional["tracing.Tracer"] = None):
+                 tracer: Optional["tracing.Tracer"] = None,
+                 owner: Optional[str] = None, stall_key=None):
         self._locations = list(locations)
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_buffered_pages)
         self._remaining = len(self._locations)
         self._lock = threading.Lock()
         self._failure: Optional[str] = None
+        # flow-ledger attribution: who these pulled bytes belong to
+        # (task:<id> on workers, query:<id> on the coordinator gather) and
+        # the (stage, partition) the empty-poll stall samples label
+        self._owner = owner or "exchange"
+        self._stall_key = stall_key if stall_key is not None else (None, None)
+        # per-client ledger totals (task stats: transferS / stallS)
+        self.pulled_seconds = 0.0
+        self.stalled_seconds = 0.0
         # span context is captured AT CONSTRUCTION (the consumer's thread):
         # puller threads record their exchange spans under the span that
         # created the client (task body / root-fragment execute). With no
@@ -77,8 +87,16 @@ class ExchangeClient:
     def _request_with_retry(self, loc: TaskLocation, token: int):
         """Retry transient failures with the SAME token — the at-least-once
         window makes re-reads of un-acked tokens safe (reference:
-        HttpPageBufferClient's Backoff); only the token advance is an ack."""
+        HttpPageBufferClient's Backoff); only the token advance is an ack.
+
+        Returns ``(body, headers, attempts, last_status)`` so the pull
+        loop's flow record carries the retry count and terminal status.
+        The per-attempt history (status or exception, with the backoff it
+        paid) rides the terminal "retries exhausted" error, so a failed
+        exchange names every attempt instead of just the last."""
         delay = 0.2
+        history: List[str] = []
+        last_status: Optional[str] = None
         trace_headers = (
             {tracing.TRACEPARENT_HEADER:
              self._tracer.traceparent(self._parent_span_id)}
@@ -87,17 +105,29 @@ class ExchangeClient:
             M.EXCHANGE_REQUESTS.inc()
             if attempt:
                 M.EXCHANGE_RETRIES.inc()
+            t0 = time.perf_counter()
             try:
                 status, body, headers = wire.http_request(
                     "GET", loc.results_url(token), timeout=120.0,
                     headers=trace_headers,
                 )
             except Exception as e:  # noqa: BLE001 — socket-level failure
+                last_status = type(e).__name__
+                history.append(
+                    f"#{attempt + 1} {last_status} after "
+                    f"{time.perf_counter() - t0:.3f}s: {str(e)[:120]}")
                 if attempt == self.MAX_ATTEMPTS - 1:
-                    raise
+                    raise RuntimeError(
+                        f"exchange pull {loc}: retries exhausted after "
+                        f"{len(history)} attempts [{'; '.join(history)}]"
+                    ) from e
                 time.sleep(delay)
                 delay *= 2
                 continue
+            last_status = str(status)
+            history.append(
+                f"#{attempt + 1} HTTP {status} after "
+                f"{time.perf_counter() - t0:.3f}s")
             if status >= 500 and attempt < self.MAX_ATTEMPTS - 1:
                 time.sleep(delay)
                 delay *= 2
@@ -106,8 +136,10 @@ class ExchangeClient:
                 raise RuntimeError(
                     f"exchange pull {loc} -> {status}: {body[:300].decode(errors='replace')}"
                 )
-            return body, headers
-        raise RuntimeError(f"exchange pull {loc}: retries exhausted")
+            return body, headers, attempt + 1, last_status
+        raise RuntimeError(
+            f"exchange pull {loc}: retries exhausted after "
+            f"{len(history)} attempts [{'; '.join(history)}]")
 
     def _read_spool(self, loc: TaskLocation) -> bool:
         """Fallback for an unreachable/failed producer: read its spooled
@@ -132,6 +164,7 @@ class ExchangeClient:
                   "spool/read", parent_id=self._parent_span_id,
                   task=loc.task_id, path=path)
               if self._tracer is not None else tracing.NOOP_SPAN)
+        t0 = time.perf_counter()
         try:
             with open(path, "rb") as f:
                 body = f.read()
@@ -148,6 +181,12 @@ class ExchangeClient:
         finally:
             if self._tracer is not None:
                 self._tracer.end_span(sp)
+        spool_s = time.perf_counter() - t0
+        self.pulled_seconds += spool_s
+        FLOW_LEDGER.record_transfer(
+            "exchange-pull", self._owner, len(body), spool_s,
+            pages=len(pages), src=f"spool:{loc.task_id}",
+            dst=FLOW_LEDGER.node_id or None, status="spool")
         for pb in pages:
             self._queue.put(deserialize_page(pb))
         # final ack to the live buffer (if the producer still exists) so it
@@ -169,20 +208,38 @@ class ExchangeClient:
               if self._tracer is not None else tracing.NOOP_SPAN)
         pulled_bytes = 0
         pulled_pages = 0
+        pull_seconds = 0.0
+        pull_retries = 0
+        last_status: Optional[str] = None
+        streamed = False
         try:
             if self._read_spool(loc):
                 sp.set("spooled", True)
                 return
+            streamed = True
             while True:
-                body, headers = self._request_with_retry(loc, token)
+                t0 = time.perf_counter()
+                body, headers, attempts, last_status = (
+                    self._request_with_retry(loc, token))
+                waited = time.perf_counter() - t0
+                pull_seconds += waited
+                pull_retries += attempts - 1
                 failed = headers.get(wire.H_TASK_FAILED)
                 if failed:
                     raise RuntimeError(f"upstream task {loc.task_id} failed: {failed}")
                 M.EXCHANGE_BYTES.inc(len(body))
                 pulled_bytes += len(body)
+                n_before = pulled_pages
                 for pb in wire.unframe_pages(body):
                     pulled_pages += 1
                     self._queue.put(deserialize_page(pb))
+                if pulled_pages == n_before:
+                    # empty poll: the producer had nothing ready — a
+                    # consumer-starved backpressure sample
+                    stage, partition = self._stall_key
+                    FLOW_LEDGER.record_stall(
+                        "exchange-poll", stage, partition, waited)
+                    self.stalled_seconds += waited
                 token = int(headers.get(wire.H_NEXT_TOKEN, token))
                 if headers.get(wire.H_BUFFER_COMPLETE) == "true":
                     # final ack so the upstream buffer can be destroyed
@@ -198,6 +255,15 @@ class ExchangeClient:
             sp.set("pages", pulled_pages)
             if self._tracer is not None:
                 self._tracer.end_span(sp)
+            if streamed:
+                # one flow record per pull stream (not per request): the
+                # whole conversation with this upstream location
+                self.pulled_seconds += pull_seconds
+                FLOW_LEDGER.record_transfer(
+                    "exchange-pull", self._owner, pulled_bytes, pull_seconds,
+                    pages=pulled_pages, src=loc.base_url,
+                    dst=FLOW_LEDGER.node_id or None,
+                    retries=pull_retries, status=last_status)
             with self._lock:
                 self._remaining -= 1
             self._queue.put(None)  # wake the consumer

@@ -38,6 +38,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from trino_tpu.obs import metrics as M
+from trino_tpu.obs.flowledger import FLOW_LEDGER
 
 # default lifetime of an un-acked segment; the per-query
 # ``result_segment_ttl_ms`` session property overrides per segment
@@ -110,12 +111,18 @@ class SegmentStore:
         path = os.path.join(self.base_dir, segment_id + _SEGMENT_SUFFIX)
         tmp = path + ".tmp"
         nbytes = 0
+        t0 = time.perf_counter()
         with open(tmp, "wb") as f:
             for frame in frames:
                 f.write(struct.pack("<I", len(frame)))
                 f.write(frame)
                 nbytes += 4 + len(frame)
         os.replace(tmp, path)  # atomic publish, like the exchange spool
+        FLOW_LEDGER.record_transfer(
+            "spool-write", f"query:{query_id}", nbytes,
+            time.perf_counter() - t0, pages=len(frames),
+            src=FLOW_LEDGER.node_id or None, dst="segment-store",
+            direction="send")
         expires_at = time.time() + ttl_s
         # the file's mtime IS its expiry: another server's boot-time
         # orphan sweep over a shared spool dir can then never reclaim a
@@ -143,6 +150,7 @@ class SegmentStore:
         meta = self.get(segment_id)
         if meta is None:
             return None
+        t0 = time.perf_counter()
         try:
             with open(meta.path, "rb") as f:
                 if start:
@@ -151,6 +159,11 @@ class SegmentStore:
         except OSError:
             return None
         M.RESULT_SEGMENT_BYTES.inc(len(data), "served")
+        FLOW_LEDGER.record_transfer(
+            "segment-fetch", f"query:{meta.query_id}", len(data),
+            time.perf_counter() - t0, src="segment-store",
+            dst=FLOW_LEDGER.node_id or None, direction="send",
+            status="range" if (start or length is not None) else "full")
         return data
 
     def ack(self, segment_id: str) -> bool:

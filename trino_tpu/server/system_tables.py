@@ -189,6 +189,10 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             return self._kernels_rows()
         if (schema, table) == ("runtime", "compiles"):
             return self._compiles_rows()
+        if (schema, table) == ("runtime", "transfers"):
+            return self._transfers_rows()
+        if (schema, table) == ("runtime", "stragglers"):
+            return self._stragglers_rows()
         if (schema, table) == ("metadata", "materialized_views"):
             return self._matview_rows()
         if (schema, table) == ("metrics", "metrics"):
@@ -245,6 +249,8 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
                 int(n["ageS"] * 1000.0),
                 int(info.get("hostCacheBytes") or 0),
                 int(info.get("hostCacheHits") or 0),
+                int(info.get("netBytesSent") or 0),
+                int(info.get("netBytesReceived") or 0),
             ))
         return rows
 
@@ -340,6 +346,63 @@ class CoordinatorSystemTables(spi.LiveTableProvider):
             str(e.get("shapeSig", "")), float(e.get("compileS", 0.0)),
             str(e.get("cache", "")), float(e.get("ts", 0.0)),
         )
+
+    def _transfers_rows(self) -> List[tuple]:
+        """``system.runtime.transfers``: the flow ledger — one row per
+        (node, link, owner) transfer rollup, cluster-wide. Worker rows
+        ride the announce payload (``flows``); the coordinator
+        contributes its own process ledger directly. A worker ledger
+        sharing this process (in-process test clusters) is NOT
+        double-reported: announce rows win for that node id."""
+        from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+        rows = []
+        announced = set()
+        for n in self._server.registry.snapshot():
+            flows = (n.get("info") or {}).get("flows")
+            if flows is None:
+                continue
+            announced.add(n["nodeId"])
+            rows.extend(self._transfer_row(n["nodeId"], r) for r in flows)
+        nid = FLOW_LEDGER.node_id or "coordinator"
+        if nid not in announced:
+            rows.extend(self._transfer_row(nid, r)
+                        for r in FLOW_LEDGER.transfer_rows())
+        return rows
+
+    @staticmethod
+    def _transfer_row(nid: str, r: dict) -> tuple:
+        return (
+            nid, str(r.get("link", "")), str(r.get("owner", "")),
+            int(r.get("bytes", 0)), int(r.get("pages", 0)),
+            int(r.get("transfers", 0)), float(r.get("seconds", 0.0)),
+            (float(r["mbPerS"]) if r.get("mbPerS") is not None else None),
+            int(r.get("retries", 0)),
+            (str(r["lastStatus"]) if r.get("lastStatus") is not None
+             else None),
+        )
+
+    def _stragglers_rows(self) -> List[tuple]:
+        """``system.runtime.stragglers``: one row per flagged task across
+        the live query registry — frozen verdicts for terminal queries,
+        live detection for RUNNING ones (QueryExecution.straggler_rows
+        makes that split)."""
+        rows = []
+        for q in self._live_executions():
+            for f in q.straggler_rows():
+                stage = f.get("stageId")
+                rows.append((
+                    q.query_id,
+                    int(stage) if stage is not None else None,
+                    f.get("taskId"), f.get("workerUri"),
+                    float(f.get("elapsedS", 0.0)),
+                    float(f.get("stageMedianS", 0.0)),
+                    float(f.get("ratio", 0.0)),
+                    float(f.get("multiple", 0.0)),
+                    str(f.get("cause", "")),
+                    int(f.get("completedSplits", 0)),
+                ))
+        return rows
 
     def _resource_group_rows(self) -> List[tuple]:
         """``system.runtime.resource_groups``: one row per live group

@@ -165,12 +165,17 @@ class SqlTask:
 
         sink_max = int(request.session_properties.get(
             "sink_max_buffer_bytes") or DEFAULT_MAX_BUFFER_BYTES)
+        # flow-ledger labels: full-wait stall samples carry this task's
+        # stage (task ids are {query}.{fragment}.{worker}.a{attempt})
+        self.stage_id = _task_stage_id(request.task_id)
         if request.output_partition_channels is not None:
             self.output = PartitionedOutputBuffer(
-                request.consumer_count, max_buffer_bytes=sink_max)
+                request.consumer_count, max_buffer_bytes=sink_max,
+                stall_stage=self.stage_id)
         else:
             self.output = OutputBuffer(
-                request.consumer_count, max_buffer_bytes=sink_max)
+                request.consumer_count, max_buffer_bytes=sink_max,
+                stall_key=(self.stage_id, None))
         # spooled result protocol: when this task produces the query's
         # result, its serialized output chunks roll into size-bounded
         # segments in the worker's segment store (server/segments.py)
@@ -222,6 +227,10 @@ class SqlTask:
         # telemetry: rolls up task -> stage -> query and into the CLI)
         self.device_cache_hits = 0
         self.device_cache_misses = 0
+        # exchange clients this task created (flow-ledger rollup: their
+        # pull/stall seconds feed the transferS/stallS stats the straggler
+        # detector attributes causes from)
+        self._exchange_clients: List = []
         self.started_at = time.monotonic()
         self.ended_at: Optional[float] = None
         self._session_factory = session_factory
@@ -291,6 +300,12 @@ class SqlTask:
         part_bytes = (self.output.partition_enqueued_bytes
                       if isinstance(self.output, PartitionedOutputBuffer)
                       else None)
+        # flow-ledger per-task seconds: exchange/spool pull wall and
+        # backpressure stalls (producer full-waits + consumer empty
+        # polls) — the straggler detector's cause inputs
+        transfer_s = sum(c.pulled_seconds for c in self._exchange_clients)
+        stall_s = (self.output.stalled_seconds
+                   + sum(c.stalled_seconds for c in self._exchange_clients))
         with self._stats_lock:
             ops = [self.operator_stats[k].to_dict()
                    for k in sorted(self.operator_stats)]
@@ -298,6 +313,8 @@ class SqlTask:
             snap = {
                 "elapsedS": round(elapsed, 6),
                 "deviceS": round(self.device_seconds, 6),
+                "transferS": round(transfer_s, 6),
+                "stallS": round(stall_s, 6),
                 "completedSplits": self.splits_completed,
                 "totalSplits": self.total_splits,
                 "inputRows": self.input_rows,
@@ -413,7 +430,11 @@ class SqlTask:
         for fid, locations in req.upstream.items():
             from trino_tpu.server.exchange_client import ExchangeClient, TaskLocation
 
-            client = ExchangeClient([TaskLocation(u, t, b) for u, t, b in locations])
+            client = ExchangeClient(
+                [TaskLocation(u, t, b) for u, t, b in locations],
+                owner=f"task:{req.task_id}",
+                stall_key=(self.stage_id, None))
+            self._exchange_clients.append(client)
             client.start()
             remote_pages[fid] = client.pages()
         ex = FragmentExecutor(session, req.splits, remote_pages)
@@ -724,7 +745,10 @@ class SqlTask:
             return False
         from trino_tpu.server.exchange_client import ExchangeClient, TaskLocation
 
-        client = ExchangeClient([TaskLocation(u, t, b) for u, t, b in locations])
+        client = ExchangeClient(
+            [TaskLocation(u, t, b) for u, t, b in locations],
+            owner=f"task:{req.task_id}", stall_key=(self.stage_id, None))
+        self._exchange_clients.append(client)
         client.start()
         # device_clock accumulates ONLY the executor calls: the stream loop
         # also waits on upstream pulls and output backpressure, and that
@@ -914,6 +938,19 @@ class SqlTask:
             # status-polling loop (reference: TaskStatus carrying TaskStats)
             "stats": self.stats_snapshot(),
         }
+
+
+def _task_stage_id(task_id: str):
+    """The fragment (stage) id embedded in a coordinator task id
+    ({query}.{fragment}.{worker}.a{attempt}); None for free-form ids
+    (direct task POSTs in tests)."""
+    parts = task_id.split(".")
+    if len(parts) >= 4 and parts[-1].startswith("a"):
+        try:
+            return int(parts[-3])
+        except ValueError:
+            return None
+    return None
 
 
 def _chunk_pages(page: Page, chunk_rows: int):

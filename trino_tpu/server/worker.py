@@ -88,6 +88,14 @@ class WorkerServer:
         if not DEVICE_PROFILER.node_id:
             DEVICE_PROFILER.node_id = self.node_id
         DEVICE_PROFILER.attach_recorder(self.recorder)
+        # the process flow ledger (obs/flowledger.py): same
+        # first-server-wins identity stamp; retried transfers mirror into
+        # the flight recorder so postmortems show flaky links
+        from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+        if not FLOW_LEDGER.node_id:
+            FLOW_LEDGER.node_id = self.node_id
+        FLOW_LEDGER.attach_recorder(self.recorder)
         # OTLP export, on only when TRINO_TPU_OTLP_ENDPOINT is set: each
         # completed task ships its span dump under the query's PROPAGATED
         # trace id, so worker spans parent into the coordinator's trace
@@ -216,6 +224,15 @@ class WorkerServer:
 
                 util_sample = DEVICE_PROFILER.sample_utilization()
                 compile_events = DEVICE_PROFILER.compile_rows(limit=64)
+                # flow-ledger ride-alongs (obs/flowledger.py): per-link
+                # rollups + stall timelines (system.runtime.transfers'
+                # per-node source) and the NIC-level byte totals the
+                # nodes table surfaces as net_bytes_sent/received
+                from trino_tpu.obs.flowledger import FLOW_LEDGER
+
+                flow_rows = FLOW_LEDGER.transfer_rows()
+                flow_stalls = FLOW_LEDGER.stall_rows()
+                net = FLOW_LEDGER.net_totals()
                 wire.json_request(
                     "PUT",
                     f"{self.coordinator_url}/v1/announce/{self.node_id}",
@@ -247,6 +264,15 @@ class WorkerServer:
                      # (system.runtime.compiles' per-node source)
                      "profiler": util_sample,
                      "compileEvents": compile_events,
+                     # flow-ledger ride-alongs: per-link transfer rollups
+                     # + backpressure stall rollups (the cluster-wide
+                     # sources of system.runtime.transfers and the
+                     # /flows surface) and NIC byte totals for the
+                     # nodes table
+                     "flows": flow_rows,
+                     "flowStalls": flow_stalls,
+                     "netBytesSent": net["sent"],
+                     "netBytesReceived": net["received"],
                      "rssBytes": rss,
                      # surfaced by system.runtime.nodes (reference: the
                      # node version in NodeSystemTable rows)
@@ -429,6 +455,7 @@ def _make_handler(server: WorkerServer):
                 # wants the context AROUND the failure (what else ran,
                 # which spans closed last) — and it still answers after
                 # the task itself was pruned from the manager
+                from trino_tpu.obs.flowledger import FLOW_LEDGER
                 from trino_tpu.obs.memledger import MEMORY_LEDGER
 
                 self._send(200, json.dumps({
@@ -439,6 +466,10 @@ def _make_handler(server: WorkerServer):
                     # merged memory snapshot for OOM postmortems: pool
                     # watermarks + top consumers + recent sheds
                     "memory": MEMORY_LEDGER.memory_snapshot(),
+                    # data-plane snapshot: per-link rollups + last
+                    # transfers + stall timeline, so a FAILED postmortem
+                    # shows what was moving when the query died
+                    "flows": FLOW_LEDGER.flow_snapshot(),
                 }).encode())
                 return
             if self.path == "/v1/metrics":
